@@ -1,0 +1,51 @@
+"""mxnet_tpu — a TPU-native deep learning framework.
+
+A from-scratch rebuild of Apache MXNet's capabilities (NDArray + autograd +
+Gluon + KVStore + data pipeline) designed for TPU hardware: XLA compiles and
+fuses every op, ``jax.jit`` backs ``hybridize()``, ``jax.sharding`` meshes +
+collectives back the KVStore, and Pallas supplies hand-tuned kernels where
+XLA's defaults are not enough.
+
+Import convention matches the reference: ``import mxnet_tpu as mx``.
+"""
+__version__ = "2.0.0a1"
+
+from . import base
+from .base import MXNetError
+from .context import (Context, cpu, tpu, gpu, cpu_pinned, current_context,
+                      num_tpus, num_gpus, device)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import random
+from . import autograd
+from . import util
+from .util import is_np_array, set_np, reset_np, use_np
+
+# Subsystems are imported as they land in the build plan (SURVEY §7); each
+# line below is enabled once the module exists and its tests pass.
+_OPTIONAL_MODULES = [
+    ("initializer", None), ("init", None), ("optimizer", None),
+    ("lr_scheduler", None), ("kvstore", None), ("gluon", None),
+    ("metric", None), ("profiler", None), ("numpy", "np"),
+    ("numpy_extension", "npx"), ("symbol", None), ("symbol", "sym"),
+    ("image", None), ("io", None), ("runtime", None), ("parallel", None),
+    ("test_utils", None), ("amp", None), ("recordio", None),
+]
+import importlib as _importlib
+
+for _mod, _alias in _OPTIONAL_MODULES:
+    try:
+        _m = _importlib.import_module(f".{_mod}", __name__)
+        globals()[_alias or _mod] = _m
+    except ImportError:
+        pass
+
+try:
+    from .kvstore import KVStore  # noqa: F401
+except ImportError:
+    pass
+
+
+def tpu_context_available() -> bool:
+    return num_tpus() > 0

@@ -1,0 +1,152 @@
+"""Public autograd API: ``record``/``pause``/``backward``/``grad``/``Function``.
+
+Reference analog: python/mxnet/autograd.py (record :121, pause :145,
+backward :245, grad :272, Function :369) over the C++ tape in
+src/imperative/imperative.cc. The tape engine itself lives in _tape.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from . import _tape
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "get_symbol", "Function"]
+
+is_recording = _tape.is_recording
+is_training = _tape.is_training
+set_recording = _tape.set_recording
+set_training = _tape.set_training
+mark_variables = _tape.mark_variables
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev_record is not None and self._prev_record != self._enter_record:
+            set_recording(self._prev_record)
+        if self._prev_train is not None and self._prev_train != self._enter_train:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    """Scope where ops are recorded to the tape (reference autograd.py:121)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """Scope where recording is suspended (reference autograd.py:145)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    return _tape.backward(list(heads), head_grads, retain_graph=retain_graph,
+                          train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference autograd.py:272).
+    create_graph=True records the backward pass for higher-order grads."""
+    from .ndarray.ndarray import NDArray
+    single = not isinstance(variables, (list, tuple))
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    var_list = [variables] if single else list(variables)
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    raw = _tape.grad(list(heads), var_list, head_grads, retain_graph,
+                     create_graph, train_mode)
+    out = [g if isinstance(g, NDArray) else NDArray(g) for g in raw]
+    return out[0] if single else out
+
+
+def get_symbol(x):
+    """Reference autograd.get_symbol: symbolic view of a recorded array."""
+    from .symbol.symbol import Symbol
+    ent = getattr(x, "_tape_entry", None)
+    if ent is None:
+        raise MXNetError("array is not part of a recorded computation graph")
+    return Symbol._from_tape(x)
+
+
+class Function:
+    """Custom differentiable function (reference autograd.py:369).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)``; both operate on NDArrays imperatively.
+    """
+
+    class _Registry:
+        pass
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self.saved_tensors = arrays
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        from ._tape import TapeNode, is_recording
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            import jax.numpy as jnp
+            import jax
+
+            func = self
+
+            class _CustomNode(TapeNode):
+                pass
+
+            avals = [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+                     for o in outs]
+
+            def vjp_fn(cts):
+                cts = cts if isinstance(cts, tuple) else (cts,)
+                with pause():
+                    gin = func.backward(*[NDArray(c) for c in cts])
+                gin = gin if isinstance(gin, (list, tuple)) else (gin,)
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in gin)
+
+            node = TapeNode(type(self).__name__, list(inputs), None, vjp_fn,
+                            avals)
+            # create_graph path not supported for custom Functions (fn=None);
+            # matches reference behavior (Function has no higher-order grad).
+            for i, o in enumerate(outs):
+                o._tape_entry = (node, i)
+        return outs[0] if single else tuple(outs)
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

@@ -1,0 +1,103 @@
+"""Base utilities: dtype registry, errors, env-var config.
+
+TPU-native rebuild of the reference's dmlc base layer. Where the reference
+reads ~103 ``MXNET_*`` environment variables through ``dmlc::GetEnv`` at use
+sites (reference: docs/static_site/src/pages/api/faq/env_var.md), we keep the
+same two-tier config model: environment variables + dataclass-reflected
+module/op parameters.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "np_dtype",
+    "jx_dtype",
+    "dtype_name",
+    "DTYPE_NAMES",
+]
+
+
+class MXNetError(RuntimeError):
+    """Default error type for the framework (reference: include/mxnet/base.h)."""
+
+
+def get_env(name: str, default: Any = None, dtype: type = str) -> Any:
+    """Read an ``MXNET_*`` style env var with a typed default.
+
+    Mirrors ``dmlc::GetEnv`` usage across the reference runtime
+    (e.g. engine selection at src/engine/engine.cc:33).
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is bool:
+        return val.lower() not in ("0", "false", "off", "")
+    return dtype(val)
+
+
+# Canonical dtype table. The reference enumerates dtypes as integer type flags
+# (mshadow base.h kFloat32=0, ...); we key by name and map to numpy/jax dtypes.
+_DTYPES = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "int16": jnp.int16,
+}
+
+DTYPE_NAMES = tuple(_DTYPES)
+
+# Integer type flags for serialization compatibility with the reference's
+# NDArray binary format (mshadow/base.h TypeFlag order).
+DTYPE_FLAG = {
+    "float32": 0, "float64": 1, "float16": 2, "uint8": 3, "int32": 4,
+    "int8": 5, "int64": 6, "bool": 7, "int16": 8, "uint16": 9,
+    "uint32": 10, "uint64": 11, "bfloat16": 12,
+}
+FLAG_DTYPE = {v: k for k, v in DTYPE_FLAG.items()}
+
+
+def np_dtype(dtype) -> onp.dtype:
+    """Normalize any dtype spec to a numpy dtype (bfloat16 stays jax-side)."""
+    if dtype is None:
+        return onp.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.bfloat16
+        return onp.dtype(dtype)
+    return onp.dtype(dtype) if dtype is not jnp.bfloat16 else jnp.bfloat16
+
+
+def jx_dtype(dtype):
+    """Normalize a dtype spec to a jax-compatible dtype object."""
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        try:
+            return _DTYPES[dtype]
+        except KeyError as e:
+            raise MXNetError(f"unknown dtype {dtype!r}") from e
+    return dtype
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name of a dtype."""
+    if isinstance(dtype, str):
+        return dtype
+    return jnp.dtype(dtype).name

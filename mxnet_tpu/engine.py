@@ -1,0 +1,99 @@
+"""Execution engine facade.
+
+The reference schedules every op through a dependency engine with versioned
+variables (reference: include/mxnet/engine.h:117-318, src/engine/threaded_engine.h).
+On TPU, XLA/PjRt dispatch is already asynchronous and ordered per-buffer, so
+the engine's dependency tracking is absorbed by the runtime. What survives is
+the *semantic* surface the reference exposes and tests
+(tests/python/unittest/test_engine.py):
+
+- engine selection (``MXNET_ENGINE_TYPE``): ``ThreadedEnginePerDevice`` (the
+  async default — ops return immediately, results materialize later) vs
+  ``NaiveEngine`` (synchronous oracle — every op blocks until complete; the
+  race-free debugging mode, reference src/engine/naive_engine.cc:51).
+- ``wait_for_all`` / per-array ``wait_to_read`` sync points where async
+  exceptions surface (reference src/engine/threaded_engine.cc:422-436).
+- op bulking knobs (``set_bulk_size``) — a no-op here because XLA fuses
+  compiled programs; kept for API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from .base import get_env
+
+__all__ = ["Engine", "get", "set_bulk_size", "bulk"]
+
+
+class Engine:
+    """Process-global engine facade (reference Engine::Get singleton)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._bulk_size = 0
+
+    @property
+    def is_naive(self) -> bool:
+        return self.kind == "NaiveEngine"
+
+    def maybe_sync(self, arrays):
+        """NaiveEngine blocks after every op — the synchronous oracle mode."""
+        if self.is_naive:
+            for a in arrays:
+                jax.block_until_ready(a)
+
+    def wait_for_all(self):
+        """Block until all pending async work completes; raises deferred
+        errors (reference Engine::WaitForAll)."""
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        # Sync all live devices; PjRt surfaces async errors here.
+        for d in jax.devices():
+            try:
+                d.synchronize_all_activity()  # pjrt device sync if available
+            except AttributeError:
+                break
+
+    def set_bulk_size(self, size: int) -> int:
+        """Reference ThreadedEngine::set_bulk_size (threaded_engine.h:414).
+        XLA fusion makes bulking implicit; we retain the knob."""
+        old, self._bulk_size = self._bulk_size, int(size)
+        return old
+
+    @property
+    def bulk_size(self) -> int:
+        return self._bulk_size
+
+
+def get() -> Engine:
+    if Engine._instance is None:
+        with Engine._lock:
+            if Engine._instance is None:
+                kind = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+                if kind not in ("NaiveEngine", "ThreadedEngine",
+                                "ThreadedEnginePerDevice", "ThreadedEnginePooled"):
+                    kind = "ThreadedEnginePerDevice"
+                Engine._instance = Engine(kind)
+    return Engine._instance
+
+
+def set_bulk_size(size: int) -> int:
+    return get().set_bulk_size(size)
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """Reference ``mx.engine.bulk`` context manager (python/mxnet/engine.py)."""
+    old = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(old)

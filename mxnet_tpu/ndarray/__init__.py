@@ -1,0 +1,12 @@
+"""``mx.nd`` namespace: NDArray + legacy op surface.
+
+Reference analog: python/mxnet/ndarray/ (generated op wrappers + NDArray
+class). Ops here are hand-defined pure-JAX functions rather than codegen from
+a C++ registry.
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      linspace, eye, concatenate, waitall, from_jax, moveaxis)
+from .ops import *  # noqa: F401,F403
+from . import ops as op
+from . import random
+from .utils import save, load

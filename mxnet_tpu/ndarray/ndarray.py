@@ -1,0 +1,738 @@
+"""NDArray: the imperative tensor handle, backed by XLA device buffers.
+
+TPU-native re-design of the reference NDArray (reference:
+include/mxnet/ndarray.h:82, src/ndarray/ndarray.cc). The reference pairs a
+Storage chunk with an engine variable for dependency ordering; here the
+backing store is a ``jax.Array`` (PjRt buffer) whose runtime is already
+async + ordered, so the handle keeps only:
+
+- ``_data``       the current jax.Array (functional; in-place ops rebind it)
+- ``_ctx``        logical Context (mx.cpu()/mx.tpu(i))
+- autograd state  ``_grad``/``_grad_req``/``_tape_entry`` (reference AGInfo)
+
+Mutation semantics: XLA buffers are immutable, so every in-place op
+(``+=``, ``[...] = v``) rewrites ``_data`` with a functionally-updated array
+— the "version-tracking aliasing layer" of SURVEY §7. Basic indexing returns
+copies (deviation from the reference's first-axis views; write-through is
+preserved because ``x[i:j] += v`` routes through ``__setitem__``).
+
+NDArray is registered as a JAX pytree node, so handles flow through
+``jax.jit`` / ``pjit`` / ``shard_map`` transparently — this is what makes
+``HybridBlock.hybridize()`` a plain jit trace.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .. import _tape, engine
+from ..base import MXNetError, jx_dtype, dtype_name
+from ..context import Context, current_context
+from ..ops.registry import invoke_raw
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "eye", "concatenate", "waitall", "from_jax", "moveaxis"]
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    """Multi-dimensional array with imperative mutation + autograd hooks."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_entry",
+                 "_fresh_grad", "__weakref__")
+
+    # make NDArray win against numpy in mixed binary expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array) and not _is_tracer(data):
+            keep_dtype = isinstance(data, (onp.ndarray, onp.generic))
+            data = onp.asarray(data, dtype=None if dtype is None else jx_dtype(dtype))
+            if dtype is None:
+                if data.dtype == onp.float64:
+                    data = data.astype(onp.float32)  # MXNet default_dtype=float32
+                elif not keep_dtype and data.dtype != onp.bool_:
+                    # python lists/scalars default to float32 like mx.nd.array
+                    data = data.astype(onp.float32)
+            data = _put(data, ctx)
+        else:
+            if dtype is not None and data.dtype != jx_dtype(dtype):
+                data = data.astype(jx_dtype(dtype))
+            if ctx is not None and not _is_tracer(data):
+                data = _put(data, ctx)  # honor explicit placement request
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_entry = None
+        self._fresh_grad = False
+
+    def _init_empty(self):
+        """Used by invoke_raw to allocate output handles before record_op."""
+        self._data = None
+        self._ctx = None
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_entry = None
+        self._fresh_grad = False
+
+    # ---------------- properties ----------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(str(self._data.dtype)) if str(self._data.dtype) != "bfloat16" \
+            else jnp.bfloat16
+
+    @property
+    def size(self) -> int:
+        return int(onp.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        if _is_tracer(self._data):
+            return current_context()
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return current_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", _accel_index(dev))
+
+    ctx = context
+    device = context
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def fresh_grad(self) -> bool:
+        return self._fresh_grad
+
+    @fresh_grad.setter
+    def fresh_grad(self, v: bool):
+        self._fresh_grad = v
+
+    # ---------------- materialization ----------------
+    def asnumpy(self) -> onp.ndarray:
+        self.wait_to_read()
+        a = onp.asarray(self._data)
+        return a
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.item()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.item())
+        raise MXNetError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        if self._data is None:
+            return "<NDArray (uninitialized)>"
+        if _is_tracer(self._data):
+            return f"<NDArray {self.shape} {dtype_name(self._data.dtype)} (traced)>"
+        return f"{onp.asarray(self._data)}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # ---------------- sync (engine semantics) ----------------
+    def wait_to_read(self):
+        """Block until the value is ready; async errors surface here
+        (reference NDArray::WaitToRead, engine exception rethrow)."""
+        if not _is_tracer(self._data):
+            jax.block_until_ready(self._data)
+
+    wait_to_write = wait_to_read
+
+    # ---------------- device / dtype movement ----------------
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+    as_ctx = as_in_context
+
+    def to_device(self, ctx):
+        return self.as_in_context(ctx)
+
+    def copyto(self, other: Union[Context, "NDArray"]) -> "NDArray":
+        if isinstance(other, NDArray):
+            other._data = _put(self._data, other.context)
+            return other
+        return NDArray(_put(self._data, other), ctx=other)
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data)
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        dt = jx_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return invoke_raw("cast", lambda x, _dt=dt: x.astype(_dt), [self])
+
+    def tostype(self, stype: str) -> "NDArray":
+        if stype == "default":
+            return self
+        from . import sparse
+        return sparse.cast_storage(self, stype)
+
+    def as_np_ndarray(self):
+        from ..numpy import ndarray as np_ndarray
+        out = np_ndarray.__new__(np_ndarray)
+        out._init_empty()
+        out._data = self._data
+        out._ctx = self._ctx
+        out._grad = self._grad
+        out._tape_entry = self._tape_entry
+        return out
+
+    def as_nd_ndarray(self):
+        return self
+
+    # ---------------- autograd ----------------
+    def attach_grad(self, grad_req: str = "write", stype: Optional[str] = None):
+        """Allocate gradient buffer and mark as autograd leaf
+        (reference python/mxnet/ndarray/ndarray.py attach_grad)."""
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+        self._tape_entry = None
+
+    def drop_grad(self):
+        self._grad = None
+        self._grad_req = "null"
+
+    def backward(self, out_grad=None, retain_graph: bool = False,
+                 train_mode: bool = True):
+        _tape.backward([self], [out_grad], retain_graph=retain_graph,
+                       train_mode=train_mode)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data)
+        return out
+
+    # ---------------- shape manipulation ----------------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None:
+            shape = tuple(kwargs["shape"])
+        new_shape = _infer_reshape(self.shape, shape, kwargs.get("reverse", False))
+        return invoke_raw("reshape", lambda x, _s=new_shape: x.reshape(_s), [self])
+
+    def reshape_like(self, other: "NDArray") -> "NDArray":
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return invoke_raw("transpose", lambda x, _a=ax: jnp.transpose(x, _a), [self])
+
+    def swapaxes(self, a1: int, a2: int) -> "NDArray":
+        return invoke_raw("swapaxes", lambda x: jnp.swapaxes(x, a1, a2), [self])
+
+    def flatten(self) -> "NDArray":
+        # MXNet Flatten: collapse all but first axis (2D result)
+        n = self.shape[0] if self.ndim else 1
+        return self.reshape(n, -1)
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return invoke_raw("squeeze", lambda x: jnp.squeeze(x, axis), [self])
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return invoke_raw("expand_dims", lambda x: jnp.expand_dims(x, axis), [self])
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return invoke_raw("broadcast_to",
+                          lambda x, _s=tuple(shape): jnp.broadcast_to(x, _s), [self])
+
+    def broadcast_like(self, other: "NDArray") -> "NDArray":
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps) -> "NDArray":
+        return invoke_raw("tile", lambda x: jnp.tile(x, reps), [self])
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return invoke_raw("repeat", lambda x: jnp.repeat(x, repeats, axis), [self])
+
+    def flip(self, axis) -> "NDArray":
+        return invoke_raw("flip", lambda x: jnp.flip(x, axis), [self])
+
+    def diag(self, k: int = 0) -> "NDArray":
+        return invoke_raw("diag", lambda x: jnp.diag(x, k), [self])
+
+    def pad(self, pad_width, mode="constant", constant_value=0.0) -> "NDArray":
+        return invoke_raw(
+            "pad", lambda x: jnp.pad(x, pad_width, mode=mode,
+                                     constant_values=constant_value)
+            if mode == "constant" else jnp.pad(x, pad_width, mode=mode), [self])
+
+    # ---------------- reductions / linalg (method forms) ----------------
+    def _reduce(self, name, jfn, axis=None, keepdims=False):
+        ax = _norm_axis(axis)
+        return invoke_raw(name, lambda x: jfn(x, axis=ax, keepdims=keepdims), [self])
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", jnp.sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", jnp.mean, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", jnp.min, axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", jnp.prod, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False):
+        return self._reduce("std", jnp.std, axis, keepdims)
+
+    def var(self, axis=None, keepdims=False):
+        return self._reduce("var", jnp.var, axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        ax = _norm_axis(axis)
+        if ord == 2:
+            fn = lambda x: jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+        elif ord == 1:
+            fn = lambda x: jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+        else:
+            raise MXNetError(f"norm ord={ord} unsupported")
+        return invoke_raw("norm", fn, [self])
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke_raw("argmax", lambda x: jnp.argmax(x, axis=axis,
+                          keepdims=keepdims).astype(jnp.float32), [self])
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke_raw("argmin", lambda x: jnp.argmin(x, axis=axis,
+                          keepdims=keepdims).astype(jnp.float32), [self])
+
+    def dot(self, other: "NDArray") -> "NDArray":
+        from . import ops as _nd_ops
+        return _nd_ops.dot(self, other)
+
+    def clip(self, a_min=None, a_max=None) -> "NDArray":
+        return invoke_raw("clip", lambda x: jnp.clip(x, a_min, a_max), [self])
+
+    def abs(self):
+        return invoke_raw("abs", jnp.abs, [self])
+
+    def sign(self):
+        return invoke_raw("sign", jnp.sign, [self])
+
+    def sqrt(self):
+        return invoke_raw("sqrt", jnp.sqrt, [self])
+
+    def square(self):
+        return invoke_raw("square", jnp.square, [self])
+
+    def exp(self):
+        return invoke_raw("exp", jnp.exp, [self])
+
+    def log(self):
+        return invoke_raw("log", jnp.log, [self])
+
+    def sigmoid(self):
+        return invoke_raw("sigmoid", jax.nn.sigmoid, [self])
+
+    def tanh(self):
+        return invoke_raw("tanh", jnp.tanh, [self])
+
+    def relu(self):
+        return invoke_raw("relu", jax.nn.relu, [self])
+
+    def softmax(self, axis=-1):
+        return invoke_raw("softmax", lambda x: jax.nn.softmax(x, axis=axis), [self])
+
+    def log_softmax(self, axis=-1):
+        return invoke_raw("log_softmax",
+                          lambda x: jax.nn.log_softmax(x, axis=axis), [self])
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        dt = jx_dtype(dtype)
+        return invoke_raw(
+            "one_hot",
+            lambda x: jnp.where(
+                jax.nn.one_hot(x.astype(jnp.int32), depth, dtype=jnp.bool_),
+                jnp.asarray(on_value, dt), jnp.asarray(off_value, dt)), [self])
+
+    def round(self):
+        return invoke_raw("round", jnp.round, [self])
+
+    def floor(self):
+        return invoke_raw("floor", jnp.floor, [self])
+
+    def ceil(self):
+        return invoke_raw("ceil", jnp.ceil, [self])
+
+    def slice_axis(self, axis, begin, end):
+        from . import ops as _nd_ops
+        return _nd_ops.slice_axis(self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from . import ops as _nd_ops
+        return _nd_ops.take(self, indices, axis=axis, mode=mode)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        from . import ops as _nd_ops
+        return _nd_ops.topk(self, axis=axis, k=k, ret_typ=ret_typ,
+                            is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        from . import ops as _nd_ops
+        return _nd_ops.sort(self, axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        from . import ops as _nd_ops
+        return _nd_ops.argsort(self, axis=axis, is_ascend=is_ascend)
+
+    # ---------------- arithmetic ----------------
+    def _binary(self, name, other, jfn, reverse=False):
+        if isinstance(other, NDArray):
+            if reverse:
+                return invoke_raw(name, lambda a, b: jfn(b, a), [self, other])
+            return invoke_raw(name, jfn, [self, other])
+        if isinstance(other, (numbers.Number, onp.number)):
+            if reverse:
+                return invoke_raw(name + "_scalar",
+                                  lambda a, _s=other: jfn(_s, a), [self])
+            return invoke_raw(name + "_scalar",
+                              lambda a, _s=other: jfn(a, _s), [self])
+        if isinstance(other, (onp.ndarray, list, tuple)):
+            return self._binary(name, NDArray(other), jfn, reverse)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary("add", o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary("sub", o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._binary("sub", o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary("mul", o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary("div", o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        return self._binary("div", o, jnp.divide, reverse=True)
+
+    def __mod__(self, o):
+        return self._binary("mod", o, jnp.mod)
+
+    def __rmod__(self, o):
+        return self._binary("mod", o, jnp.mod, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary("pow", o, jnp.power)
+
+    def __rpow__(self, o):
+        return self._binary("pow", o, jnp.power, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binary("floordiv", o, jnp.floor_divide)
+
+    def __matmul__(self, o):
+        return self.dot(o)
+
+    def __neg__(self):
+        return invoke_raw("negative", jnp.negative, [self])
+
+    def __abs__(self):
+        return self.abs()
+
+    # in-place: rebind _data (functional update; see module docstring)
+    def _inplace(self, name, other, jfn):
+        out = self._binary(name, other, jfn)
+        self._data = out._data
+        self._tape_entry = out._tape_entry
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace("add", o, jnp.add)
+
+    def __isub__(self, o):
+        return self._inplace("sub", o, jnp.subtract)
+
+    def __imul__(self, o):
+        return self._inplace("mul", o, jnp.multiply)
+
+    def __itruediv__(self, o):
+        return self._inplace("div", o, jnp.divide)
+
+    # comparisons: legacy nd returns 0/1 in the operand dtype (reference
+    # broadcast_equal etc.), except same-dtype bools pass through
+    def _compare(self, name, other, jfn):
+        dt = self._data.dtype
+        if isinstance(other, NDArray):
+            return invoke_raw(name, lambda a, b: jfn(a, b).astype(dt),
+                              [self, other], record=False)
+        return invoke_raw(name + "_scalar",
+                          lambda a, _s=other: jfn(a, _s).astype(dt),
+                          [self], record=False)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._compare("equal", o, jnp.equal)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._compare("not_equal", o, jnp.not_equal)
+
+    def __gt__(self, o):
+        return self._compare("greater", o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._compare("greater_equal", o, jnp.greater_equal)
+
+    def __lt__(self, o):
+        return self._compare("lesser", o, jnp.less)
+
+    def __le__(self, o):
+        return self._compare("lesser_equal", o, jnp.less_equal)
+
+    __hash__ = object.__hash__
+
+    # ---------------- indexing ----------------
+    def __getitem__(self, key):
+        key = _norm_key(key)
+        return invoke_raw("slice", lambda x, _k=key: x[_k], [self])
+
+    def __setitem__(self, key, value):
+        key = _norm_key(key)
+        # Route through invoke_raw so autograd records the functional
+        # scatter-update (stale-tape-entry writes would corrupt gradients).
+        if isinstance(value, NDArray):
+            out = invoke_raw("set_item",
+                             lambda x, v, _k=key: x.at[_k].set(v),
+                             [self, value])
+        else:
+            out = invoke_raw("set_item_scalar",
+                             lambda x, _k=key, _v=value: x.at[_k].set(_v),
+                             [self])
+        self._data = out._data
+        self._tape_entry = out._tape_entry
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _accel_index(dev) -> int:
+    accels = [d for d in jax.devices() if d.platform != "cpu"]
+    for i, d in enumerate(accels):
+        if d == dev:
+            return i
+    return 0
+
+
+def _put(data, ctx: Optional[Context]):
+    """Place host data on the right device (reference CopyFromTo analog).
+    Invalid devices raise (MXNetError), like the reference's ctx checks."""
+    if ctx is None:
+        ctx = current_context()
+    dev = ctx.jax_device  # raises MXNetError for out-of-range device ids
+    try:
+        return jax.device_put(data, dev)
+    except (TypeError, ValueError):
+        # tracers / weak types can't be device_put mid-trace; leave to XLA
+        return jnp.asarray(data)
+
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def _norm_key(key):
+    """Convert NDArray indices inside keys to jax arrays."""
+    if isinstance(key, NDArray):
+        k = key._data
+        return k.astype(jnp.int32) if k.dtype not in (jnp.int32, jnp.int64, jnp.bool_) else k
+    if isinstance(key, tuple):
+        return tuple(_norm_key(k) for k in key)
+    return key
+
+
+def _infer_reshape(old: Tuple[int, ...], spec, reverse=False) -> Tuple[int, ...]:
+    """MXNet reshape special codes (reference src/operator/tensor/matrix_op-inl.h
+    ReshapeParam): 0 copy dim, -1 infer, -2 copy rest, -3 merge two, -4 split."""
+    if reverse:
+        old = old[::-1]
+        spec = tuple(spec)[::-1]
+    out = []
+    i = 0  # index into old
+    spec = list(spec)
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(old[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(old[i:]); i = len(old)
+        elif s == -3:
+            out.append(old[i] * old[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            cur = old[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(int(s))
+            if i < len(old):
+                i += 1
+        j += 1
+    if out.count(-1) > 1:
+        raise MXNetError("can only specify one unknown dimension")
+    if -1 in out:
+        known = int(onp.prod([d for d in out if d != -1])) or 1
+        total = int(onp.prod(old)) if old else 1
+        out[out.index(-1)] = total // known
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+# ---- pytree registration: NDArray flows through jit/pjit/vmap/shard_map ----
+def _flatten(x: NDArray):
+    return (x._data,), None
+
+
+def _unflatten(aux, children):
+    out = NDArray.__new__(NDArray)
+    out._init_empty()
+    out._data = children[0]
+    return out
+
+
+jax.tree_util.register_pytree_node(NDArray, _flatten, _unflatten)
+
+
+# ---------------- creation functions ----------------
+def array(source, ctx=None, dtype=None) -> NDArray:
+    return NDArray(source, ctx=ctx, dtype=dtype)
+
+
+def from_jax(x, ctx=None) -> NDArray:
+    return NDArray(x, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(_put(jnp.zeros(shape, jx_dtype(dtype)), ctx), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(_put(jnp.ones(shape, jx_dtype(dtype)), ctx), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(_put(jnp.full(shape, val, jx_dtype(dtype)), ctx), ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    a = jnp.arange(start, stop, step, jx_dtype(dtype))
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(_put(a, ctx), ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None) -> NDArray:
+    return NDArray(_put(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                     dtype=jx_dtype(dtype)), ctx), ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    return NDArray(_put(jnp.eye(N, M if M else None, k, jx_dtype(dtype)), ctx),
+                   ctx=ctx)
+
+
+def concatenate(arrays, axis=0) -> NDArray:
+    return invoke_raw("concat", lambda *xs: jnp.concatenate(xs, axis=axis),
+                      list(arrays))
+
+
+def moveaxis(a: NDArray, source, destination) -> NDArray:
+    return invoke_raw("moveaxis", lambda x: jnp.moveaxis(x, source, destination), [a])
+
+
+def waitall():
+    """Reference mx.nd.waitall — block until all async compute completes."""
+    engine.get().wait_for_all()

@@ -1,0 +1,191 @@
+"""Random sampling ops.
+
+Reference analog: src/operator/random/ (sampler kernels backed by per-device
+PRNG states via Resource kRandom, reference include/mxnet/resource.h:39). On
+TPU the idiomatic design is counter-based stateless PRNG: a process-global
+``jax.random`` key chain (split per op) gives reproducibility under
+``mx.random.seed`` while every sample op stays a pure XLA kernel.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import jx_dtype
+from ..ops.registry import invoke_raw
+from .ndarray import NDArray, _put
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randn", "randint",
+           "exponential", "gamma", "poisson", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle",
+           "bernoulli", "laplace"]
+
+_state = threading.local()
+_GLOBAL_SEED = [0]
+
+
+def _key_state():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_GLOBAL_SEED[0])
+    return _state.key
+
+
+def seed(seed_state, ctx="all"):
+    """Reference mx.random.seed (python/mxnet/random.py)."""
+    _GLOBAL_SEED[0] = int(seed_state)
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    onp.random.seed(int(seed_state) & 0x7FFFFFFF)
+
+
+def next_key():
+    k = _key_state()
+    k, sub = jax.random.split(k)
+    _state.key = k
+    return sub
+
+
+def _maybe_out(res, out):
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _sample(name, fn, shape, dtype, ctx):
+    key = next_key()
+    out = fn(key, _shape(shape), jx_dtype(dtype or "float32"))
+    return NDArray(_put(out, ctx), ctx=ctx)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    res = _sample("uniform",
+                  lambda k, s, d: jax.random.uniform(k, s, d, low, high),
+                  shape, dtype, ctx)
+    return _maybe_out(res, out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    res = _sample("normal",
+                  lambda k, s, d: loc + scale * jax.random.normal(k, s, d),
+                  shape, dtype, ctx)
+    return _maybe_out(res, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def randint(low, high=None, shape=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    key = next_key()
+    out_arr = jax.random.randint(key, _shape(shape), low, high,
+                                 jx_dtype(dtype or "int32"))
+    return _maybe_out(NDArray(_put(out_arr, ctx), ctx=ctx), out)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    res = _sample("exponential",
+                  lambda k, s, d: scale * jax.random.exponential(k, s, d),
+                  shape, dtype, ctx)
+    return _maybe_out(res, out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None):
+    res = _sample("gamma",
+                  lambda k, s, d: beta * jax.random.gamma(k, alpha, s, d),
+                  shape, dtype, ctx)
+    return _maybe_out(res, out)
+
+
+def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    res = _sample("laplace",
+                  lambda k, s, d: loc + scale * jax.random.laplace(k, s, d),
+                  shape, dtype, ctx)
+    return _maybe_out(res, out)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None):
+    key = next_key()
+    out_arr = jax.random.poisson(key, lam, _shape(shape)).astype(
+        jx_dtype(dtype or "float32"))
+    return _maybe_out(NDArray(_put(out_arr, ctx), ctx=ctx), out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, out=None):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    key = next_key()
+    g = jax.random.gamma(key, k, _shape(shape)) * (1.0 - p) / p
+    out_arr = jax.random.poisson(next_key(), g).astype(jx_dtype(dtype or "float32"))
+    return _maybe_out(NDArray(_put(out_arr, ctx), ctx=ctx), out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, out=None):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return negative_binomial(k=k, p=p, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, out=None):
+    key = next_key()
+    out_arr = jax.random.bernoulli(key, prob, _shape(shape)).astype(
+        jx_dtype(dtype or "float32"))
+    return _maybe_out(NDArray(_put(out_arr, ctx), ctx=ctx), out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    """Sample category indices from (batched) probability rows; with
+    get_prob=True also return log-likelihoods of the samples for
+    REINFORCE-style use (reference sample_multinomial semantics)."""
+    data = data if isinstance(data, NDArray) else NDArray(data)
+    key = next_key()
+    n = 1 if shape is None else int(onp.prod(_shape(shape)))
+
+    def fn(p, _key=key):
+        logits = jnp.log(jnp.maximum(p, 1e-37))
+        if p.ndim == 1:
+            out = jax.random.categorical(_key, logits, shape=(n,))
+            if shape is None:
+                out = out[0]
+        else:
+            out = jax.random.categorical(_key, logits[:, None, :], axis=-1,
+                                         shape=(p.shape[0], n))
+            if shape is None:
+                out = out[:, 0]
+        return out.astype(jx_dtype(dtype))
+
+    samples = invoke_raw("multinomial", fn, [data], record=False)
+
+    if not get_prob:
+        return samples
+
+    def logp_fn(p, s):
+        logits = jnp.log(jnp.maximum(p, 1e-37))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        idx = s.astype(jnp.int32)
+        if p.ndim == 1:
+            return jnp.take(logp, idx)
+        take = jnp.take_along_axis(
+            logp, idx.reshape(p.shape[0], -1), axis=-1)
+        return take.reshape(idx.shape)
+    logp = invoke_raw("multinomial_logp", logp_fn, [data, samples])
+    return samples, logp
+
+
+def shuffle(data, **kw):
+    data = data if isinstance(data, NDArray) else NDArray(data)
+    key = next_key()
+    return invoke_raw("shuffle",
+                      lambda x, _k=key: jax.random.permutation(_k, x, axis=0),
+                      [data], record=False)

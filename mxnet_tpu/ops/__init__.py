@@ -1,0 +1,10 @@
+"""Operator library: registry + op definitions (TPU/XLA backed).
+
+Reference analog: src/operator/ (~200k LoC of CPU/CUDA kernels registered into
+the nnvm op registry via NNVM_REGISTER_OP). Here every op is a pure JAX
+function — XLA emits the TPU kernel, Pallas covers the hand-written hot ops —
+registered into a Python registry that drives the imperative invoke path, the
+autograd tape, and symbolic/deferred-compute tracing.
+"""
+from . import registry
+from .registry import Op, register, get_op, invoke, invoke_raw, list_ops

@@ -1,0 +1,110 @@
+"""Op registry + imperative invoke path.
+
+Reference analog: the nnvm op registry plus ``Imperative::Invoke``
+(src/imperative/imperative.cc:98) and ``PushFCompute``
+(src/imperative/imperative_utils.h:448). The reference infers shape/type,
+picks a DispatchMode, and pushes a closure to the threaded engine; here the
+"kernel" is a pure JAX function dispatched through XLA's async runtime, and
+the invoke layer's remaining jobs are (a) NDArray unwrap/wrap, (b) autograd
+tape recording (see _tape.py), (c) NaiveEngine synchronous mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .. import _tape, engine
+from ..base import MXNetError
+
+__all__ = ["Op", "register", "get_op", "invoke", "invoke_raw", "list_ops"]
+
+_OP_REGISTRY: Dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator.
+
+    ``fn(*jax_arrays, **attrs)`` is the pure functional kernel — everything
+    XLA needs. Optional metadata mirrors the reference op attributes
+    (include/mxnet/op_attr_types.h): num_outputs, differentiability.
+    """
+
+    def __init__(self, name: str, fn: Callable, num_outputs: int = 1,
+                 differentiable: bool = True, ndarray_alias: Optional[str] = None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.ndarray_alias = ndarray_alias
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return f"Op({self.name})"
+
+
+def register(name: str, num_outputs: int = 1, differentiable: bool = True,
+             alias: Optional[str] = None):
+    """Decorator: register a JAX function as an operator."""
+    def deco(fn):
+        op = Op(name, fn, num_outputs, differentiable, alias)
+        _OP_REGISTRY[name] = op
+        if alias:
+            _OP_REGISTRY[alias] = op
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Op:
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError as e:
+        raise MXNetError(f"operator {name!r} is not registered") from e
+
+
+def list_ops() -> List[str]:
+    return sorted(_OP_REGISTRY)
+
+
+def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
+               n_outputs: int = 1, record: Optional[bool] = None):
+    """Invoke a pure function on NDArray inputs, returning NDArray outputs.
+
+    This is the single funnel every imperative op goes through — the analog
+    of MXImperativeInvokeEx → Imperative::Invoke (c_api_ndarray.cc:153).
+    """
+    from ..ndarray.ndarray import NDArray  # lazy to break import cycle
+
+    in_datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    should_record = _tape.is_recording() if record is None else record
+
+    if should_record:
+        nd_inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+        # Allocate output handles; record_op fills data + tape entries.
+        outs = [NDArray.__new__(NDArray) for _ in range(n_outputs)]
+        for o in outs:
+            o._init_empty()
+        node = _tape.record_op(name, fn, nd_inputs, outs)
+        del node
+        result = outs[0] if n_outputs == 1 else tuple(outs)
+    else:
+        raw = fn(*in_datas)
+        if n_outputs == 1 and not isinstance(raw, (tuple, list)):
+            result = NDArray(raw)
+        else:
+            raw = raw if isinstance(raw, (tuple, list)) else (raw,)
+            result = tuple(NDArray(r) for r in raw)
+
+    eng = engine.get()
+    if eng.is_naive:
+        rs = result if isinstance(result, tuple) else (result,)
+        eng.maybe_sync([r._data for r in rs])
+    return result
+
+
+def invoke(name: str, *inputs, **attrs):
+    """Invoke a registered op by name with NDArray inputs + python attrs."""
+    op = get_op(name)
+    fn = functools.partial(op.fn, **attrs) if attrs else op.fn
+    return invoke_raw(op.name, fn, list(inputs), n_outputs=op.num_outputs)

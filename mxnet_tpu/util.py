@@ -1,0 +1,100 @@
+"""Utility flags: NumPy-semantics switches and decorators.
+
+Reference analog: python/mxnet/util.py (np-shape/np-array global flags with
+decorators). In the TPU rebuild np-shape semantics (0-dim/0-size arrays) are
+always on — XLA handles them natively — so the switches mostly gate which
+frontend (`mx.nd` vs `mx.np`) Gluon blocks produce.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "set_np_shape", "reset_np",
+           "use_np", "use_np_array", "np_array", "np_shape", "wrap_np_unary_func",
+           "wrap_np_binary_func", "get_cuda_compute_capability"]
+
+_state = threading.local()
+
+
+def _flags():
+    if not getattr(_state, "init", False):
+        _state.np_array = False
+        _state.np_shape = True  # always-on: XLA supports 0-dim natively
+        _state.init = True
+    return _state
+
+
+def is_np_array() -> bool:
+    return _flags().np_array
+
+
+def is_np_shape() -> bool:
+    return _flags().np_shape
+
+
+def set_np_shape(active: bool) -> bool:
+    f = _flags()
+    old, f.np_shape = f.np_shape, active
+    return old
+
+
+def set_np(shape: bool = True, array: bool = True, dtype: bool = False):
+    f = _flags()
+    f.np_shape = shape
+    f.np_array = array
+
+
+def reset_np():
+    set_np(shape=True, array=False)
+
+
+class _NumpyScope:
+    def __init__(self, array: bool, shape: bool = True):
+        self._array = array
+        self._shape = shape
+
+    def __enter__(self):
+        f = _flags()
+        self._old = (f.np_array, f.np_shape)
+        f.np_array, f.np_shape = self._array, self._shape
+        return self
+
+    def __exit__(self, *exc):
+        f = _flags()
+        f.np_array, f.np_shape = self._old
+
+
+def np_array(active: bool = True):
+    return _NumpyScope(active)
+
+
+def np_shape(active: bool = True):
+    return _NumpyScope(_flags().np_array, active)
+
+
+def use_np_array(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NumpyScope(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np(func_or_cls):
+    """Decorator forcing mx.np semantics (reference util.use_np)."""
+    if isinstance(func_or_cls, type):
+        return func_or_cls
+    return use_np_array(func_or_cls)
+
+
+def wrap_np_unary_func(func):
+    return func
+
+
+def wrap_np_binary_func(func):
+    return func
+
+
+def get_cuda_compute_capability(ctx):  # compat shim; no CUDA on TPU builds
+    return None

@@ -1,0 +1,225 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py,
+test_higher_order_grad.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_rule():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * x  # x^3
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0])  # 3x^2
+
+
+def test_backward_with_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([10.0, 100.0]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = 2 * x
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_multiple_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), [4.0, 5.0])
+    onp.testing.assert_allclose(b.grad.asnumpy(), [1.0, 2.0])
+
+
+def test_reused_input():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 2
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [8.0])  # 2x + 2
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # only d(z)/dx = y
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) * x
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_matmul_grad():
+    a = nd.array(onp.random.rand(3, 4).astype("float32"))
+    b = nd.array(onp.random.rand(4, 2).astype("float32"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                onp.ones((3, 2)) @ b.asnumpy().T, rtol=1e-5)
+    onp.testing.assert_allclose(b.grad.asnumpy(),
+                                a.asnumpy().T @ onp.ones((3, 2)), rtol=1e-5)
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_pause_stops_recording():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 10  # not recorded
+        w = y + 1
+    w.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    gx = autograd.grad(y, x)
+    onp.testing.assert_allclose(gx.asnumpy(), [6.0])
+    # .grad untouched by grad()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_higher_order_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x  # x^3
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+    gx.backward()
+    # d/dx (3x^2) = 6x = 12
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_multiple_heads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * 3
+    autograd.backward([y1, y2])
+    onp.testing.assert_allclose(x.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y = self.saved_tensors[0]
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100,))
+    out_predict = nd.Dropout(x, p=0.5)
+    onp.testing.assert_allclose(out_predict.asnumpy(), x.asnumpy())
+    with autograd.record(train_mode=True):
+        out_train = nd.Dropout(x, p=0.5)
+    zeros = (out_train.asnumpy() == 0).sum()
+    assert 10 < zeros < 90  # roughly half dropped
+
+
+def test_exception_in_graph_propagates():
+    x = nd.array([1.0])
+    with pytest.raises(Exception):
+        x.backward()  # not recorded, no grad
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [10.0])

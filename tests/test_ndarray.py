@@ -1,0 +1,210 @@
+"""NDArray semantics tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    onp.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    onp.testing.assert_allclose(nd.full((2,), 7).asnumpy(), [7, 7])
+    a = nd.arange(0, 10, 2)
+    onp.testing.assert_allclose(a.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    onp.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    onp.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    onp.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    onp.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    onp.testing.assert_allclose((a + 1).asnumpy(), [2, 3, 4])
+    onp.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+    onp.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    onp.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    onp.testing.assert_allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    onp.testing.assert_allclose(a.asnumpy(), [4, 6])
+    a -= nd.array([1.0, 1.0])
+    onp.testing.assert_allclose(a.asnumpy(), [3, 5])
+
+
+def test_broadcasting():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    c = nd.ones((3, 1))
+    assert c.broadcast_to((3, 4)).shape == (3, 4)
+
+
+def test_indexing_read():
+    a = nd.array(onp.arange(24).reshape(2, 3, 4))
+    onp.testing.assert_allclose(a[0].asnumpy(), onp.arange(12).reshape(3, 4))
+    onp.testing.assert_allclose(a[1, 2].asnumpy(), [20, 21, 22, 23])
+    onp.testing.assert_allclose(a[:, 1, :].asnumpy(),
+                                onp.arange(24).reshape(2, 3, 4)[:, 1, :])
+
+
+def test_indexing_write():
+    a = nd.zeros((3, 3))
+    a[1] = 5.0
+    assert a.asnumpy()[1].sum() == 15
+    a[0, 0] = 2.0
+    assert a.asnumpy()[0, 0] == 2
+    # augmented slice assignment mutates the base
+    a[2] += 1.0
+    onp.testing.assert_allclose(a.asnumpy()[2], [1, 1, 1])
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_transpose_and_dot():
+    a = nd.array(onp.random.rand(3, 4).astype("float32"))
+    b = nd.array(onp.random.rand(4, 5).astype("float32"))
+    c = nd.dot(a, b)
+    onp.testing.assert_allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(),
+                                rtol=1e-5)
+    assert a.T.shape == (4, 3)
+    d = nd.dot(a, b, transpose_a=False, transpose_b=False)
+    assert d.shape == (3, 5)
+
+
+def test_reductions():
+    a = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    assert a.sum().asnumpy() == 66
+    onp.testing.assert_allclose(a.sum(axis=0).asnumpy(), [12, 15, 18, 21])
+    onp.testing.assert_allclose(a.mean(axis=1).asnumpy(), [1.5, 5.5, 9.5])
+    assert a.max().asnumpy() == 11
+    assert a.min().asnumpy() == 0
+    onp.testing.assert_allclose(nd.sum(a, axis=1, keepdims=True).shape, (3, 1))
+    # exclude semantics
+    onp.testing.assert_allclose(nd.sum(a, axis=0, exclude=True).asnumpy(),
+                                a.asnumpy().sum(axis=1))
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_one_hot_pick():
+    w = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    idx = nd.array([0, 2])
+    t = nd.take(w, idx)
+    assert t.shape == (2, 3)
+    onp.testing.assert_allclose(t.asnumpy(), w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(nd.array([1, 0]), 3)
+    onp.testing.assert_allclose(oh.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+    p = nd.pick(w, nd.array([0, 1, 2, 0]), axis=1)
+    onp.testing.assert_allclose(p.asnumpy(), [0, 4, 8, 9])
+
+
+def test_comparison_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    onp.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    onp.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    onp.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_sort_topk():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    onp.testing.assert_allclose(nd.sort(a).asnumpy(), [[1, 2, 3], [0, 4, 5]])
+    idx = nd.topk(a, k=2)
+    onp.testing.assert_allclose(idx.asnumpy(), [[0, 2], [1, 2]])
+    both = nd.topk(a, k=1, ret_typ="both")
+    onp.testing.assert_allclose(both[0].asnumpy(), [[3], [5]])
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.astype("float16")
+    assert c.dtype == onp.float16
+
+
+def test_wait_to_read_and_waitall():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 100
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    a, b = nd.ones((2, 2)), nd.zeros((3,))
+    nd.save(fname, [a, b])
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    onp.testing.assert_allclose(loaded[0].asnumpy(), a.asnumpy())
+    nd.save(fname, {"x": a, "y": b})
+    d = nd.load(fname)
+    assert set(d) == {"x", "y"}
+
+
+def test_context_placement():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+
+
+def test_sequence_mask():
+    x = nd.ones((4, 2, 3))  # (T, B, ...)
+    y = nd.SequenceMask(x, sequence_length=nd.array([2, 3]),
+                        use_sequence_length=True, value=0)
+    ynp = y.asnumpy()
+    assert ynp[:2, 0].sum() == 6 and ynp[2:, 0].sum() == 0
+    assert ynp[:3, 1].sum() == 9 and ynp[3:, 1].sum() == 0
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= a.asnumpy().min() and a.asnumpy().max() <= 1
+    mx.random.seed(42)
+    b = mx.nd.random.uniform(0, 1, shape=(100,))
+    onp.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    n = mx.nd.random.normal(0, 1, shape=(10000,))
+    assert abs(n.asnumpy().mean()) < 0.1
+
+
+def test_elemwise_unary_math():
+    a = nd.array([0.5, 1.0, 2.0])
+    onp.testing.assert_allclose(nd.exp(a).asnumpy(), onp.exp(a.asnumpy()),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(nd.log(a).asnumpy(), onp.log(a.asnumpy()),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(nd.sigmoid(a).asnumpy(),
+                                1 / (1 + onp.exp(-a.asnumpy())), rtol=1e-6)
+    onp.testing.assert_allclose(nd.relu(nd.array([-1.0, 1.0])).asnumpy(), [0, 1])
