@@ -67,9 +67,9 @@ class TapeNode:
     """
 
     __slots__ = ("id", "name", "inputs", "fn", "vjp_fn", "out_avals",
-                 "n_outputs", "input_entries")
+                 "n_outputs", "input_entries", "out_is_tuple")
 
-    def __init__(self, name, inputs, fn, vjp_fn, out_avals):
+    def __init__(self, name, inputs, fn, vjp_fn, out_avals, out_is_tuple=False):
         _node_counter[0] += 1
         self.id = _node_counter[0]
         self.name = name
@@ -82,6 +82,7 @@ class TapeNode:
         self.vjp_fn = vjp_fn
         self.out_avals = out_avals          # list of jax.ShapeDtypeStruct
         self.n_outputs = len(out_avals)
+        self.out_is_tuple = out_is_tuple    # fn returned a tuple (vjp wants one)
 
 
 def record_op(name: str, fn: Callable, inputs: Sequence[Any],
@@ -91,10 +92,11 @@ def record_op(name: str, fn: Callable, inputs: Sequence[Any],
     Called by the op-invoke layer (ops/registry.py) when recording."""
     in_datas = [x._data for x in inputs]
     outs, vjp_fn = jax.vjp(fn, *in_datas)
-    if not isinstance(outs, (tuple, list)):
+    out_is_tuple = isinstance(outs, (tuple, list))
+    if not out_is_tuple:
         outs = (outs,)
     avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
-    node = TapeNode(name, inputs, fn, vjp_fn, avals)
+    node = TapeNode(name, inputs, fn, vjp_fn, avals, out_is_tuple)
     for i, arr in enumerate(out_arrays):
         arr._data = outs[i]
         arr._tape_entry = (node, i)
@@ -196,7 +198,7 @@ def backward(heads, head_grads=None, retain_graph=False, create_graph=False,
                         "through the graph a second time")
                 cts = [c if c is not None else _zeros_like_aval(a)
                        for c, a in zip(cts, node.out_avals)]
-                arg = tuple(cts) if node.n_outputs > 1 else cts[0]
+                arg = tuple(cts) if node.out_is_tuple else cts[0]
                 in_cts = node.vjp_fn(arg)
                 _scatter_input_cts(node, in_cts, ct, leaf_grads, var_ids)
                 if not retain_graph:
@@ -275,10 +277,10 @@ def _backward_create_graph(order, ct, leaf_grads, var_ids, variables):
         n_in = len(node.inputs)
         fn = node.fn
 
-        def grad_fn(*args, _fn=fn, _n_in=n_in):
+        def grad_fn(*args, _fn=fn, _n_in=n_in, _tup=node.out_is_tuple):
             xs, gs = args[:_n_in], args[_n_in:]
             _, vjp_fn = jax.vjp(_fn, *xs)
-            arg = tuple(gs) if len(gs) > 1 else gs[0]
+            arg = tuple(gs) if _tup else gs[0]
             return tuple(vjp_fn(arg))
 
         ct_handles = []

@@ -138,7 +138,7 @@ class Function:
                              for g in gin)
 
             node = TapeNode(type(self).__name__, list(inputs), None, vjp_fn,
-                            avals)
+                            avals, out_is_tuple=not single)
             # create_graph path not supported for custom Functions (fn=None);
             # matches reference behavior (Function has no higher-order grad).
             for i, o in enumerate(outs):

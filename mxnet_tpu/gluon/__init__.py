@@ -1,0 +1,21 @@
+"""Gluon: the imperative/hybrid user API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from .utils import split_and_load, split_data, clip_global_norm
+
+import importlib as _importlib
+
+for _mod in ["trainer", "data", "rnn", "model_zoo", "contrib", "probability"]:
+    try:
+        globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
+    except ImportError:
+        pass
+
+try:
+    from .trainer import Trainer  # noqa: F401
+except ImportError:
+    pass
+
+from .. import metric  # parity: mx.gluon.metric mirrors reference layout

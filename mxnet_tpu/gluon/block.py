@@ -1,0 +1,458 @@
+"""Gluon Block / HybridBlock.
+
+Reference analog: python/mxnet/gluon/block.py (Block :201, HybridBlock :859;
+_build_cache :993 traces forward under deferred compute into a Symbol and
+wraps it in a C++ CachedOp; __call__ :1384 routes to _call_cached_op :1095).
+
+TPU-native re-design: ``hybridize()`` makes the whole forward ONE XLA
+computation. ``_CachedOp`` here traces the block's imperative forward with
+``jax.jit`` — NDArray is a jax pytree node, so the same Python forward code
+runs both eagerly and under trace. Under ``autograd.record`` the jitted
+callable becomes a single tape node, so backward is also one fused XLA
+computation (the reference needed bulking + static_alloc to approximate this;
+XLA gives it natively, which is the core perf story of the rebuild).
+
+Mutable layer state (BatchNorm running stats) is handled functionally: params
+rebound during tracing are detected and returned as extra outputs, then
+written back after each call — the jit-compatible version of the reference's
+aux-state mutation.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as onp
+
+import jax
+
+from .. import _tape, autograd
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray import utils as nd_utils
+from ..ndarray.ndarray import NDArray
+from ..ndarray.random import next_key, push_trace_key, pop_trace_key
+from ..ops.registry import invoke_raw
+from .parameter import Parameter, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+def _in_trace(args) -> bool:
+    """True when any input is a jax tracer — i.e. we are already inside an
+    enclosing jit trace and must inline rather than nest cached ops."""
+    for leaf in jax.tree_util.tree_leaves(args):
+        if isinstance(leaf, jax.core.Tracer):
+            return True
+    return False
+
+
+class _ParamDict(dict):
+    """Dict of name->Parameter with reference ParameterDict conveniences."""
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename):
+        nd_utils.save(filename, {k: v.data() for k, v in self.items()})
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False):
+        loaded = nd_utils.load(filename)
+        for k, v in self.items():
+            if k in loaded:
+                v.set_data(loaded[k])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {k} missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self)
+            if extra:
+                raise MXNetError(f"file {filename} has extra params {extra}")
+
+
+class Block:
+    """Base class for all layers/models (reference gluon/block.py:201)."""
+
+    def __init__(self, prefix: Optional[str] = None, params=None):
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List[Callable] = []
+        self._forward_pre_hooks: List[Callable] = []
+        self._prefix = prefix or ""
+        self._name = type(self).__name__.lower()
+
+    # ---------------- attribute registration ----------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", OrderedDict())[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+            if value._name in ("weight", "bias", "const", "") or \
+                    value._name == "weight":
+                value._name = name
+        super().__setattr__(name, value)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def params(self) -> Dict[str, Parameter]:
+        return dict(self._reg_params)
+
+    def name_scope(self):
+        """1.x compat no-op scope (naming is structural in 2.0)."""
+        import contextlib
+        return contextlib.nullcontext(self)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # ---------------- parameter management ----------------
+    def collect_params(self, select: Optional[str] = None) -> _ParamDict:
+        """Structural-path-keyed parameter dict (reference block.py
+        collect_params; 2.0 keys are 'child.param' paths)."""
+        out = _ParamDict()
+        self._collect_params_into(out, "")
+        if select is not None:
+            import re
+            pat = re.compile(select.replace(".*", "@@").replace("*", ".*")
+                             .replace("@@", ".*"))
+            out = _ParamDict({k: v for k, v in out.items()
+                              if pat.search(k) or pat.search(v.name)})
+        return out
+
+    def _collect_params_into(self, out: _ParamDict, prefix: str):
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            child._collect_params_into(out, f"{prefix}{cname}.")
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        self._on_cast(dtype)
+
+    def _on_cast(self, dtype):
+        for child in self._children.values():
+            child._on_cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # ---------------- persistence ----------------
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        """Reference block.py:339 — structural-key param file."""
+        params = self.collect_params()
+        nd_utils.save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        """Reference block.py:375."""
+        loaded = nd_utils.load(filename)
+        params = self.collect_params()
+        for k, v in params.items():
+            if k in loaded:
+                arr = loaded[k]
+                if cast_dtype and v._data is not None:
+                    arr = arr.astype(v._data._data.dtype)
+                v.set_data(arr)
+            elif not allow_missing:
+                raise MXNetError(f"parameter {k} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"{filename} contains extra parameters {extra}")
+
+    # ---------------- execution ----------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def hybridize(self, active: bool = True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        lines = [f"{type(self).__name__}:"]
+        for k, p in self.collect_params().items():
+            lines.append(f"  {k}: {p.shape}")
+        s = "\n".join(lines)
+        print(s)
+        return s
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({k}): {type(v).__name__}"
+                         for k, v in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)" if mods else \
+            f"{type(self).__name__}()"
+
+
+class HybridBlock(Block):
+    """Block that can fuse its forward into one compiled XLA computation."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_fn = None
+        self._cached_params: List[Parameter] = []
+        self._cached_out_info = {}
+        self._state_idx: List[int] = []
+        self._flags = {}
+        self._backend = None
+        self._partition_if_dynamic = True
+        self._last_input_avals = None
+
+    def hybridize(self, active: bool = True, backend=None, clear=True,
+                  static_alloc: bool = False, static_shape: bool = False,
+                  partition_if_dynamic: bool = True, **kwargs):
+        """Reference block.py:1216. static_alloc/static_shape are accepted
+        for parity; XLA's buffer assignment subsumes them."""
+        self._active = active
+        self._backend = backend
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        if clear:
+            self._cached_fn = None
+            self._cached_out_info = {}
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Reference block.py:1141 — partition for a backend then build the
+        cache. Backends hook in via parallel/partition.py."""
+        self._backend = backend
+        self.hybridize(True, backend=backend, clear=clear, **kwargs)
+        return self(x, *args)
+
+    # -------- cache construction --------
+    def _ensure_shapes(self, args):
+        """Trigger deferred param init by one throwaway eager forward
+        (the reference's deferred-compute trace performs shape inference;
+        our layers infer shapes inline in forward)."""
+        incomplete = any(p._data is None
+                         for p in self.collect_params().values())
+        if incomplete:
+            with autograd.pause():
+                self.forward(*args)
+
+    def _build_cache(self, args):
+        self._ensure_shapes(args)
+        self._cached_out_info = {}
+        params = [p for p in self.collect_params().values()
+                  if p._data is not None]
+        self._cached_params = params
+        block = self
+        info = self._cached_out_info
+
+        def fn(rng_key, arg_leaves, arg_treedef, train_mode, *param_datas):
+            args_nd = jax.tree_util.tree_unflatten(arg_treedef, list(arg_leaves))
+            if not isinstance(args_nd, (list, tuple)):
+                args_nd = (args_nd,)
+            orig = [p._data for p in params]
+            bound_ids = []
+            for p, d in zip(params, param_datas):
+                nd = NDArray(jax.lax.stop_gradient(d)
+                             if p.grad_req == "null" else d)
+                p._data = nd
+                bound_ids.append(id(nd))
+            push_trace_key(rng_key)
+            prev = _tape.set_recording(False)
+            prev_t = _tape.set_training(train_mode)
+            try:
+                out = block.forward(*args_nd)
+            finally:
+                _tape.set_recording(prev)
+                _tape.set_training(prev_t)
+                pop_trace_key()
+            # capture functional state updates (BN running stats etc.)
+            state_leaves, state_idx = [], []
+            for i, p in enumerate(params):
+                if id(p._data) != bound_ids[i]:
+                    state_idx.append(i)
+                    state_leaves.append(
+                        p._data._data if isinstance(p._data, NDArray)
+                        else p._data)
+            for p, o in zip(params, orig):
+                p._data = o
+            # flatten outputs with NDArray as LEAF (not pytree node) so the
+            # call path can rebuild the structure around the tape-carrying
+            # output handles
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda t: isinstance(t, NDArray))
+            # per-mode info: train traces may emit extra state outputs
+            info[train_mode] = dict(out_treedef=out_treedef,
+                                    n_out=len(out_leaves),
+                                    state_idx=state_idx)
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in out_leaves) + tuple(state_leaves)
+
+        self._cached_fn = jax.jit(fn, static_argnums=(2, 3))
+
+    def _call_cached_op(self, *args):
+        """Reference block.py:1095 → CachedOp::Forward. One tape node per
+        call; backward differentiates the whole compiled computation."""
+        if self._cached_fn is None:
+            self._build_cache(args)
+        params = self._cached_params
+        arg_leaves, arg_treedef = jax.tree_util.tree_flatten(args)
+        rng_key = next_key()
+        train = _tape.is_training()
+
+        fn = self._cached_fn
+
+        def op_fn(*leaves_and_params, _fn=fn, _treedef=arg_treedef,
+                  _key=rng_key, _n_args=len(arg_leaves), _train=train):
+            a = leaves_and_params[:_n_args]
+            pd = leaves_and_params[_n_args:]
+            return _fn(_key, a, _treedef, _train, *pd)
+
+        inputs = ([NDArray(l) if not isinstance(l, NDArray) else l
+                   for l in arg_leaves] +
+                  [p._data for p in params])
+        # first call per mode: lower once (traces fn, populating info)
+        if train not in self._cached_out_info:
+            fn.lower(rng_key,
+                     tuple(l._data for l in inputs[:len(arg_leaves)]),
+                     arg_treedef, train,
+                     *[p._data._data for p in params])
+        info = self._cached_out_info[train]
+        n_total = info["n_out"] + len(info["state_idx"])
+        result = invoke_raw(f"cached_op_{self._name}", op_fn, inputs,
+                            n_outputs=n_total)
+        result = result if isinstance(result, tuple) else (result,)
+        outs = result[:info["n_out"]]
+        states = result[info["n_out"]:]
+        with autograd.pause():
+            for i, s in zip(info["state_idx"], states):
+                # REBIND (not mutate) so an enclosing hybridized parent's
+                # trace detects this as a state update too (id check in its
+                # _build_cache); in-place mutation would be invisible to it
+                params[i]._data = s
+        # rebuild output structure around the tape-carrying handles
+        return jax.tree_util.tree_unflatten(info["out_treedef"], list(outs))
+
+    def __call__(self, *args, **kwargs):
+        if not _in_trace(args):
+            # remember input signature for export (trace_block_to_symbol)
+            self._last_input_avals = [
+                jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+                for a in args if isinstance(a, NDArray)]
+        if self._active and not _in_trace(args):
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self._call_cached_op(*args, **kwargs)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        # inside an enclosing hybridized parent's trace, run the raw forward
+        # so the whole model compiles into ONE flat XLA computation
+        return super().__call__(*args, **kwargs)
+
+    # -------- export (reference block.py:1296) --------
+    def export(self, path: str, epoch: int = 0, remove_amp_cast=True):
+        """Save architecture descriptor + params; re-importable by
+        SymbolBlock.imports (format: symbol.py JSON graph)."""
+        from ..symbol.symbol import trace_block_to_symbol
+        params = self.collect_params()
+        sym = trace_block_to_symbol(self)
+        sym_file = f"{path}-symbol.json"
+        param_file = f"{path}-{epoch:04d}.params"
+        sym.save(sym_file)
+        nd_utils.save(param_file,
+                      {k: v.data() for k, v in params.items()})
+        return sym_file, param_file
+
+    def infer_shape(self, *args):
+        self._ensure_shapes(args)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Run a saved symbolic graph as a block (reference block.py:1479).
+    Fleshed out with the symbol module; imports() loads an exported pair."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__()
+        self._symbol_outputs = outputs
+        self._symbol_inputs = inputs
+        self._symbol_params = params or {}
+        for k, v in self._symbol_params.items():
+            p = Parameter(name=k, shape=v.shape)
+            p.set_data(v)
+            self._reg_params[k.replace(".", "_")] = p
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file: Optional[str] = None,
+                ctx=None):
+        from ..symbol.symbol import Symbol
+        sym = Symbol.load(symbol_file)
+        params = nd_utils.load(param_file) if param_file else {}
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        blk = SymbolBlock(sym, input_names, params)
+        return blk
+
+    def forward(self, *args):
+        from ..symbol import executor as sym_executor
+        sym = self._symbol_outputs
+        arg_names = sym.list_arguments()
+        feeds = {}
+        # positional inputs map to the symbol's non-param arguments in order
+        input_slots = [n for n in arg_names if n not in self._symbol_params]
+        for n, a in zip(input_slots, args):
+            feeds[n] = a if isinstance(a, NDArray) else NDArray(a)
+        for k, v in self._symbol_params.items():
+            feeds[k] = v
+        return sym_executor.eval_symbol(sym, feeds)

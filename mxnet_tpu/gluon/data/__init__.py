@@ -1,0 +1,8 @@
+"""Gluon data API (reference: python/mxnet/gluon/data/)."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      BatchSampler, FilterSampler, IntervalSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision
+from . import batchify

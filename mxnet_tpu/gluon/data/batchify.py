@@ -1,0 +1,52 @@
+"""Batchify functions (reference: src/io/batchify.cc + gluon batchify)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Stack", "Pad", "Group"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+class Stack:
+    """Stack samples along a new batch axis (reference StackBatchify)."""
+
+    def __call__(self, data):
+        return NDArray(onp.stack([_to_np(d) for d in data]))
+
+
+class Pad:
+    """Pad variable-length samples to the batch max (reference PadBatchify)."""
+
+    def __init__(self, axis=0, val=0, dtype=None):
+        self._axis = axis
+        self._val = val
+        self._dtype = dtype
+
+    def __call__(self, data):
+        arrs = [_to_np(d) for d in data]
+        max_len = max(a.shape[self._axis] for a in arrs)
+        padded = []
+        for a in arrs:
+            pad_width = [(0, 0)] * a.ndim
+            pad_width[self._axis] = (0, max_len - a.shape[self._axis])
+            padded.append(onp.pad(a, pad_width, constant_values=self._val))
+        out = onp.stack(padded)
+        if self._dtype:
+            out = out.astype(self._dtype)
+        return NDArray(out)
+
+
+class Group:
+    """Apply one batchify fn per field (reference GroupBatchify)."""
+
+    def __init__(self, *fns):
+        self._fns = fns
+
+    def __call__(self, data):
+        return tuple(fn([d[i] for d in data])
+                     for i, fn in enumerate(self._fns))

@@ -1,0 +1,110 @@
+"""DataLoader: batched, shuffled, prefetching iteration.
+
+Reference analog: python/mxnet/gluon/data/dataloader.py (:513 __iter__;
+fork-based _MultiWorkerIter :439 with shared-memory NDArray pickling). TPU
+host design: JPEG decode/augment happens on the host CPU while the chip runs
+the previous step, so what matters is (a) worker parallelism for decode and
+(b) pipelining ahead of the device. We use a thread pool (decode is
+numpy/PIL releasing the GIL; fork is hostile to the XLA runtime) plus a
+bounded prefetch queue — the analog of the reference's iter_prefetcher.h
+double-buffering.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py
+    default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return NDArray(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(d)) for d in zip(*data))
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return NDArray(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference DataLoader API: batch_size,
+    shuffle, sampler, batch_sampler, last_batch, batchify_fn, num_workers,
+    pin_memory, prefetch)."""
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[Sampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 pin_device_id: int = 0, prefetch: Optional[int] = None,
+                 thread_pool: bool = False, timeout: int = 120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size is required unless batch_sampler is given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle is mutually exclusive with sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch are mutually "
+                "exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * max(self._num_workers, 1))
+        self._timeout = timeout
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        # threaded pipeline with bounded in-flight futures
+        # (reference prefetcher double-buffering, src/io/iter_prefetcher.h)
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            batches = iter(self._batch_sampler)
+            inflight = queue.Queue()
+            submitted = 0
+            for indices in batches:
+                inflight.put(pool.submit(self._load_batch, indices))
+                submitted += 1
+                if submitted >= self._prefetch:
+                    break
+            while not inflight.empty():
+                fut = inflight.get()
+                nxt = next(batches, None)
+                if nxt is not None:
+                    inflight.put(pool.submit(self._load_batch, nxt))
+                yield fut.result(timeout=self._timeout)

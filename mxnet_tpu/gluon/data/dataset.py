@@ -1,0 +1,110 @@
+"""Datasets (reference: python/mxnet/gluon/data/dataset.py)."""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Sequence
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    """Abstract dataset (reference dataset.py:30)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn: Callable) -> "SimpleDataset":
+        return SimpleDataset([self[i] for i in range(len(self))
+                              if fn(self[i])])
+
+    def shard(self, num_shards: int, index: int) -> "SimpleDataset":
+        items = [self[i] for i in range(index, len(self), num_shards)]
+        return SimpleDataset(items)
+
+    def take(self, count: int) -> "SimpleDataset":
+        return SimpleDataset([self[i]
+                              for i in range(min(count, len(self)))])
+
+    def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        t = _LazyTransformDataset(self, fn)
+        if lazy:
+            return t
+        return SimpleDataset([t[i] for i in range(len(t))])
+
+    def transform_first(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        def first(*items):
+            if len(items) == 1:
+                return fn(items[0])
+            return (fn(items[0]),) + items[1:]
+        return self.transform(first, lazy)
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset: Dataset, fn: Callable):
+        self._dataset = dataset
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        item = self._dataset[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data: Sequence):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays (reference ArrayDataset)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for a in args:
+            if len(a) != self._length:
+                raise MXNetError("all arrays must have the same length")
+            self._data.append(a)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO file (reference src/io/dataset.cc:63
+    RecordFileDataset; our reader is the C++ recordio library when built,
+    with a pure-Python fallback — see mxnet_tpu/recordio.py)."""
+
+    def __init__(self, filename: str):
+        from ... import recordio
+        self._filename = filename
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

@@ -1,0 +1,5 @@
+"""Vision datasets + transforms (reference: gluon/data/vision/)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageFolderDataset, ImageRecordDataset)
+from . import transforms
+from . import datasets

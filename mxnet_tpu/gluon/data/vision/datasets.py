@@ -1,0 +1,214 @@
+"""Vision datasets (reference: gluon/data/vision/datasets.py).
+
+Zero-egress environment: datasets load from local files when present
+(standard idx-ubyte / CIFAR binary formats); MNIST/FashionMNIST fall back to
+a deterministic synthetic set so training-convergence tests can run anywhere
+(labels are a known function of the images, so a model CAN fit them).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ..dataset import ArrayDataset, Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset", "ImageRecordDataset"]
+
+
+def _synthetic_mnist(num: int, seed: int, num_classes: int = 10):
+    """Deterministic learnable stand-in: each class is a blurred template
+    plus noise."""
+    rng = onp.random.RandomState(seed)
+    templates = rng.rand(num_classes, 28, 28).astype("float32")
+    labels = rng.randint(0, num_classes, size=num).astype("int32")
+    noise = rng.rand(num, 28, 28).astype("float32") * 0.5
+    images = templates[labels] + noise
+    images = (images / images.max() * 255).astype("uint8")
+    return images[..., None], labels
+
+
+class MNIST(Dataset):
+    """MNIST (reference vision.MNIST). Reads idx-ubyte files from ``root``
+    when present, else generates the synthetic stand-in."""
+
+    _base_seed = 42
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._load()
+
+    def _file_names(self):
+        if self._train:
+            return ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+        return ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def _load(self):
+        img_name, lbl_name = self._file_names()
+        img_path = os.path.join(self._root, img_name)
+        lbl_path = os.path.join(self._root, lbl_name)
+        if os.path.exists(img_path) or os.path.exists(img_path + ".gz"):
+            self._data, self._label = self._read_idx(img_path, lbl_path)
+        else:
+            n = 8000 if self._train else 2000
+            self._data, self._label = _synthetic_mnist(
+                n, self._base_seed + (0 if self._train else 1))
+
+    @staticmethod
+    def _read_idx(img_path, lbl_path):
+        def opener(p):
+            return gzip.open(p + ".gz", "rb") if os.path.exists(p + ".gz") \
+                else open(p, "rb")
+        with opener(lbl_path) as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            labels = onp.frombuffer(f.read(), dtype=onp.uint8) \
+                .astype("int32")
+        with opener(img_path) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = onp.frombuffer(f.read(), dtype=onp.uint8) \
+                .reshape(num, rows, cols, 1)
+        return images, labels
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        img = NDArray(self._data[idx])
+        lbl = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(img, lbl)
+        return img, lbl
+
+
+class FashionMNIST(MNIST):
+    _base_seed = 77
+
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(Dataset):
+    """CIFAR-10 (reference vision.CIFAR10); reads the binary batch format
+    from root, else synthesizes 32x32x3 learnable data."""
+
+    _num_classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._load()
+
+    def _load(self):
+        files = [f"data_batch_{i}.bin" for i in range(1, 6)] if self._train \
+            else ["test_batch.bin"]
+        paths = [os.path.join(self._root, f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            datas, labels = [], []
+            rec = 1 + 3072 if self._num_classes == 10 else 2 + 3072
+            for p in paths:
+                raw = onp.fromfile(p, dtype=onp.uint8).reshape(-1, rec)
+                labels.append(raw[:, rec - 3073].astype("int32"))
+                datas.append(raw[:, rec - 3072:].reshape(-1, 3, 32, 32)
+                             .transpose(0, 2, 3, 1))
+            self._data = onp.concatenate(datas)
+            self._label = onp.concatenate(labels)
+        else:
+            rng = onp.random.RandomState(123 if self._train else 321)
+            n = 4000 if self._train else 1000
+            templates = rng.rand(self._num_classes, 32, 32, 3) \
+                .astype("float32")
+            self._label = rng.randint(0, self._num_classes, n).astype("int32")
+            imgs = templates[self._label] + \
+                rng.rand(n, 32, 32, 3).astype("float32") * 0.5
+            self._data = (imgs / imgs.max() * 255).astype("uint8")
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        img = NDArray(self._data[idx])
+        lbl = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(img, lbl)
+        return img, lbl
+
+
+class CIFAR100(CIFAR10):
+    _num_classes = 100
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 transform=None, fine_label=True):
+        super().__init__(root, train, transform)
+
+
+class ImageFolderDataset(Dataset):
+    """A folder-of-class-folders image dataset (reference
+    ImageFolderDataset); decodes with PIL/numpy on the host."""
+
+    def __init__(self, root: str, flag: int = 1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        if not os.path.isdir(self._root):
+            raise MXNetError(f"{self._root} is not a directory")
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith((".jpg", ".jpeg", ".png", ".bmp",
+                                           ".npy")):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        path, label = self.items[idx]
+        from .... import image as mx_image
+        img = mx_image.imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageRecordDataset(Dataset):
+    """Images in a RecordIO file (reference ImageRecordDataset over
+    src/io/dataset.cc:188)."""
+
+    def __init__(self, filename: str, flag: int = 1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        from .... import image as mx_image
+        raw = self._record[idx]
+        header, img_bytes = recordio.unpack(raw)
+        img = mx_image.imdecode(img_bytes, self._flag)
+        label = int(header.label) if onp.isscalar(header.label) \
+            else NDArray(onp.asarray(header.label))
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,0 +1,183 @@
+"""Vision transforms (reference: gluon/data/vision/transforms.py).
+
+Transforms are HybridBlocks operating on HWC uint8/float images on the host;
+under a Compose chain they run inside the DataLoader workers.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ....ndarray import ops as F
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+class Compose(Sequential):
+    """Sequentially composed transforms (reference Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference ToTensor)."""
+
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32") / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return NDArray(arr)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype="float32")
+        self._std = onp.asarray(std, dtype="float32")
+
+    def forward(self, x):
+        arr = x.asnumpy()
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return NDArray((arr - mean) / std)
+
+
+def _resize_np(arr, size):
+    """Nearest-neighbor host resize (decode path; avoids device round-trip)."""
+    h, w = arr.shape[:2]
+    ow, oh = (size, size) if isinstance(size, int) else size
+    ys = (onp.arange(oh) * h / oh).astype(int).clip(0, h - 1)
+    xs = (onp.arange(ow) * w / ow).astype(int).clip(0, w - 1)
+    return arr[ys][:, xs]
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        return NDArray(_resize_np(x.asnumpy(), self._size))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        arr = x.asnumpy()
+        h, w = arr.shape[:2]
+        cw, ch = self._size
+        x0 = max((w - cw) // 2, 0)
+        y0 = max((h - ch) // 2, 0)
+        out = arr[y0:y0 + ch, x0:x0 + cw]
+        if out.shape[:2] != (ch, cw):
+            out = _resize_np(arr, self._size)
+        return NDArray(out)
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        arr = x.asnumpy()
+        if self._pad:
+            p = self._pad
+            arr = onp.pad(arr, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = arr.shape[:2]
+        cw, ch = self._size
+        y0 = onp.random.randint(0, max(h - ch, 0) + 1)
+        x0 = onp.random.randint(0, max(w - cw, 0) + 1)
+        return NDArray(arr[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        arr = x.asnumpy()
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            ar = onp.random.uniform(*self._ratio)
+            cw = int(round(onp.sqrt(target_area * ar)))
+            ch = int(round(onp.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                x0 = onp.random.randint(0, w - cw + 1)
+                y0 = onp.random.randint(0, h - ch + 1)
+                crop = arr[y0:y0 + ch, x0:x0 + cw]
+                return NDArray(_resize_np(crop, self._size))
+        return NDArray(_resize_np(arr, self._size))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return NDArray(x.asnumpy()[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return NDArray(x.asnumpy()[::-1].copy())
+        return x
+
+
+class _RandomJitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _factor(self):
+        return 1.0 + onp.random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32") * self._factor()
+        return NDArray(arr)
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32")
+        mean = arr.mean()
+        return NDArray(mean + (arr - mean) * self._factor())
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32")
+        gray = arr.mean(axis=-1, keepdims=True)
+        return NDArray(gray + (arr - gray) * self._factor())

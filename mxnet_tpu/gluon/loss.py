@@ -1,0 +1,354 @@
+"""Loss functions (reference: python/mxnet/gluon/loss.py — 16 classes).
+
+Each Loss is a HybridBlock: forward(pred, label, sample_weight=None) returns
+per-sample losses reduced over ``batch_axis`` like the reference.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import ops as F
+from ..ndarray.ndarray import NDArray
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "TripletLoss", "CosineEmbeddingLoss",
+           "PoissonNLLLoss", "CTCLoss", "SDMLLoss"]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    return label.reshape(pred.shape) if label.shape != pred.shape else label
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean_all_but_batch(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = F.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = F.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        err = F.abs(label - pred)
+        loss = F.where(err > self._rho,
+                       err - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(err))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = F.relu(self._margin - pred * label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = F.square(F.relu(self._margin - pred * label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = F.relu(pred) - pred * label + \
+            F.Activation(-F.abs(pred), act_type="softrelu")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """Reference SigmoidBCELoss: numerically-stable BCE on logits."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                loss = F.relu(pred) - pred * label + \
+                    F.Activation(-F.abs(pred), act_type="softrelu")
+            else:
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = F.relu(pred) - pred * label + log_weight * \
+                    (F.Activation(-F.abs(pred), act_type="softrelu") +
+                     F.relu(-pred))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(F.log(pred + eps) * label +
+                         F.log(1. - pred + eps) * (1. - label))
+            else:
+                loss = -(F.log(pred + eps) * label * pos_weight +
+                         F.log(1. - pred + eps) * (1. - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Reference SoftmaxCELoss: fused log-softmax + pick, sparse or dense
+    labels."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        else:
+            label = _reshape_like(pred, label)
+            loss = -(pred * label).sum(axis=self._axis, keepdims=True)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(pred, positive)
+        negative = _reshape_like(pred, negative)
+        loss = (F.square(pred - positive) - F.square(pred - negative)) \
+            .sum(axis=tuple(range(1, pred.ndim)))
+        loss = F.relu(loss + self._margin)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        eps = 1e-12
+        num = (input1 * input2).sum(axis=1)
+        den = F.sqrt((input1 * input1).sum(axis=1) + eps) * \
+            F.sqrt((input2 * input2).sum(axis=1) + eps)
+        cos = num / den
+        label = label.reshape((-1,))
+        loss = F.where(label == 1, 1.0 - cos,
+                       F.relu(cos - self._margin))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = _reshape_like(pred, target)
+        if self._from_logits:
+            loss = F.exp(pred) - target * pred
+        else:
+            loss = pred - target * F.log(pred + epsilon)
+        if self._compute_full:
+            stirling = target * F.log(target + 1e-12) - target + \
+                0.5 * F.log(2 * onp.pi * (target + 1e-12))
+            stirling = F.where(target <= 1, F.zeros_like(stirling), stirling)
+            loss = loss + stirling
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(range(1, loss.ndim))) if loss.ndim > 1 \
+            else loss
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (reference CTCLoss over
+    src/operator/nn/ctc_loss.cc / vendored ctc_include). Implemented with the
+    standard alpha-recursion in log space via lax.scan — sequential in T but
+    vectorized over batch/labels on the MXU."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.registry import invoke_raw as _inv
+        from ..ndarray.ndarray import NDArray as _ND
+
+        if self._layout == "TNC":
+            pred = pred.swapaxes(0, 1)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+        B, T, C = pred.shape
+        L = label.shape[1]
+        inputs = [pred, label]
+        if pred_lengths is not None:
+            inputs.append(pred_lengths)
+        if label_lengths is not None:
+            inputs.append(label_lengths)
+
+        def fn(p, lab, *lens):
+            plen = lens[0].astype(jnp.int32) if pred_lengths is not None \
+                else jnp.full((B,), T, jnp.int32)
+            rest = lens[1:] if pred_lengths is not None else lens
+            llen = rest[0].astype(jnp.int32) if label_lengths is not None \
+                else jnp.sum((lab != 0).astype(jnp.int32), axis=1)
+            logp = jax.nn.log_softmax(p, axis=-1)
+            blank = 0
+            lab = lab.astype(jnp.int32)
+            # extended label seq: blank, l1, blank, l2, ... blank (2L+1)
+            ext = jnp.full((B, 2 * L + 1), blank, jnp.int32)
+            ext = ext.at[:, 1::2].set(lab)
+            S = 2 * L + 1
+            neg_inf = -1e30
+            # can-skip mask: s>=2 and ext[s] != blank and ext[s] != ext[s-2]
+            idx = jnp.arange(S)
+            skip_ok = (idx[None, :] >= 2) & (ext != blank) & \
+                (ext != jnp.roll(ext, 2, axis=1))
+            alpha0 = jnp.full((B, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(llen > 0, jnp.take_along_axis(
+                    logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0], neg_inf))
+
+            def step(alpha, t):
+                lp = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+                a1 = jnp.roll(alpha, 1, axis=1).at[:, 0].set(neg_inf)
+                a2 = jnp.roll(alpha, 2, axis=1).at[:, :2].set(neg_inf)
+                a2 = jnp.where(skip_ok, a2, neg_inf)
+                m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+                new = m + jnp.log(
+                    jnp.exp(alpha - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m))
+                new = new + lp
+                # freeze past pred_length
+                new = jnp.where((t < plen)[:, None], new, alpha)
+                return new, None
+
+            alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+            send = 2 * llen  # index of final blank
+            a_end = jnp.take_along_axis(alpha, send[:, None], axis=1)[:, 0]
+            a_end1 = jnp.take_along_axis(
+                alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+            m = jnp.maximum(a_end, a_end1)
+            ll = m + jnp.log(jnp.exp(a_end - m) + jnp.exp(a_end1 - m))
+            return -ll
+        loss = _inv("ctc_loss", fn, inputs)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference SDMLLoss)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smooth = smoothing_parameter
+
+    def forward(self, x1, x2, sample_weight=None):
+        import jax.numpy as jnp
+        from ..ops.registry import invoke_raw as _inv
+        N = x1.shape[0]
+
+        import jax
+
+        def fn(a, b):
+            # pairwise euclidean distances
+            d = jnp.sqrt(jnp.sum(
+                (a[:, None, :] - b[None, :, :]) ** 2, axis=-1) + 1e-12)
+            logits = -d
+            labels = jnp.eye(N) * (1 - self._smooth) + \
+                (1 - jnp.eye(N)) * self._smooth / (N - 1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -(labels * logp).sum(axis=1)
+        return _inv("sdml_loss", fn, [x1, x2])

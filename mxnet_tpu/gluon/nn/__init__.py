@@ -1,0 +1,8 @@
+"""Gluon neural-network layers (reference: python/mxnet/gluon/nn/)."""
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *  # noqa: F401,F403
+from . import basic_layers, conv_layers
+from .basic_layers import __all__ as _b
+from .conv_layers import __all__ as _c
+
+__all__ = list(_b) + list(_c)

@@ -1,0 +1,273 @@
+"""Convolution / pooling Gluon layers (reference: gluon/nn/conv_layers.py).
+
+All conv layers carry NC+spatial ("NCHW"-family) layouts like the reference;
+the kernels lower to a single `lax.conv_general_dilated` (ops/nn.py) which
+XLA tiles onto the MXU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...base import MXNetError
+from ...ndarray import nn_ops as FNN
+from ...ndarray import ops as F
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 ndim=2, transpose=False, output_padding=0, dtype="float32",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._ndim = ndim
+        self._kernel = _tup(kernel_size, ndim)
+        self._strides = _tup(strides, ndim)
+        self._padding = _tup(padding, ndim)
+        self._dilation = _tup(dilation, ndim)
+        self._groups = groups
+        self._transpose = transpose
+        self._adj = _tup(output_padding, ndim)
+        self._activation = activation
+        if layout is not None and not layout.startswith("NC"):
+            raise MXNetError(f"only NC-leading layouts supported, got {layout}")
+        if transpose:
+            wshape = (in_channels, channels // groups) + self._kernel
+        else:
+            wshape = (channels, in_channels // groups if in_channels else 0) \
+                + self._kernel
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer)
+        self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                              init=bias_initializer) if use_bias else None
+
+    def _infer(self, x):
+        if self.weight._data is None:
+            in_ch = x.shape[1]
+            if self._transpose:
+                self.weight.shape = (in_ch, self._channels // self._groups) \
+                    + self._kernel
+            else:
+                self.weight.shape = (self._channels, in_ch // self._groups) \
+                    + self._kernel
+            if self.weight._deferred_init_args is not None:
+                self.weight._finish_deferred_init()
+            if self.bias is not None and \
+                    self.bias._deferred_init_args is not None:
+                self.bias._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        b = None if self.bias is None else self.bias.data()
+        if self._transpose:
+            out = FNN.Deconvolution(
+                x, self.weight.data(), b, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, adj=self._adj, num_filter=self._channels,
+                num_group=self._groups, no_bias=b is None)
+        else:
+            out = FNN.Convolution(
+                x, self.weight.data(), b, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, num_filter=self._channels,
+                num_group=self._groups, no_bias=b is None)
+        if self._activation:
+            out = F.Activation(out, act_type=self._activation)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=3, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=1,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=2,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=3,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, pool_type, ndim,
+                 global_pool=False, count_include_pad=True, ceil_mode=False,
+                 layout=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = _tup(pool_size, ndim)
+        self._strides = _tup(strides if strides is not None else pool_size,
+                             ndim)
+        self._padding = _tup(padding, ndim)
+        self._pool_type = pool_type
+        self._global = global_pool
+        self._cip = count_include_pad
+
+    def forward(self, x):
+        return FNN.Pooling(x, kernel=self._kernel, pool_type=self._pool_type,
+                           stride=self._strides, pad=self._padding,
+                           global_pool=self._global,
+                           count_include_pad=self._cip)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, "max", 1,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, "max", 2,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, "max", 3,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, "avg", 1,
+                         count_include_pad=count_include_pad,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, "avg", 2,
+                         count_include_pad=count_include_pad,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, "avg", 3,
+                         count_include_pad=count_include_pad,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class _GlobalPool(HybridBlock):
+    def __init__(self, pool_type, **kwargs):
+        super().__init__(**kwargs)
+        self._pool_type = pool_type
+
+    def forward(self, x):
+        return FNN.Pooling(x, pool_type=self._pool_type, global_pool=True)
+
+
+class GlobalMaxPool1D(_GlobalPool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__("max", **kwargs)
+
+
+class GlobalMaxPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__("max", **kwargs)
+
+
+class GlobalMaxPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__("max", **kwargs)
+
+
+class GlobalAvgPool1D(_GlobalPool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__("avg", **kwargs)
+
+
+class GlobalAvgPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__("avg", **kwargs)
+
+
+class GlobalAvgPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__("avg", **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._padding = padding
+
+    def forward(self, x):
+        p = self._padding
+        pw = (0, 0, 0, 0, p, p, p, p) if isinstance(p, int) else p
+        return F.pad(x, mode="reflect", pad_width=pw)

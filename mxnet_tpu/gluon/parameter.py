@@ -1,0 +1,245 @@
+"""Gluon Parameter: lazily-initialized, device-placed, grad-carrying weights.
+
+Reference analog: python/mxnet/gluon/parameter.py (Parameter :366 _init_impl
+per-ctx replicas, :398 _reduce, :527 row_sparse pull). TPU-native difference:
+instead of N per-device replica arrays kept in sync by a kvstore, a Parameter
+owns ONE logical NDArray which may carry a ``jax.sharding.NamedSharding`` —
+replication/sharding across the mesh is a layout property of the single
+array, and XLA inserts the collectives (SURVEY §2.3 "absorbed" notes).
+``list_data``/``list_grad`` keep API parity for reference-style loops.
+"""
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from ..base import MXNetError, jx_dtype
+from ..context import Context, current_context
+from ..ndarray import ndarray as ndmod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before shape inference completed
+    (reference parameter.py DeferredInitializationError)."""
+
+
+def _shape_incomplete(shape) -> bool:
+    return shape is None or any(s in (0, -1, None) for s in shape)
+
+
+class Parameter:
+    """A weight/state tensor of a Block.
+
+    grad_req: 'write' | 'add' | 'null' (reference semantics). Unknown dims
+    (0/-1) defer allocation until shape inference at first forward.
+    """
+
+    def __init__(self, name: str = "weight", grad_req: str = "write",
+                 shape=None, dtype="float32", lr_mult: float = 1.0,
+                 wd_mult: float = 1.0, init=None, allow_deferred_init: bool = True,
+                 differentiable: bool = True, stype: str = "default",
+                 grad_stype: str = "default"):
+        self._name = name
+        self._uuid = str(uuid.uuid4())
+        if not differentiable:
+            grad_req = "null"
+        self.grad_req = grad_req
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data: Optional[NDArray] = None
+        self._deferred_init_args = None
+        self._sharding = None  # jax NamedSharding once attached to a mesh
+
+    # ---------------- identity ----------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape):
+            raise MXNetError(
+                f"cannot reset shape of {self.name}: rank mismatch "
+                f"{self._shape} vs {new_shape}")
+        merged = []
+        for a, b in zip(self._shape, new_shape):
+            if a in (0, -1, None):
+                merged.append(b)
+            elif b in (0, -1, None) or a == b:
+                merged.append(a)
+            else:
+                raise MXNetError(
+                    f"shape mismatch for {self.name}: {self._shape} vs {new_shape}")
+        self._shape = tuple(merged)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # ---------------- initialization ----------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit: bool = False):
+        """Allocate + fill data (reference parameter.py initialize). With an
+        incomplete shape, records deferred-init args and returns."""
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else current_context()
+        default_init = default_init or init_mod.Uniform()
+        initializer = init if init is not None else self.init
+        if _shape_incomplete(self._shape):
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize {self.name}: shape {self._shape} "
+                    f"incomplete and deferred init not allowed")
+            self._deferred_init_args = (initializer, ctx, default_init)
+            return
+        self._finish_init(initializer, ctx, default_init)
+
+    def _finish_init(self, initializer, ctx, default_init):
+        if initializer is not None:
+            # explicit initializer wins outright — no name-suffix dispatch
+            # (reference: InitDesc attrs['__init__'] bypasses suffix rules)
+            ini = init_mod.create(initializer)
+            arr = NDArray(ini._init_weight(self._name, self._shape,
+                                           jx_dtype(self.dtype)))
+        else:
+            ini = init_mod.create(default_init)
+            arr = ini.init_array(self._name, self._shape,
+                                 jx_dtype(self.dtype))
+        self._data = NDArray(arr._data, ctx=ctx)
+        self._deferred_init_args = None
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+        if self._sharding is not None:
+            self._apply_sharding()
+
+    def _finish_deferred_init(self):
+        if self._deferred_init_args is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} not initialized; call initialize()")
+        if _shape_incomplete(self._shape):
+            raise DeferredInitializationError(
+                f"parameter {self.name} shape {self._shape} still unknown")
+        self._finish_init(*self._deferred_init_args)
+
+    # ---------------- access ----------------
+    def data(self, ctx=None) -> NDArray:
+        if self._data is None:
+            if self._deferred_init_args is not None:
+                self._finish_deferred_init()
+            else:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} not initialized; call initialize()")
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        d = self.data()
+        if d.grad is None:
+            raise MXNetError(
+                f"parameter {self.name} has grad_req='null'; no gradient")
+        return d.grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self.data().context]
+
+    def set_data(self, data):
+        data = data if isinstance(data, NDArray) else NDArray(data)
+        if self._data is None:
+            self.shape = data.shape
+            self._data = data
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+            return
+        if data.shape != self._data.shape:
+            raise MXNetError(
+                f"shape mismatch setting {self.name}: {data.shape} vs "
+                f"{self._data.shape}")
+        self._data._data = data._data.astype(self._data._data.dtype)
+
+    def zero_grad(self):
+        d = self._data
+        if d is not None and d.grad is not None:
+            d.grad._data = d.grad._data * 0
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            had_grad = self._data.grad is not None
+            self._data = self._data.astype(dtype)
+            if had_grad:
+                self._data.attach_grad(self.grad_req)
+
+    # ---------------- sharding (TPU-native extension) ----------------
+    def set_sharding(self, sharding):
+        """Attach a jax NamedSharding; the single logical array is laid out
+        across the mesh (replaces reference per-ctx replica lists)."""
+        self._sharding = sharding
+        if self._data is not None:
+            self._apply_sharding()
+
+    def _apply_sharding(self):
+        import jax
+        self._data._data = jax.device_put(self._data._data, self._sharding)
+        if self._data.grad is not None:
+            self._data.grad._data = jax.device_put(self._data.grad._data,
+                                                   self._sharding)
+
+    # ---------------- misc ----------------
+    @property
+    def var_name(self):
+        return self._name
+
+    def __repr__(self):
+        return (f"Parameter {self._name} (shape={self._shape}, "
+                f"dtype={self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference gluon Constant)."""
+
+    def __init__(self, value, name: str = "const"):
+        value = value if isinstance(value, NDArray) else NDArray(value)
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=str(onp.dtype(str(value._data.dtype))
+                                   if str(value._data.dtype) != "bfloat16"
+                                   else "bfloat16"),
+                         init="zeros", differentiable=False)
+        self._value = value
+        self._data = value
+
+    def initialize(self, *args, **kwargs):
+        pass

@@ -1,0 +1,155 @@
+"""Gluon Trainer: optimizer application + data-parallel gradient reduction.
+
+Reference analog: python/mxnet/gluon/trainer.py (_init_kvstore :188 decision
+matrix, step :334 = allreduce + update, update :411). The TPU-native
+difference is in what "allreduce" means: with one logical array per Parameter
+(possibly mesh-sharded), reduction over devices is either a no-op (replicated
+arrays under pjit get psum'ed by XLA inside the step) or a kvstore pushpull
+for reference-style per-device replica lists.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..kvstore import kvstore as kvs_mod
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, dict):
+            param_items = sorted(params.items())
+            self._params = [p for _, p in param_items]
+            self._param_names = [k for k, _ in param_items]
+        elif isinstance(params, (list, tuple)):
+            self._params = list(params)
+            self._param_names = [p.name for p in params]
+        else:
+            raise MXNetError("params must be a dict or list of Parameters")
+        self._params = [p for p in self._params if p.grad_req != "null"]
+        self._param2idx = {id(p): i for i, p in enumerate(self._params)}
+
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = dict(enumerate(self._params))
+        self._updater = opt_mod.get_updater(self._optimizer)
+
+        self._kvstore_kind = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._compression_params = compression_params
+        self._kv_initialized = False
+        self._scale = 1.0
+        self._contains_sparse = False
+
+    # ---------------- properties ----------------
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self._optimizer.learning_rate = lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.learning_rate = lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # ---------------- kvstore setup (reference trainer.py:188) -------------
+    def _init_kvstore(self):
+        if self._kvstore_kind is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            self._kvstore = kvs_mod.create(self._kvstore_kind) \
+                if isinstance(self._kvstore_kind, str) else self._kvstore_kind
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            if self._update_on_kvstore is None:
+                # single-worker: updating locally is cheaper; dist sync
+                # stores traditionally update on store
+                self._update_on_kvstore = \
+                    self._kvstore.num_workers > 1 and \
+                    "dist" in getattr(self._kvstore, "type", "")
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            # seed store with current weights
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    # ---------------- core ----------------
+    def step(self, batch_size: int, ignore_stale_grad: bool = False):
+        """allreduce gradients then apply optimizer
+        (reference trainer.py:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            grads = p.list_grad()
+            if self._update_on_kvstore:
+                self._kvstore.push(i, grads)
+            else:
+                self._kvstore.pushpull(i, grads)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False):
+        """Apply optimizer only (grads assumed reduced;
+        reference trainer.py:411)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if self._update_on_kvstore:
+                # store ran the optimizer during push; pull fresh weights
+                self._kvstore.pull(i, p.list_data())
+                continue
+            data = p.data()
+            if not ignore_stale_grad and p.grad_req != "null" \
+                    and data.grad is not None and not data.fresh_grad:
+                raise MXNetError(
+                    f"gradient of parameter {p.name} has not been updated "
+                    "by backward since the last step; set "
+                    "ignore_stale_grad=True to suppress")
+            self._updater(i, p.grad(), data)
+            data.fresh_grad = False
+
+    # ---------------- persistence (reference trainer.py:477,506) -----------
+    def save_states(self, fname: str):
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states(dump_optimizer=True))
+
+    def load_states(self, fname: str):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())

@@ -1,0 +1,82 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py).
+
+``split_and_load`` keeps its API but gains a TPU-native mode: with
+``even_split`` over a device list it returns per-device slices like the
+reference; with a mesh axis (parallel module) the idiomatic path is a single
+batch-sharded array instead.
+"""
+from __future__ import annotations
+
+import math
+from typing import List
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+
+
+def split_data(data, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"cannot evenly split batch of {size} into {num_slice} slices")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, lo, hi))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Split batch along batch_axis and load each slice onto one ctx
+    (reference gluon.utils.split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = NDArray(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm: float, check_isfinite: bool = True):
+    """Rescale arrays so the joint L2 norm <= max_norm (reference
+    gluon.utils.clip_global_norm)."""
+    total = 0.0
+    norms = []
+    for a in arrays:
+        n2 = float((a * a).sum().asnumpy())
+        norms.append(n2)
+        total += n2
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        raise MXNetError(f"global norm is not finite: {total}")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference gluon.utils.download. This build runs zero-egress; only
+    file:// and existing local paths are served."""
+    import os
+    import shutil
+    if url.startswith("file://"):
+        src = url[7:]
+        dst = path or os.path.basename(src)
+        if os.path.isdir(dst):
+            dst = os.path.join(dst, os.path.basename(src))
+        if not os.path.exists(dst) or overwrite:
+            shutil.copyfile(src, dst)
+        return dst
+    if os.path.exists(url):
+        return url
+    raise MXNetError(
+        "network downloads unavailable (zero-egress environment); "
+        f"cannot fetch {url}")

@@ -1,0 +1,3 @@
+"""``mx.init`` alias namespace (reference exposes initializers both ways)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import __all__  # noqa: F401

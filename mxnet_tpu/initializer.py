@@ -1,0 +1,221 @@
+"""Weight initializers (reference: python/mxnet/initializer.py).
+
+Each initializer is a callable producing the initial value for a parameter
+shape/dtype. Registered by lowercase alias so ``init="xavier"`` strings work
+like the reference's registry.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, jx_dtype
+from .ndarray import random as nd_random
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "registry", "create"]
+
+registry = {}
+
+
+def _register(name):
+    def deco(cls):
+        registry[name.lower()] = cls
+        return cls
+    return deco
+
+
+class Initializer:
+    """Base initializer. Subclasses implement _init_weight(name, shape, dtype)
+    returning a jax array."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name_or_arr, arr: Optional[NDArray] = None):
+        """Either init(name, arr) like the reference or init(arr)."""
+        if arr is None:
+            name, arr = "", name_or_arr
+        else:
+            name = str(name_or_arr)
+        arr._data = self.init_array(name, arr.shape, arr._data.dtype)._data
+        return arr
+
+    def init_array(self, name: str, shape, dtype) -> NDArray:
+        lname = name.lower()
+        if lname.endswith("bias") or lname.endswith("beta") \
+                or lname.endswith("running_mean") or lname.endswith("moving_mean"):
+            return NDArray(jnp.zeros(shape, dtype))
+        if lname.endswith("gamma") or lname.endswith("running_var") \
+                or lname.endswith("moving_var"):
+            return NDArray(jnp.ones(shape, dtype))
+        return NDArray(self._init_weight(name, shape, dtype))
+
+    def _init_weight(self, name, shape, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@_register("zeros")
+@_register("zero")
+class Zero(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@_register("ones")
+@_register("one")
+class One(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+@_register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@_register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        return jax.random.uniform(nd_random.next_key(), shape, dtype,
+                                  -self.scale, self.scale)
+
+
+@_register("normal")
+@_register("gaussian")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, dtype):
+        return self.sigma * jax.random.normal(nd_random.next_key(), shape, dtype)
+
+
+@_register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, shape, dtype):
+        rows = shape[0]
+        cols = int(onp.prod(shape[1:])) if len(shape) > 1 else 1
+        key = nd_random.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (max(rows, cols), min(rows, cols)),
+                                     jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (max(rows, cols), min(rows, cols)),
+                                    jnp.float32)
+        q, _ = jnp.linalg.qr(tmp)
+        q = q.T if rows < cols else q
+        return (self.scale * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+@_register("xavier")
+class Xavier(Initializer):
+    """Glorot init (reference initializer.py Xavier): factor by fan avg/in/out,
+    magnitude scales the bound."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _fans(self, shape):
+        hw = int(onp.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+        fan_out = shape[0] * hw
+        return fan_in, fan_out
+
+    def _init_weight(self, name, shape, dtype):
+        fan_in, fan_out = self._fans(shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / factor)
+        key = nd_random.next_key()
+        if self.rnd_type == "uniform":
+            return jax.random.uniform(key, shape, dtype, -scale, scale)
+        return scale * jax.random.normal(key, shape, dtype)
+
+
+@_register("msraprelu")
+class MSRAPrelu(Xavier):
+    """He init variant (reference MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@_register("bilinear")
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference Bilinear init for Deconv)."""
+
+    def _init_weight(self, name, shape, dtype):
+        weight = onp.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype)
+
+
+@_register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, shape, dtype):
+        b = onp.zeros(shape, dtype="float32")
+        n = shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        return jnp.asarray(b, dtype)
+
+
+def create(init, **kwargs) -> Initializer:
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform()
+    if isinstance(init, str):
+        try:
+            return registry[init.lower()](**kwargs)
+        except KeyError as e:
+            raise MXNetError(f"unknown initializer {init!r}") from e
+    raise MXNetError(f"cannot create initializer from {init!r}")

@@ -1,0 +1,5 @@
+"""KVStore: data-parallel gradient aggregation (reference: src/kvstore/ +
+python/mxnet/kvstore/)."""
+from .base import KVStoreBase
+from .kvstore import KVStore, KVStoreTPU, create
+from . import base, kvstore

@@ -1,0 +1,50 @@
+"""Pluggable KVStore backend registry.
+
+Reference analog: python/mxnet/kvstore/base.py:74,220 — KVStoreBase.register
+lets Horovod/BytePS-style backends plug in by name. Here the default backend
+is 'tpu' (ICI collectives); the registry is preserved so external backends
+can still be registered.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreBase"]
+
+
+class KVStoreBase:
+    """Backend interface: broadcast + pushpull (2.0-era API; reference
+    kvstore/base.py)."""
+
+    kv_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return capability in ("optimizer", "int_keys")
+
+    # ---- interface ----
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @property
+    def type(self) -> str:
+        return type(self).__name__.lower()
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1

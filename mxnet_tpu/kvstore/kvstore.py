@@ -1,0 +1,242 @@
+"""KVStore implementations.
+
+Reference analog: src/kvstore/ — KVStoreLocal's CPU/GPU Comm trees
+(comm.h:104,452), KVStoreNCCL (kvstore_nccl.h:62), and the ps-lite
+KVStoreDist (kvstore_dist.h). TPU-native collapse (SURVEY §2.3): ALL of those
+become one 'tpu' backend. Single-process multi-device reduction is a jnp sum
+(XLA inserts the device transfers); when arrays are sharded over a
+jax.sharding Mesh, the reduction IS `psum` over the mesh axis and rides ICI;
+multi-host uses the same code over a global mesh via jax.distributed
+(DCN-spanning collectives) — see parallel/dist.py.
+
+API parity: both the legacy int/str-keyed init/push/pull surface
+(include/mxnet/kvstore.h:59-497) and the 2.0 broadcast/pushpull surface.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, get_env
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["KVStore", "KVStoreTPU", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _reduce_sum(values: List[NDArray]) -> NDArray:
+    """Sum per-device replica arrays. XLA handles cross-device copies; with
+    a sharded mesh array this lowers to psum over ICI (the CommDevice /
+    CommDeviceTree / NCCL paths of the reference collapse here)."""
+    if len(values) == 1:
+        return NDArray(values[0]._data)
+    acc = values[0]._data
+    for v in values[1:]:
+        acc = acc + v._data
+    return NDArray(acc)
+
+
+@KVStoreBase.register
+class KVStoreTPU(KVStoreBase):
+    """The 'tpu' backend (reference north star: kvstore='tpu').
+
+    Also serves as 'local'/'device'/'nccl' — on TPU those distinctions
+    (CPU-reduce vs GPU merge-buffer vs NCCL ring) are mesh-layout choices
+    XLA makes, not code paths.
+    """
+
+    def __init__(self, name: str = "tpu"):
+        self._name = name
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_states: Dict[str, tuple] = {}
+        self._compression = None
+
+    @property
+    def type(self) -> str:
+        return self._name
+
+    # ---------------- 2.0 API ----------------
+    def broadcast(self, key, value, out, priority=0):
+        value = _as_list(value)
+        merged = _reduce_sum(value) if len(value) > 1 else value[0]
+        self._store[str(key)] = NDArray(merged._data)
+        for o in _as_list(out):
+            o._data = merged._data
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        values = _as_list(value)
+        if self._compression is not None:
+            values = [self._compression.compress_decompress(v)
+                      for v in values]
+        merged = _reduce_sum(values)
+        if self._updater is not None:
+            skey = str(key)
+            if skey not in self._store:
+                self._store[skey] = NDArray(merged._data)
+            self._updater(key, merged, self._store[skey])
+            result = self._store[skey]
+        else:
+            result = merged
+        if out is None:
+            for v in values:
+                v._data = result._data
+            return value
+        for o in _as_list(out):
+            o._data = result._data
+        return out
+
+    # ---------------- legacy API (reference kvstore.h) ----------------
+    def init(self, key, value):
+        keys = _as_list(key) if isinstance(key, (list, tuple)) else [key]
+        values = _as_list(value)
+        for k, v in zip(keys, values):
+            self._store[str(k)] = NDArray(v._data)
+
+    def push(self, key, value, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        if len(keys) == 1:
+            grouped = {str(keys[0]): _as_list(value)}
+        else:
+            grouped = {}
+            for k, v in zip(keys, value):
+                grouped.setdefault(str(k), []).extend(_as_list(v))
+        for k, vals in grouped.items():
+            merged = _reduce_sum(vals)
+            if self._updater is not None:
+                if k not in self._store:
+                    self._store[k] = NDArray(merged._data)
+                self._updater(_int_or_str(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = _as_list(out)
+        if len(keys) == 1:
+            for o in outs:
+                o._data = self._store[str(keys[0])]._data
+        else:
+            for k, o in zip(keys, outs):
+                for oo in _as_list(o):
+                    oo._data = self._store[str(k)]._data
+        return out
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull (reference kvstore row_sparse_pull): gathers only the
+        requested rows."""
+        from ..ndarray import sparse as nd_sparse
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = _as_list(out)
+        rids = _as_list(row_ids)
+        for k, o, r in zip(keys, outs, rids):
+            full = self._store[str(k)]
+            rows = r._data.astype(jnp.int32)
+            vals = jnp.take(full._data, rows, axis=0)
+            o._data = jnp.zeros_like(full._data).at[rows].set(vals)
+        return out
+
+    # ---------------- optimizer-on-store ----------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def is_capable(self, capability: str) -> bool:
+        return capability in ("optimizer", "int_keys")
+
+    # ---------------- compression ----------------
+    def set_gradient_compression(self, compression_params):
+        from ..parallel.compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
+
+    # ---------------- topology ----------------
+    @property
+    def rank(self) -> int:
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self) -> int:
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def barrier(self):
+        """Global sync point (reference kvstore barrier). Within one process
+        this is a device sync; multi-host riding jax.distributed it is a
+        cross-host barrier."""
+        from .. import engine
+        engine.get().wait_for_all()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _int_or_str(k: str):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+class KVStoreDist(KVStoreTPU):
+    """Multi-host store (reference kvstore_dist.h over ps-lite). TPU-native:
+    rides the jax.distributed runtime — every worker holds a shard of the
+    global mesh and pushpull lowers to DCN-spanning allreduce. Requires
+    jax.distributed.initialize() (see parallel/dist.py launch helper)."""
+
+    def __init__(self, name: str = "dist_sync"):
+        super().__init__(name)
+        self._async = "async" in name
+
+
+# name → class resolution (reference factory kvstore.cc:41-79)
+_ALIASES = {
+    "local": KVStoreTPU, "device": KVStoreTPU, "tpu": KVStoreTPU,
+    "nccl": KVStoreTPU,
+    "dist": KVStoreDist, "dist_sync": KVStoreDist, "dist_async": KVStoreDist,
+    "dist_device_sync": KVStoreDist, "p3": KVStoreDist,
+}
+
+
+def create(name: str = "local") -> KVStoreTPU:
+    """Create a KVStore (reference kvstore.create / factory
+    src/kvstore/kvstore.cc:41)."""
+    if isinstance(name, KVStoreBase):
+        return name
+    lname = name.lower()
+    if lname in _ALIASES:
+        return _ALIASES[lname](lname)
+    if lname in KVStoreBase.kv_registry:
+        return KVStoreBase.kv_registry[lname]()
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+KVStore = KVStoreTPU

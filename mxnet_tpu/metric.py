@@ -1,0 +1,378 @@
+"""Evaluation metrics (reference: python/mxnet/gluon/metric.py — 32 classes).
+
+Metrics accumulate on host in float64 like the reference; update() accepts
+NDArrays or numpy arrays.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+           "Perplexity", "F1", "MCC", "PearsonCorrelation", "Loss",
+           "Torch", "Caffe", "CustomMetric", "np", "create", "PCC"]
+
+_registry = {}
+
+
+def _register(*names):
+    def deco(cls):
+        for n in names:
+            _registry[n.lower()] = cls
+        return cls
+    return deco
+
+
+def _to_numpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if len(labels) != len(preds):
+        raise MXNetError(
+            f"labels/preds count mismatch: {len(labels)} vs {len(preds)}")
+
+
+class EvalMetric:
+    """Base metric (reference metric.py EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def update_dict(self, label, pred):
+        self.update(list(label.values()), list(pred.values()))
+
+    def __repr__(self):
+        return f"EvalMetric: {dict([self.get()])}"
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str)
+                            else metric)
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@_register("accuracy", "acc")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = _as_list(labels), _as_list(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_numpy(label)
+            pred = _to_numpy(pred)
+            if pred.ndim > label.ndim:
+                pred = onp.argmax(pred, axis=self.axis)
+            pred = pred.astype("int64").flatten()
+            label = label.astype("int64").flatten()
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@_register("top_k_accuracy", "topkaccuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype("int64").flatten()
+            pred = _to_numpy(pred)
+            topk = onp.argsort(-pred, axis=-1)[:, :self.top_k]
+            self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
+            self.num_inst += len(label)
+
+
+@_register("mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_numpy(label), _to_numpy(pred)
+            self.sum_metric += float(onp.abs(label.reshape(pred.shape)
+                                             - pred).mean())
+            self.num_inst += 1
+
+
+@_register("mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_numpy(label), _to_numpy(pred)
+            self.sum_metric += float(((label.reshape(pred.shape)
+                                       - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@_register("rmse")
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.sqrt(self.sum_metric / self.num_inst)
+
+
+@_register("ce", "crossentropy", "cross-entropy")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype("int64").flatten()
+            pred = _to_numpy(pred)
+            prob = pred[onp.arange(label.shape[0]), label]
+            self.sum_metric += float((-onp.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@_register("nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@_register("perplexity")
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        super().__init__(name, **kwargs)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype("int64").reshape(-1)
+            pred = _to_numpy(pred).reshape(label.shape[0], -1)
+            prob = pred[onp.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = label == self.ignore_label
+                prob = prob[~ignore]
+            self.sum_metric += float(-onp.log(onp.maximum(prob, 1e-10)).sum())
+            self.num_inst += prob.shape[0]
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.exp(self.sum_metric / self.num_inst)
+
+
+@_register("f1")
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self.reset_stats()
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype("int64").flatten()
+            pred = _to_numpy(pred)
+            if pred.ndim > 1:
+                pred = onp.argmax(pred, axis=-1)
+            pred = pred.astype("int64").flatten()
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+@_register("mcc")
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype("int64").flatten()
+            pred = _to_numpy(pred)
+            if pred.ndim > 1:
+                pred = onp.argmax(pred, axis=-1)
+            pred = pred.astype("int64").flatten()
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        den = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return self.name, (tp * tn - fp * fn) / den if den else 0.0
+
+
+@_register("pearsonr", "pcc")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._labels, self._preds = [], []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._labels.append(_to_numpy(label).flatten())
+            self._preds.append(_to_numpy(pred).flatten())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        l = onp.concatenate(self._labels)
+        p = onp.concatenate(self._preds)
+        return self.name, float(onp.corrcoef(l, p)[0, 1])
+
+
+PCC = PearsonCorrelation
+
+
+@_register("loss")
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            loss = _to_numpy(pred)
+            self.sum_metric += float(loss.sum())
+            self.num_inst += loss.size
+
+
+class Torch(Loss):
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+class Caffe(Loss):
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+@_register("custom")
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            v = self._feval(_to_numpy(label), _to_numpy(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric (reference metric.np)."""
+    return CustomMetric(numpy_feval, name, allow_extra_outputs)
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        return CompositeEvalMetric([create(m) for m in metric])
+    try:
+        return _registry[metric.lower()](*args, **kwargs)
+    except KeyError as e:
+        raise MXNetError(f"unknown metric {metric!r}") from e

@@ -7,6 +7,8 @@ a C++ registry.
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       linspace, eye, concatenate, waitall, from_jax, moveaxis)
 from .ops import *  # noqa: F401,F403
+from .nn_ops import *  # noqa: F401,F403
 from . import ops as op
 from . import random
+from . import sparse
 from .utils import save, load

@@ -1,0 +1,145 @@
+"""Legacy NN op wrappers (``mx.nd.Convolution`` etc.) over ops/nn.py kernels.
+
+Reference analog: the generated wrappers for src/operator/nn/ registrations.
+Parameter names/semantics follow the reference ops so model code ports 1:1.
+"""
+from __future__ import annotations
+
+from .. import _tape
+from ..base import MXNetError
+from ..ops import nn as K
+from ..ops.registry import invoke_raw
+from .ndarray import NDArray
+
+__all__ = ["Convolution", "Deconvolution", "Pooling", "BatchNorm",
+           "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
+           "LRN", "UpSampling"]
+
+
+def _wrap(x):
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **_ignored):
+    data, weight = _wrap(data), _wrap(weight)
+    if no_bias or bias is None:
+        return invoke_raw(
+            "convolution",
+            lambda x, w: K.conv(x, w, None, stride, dilate, pad, num_group),
+            [data, weight])
+    return invoke_raw(
+        "convolution",
+        lambda x, w, b: K.conv(x, w, b, stride, dilate, pad, num_group),
+        [data, weight, _wrap(bias)])
+
+
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, target_shape=None, **_ignored):
+    data, weight = _wrap(data), _wrap(weight)
+    if no_bias or bias is None:
+        return invoke_raw(
+            "deconvolution",
+            lambda x, w: K.conv_transpose(x, w, None, stride, dilate, pad,
+                                          adj, num_group),
+            [data, weight])
+    return invoke_raw(
+        "deconvolution",
+        lambda x, w, b: K.conv_transpose(x, w, b, stride, dilate, pad, adj,
+                                         num_group),
+        [data, weight, _wrap(bias)])
+
+
+def Pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
+            global_pool=False, count_include_pad=True, pooling_convention=None,
+            **_ignored):
+    data = _wrap(data)
+    if global_pool:
+        return invoke_raw("global_pool",
+                          lambda x: K.global_pool(x, pool_type), [data])
+    return invoke_raw(
+        "pooling",
+        lambda x: K.pool(x, kernel, pool_type, stride, pad, count_include_pad),
+        [data])
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              output_mean_var=False, axis=1, **_ignored):
+    """Imperative BatchNorm. In training mode returns normalized output and
+    updates moving stats in place on the passed arrays (the Gluon layer calls
+    the functional kernels directly for the hybridized path)."""
+    data = _wrap(data)
+    gamma, beta = _wrap(gamma), _wrap(beta)
+    mm, mv = _wrap(moving_mean), _wrap(moving_var)
+    training = _tape.is_training() and not use_global_stats
+    if fix_gamma:
+        gamma = NDArray(gamma._data * 0 + 1)
+    if not training:
+        return invoke_raw(
+            "batch_norm",
+            lambda x, g, b, m, v: K.batch_norm_infer(x, g, b, m, v, eps),
+            [data, gamma, beta, mm, mv])
+    out, bm, bv = invoke_raw(
+        "batch_norm",
+        lambda x, g, b: K.batch_norm_train(x, g, b, eps),
+        [data, gamma, beta], n_outputs=3)
+    # update running stats outside the recorded graph (stats reused from the
+    # same kernel invocation; batch mean/var get zero cotangents)
+    with _tape_paused():
+        mm._data = momentum * mm._data + (1 - momentum) * bm._data
+        mv._data = momentum * mv._data + (1 - momentum) * bv._data
+    return out
+
+
+class _tape_paused:
+    def __enter__(self):
+        self._old = _tape.set_recording(False)
+
+    def __exit__(self, *exc):
+        _tape.set_recording(self._old)
+
+
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, **_ignored):
+    return invoke_raw(
+        "layer_norm",
+        lambda x, g, b: K.layer_norm(x, g, b, axis, eps),
+        [_wrap(data), _wrap(gamma), _wrap(beta)])
+
+
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5, **_ignored):
+    return invoke_raw(
+        "group_norm",
+        lambda x, g, b: K.group_norm(x, g, b, num_groups, eps),
+        [_wrap(data), _wrap(gamma), _wrap(beta)])
+
+
+def InstanceNorm(data, gamma, beta, eps=1e-5, **_ignored):
+    return invoke_raw(
+        "instance_norm",
+        lambda x, g, b: K.instance_norm(x, g, b, eps),
+        [_wrap(data), _wrap(gamma), _wrap(beta)])
+
+
+def L2Normalization(data, eps=1e-10, mode="instance", **_ignored):
+    return invoke_raw("l2_normalization",
+                      lambda x: K.l2_norm(x, eps=eps, mode=mode), [_wrap(data)])
+
+
+def LRN(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0, **_ignored):
+    return invoke_raw("lrn",
+                      lambda x: K.lrn(x, nsize, alpha, beta, knorm),
+                      [_wrap(data)])
+
+
+def UpSampling(data, scale=2, sample_type="nearest", num_args=1, **_ignored):
+    import jax
+    data = _wrap(data)
+
+    def fn(x):
+        n, c, h, w = x.shape
+        method = "nearest" if sample_type == "nearest" else "linear"
+        return jax.image.resize(x, (n, c, h * scale, w * scale), method=method)
+    return invoke_raw("upsampling", fn, [data])

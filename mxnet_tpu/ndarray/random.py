@@ -42,10 +42,28 @@ def seed(seed_state, ctx="all"):
 
 
 def next_key():
+    """Split off a fresh PRNG key. Inside a hybridized (jit) trace, keys
+    derive from the traced per-call key so dropout etc. stays random across
+    calls instead of baking one mask into the compiled program."""
+    stack = getattr(_state, "trace_keys", None)
+    if stack:
+        k, sub = jax.random.split(stack[-1])
+        stack[-1] = k
+        return sub
     k = _key_state()
     k, sub = jax.random.split(k)
     _state.key = k
     return sub
+
+
+def push_trace_key(key):
+    if not hasattr(_state, "trace_keys"):
+        _state.trace_keys = []
+    _state.trace_keys.append(key)
+
+
+def pop_trace_key():
+    _state.trace_keys.pop()
 
 
 def _maybe_out(res, out):

@@ -1,0 +1,207 @@
+"""Sparse storage types: row_sparse and csr.
+
+Reference analog: ndarray.h:61-65 storage types + python/mxnet/ndarray/sparse.py.
+XLA has no first-class sparsity (SURVEY §7 hard parts), so these are
+structured wrappers: the compressed representation lives in dense index/value
+arrays (TPU-friendly — gathers/scatters are XLA ops on the MXU/VPU), and any
+op without a sparse-aware path falls back to the dense form, mirroring the
+reference's storage-fallback mechanism (``FInferStorageType`` fallback casts,
+src/common/exec_utils.h).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, jx_dtype
+from .ndarray import NDArray, _put
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "retain",
+           "sparse_dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; behaves as its dense form for any generic op (dense
+    fallback), while keeping the compressed arrays for sparse-aware paths."""
+
+    __slots__ = ("_aux",)
+
+    @property
+    def stype(self) -> str:
+        raise NotImplementedError
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return NDArray(self._data)
+        return cast_storage(self, stype)
+
+    def asdense(self) -> NDArray:
+        return NDArray(self._data)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse tensor: (indices, values-rows) (reference
+    RowSparseNDArray; used for sparse gradients of Embedding/FC)."""
+
+    @property
+    def stype(self) -> str:
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return self._aux["indices"]
+
+    @property
+    def data(self) -> NDArray:
+        return self._aux["values"]
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        return retain(self, row_ids)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference CSRNDArray)."""
+
+    @property
+    def stype(self) -> str:
+        return "csr"
+
+    @property
+    def indices(self) -> NDArray:
+        return self._aux["indices"]
+
+    @property
+    def indptr(self) -> NDArray:
+        return self._aux["indptr"]
+
+    @property
+    def data(self) -> NDArray:
+        return self._aux["values"]
+
+
+def _make_row_sparse(dense_data, indices, values) -> RowSparseNDArray:
+    out = RowSparseNDArray.__new__(RowSparseNDArray)
+    out._init_empty()
+    out._data = dense_data
+    out._aux = {"indices": NDArray(indices), "values": NDArray(values)}
+    return out
+
+
+def _make_csr(dense_data, data, indices, indptr) -> CSRNDArray:
+    out = CSRNDArray.__new__(CSRNDArray)
+    out._init_empty()
+    out._data = dense_data
+    out._aux = {"values": NDArray(data), "indices": NDArray(indices),
+                "indptr": NDArray(indptr)}
+    return out
+
+
+def row_sparse_array(arg1, shape: Optional[Tuple[int, ...]] = None,
+                     ctx=None, dtype=None) -> RowSparseNDArray:
+    """Create from (values, indices) or a dense array (reference
+    mx.nd.sparse.row_sparse_array)."""
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
+        values, indices = arg1
+        values = values._data if isinstance(values, NDArray) \
+            else jnp.asarray(values, jx_dtype(dtype))
+        indices = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int32)
+        if shape is None:
+            nrows = int(jnp.max(indices)) + 1 if indices.size else 0
+            shape = (nrows,) + tuple(values.shape[1:])
+        dense = jnp.zeros(shape, values.dtype) \
+            .at[indices.astype(jnp.int32)].set(values)
+        return _make_row_sparse(_put(dense, ctx), indices, values)
+    d = arg1._data if isinstance(arg1, NDArray) else jnp.asarray(arg1)
+    return cast_storage(NDArray(d), "row_sparse")
+
+
+def csr_matrix(arg1, shape: Optional[Tuple[int, ...]] = None, ctx=None,
+               dtype=None) -> CSRNDArray:
+    """Create from (data, indices, indptr) or dense (reference
+    mx.nd.sparse.csr_matrix)."""
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = (
+            a._data if isinstance(a, NDArray) else jnp.asarray(a)
+            for a in arg1)
+        data = data.astype(jx_dtype(dtype)) if dtype else data
+        indices = indices.astype(jnp.int32)
+        indptr = indptr.astype(jnp.int32)
+        if shape is None:
+            ncols = int(jnp.max(indices)) + 1 if indices.size else 0
+            shape = (len(indptr) - 1, ncols)
+        # expand indptr -> row ids, scatter into dense
+        counts = indptr[1:] - indptr[:-1]
+        row_ids = jnp.repeat(jnp.arange(shape[0]), counts,
+                             total_repeat_length=data.shape[0])
+        dense = jnp.zeros(shape, data.dtype) \
+            .at[row_ids, indices.astype(jnp.int32)].set(data)
+        return _make_csr(_put(dense, ctx), data, indices, indptr)
+    d = arg1._data if isinstance(arg1, NDArray) else jnp.asarray(arg1)
+    return cast_storage(NDArray(d), "csr")
+
+
+def cast_storage(arr: NDArray, stype: str):
+    """Convert between storage types (reference cast_storage op)."""
+    if stype == "default":
+        return NDArray(arr._data)
+    dense = onp.asarray(arr._data)
+    if stype == "row_sparse":
+        nz_rows = onp.nonzero(dense.reshape(dense.shape[0], -1)
+                              .any(axis=1))[0]
+        return _make_row_sparse(arr._data, jnp.asarray(nz_rows, jnp.int32),
+                                jnp.asarray(dense[nz_rows]))
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr storage requires a 2-D array")
+        indptr = [0]
+        indices, values = [], []
+        for row in dense:
+            nz = onp.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            values.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return _make_csr(arr._data,
+                         jnp.asarray(onp.array(values, dense.dtype)),
+                         jnp.asarray(onp.array(indices, onp.int32)),
+                         jnp.asarray(onp.array(indptr, onp.int32)))
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def retain(arr: RowSparseNDArray, row_ids) -> RowSparseNDArray:
+    """Keep only the requested rows (reference sparse retain op — the
+    row_sparse pull-on-demand primitive, parameter.py:527)."""
+    rids = row_ids._data if isinstance(row_ids, NDArray) \
+        else jnp.asarray(row_ids)
+    rids = rids.astype(jnp.int32)
+    vals = jnp.take(arr._data, rids, axis=0)
+    dense = jnp.zeros_like(arr._data).at[rids].set(vals)
+    return _make_row_sparse(dense, rids.astype(jnp.int32), vals)
+
+
+def sparse_dot(lhs, rhs, transpose_a=False) -> NDArray:
+    """dot(csr, dense) (reference sparse dot). The compressed values ride a
+    segment-sum; on TPU the dense fallback is usually faster for the shapes
+    the MXU likes, so small nnz uses gather+segment_sum, else dense dot."""
+    if isinstance(lhs, CSRNDArray) and not transpose_a:
+        data = lhs._aux["values"]._data
+        indices = lhs._aux["indices"]._data.astype(jnp.int32)
+        indptr = lhs._aux["indptr"]._data
+        counts = indptr[1:] - indptr[:-1]
+        row_ids = jnp.repeat(jnp.arange(lhs.shape[0]), counts,
+                             total_repeat_length=data.shape[0])
+        rhs_rows = jnp.take(rhs._data, indices, axis=0)
+        contrib = rhs_rows * data[:, None]
+        out = jax.ops.segment_sum(contrib, row_ids,
+                                  num_segments=lhs.shape[0])
+        return NDArray(out)
+    return NDArray(jnp.matmul(
+        lhs._data.T if transpose_a else lhs._data, rhs._data))
+
+
+dot = sparse_dot

@@ -1,0 +1,252 @@
+"""Neural-network ops: convolution, pooling, normalization.
+
+Reference analog: src/operator/nn/ (~31k LoC: conv via im2col/cuDNN, pooling
+kernels, batch/layer/group/instance norm CPU+CUDA kernels). TPU-native design:
+everything lowers to XLA's native conv/reduce-window/reduce emitters —
+`lax.conv_general_dilated` maps directly onto the MXU, and XLA fuses the
+normalization arithmetic into surrounding ops, absorbing what the reference's
+cuDNN/MKLDNN vendor layers did by hand (SURVEY §2.2 note).
+
+Layout: the public API is NCHW/NCW/NCDHW like the reference ops; XLA's TPU
+layout assignment transposes internally to the MXU-friendly layout, so we keep
+API parity without a perf tax.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["conv", "conv_transpose", "pool", "global_pool", "batch_norm_infer",
+           "batch_norm_train", "layer_norm", "group_norm", "instance_norm",
+           "l2_norm", "lrn", "adaptive_avg_pool", "bilinear_resize"]
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t + t[-1:] * (n - len(t))
+
+
+def _conv_dn(ndim: int):
+    """NC+spatial dimension numbers for lax.conv_general_dilated."""
+    sp = "DHW"[3 - ndim:]
+    return lax.conv_dimension_numbers(
+        (1, 1) + (1,) * ndim,  # dummy shapes; only layout strings matter
+        (1, 1) + (1,) * ndim,
+        ("NC" + sp, "OI" + sp, "NC" + sp))
+
+
+def conv(x, w, b=None, stride=None, dilate=None, pad=None, num_group: int = 1):
+    """N-d convolution, NC+spatial layout (reference Convolution op,
+    src/operator/nn/convolution.cc). Lowers to one XLA conv → MXU."""
+    ndim = x.ndim - 2
+    stride = _tup(stride, ndim)
+    dilate = _tup(dilate, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    dn = _conv_dn(ndim)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+def conv_transpose(x, w, b=None, stride=None, dilate=None, pad=None,
+                   adj=None, num_group: int = 1):
+    """Transposed convolution (reference Deconvolution op). Implemented as
+    the gradient of conv: lhs-dilated XLA conv."""
+    ndim = x.ndim - 2
+    stride = _tup(stride, ndim)
+    dilate = _tup(dilate, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    adj = _tup(adj if adj is not None else 0, ndim)
+    dn = _conv_dn(ndim)
+    k = w.shape[2:]
+    # effective kernel extent with dilation
+    eff = [(kk - 1) * dd + 1 for kk, dd in zip(k, dilate)]
+    padding = [(e - 1 - p, e - 1 - p + a)
+               for e, p, a in zip(eff, pad, adj)]
+    # flip spatial dims and swap I/O channels for the gradient-conv form
+    wt = jnp.flip(w, axis=tuple(range(2, 2 + ndim)))
+    if num_group > 1:
+        o, i = wt.shape[0], wt.shape[1]
+        wt = wt.reshape((num_group, o // num_group, i) + k)
+        wt = jnp.swapaxes(wt, 1, 2)
+        wt = wt.reshape((num_group * i, o // num_group) + k)
+    else:
+        wt = jnp.swapaxes(wt, 0, 1)
+    out = lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * ndim,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+def pool(x, kernel, pool_type: str = "max", stride=None, pad=None,
+         count_include_pad: bool = True):
+    """Max/avg/sum/lp pooling via XLA reduce_window (reference Pooling op)."""
+    ndim = x.ndim - 2
+    kernel = _tup(kernel, ndim)
+    stride = _tup(stride if stride is not None else kernel, ndim)
+    pad = _tup(pad if pad is not None else 0, ndim)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad or all(p == 0 for p in pad):
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones(x.shape, x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.abs(x) ** 2, 0.0, lax.add, window, strides,
+                              padding)
+        return jnp.sqrt(s)
+    raise MXNetError(f"unknown pool_type {pool_type}")
+
+
+def global_pool(x, pool_type: str = "max"):
+    axes = tuple(range(2, x.ndim))
+    if pool_type == "max":
+        return jnp.max(x, axis=axes, keepdims=True)
+    if pool_type == "avg":
+        return jnp.mean(x, axis=axes, keepdims=True)
+    return jnp.sum(x, axis=axes, keepdims=True)
+
+
+def adaptive_avg_pool(x, output_size):
+    """Reference contrib.AdaptiveAvgPooling2D."""
+    n, c, h, w = x.shape
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    if h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    # general case: interp-style averaging via image resize of the integral
+    return jax.image.resize(x, (n, c, oh, ow), method="linear")
+
+
+def bilinear_resize(x, height: int, width: int, align_corners: bool = False):
+    """Reference contrib.BilinearResize2D."""
+    n, c, h, w = x.shape
+    return jax.image.resize(x, (n, c, height, width), method="linear")
+
+
+def _bcast_stats(ndim, v):
+    return v.reshape((1, -1) + (1,) * (ndim - 2))
+
+
+def batch_norm_infer(x, gamma, beta, moving_mean, moving_var, eps: float):
+    """Inference-mode BN: normalize with running stats."""
+    mm, mv = _bcast_stats(x.ndim, moving_mean), _bcast_stats(x.ndim, moving_var)
+    g, b = _bcast_stats(x.ndim, gamma), _bcast_stats(x.ndim, beta)
+    inv = lax.rsqrt(mv + eps)
+    return (x - mm) * inv * g + b
+
+
+def batch_norm_train(x, gamma, beta, eps: float):
+    """Training-mode BN: returns (out, batch_mean, batch_var) so the layer
+    can fold the running-stat update into the same compiled step
+    (reference batch_norm.cc saves mean/var as aux outputs)."""
+    axes = (0,) + tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    m = _bcast_stats(x.ndim, mean)
+    v = _bcast_stats(x.ndim, var)
+    g, b = _bcast_stats(x.ndim, gamma), _bcast_stats(x.ndim, beta)
+    out = (x - m) * lax.rsqrt(v + eps) * g + b
+    return out, mean, var
+
+
+def layer_norm(x, gamma, beta, axis: int = -1, eps: float = 1e-5):
+    """Reference LayerNorm (src/operator/nn/layer_norm.cc)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def group_norm(x, gamma, beta, num_groups: int, eps: float = 1e-5):
+    """Reference GroupNorm (src/operator/nn/group_norm.cc). x: (N, C, ...)."""
+    n, c = x.shape[:2]
+    sp = x.shape[2:]
+    xg = x.reshape((n, num_groups, c // num_groups) + sp)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    out = xg.reshape(x.shape)
+    shape = (1, c) + (1,) * len(sp)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def instance_norm(x, gamma, beta, eps: float = 1e-5):
+    """Reference InstanceNorm: normalize per (N, C) over spatial dims."""
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def l2_norm(x, axis=None, eps: float = 1e-10, mode: str = "instance"):
+    """Reference L2Normalization."""
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        axes = axis
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+def lrn(x, nsize: int, alpha: float = 1e-4, beta: float = 0.75,
+        knorm: float = 2.0):
+    """Local response normalization across channels (reference lrn.cc)."""
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[1] = (half, half)
+    sqp = jnp.pad(sq, pad_cfg)
+    window = [1] * x.ndim
+    window[1] = nsize
+    ssum = lax.reduce_window(sqp, 0.0, lax.add, tuple(window),
+                             (1,) * x.ndim, "VALID")
+    return x / jnp.power(knorm + alpha * ssum / nsize, beta)

@@ -1,0 +1,4 @@
+"""Optimizer package (reference: python/mxnet/optimizer/ — 19 classes)."""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import __all__  # noqa: F401
+from . import optimizer

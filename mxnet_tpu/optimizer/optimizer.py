@@ -1,0 +1,703 @@
+"""Optimizers.
+
+Reference analog: python/mxnet/optimizer/*.py (19 classes) backed by fused
+C++/CUDA update kernels (src/operator/optimizer_op.cc, multi-tensor
+multi_sgd_*). TPU-native design: every optimizer's update rule is ONE pure
+function (w, g, *states) -> (w', *states') compiled with jax.jit and shared
+across all parameters of the same shape — XLA fuses the whole rule into a
+single kernel, and buffer donation makes updates in-place in HBM, matching
+the reference's fused+inplace update kernels.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Signum", "SGLD", "DCASGD", "Adam",
+           "AdaBelief", "Adamax", "Nadam", "AdaGrad", "AdaDelta", "RMSProp",
+           "Ftrl", "FTML", "LARS", "LAMB", "LANS", "Updater", "get_updater",
+           "create", "register"]
+
+_registry: Dict[str, type] = {}
+
+
+def register(cls):
+    """Register an optimizer under its lowercase class name
+    (reference Optimizer.register)."""
+    _registry[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    try:
+        return _registry[name.lower()](**kwargs)
+    except KeyError as e:
+        raise MXNetError(f"unknown optimizer {name!r}") from e
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer/optimizer.py).
+
+    Subclasses define ``create_state(index, weight)`` and a pure
+    ``_update_rule(w, g, lr, wd, t, *states)`` returning (w', states').
+    The rule is jitted once with donated buffers.
+    """
+
+    def __init__(self, rescale_grad: float = 1.0, param_idx2name=None,
+                 wd: float = 0.0, clip_gradient: Optional[float] = None,
+                 learning_rate: Optional[float] = None, lr_scheduler=None,
+                 multi_precision: bool = False, param_dict=None,
+                 begin_num_update: int = 0, use_fused_step: bool = True,
+                 **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self._jit_update = None
+        self._lr_mult: Dict[Any, float] = {}
+        self._wd_mult: Dict[Any, float] = {}
+
+    # ---------------- lr/wd handling ----------------
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler(self.num_update))
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.lr = lr
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult: Dict[Any, float]):
+        self._lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[Any, float]):
+        self._wd_mult = dict(args_wd_mult)
+
+    def _get_lr(self, index) -> float:
+        lr = self.learning_rate
+        name = self.idx2name.get(index, index)
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        lr *= self._lr_mult.get(name, self._lr_mult.get(index, 1.0))
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        wd *= self._wd_mult.get(name, self._wd_mult.get(index, 1.0))
+        return wd
+
+    def _update_count(self, index):
+        cnt = self._index_update_count.get(index, self.begin_num_update) + 1
+        self._index_update_count[index] = cnt
+        self.num_update = max(cnt, self.num_update)
+        return cnt
+
+    # ---------------- state ----------------
+    # States are tuples of NDArray handles: mutable like the reference's
+    # state NDArrays, while the update math itself is functional + jitted.
+    def create_state(self, index, weight: NDArray):
+        return ()
+
+    def _zeros_state(self, weight, n: int):
+        return tuple(NDArray(jnp.zeros_like(weight._data)) for _ in range(n))
+
+    def create_state_multi_precision(self, index, weight: NDArray):
+        if self.multi_precision and weight._data.dtype in (jnp.float16,
+                                                           jnp.bfloat16):
+            master = NDArray(jnp.asarray(weight._data, jnp.float32))
+            return (self.create_state(index, weight), master)
+        return self.create_state(index, weight)
+
+    # ---------------- update ----------------
+    def _rule(self):
+        """Pure update rule; jitted lazily with donated args so XLA updates
+        weights in place (the reference's in-place fused kernels)."""
+        raise NotImplementedError
+
+    def _jitted(self):
+        if self._jit_update is None:
+            rule = self._rule()
+            has_clip = self.clip_gradient is not None
+
+            # rescale/clip are traced args (NOT closure constants): Trainer
+            # changes rescale_grad every step(batch_size) call.
+            def stepfn(w, g, lr, wd, t, rescale, clip, states):
+                g = g * rescale
+                if has_clip:
+                    g = jnp.clip(g, -clip, clip)
+                return rule(w, g, lr, wd, t, states)
+
+            # donate only optimizer-private state buffers; the weight buffer
+            # may be aliased by kvstore entries / user-held NDArrays
+            self._jit_update = jax.jit(stepfn, donate_argnums=(7,))
+        return self._jit_update
+
+    def update(self, index, weight, grad, state):
+        """Single-param update (reference Optimizer.update). Lists are the
+        reference's multi-tensor form."""
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self._update_one(i, w, g, s)
+        else:
+            self._update_one(index, weight, grad, state)
+
+    update_multi_precision = update
+
+    def _update_one(self, index, weight: NDArray, grad: NDArray, state):
+        t = self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        master = None
+        if isinstance(state, tuple) and len(state) == 2 and \
+                isinstance(state[0], tuple) and isinstance(state[1], NDArray) \
+                and weight._data.dtype in (jnp.float16, jnp.bfloat16):
+            state, master = state
+        fn = self._jitted()
+        raw_state = tuple(s._data for s in state)
+        clip = self.clip_gradient if self.clip_gradient is not None else 0.0
+        if master is not None:
+            new_master, new_state = fn(master._data,
+                                       grad._data.astype(jnp.float32),
+                                       lr, wd, t, self.rescale_grad, clip,
+                                       raw_state)
+            master._data = new_master
+            weight._data = new_master.astype(weight._data.dtype)
+        else:
+            new_w, new_state = fn(weight._data, grad._data, lr, wd, t,
+                                  self.rescale_grad, clip, raw_state)
+            weight._data = new_w
+        for s, ns in zip(state, new_state):
+            s._data = ns
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.learning_rate})"
+
+
+class _StatefulMixin:
+    """States stored as a dict index->pytree of jax arrays owned by the
+    Updater/Trainer; update() returns new states functionally."""
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum/nesterov-free path (reference optimizer/sgd.py;
+    kernels src/operator/optimizer_op.cc sgd_update/sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return self._zeros_state(weight, 1)
+
+    def _rule(self):
+        mom = self.momentum
+
+        def rule(w, g, lr, wd, t, states):
+            g = g + wd * w
+            if mom == 0.0:
+                return w - lr * g, states
+            (m,) = states
+            m = mom * m - lr * g
+            return w + m, (m,)
+        return rule
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer/nag.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1)
+
+    def _rule(self):
+        mom = self.momentum
+
+        def rule(w, g, lr, wd, t, states):
+            g = g + wd * w
+            (m,) = states
+            m = mom * m + g
+            return w - lr * (g + mom * m), (m,)
+        return rule
+
+
+@register
+class Signum(Optimizer):
+    """Sign SGD with momentum (reference optimizer/signum.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1) if self.momentum != 0 else ()
+
+    def _rule(self):
+        mom, wd_lh = self.momentum, self.wd_lh
+
+        def rule(w, g, lr, wd, t, states):
+            if mom == 0.0:
+                return w * (1 - lr * (wd + wd_lh)) - lr * jnp.sign(g), states
+            (m,) = states
+            m = mom * m - (1 - mom) * (g + wd * w)
+            return w * (1 - lr * wd_lh) + lr * jnp.sign(m), (m,)
+        return rule
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self._keyidx = 0
+
+    def create_state(self, index, weight):
+        return ()
+
+    def _rule(self):
+        def rule(w, g, lr, wd, t, states):
+            g = g + wd * w
+            key = jax.random.fold_in(jax.random.PRNGKey(0x51D), t)
+            noise = jax.random.normal(key, w.shape, w.dtype) * \
+                jnp.sqrt(lr)
+            return w - 0.5 * lr * g + noise, states
+        return rule
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.array(weight._data)))  # (mom, prev_weight)
+
+    def _rule(self):
+        mom, lam = self.momentum, self.lamda
+
+        def rule(w, g, lr, wd, t, states):
+            m, prev = states
+            g = g + wd * w
+            g = g + lam * g * g * (w - prev)
+            m = mom * m - lr * g
+            return w + m, (m, jnp.array(w))
+        return rule
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer/adam.py; kernel adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            m, v = states
+            g = g + wd * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            return w - lr * mhat / (jnp.sqrt(vhat) + eps), (m, v)
+        return rule
+
+
+@register
+class AdaBelief(Optimizer):
+    """AdaBelief (belief in observed gradients)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            m, s = states
+            g = g + wd * w
+            m = b1 * m + (1 - b1) * g
+            s = b2 * s + (1 - b2) * (g - m) ** 2 + eps
+            mhat = m / (1 - b1 ** t)
+            shat = s / (1 - b2 ** t)
+            return w - lr * mhat / (jnp.sqrt(shat) + eps), (m, s)
+        return rule
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2 = self.beta1, self.beta2
+
+        def rule(w, g, lr, wd, t, states):
+            m, u = states
+            g = g + wd * w
+            m = b1 * m + (1 - b1) * g
+            u = jnp.maximum(b2 * u, jnp.abs(g))
+            return w - lr / (1 - b1 ** t) * m / (u + 1e-8), (m, u)
+        return rule
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2, eps, sd = self.beta1, self.beta2, self.epsilon, \
+            self.schedule_decay
+
+        def rule(w, g, lr, wd, t, states):
+            m, v = states
+            g = g + wd * w
+            mu_t = b1 * (1 - 0.5 * 0.96 ** (t * sd))
+            mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * sd))
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            ghat = g / (1 - mu_t)
+            mhat = m / (1 - mu_t1)
+            vhat = v / (1 - b2 ** t)
+            mbar = (1 - mu_t) * ghat + mu_t1 * mhat
+            return w - lr * mbar / (jnp.sqrt(vhat) + eps), (m, v)
+        return rule
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1)
+
+    def _rule(self):
+        eps = self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            (h,) = states
+            g = g + wd * w
+            h = h + g * g
+            return w - lr * g / (jnp.sqrt(h) + eps), (h,)
+        return rule
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        rho, eps = self.rho, self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            acc_g, acc_d = states
+            g = g + wd * w
+            acc_g = rho * acc_g + (1 - rho) * g * g
+            d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+            acc_d = rho * acc_d + (1 - rho) * d * d
+            return w - lr * d, (acc_g, acc_d)
+        return rule
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum = rho, momentum
+        self.epsilon, self.centered = epsilon, centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return self._zeros_state(weight, 3)  # n, g_avg, delta
+        return self._zeros_state(weight, 2)  # n, delta
+
+    def _rule(self):
+        rho, mom, eps = self.rho, self.momentum, self.epsilon
+        centered, cw = self.centered, self.clip_weights
+
+        def rule(w, g, lr, wd, t, states):
+            g = g + wd * w
+            if centered:
+                n, gavg, delta = states
+                n = rho * n + (1 - rho) * g * g
+                gavg = rho * gavg + (1 - rho) * g
+                delta = mom * delta - lr * g / \
+                    (jnp.sqrt(n - gavg * gavg + eps))
+                w = w + delta
+                new_states = (n, gavg, delta)
+            else:
+                n, delta = states
+                n = rho * n + (1 - rho) * g * g
+                delta = mom * delta - lr * g / jnp.sqrt(n + eps)
+                w = w + delta
+                new_states = (n, delta)
+            if cw:
+                w = jnp.clip(w, -cw, cw)
+            return w, new_states
+        return rule
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        l1, beta = self.lamda1, self.beta
+
+        def rule(w, g, lr, wd, t, states):
+            z, n = states
+            g = g + wd * w
+            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+            z = z + g - sigma * w
+            n = n + g * g
+            w = jnp.where(
+                jnp.abs(z) > l1,
+                -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / lr),
+                jnp.zeros_like(w))
+            return w, (z, n)
+        return rule
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 3)  # d, v, z
+
+    def _rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            d, v, z = states
+            g = g + wd * w
+            v = b2 * v + (1 - b2) * g * g
+            d_t = (1 - b1 ** t) / lr * \
+                (jnp.sqrt(v / (1 - b2 ** t)) + eps)
+            sigma = d_t - b1 * d
+            z = b1 * z + (1 - b1) * g - sigma * w
+            w = -z / d_t
+            return w, (d_t, v, z)
+        return rule
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1)
+
+    def _rule(self):
+        mom, eta, eps = self.momentum, self.eta, self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            (m,) = states
+            wnorm = jnp.sqrt(jnp.sum(w * w))
+            gnorm = jnp.sqrt(jnp.sum(g * g))
+            trust = jnp.where(
+                (wnorm > 0) & (gnorm > 0),
+                eta * wnorm / (gnorm + wd * wnorm + eps), 1.0)
+            g = g + wd * w
+            m = mom * m + trust * lr * g
+            return w - m, (m,)
+        return rule
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise Adam for large-batch (reference optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        lo, hi, bc = self.lower_bound, self.upper_bound, self.bias_correction
+
+        def rule(w, g, lr, wd, t, states):
+            m, v = states
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            if bc:
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+            else:
+                mhat, vhat = m, v
+            r = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+            wnorm = jnp.sqrt(jnp.sum(w * w))
+            rnorm = jnp.sqrt(jnp.sum(r * r))
+            if lo is not None:
+                wnorm = jnp.maximum(wnorm, lo)
+            if hi is not None:
+                wnorm = jnp.minimum(wnorm, hi)
+            trust = jnp.where((wnorm > 0) & (rnorm > 0), wnorm / rnorm, 1.0)
+            return w - lr * trust * r, (m, v)
+        return rule
+
+
+@register
+class LANS(Optimizer):
+    """LAMB with normalized gradients (reference optimizer/lans.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            m, v = states
+            gnorm = jnp.sqrt(jnp.sum(g * g))
+            g = g / jnp.maximum(gnorm, 1e-12)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            r1 = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+            r2 = g / (jnp.sqrt(vhat) + eps) + wd * w
+            wnorm = jnp.sqrt(jnp.sum(w * w))
+
+            def ratio(r):
+                rn = jnp.sqrt(jnp.sum(r * r))
+                return jnp.where((wnorm > 0) & (rn > 0), wnorm / rn, 1.0)
+            w = w - lr * (b1 * ratio(r1) * r1 + (1 - b1) * ratio(r2) * r2)
+            return w, (m, v)
+        return rule
+
+
+class Updater:
+    """Applies an optimizer to indexed weights, owning the state dict
+    (reference optimizer/updater.py — the kvstore-side updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+
+    def __call__(self, index, grad, weight):
+        indices = index if isinstance(index, (list, tuple)) else [index]
+        grads = grad if isinstance(grad, (list, tuple)) else [grad]
+        weights = weight if isinstance(weight, (list, tuple)) else [weight]
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+            self.optimizer._update_one(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        host = {k: jax.tree_util.tree_map(
+                    lambda s: onp.asarray(s._data), v,
+                    is_leaf=lambda s: isinstance(s, NDArray))
+                for k, v in self.states.items()}
+        return pickle.dumps(host)
+
+    def set_states(self, states_bytes):
+        import pickle
+        loaded = pickle.loads(states_bytes)
+        self.states = {k: jax.tree_util.tree_map(
+                           lambda a: NDArray(jnp.asarray(a)), v)
+                       for k, v in loaded.items()}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

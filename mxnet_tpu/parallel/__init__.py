@@ -1,0 +1,13 @@
+"""Parallelism: device meshes, sharding helpers, collectives, compression.
+
+Reference analog: src/kvstore/'s Comm/NCCL/ps-lite stack plus the manual
+model-parallel placement story (SURVEY §2.3). TPU-native design: ONE
+abstraction — a `jax.sharding.Mesh` with named axes — carries every
+parallelism flavor (dp/tp/pp/sp/ep); annotate shardings, let XLA insert the
+ICI/DCN collectives.
+"""
+from .mesh import (DeviceMesh, make_mesh, current_mesh, data_parallel_mesh,
+                   shard_batch, replicate, shard_params)
+from .compression import GradientCompression
+from . import mesh, compression, dist, collectives
+from .collectives import allreduce, allgather, reduce_scatter, broadcast_axis

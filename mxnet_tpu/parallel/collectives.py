@@ -1,0 +1,108 @@
+"""Collective operations over mesh axes.
+
+Reference analog: the Comm reduce paths (src/kvstore/comm.h), NCCL
+collectives (kvstore_nccl.h), and tree reduction (comm_tree.h). On TPU every
+one of these is an XLA collective over a mesh axis: psum/all_gather/
+reduce_scatter/ppermute riding ICI. These helpers wrap shard_map so
+imperative code can call collectives on sharded NDArrays.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .mesh import DeviceMesh, current_mesh
+
+__all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast_axis",
+           "ppermute"]
+
+
+def _get_mesh(mesh):
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; wrap in `with make_mesh(...)`")
+    return mesh
+
+
+def _shard_map(fn, mesh: DeviceMesh, in_spec, out_spec):
+    return jax.shard_map(fn, mesh=mesh.mesh, in_specs=in_spec,
+                         out_specs=out_spec)
+
+
+def allreduce(x: NDArray, axis: str = "dp",
+              mesh: Optional[DeviceMesh] = None, op: str = "sum") -> NDArray:
+    """psum over a mesh axis (the kvstore pushpull primitive)."""
+    mesh = _get_mesh(mesh)
+
+    def f(v):
+        if op == "sum":
+            return jax.lax.psum(v, axis)
+        if op == "mean":
+            return jax.lax.pmean(v, axis)
+        if op == "max":
+            return jax.lax.pmax(v, axis)
+        raise MXNetError(f"unknown reduce op {op}")
+    spec = _batch_spec(x, axis)
+    out = _shard_map(f, mesh, (spec,), spec)(x._data)
+    return NDArray(out)
+
+
+def allgather(x: NDArray, axis: str = "dp",
+              mesh: Optional[DeviceMesh] = None, tiled: bool = True) -> NDArray:
+    mesh = _get_mesh(mesh)
+
+    def f(v):
+        return jax.lax.all_gather(v, axis, tiled=tiled)
+    spec = _batch_spec(x, axis)
+    out = _shard_map(f, mesh, (spec,), P())(x._data)
+    return NDArray(out)
+
+
+def reduce_scatter(x: NDArray, axis: str = "dp",
+                   mesh: Optional[DeviceMesh] = None) -> NDArray:
+    mesh = _get_mesh(mesh)
+
+    def f(v):
+        return jax.lax.psum_scatter(v, axis, tiled=True)
+    out = _shard_map(f, mesh, (P(),), _batch_spec_ndim(x.ndim, axis))(x._data)
+    return NDArray(out)
+
+
+def broadcast_axis(x: NDArray, axis: str = "dp",
+                   mesh: Optional[DeviceMesh] = None, src: int = 0) -> NDArray:
+    """Broadcast shard `src`'s value to all shards along the axis."""
+    mesh = _get_mesh(mesh)
+    n = mesh.shape[axis]
+
+    def f(v):
+        idx = jax.lax.axis_index(axis)
+        perm = [(src, i) for i in range(n)]
+        got = jax.lax.ppermute(v, axis, perm)
+        return jnp.where(idx == src, v, got)
+    spec = _batch_spec(x, axis)
+    out = _shard_map(f, mesh, (spec,), spec)(x._data)
+    return NDArray(out)
+
+
+def ppermute(x: NDArray, perm, axis: str = "dp",
+             mesh: Optional[DeviceMesh] = None) -> NDArray:
+    mesh = _get_mesh(mesh)
+
+    def f(v):
+        return jax.lax.ppermute(v, axis, perm)
+    spec = _batch_spec(x, axis)
+    out = _shard_map(f, mesh, (spec,), spec)(x._data)
+    return NDArray(out)
+
+
+def _batch_spec(x: NDArray, axis: str):
+    return _batch_spec_ndim(x.ndim, axis)
+
+
+def _batch_spec_ndim(ndim: int, axis: str):
+    return P(axis, *([None] * (ndim - 1)))

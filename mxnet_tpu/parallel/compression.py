@@ -1,0 +1,61 @@
+"""Gradient compression with error feedback.
+
+Reference analog: src/kvstore/gradient_compression.{h,cc,cu} — 2-bit
+stochastic quantization with a residual buffer, applied before network
+transfer. TPU-native: the quantize/dequantize pair is a pure jitted function
+(XLA fuses it; a Pallas variant can replace it when profiling shows need),
+applied before DCN allreduce where bandwidth is scarce; ICI is fast enough
+that compression is off by default, matching the reference's opt-in design.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    """type='2bit' (threshold) or 'fp16'/'bf16' casts
+    (reference set_gradient_compression params)."""
+
+    def __init__(self, type: str = "2bit", threshold: float = 0.5):  # noqa: A002
+        if type not in ("2bit", "1bit", "fp16", "bf16"):
+            raise MXNetError(f"unsupported compression type {type!r}")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals: Dict[int, jax.Array] = {}
+        self._fn = jax.jit(self._make_fn())
+
+    def _make_fn(self):
+        t = self.threshold
+        kind = self.type
+
+        def fn(g, residual):
+            g = g + residual
+            if kind == "2bit":
+                q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0))
+            elif kind == "1bit":
+                q = jnp.where(g >= 0, t, -t)
+            elif kind == "fp16":
+                q = g.astype(jnp.float16).astype(g.dtype)
+            else:
+                q = g.astype(jnp.bfloat16).astype(g.dtype)
+            return q, g - q  # (compressed value, new error residual)
+        return fn
+
+    def compress_decompress(self, grad: NDArray) -> NDArray:
+        """Round-trip compress (what the wire would carry) with error
+        feedback accumulation, keyed per gradient buffer."""
+        key = id(grad)
+        res = self._residuals.get(key)
+        if res is None or res.shape != grad._data.shape:
+            res = jnp.zeros_like(grad._data)
+        q, new_res = self._fn(grad._data, res)
+        self._residuals[key] = new_res
+        return NDArray(q)

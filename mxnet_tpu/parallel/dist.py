@@ -1,0 +1,76 @@
+"""Multi-host distributed runtime.
+
+Reference analog: ps-lite worker/server/scheduler roles launched by
+tools/launch.py with DMLC_* env vars (SURVEY §2.3). TPU-native: one SPMD
+program per host over a global mesh; `jax.distributed.initialize` replaces
+the tracker, and DCN-spanning XLA collectives replace ZMQ push/pull. The
+DMLC_* env names are honored so reference launch scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..base import MXNetError, get_env
+
+__all__ = ["initialize", "is_initialized", "rank", "size", "global_mesh"]
+
+_initialized = [False]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Join the multi-host job. Maps reference env vars:
+    DMLC_PS_ROOT_URI/PORT -> coordinator, DMLC_NUM_WORKER -> num_processes,
+    DMLC_WORKER_ID -> process_id. (reference: launch via tools/launch.py)."""
+    if _initialized[0]:
+        return
+    coordinator_address = coordinator_address or _coord_from_env()
+    num_processes = num_processes or get_env("DMLC_NUM_WORKER", None, int)
+    process_id = process_id if process_id is not None \
+        else get_env("DMLC_WORKER_ID", None, int)
+    if coordinator_address is None:
+        # single-process: nothing to join
+        _initialized[0] = True
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized[0] = True
+
+
+def _coord_from_env() -> Optional[str]:
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+    if uri:
+        return f"{uri}:{port}"
+    return None
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def global_mesh(axes=None):
+    """Mesh over ALL devices across hosts: intra-host axes ride ICI, the
+    cross-host axis rides DCN (reference dist kvstore topology)."""
+    from .mesh import make_mesh
+    axes = axes or {"dp": -1}
+    return make_mesh(axes, jax.devices())

@@ -1,0 +1,137 @@
+"""Device-mesh management.
+
+The reference automates only data parallelism (kvstore) and leaves model
+parallelism to manual per-layer ctx placement (SURVEY §2.3). Here the mesh is
+first-class: axes named 'dp'/'tp'/'pp'/'sp'/'ep' by convention, sharding
+attached per-array with NamedSharding, XLA emits collectives over ICI.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "data_parallel_mesh",
+           "shard_batch", "replicate", "shard_params", "P"]
+
+_state = threading.local()
+
+
+class DeviceMesh:
+    """Named-axis device mesh wrapper (thin over jax.sharding.Mesh)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self.mesh.shape)
+
+    @property
+    def size(self) -> int:
+        return int(onp.prod(list(self.mesh.shape.values())))
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def __enter__(self):
+        stack = getattr(_state, "stack", None)
+        if stack is None:
+            stack = _state.stack = []
+        stack.append(self)
+        self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self.mesh.__exit__(*exc)
+        _state.stack.pop()
+
+    def __repr__(self):
+        return f"DeviceMesh({self.shape})"
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) \
+        -> DeviceMesh:
+    """Build a mesh from axis_name->size. Sizes must multiply to the device
+    count; a -1 size is inferred."""
+    devices = list(devices) if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(onp.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(onp.prod(sizes))
+    if total != len(devices):
+        raise MXNetError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices but "
+            f"{len(devices)} available")
+    arr = onp.array(devices).reshape(sizes)
+    return DeviceMesh(Mesh(arr, tuple(names)))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> DeviceMesh:
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return make_mesh({"dp": len(devs)}, devs)
+
+
+def current_mesh() -> Optional[DeviceMesh]:
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+def shard_batch(data: NDArray, mesh: Optional[DeviceMesh] = None,
+                axis: str = "dp") -> NDArray:
+    """Shard the batch dimension over a mesh axis — the TPU-native
+    split_and_load: ONE logical array, batch-sharded; XLA's psum over the
+    axis replaces kvstore reduction."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return data
+    spec = [None] * data.ndim
+    spec[0] = axis
+    sharding = mesh.sharding(*spec)
+    return NDArray(jax.device_put(data._data, sharding))
+
+
+def replicate(data: NDArray, mesh: Optional[DeviceMesh] = None) -> NDArray:
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return data
+    return NDArray(jax.device_put(data._data, mesh.sharding()))
+
+
+def shard_params(params, rules: Sequence[Tuple[str, Tuple]],
+                 mesh: Optional[DeviceMesh] = None):
+    """Attach NamedShardings to Parameters by name-pattern rules.
+
+    rules: list of (substring, partition_spec_tuple); first match wins; no
+    match → replicated. e.g. [("dense.weight", ("tp", None))] shards the
+    units dim of every dense weight over the 'tp' axis.
+    """
+    import re
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; use `with make_mesh(...)`")
+    items = params.items() if hasattr(params, "items") else \
+        [(p.name, p) for p in params]
+    for name, p in items:
+        spec = ()
+        for pat, s in rules:
+            if re.search(pat, name):
+                spec = s
+                break
+        p.set_sharding(mesh.sharding(*spec))

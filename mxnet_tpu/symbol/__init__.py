@@ -1,0 +1,29 @@
+"""``mx.sym`` namespace (reference: python/mxnet/symbol/).
+
+Op calls like ``mx.sym.exp(x)`` / ``mx.sym.FullyConnected(...)`` build graph
+nodes lazily; any ``mx.nd`` function is available symbolically (PEP 562
+module __getattr__), replacing the reference's codegen from the C++ registry.
+"""
+from .symbol import (Symbol, Variable, var, load, load_json,
+                     trace_block_to_symbol, StableHLOSymbol)
+from .executor import Executor, eval_symbol
+from . import symbol as _symbol_mod
+
+
+def _make_sym_op(opname):
+    def op(*args, name=None, **kwargs):
+        sym_inputs = [a for a in args if isinstance(a, Symbol)]
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Symbol)}
+        sym_inputs += [v for v in kwargs.values() if isinstance(v, Symbol)]
+        return Symbol(opname, name or f"{opname.lower()}_{len(sym_inputs)}",
+                      sym_inputs, attrs)
+    op.__name__ = opname
+    return op
+
+
+def __getattr__(name):
+    from .. import ndarray as nd
+    if hasattr(nd, name) and callable(getattr(nd, name)):
+        return _make_sym_op(name)
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute {name!r}")

@@ -1,0 +1,120 @@
+"""Executor: evaluate a Symbol graph.
+
+Reference analog: python/mxnet/executor.py (:25 — thin CachedOp wrapper with
+args/grads). Here forward evaluates the DAG through the ``mx.nd`` namespace
+(each op an XLA kernel; wrap in jit for one fused computation) and backward
+rides the autograd tape.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import autograd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Executor", "eval_symbol"]
+
+
+def _nd_namespace():
+    from .. import ndarray as nd
+    return nd
+
+
+def _eval_node(sym, feeds: Dict[str, NDArray], cache: Dict[int, NDArray]):
+    if id(sym) in cache:
+        return cache[id(sym)]
+    if sym._op is None:
+        try:
+            val = feeds[sym._name]
+        except KeyError as e:
+            raise MXNetError(f"missing value for variable {sym._name!r}") from e
+        cache[id(sym)] = val
+        return val
+    if sym._op == "_stablehlo":
+        arrays = [feeds[n]._data for n in sym.list_arguments()]
+        out = sym._call(*arrays)
+        val = NDArray(out[0] if isinstance(out, (list, tuple)) else out)
+        cache[id(sym)] = val
+        return val
+    ins = [_eval_node(i, feeds, cache) for i in sym._inputs]
+    nd = _nd_namespace()
+    attrs = {k: v for k, v in sym._attrs.items()
+             if k not in ("shape", "dtype") and v is not None}
+    opname = sym._op
+    if opname.endswith("_scalar"):
+        base = opname[:-len("_scalar")]
+        scalar = attrs.pop("scalar")
+        fn = getattr(nd, _op_alias(base))
+        val = fn(ins[0], scalar, **attrs)
+    else:
+        fn = getattr(nd, _op_alias(opname), None)
+        if fn is None:
+            raise MXNetError(f"symbol op {opname!r} has no nd implementation")
+        val = fn(*ins, **attrs)
+    if isinstance(val, (list, tuple)):
+        val = val[sym._out_index]
+    cache[id(sym)] = val
+    return val
+
+
+_ALIASES = {"add": "add", "sub": "subtract", "mul": "multiply",
+            "div": "divide", "pow": "power"}
+
+
+def _op_alias(name: str) -> str:
+    return _ALIASES.get(name, name)
+
+
+def eval_symbol(sym, feeds: Dict[str, NDArray]):
+    return _eval_node(sym, feeds, {})
+
+
+class Executor:
+    """Holds arg arrays (+grads) for repeated forward/backward
+    (reference executor.py Executor)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write"):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            self.arg_dict = dict(zip(arg_names, args))
+        else:
+            self.arg_dict = dict(args or {})
+        self.grad_dict: Dict[str, NDArray] = {}
+        if grad_req != "null":
+            for name, arr in self.arg_dict.items():
+                if args_grad is not None and name not in args_grad:
+                    continue
+                arr.attach_grad(grad_req if isinstance(grad_req, str)
+                                else grad_req.get(name, "write"))
+                self.grad_dict[name] = arr.grad
+        self.outputs: List[NDArray] = []
+
+    def forward(self, is_train: bool = False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
+                    else v
+        if is_train:
+            with autograd.record():
+                out = eval_symbol(self._symbol, self.arg_dict)
+        else:
+            out = eval_symbol(self._symbol, self.arg_dict)
+        self.outputs = [out] if isinstance(out, NDArray) else list(out)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self.outputs:
+            raise MXNetError("run forward(is_train=True) before backward")
+        heads = self.outputs
+        hg = out_grads if out_grads is None or isinstance(out_grads, list) \
+            else [out_grads]
+        autograd.backward(heads, hg)
+        # refresh grad_dict views
+        for name, arr in self.arg_dict.items():
+            if arr.grad is not None:
+                self.grad_dict[name] = arr.grad
+        return self.grad_dict

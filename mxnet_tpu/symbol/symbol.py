@@ -1,0 +1,295 @@
+"""Symbol: lazy graph construction (``mx.sym``) + serialized-model export.
+
+Reference analog: python/mxnet/symbol/ (graph building over the nnvm op
+registry, saved as symbol.json) and the deferred-compute tracing behind
+Gluon 2.0 export (SURVEY layer 5/6). TPU-native split:
+
+- The *graph API* (`Variable`, op calls, `bind`) is a light Python DAG whose
+  nodes name ops in the ``mx.nd`` namespace; an Executor evaluates it
+  imperatively or jits the whole evaluation. Saved as portable JSON.
+- The *export path* for trained models serializes the block's forward as
+  StableHLO via ``jax.export`` — the XLA-native interchange format (the
+  analog of the reference's symbol.json+params pair, but compiler-level and
+  version-stable).
+"""
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Symbol", "Variable", "var", "load", "load_json",
+           "trace_block_to_symbol", "StableHLOSymbol"]
+
+
+class Symbol:
+    """A node in the op DAG. ``op`` is the name of an ``mx.nd`` function;
+    leaf nodes are variables (op=None)."""
+
+    def __init__(self, op: Optional[str], name: str,
+                 inputs: Sequence["Symbol"] = (), attrs: Optional[Dict] = None,
+                 out_index: int = 0):
+        self._op = op
+        self._name = name
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._out_index = out_index
+
+    # ---------------- introspection ----------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def list_arguments(self) -> List[str]:
+        seen, order = set(), []
+
+        def walk(s):
+            if s._op is None:
+                if s._name not in seen:
+                    seen.add(s._name)
+                    order.append(s._name)
+            for i in s._inputs:
+                walk(i)
+        walk(self)
+        return order
+
+    def list_outputs(self) -> List[str]:
+        return [f"{self._name}_output"]
+
+    def get_internals(self) -> List["Symbol"]:
+        nodes = []
+
+        def walk(s):
+            for i in s._inputs:
+                walk(i)
+            if s not in nodes:
+                nodes.append(s)
+        walk(self)
+        return nodes
+
+    # ---------------- composition ----------------
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("composing symbols via call is not supported; "
+                         "use operator functions")
+
+    def _binary(self, other, opname):
+        if isinstance(other, (int, float)):
+            return Symbol(opname + "_scalar", f"{opname}_{id(self)}",
+                          [self], {"scalar": other})
+        return Symbol(opname, f"{opname}_{id(self)}", [self, other])
+
+    def __add__(self, o):
+        return self._binary(o, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "sub")
+
+    def __mul__(self, o):
+        return self._binary(o, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "div")
+
+    def __pow__(self, o):
+        return self._binary(o, "pow")
+
+    def __neg__(self):
+        return Symbol("negative", f"neg_{id(self)}", [self])
+
+    # ---------------- evaluation ----------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def _simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from .executor import Executor
+        from ..ndarray import zeros
+        args = {name: zeros(shapes[name]) for name in self.list_arguments()
+                if name in shapes}
+        return Executor(self, ctx, args, None, grad_req)
+
+    simple_bind = _simple_bind
+
+    def eval(self, ctx=None, **kwargs):
+        from .executor import eval_symbol
+        return eval_symbol(self, kwargs)
+
+    def infer_shape(self, **shapes):
+        """Infer output shape by abstract evaluation (XLA's shape inference
+        replaces the reference's FInferShape pass)."""
+        import jax
+        from .executor import _eval_node
+        from ..ndarray import zeros
+        feeds = {n: zeros(shapes[n]) for n in self.list_arguments()}
+
+        def f(**kw):
+            return _eval_node(self, {k: v for k, v in kw.items()}, {})._data
+        out = jax.eval_shape(lambda: f(**feeds))
+        arg_shapes = [shapes[n] for n in self.list_arguments()]
+        return arg_shapes, [tuple(out.shape)], []
+
+    # ---------------- serialization ----------------
+    def tojson(self) -> str:
+        nodes = []
+        node_ids: Dict[int, int] = {}
+
+        def visit(s: "Symbol") -> int:
+            if id(s) in node_ids:
+                return node_ids[id(s)]
+            in_ids = [visit(i) for i in s._inputs]
+            nid = len(nodes)
+            nodes.append({"op": s._op or "null", "name": s._name,
+                          "attrs": _jsonable(s._attrs), "inputs": in_ids})
+            node_ids[id(s)] = nid
+            return nid
+        head = visit(self)
+        return json.dumps({"format": "mxnet_tpu-symbol-v1",
+                           "nodes": nodes, "head": head}, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    @staticmethod
+    def load(fname: str) -> "Symbol":
+        return load(fname)
+
+    def __repr__(self):
+        return f"<Symbol {self._name} op={self._op}>"
+
+
+def _jsonable(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (list, tuple)):
+            out[k] = list(v)
+        else:
+            out[k] = v
+    return out
+
+
+def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
+    s = Symbol(None, name)
+    s._attrs.update({"shape": shape, "dtype": dtype})
+    return s
+
+
+var = Variable
+
+
+def load_json(json_str: str) -> Symbol:
+    spec = json.loads(json_str)
+    if spec.get("format") == "mxnet_tpu-stablehlo-v1":
+        return StableHLOSymbol._from_spec(spec)
+    if spec.get("format") != "mxnet_tpu-symbol-v1":
+        raise MXNetError("unrecognized symbol file format")
+    built: List[Symbol] = []
+    for node in spec["nodes"]:
+        if node["op"] == "null":
+            s = Variable(node["name"])
+            s._attrs.update(node.get("attrs", {}))
+        else:
+            s = Symbol(node["op"], node["name"],
+                       [built[i] for i in node["inputs"]],
+                       node.get("attrs", {}))
+        built.append(s)
+    return built[spec["head"]]
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+class StableHLOSymbol(Symbol):
+    """A trained-model graph serialized as StableHLO (jax.export) — the
+    TPU-native analog of the reference's exported symbol.json. Holds the
+    serialized artifact + input/param metadata; executable on any device via
+    XLA without the defining Python code."""
+
+    def __init__(self, serialized: bytes, input_names: List[str],
+                 param_names: List[str], name: str = "stablehlo"):
+        super().__init__("_stablehlo", name)
+        self._serialized = serialized
+        self._input_names = list(input_names)
+        self._param_names = list(param_names)
+        self._exported = None
+
+    def list_arguments(self) -> List[str]:
+        return self._input_names + self._param_names
+
+    def _call(self, *arrays):
+        from jax import export as jax_export
+        if self._exported is None:
+            self._exported = jax_export.deserialize(self._serialized)
+        return self._exported.call(*arrays)
+
+    def tojson(self) -> str:
+        return json.dumps({
+            "format": "mxnet_tpu-stablehlo-v1",
+            "inputs": self._input_names,
+            "params": self._param_names,
+            "artifact_b64": base64.b64encode(self._serialized).decode(),
+        })
+
+    @staticmethod
+    def _from_spec(spec) -> "StableHLOSymbol":
+        return StableHLOSymbol(base64.b64decode(spec["artifact_b64"]),
+                               spec["inputs"], spec["params"])
+
+
+def trace_block_to_symbol(block) -> StableHLOSymbol:
+    """Trace a HybridBlock's inference forward to StableHLO
+    (reference HybridBlock.export's deferred-compute trace, block.py:1296).
+    Requires the block to have run at least once (shapes known)."""
+    import jax
+    from jax import export as jax_export
+
+    params = [(k, p) for k, p in block.collect_params().items()
+              if p._data is not None]
+    if not params and not getattr(block, "_cached_out_info", None):
+        raise MXNetError("run the block once before export (shapes unknown)")
+    in_avals = getattr(block, "_last_input_avals", None)
+    if in_avals is None:
+        raise MXNetError("run the block once before export (no traced input)")
+
+    names = [k for k, _ in params]
+    plist = [p for _, p in params]
+
+    def fn(*arrays):
+        n_in = len(in_avals)
+        inputs, pvals = arrays[:n_in], arrays[n_in:]
+        orig = [p._data for p in plist]
+        from .. import _tape
+        prev = _tape.set_recording(False)
+        prev_t = _tape.set_training(False)
+        try:
+            for p, v in zip(plist, pvals):
+                p._data = NDArray(v)
+            out = block.forward(*[NDArray(a) for a in inputs])
+        finally:
+            for p, o in zip(plist, orig):
+                p._data = o
+            _tape.set_recording(prev)
+            _tape.set_training(prev_t)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    import jax.numpy as jnp
+    args = tuple(jnp.zeros(a.shape, a.dtype) for a in in_avals) + \
+        tuple(p._data._data for p in plist)
+    exported = jax_export.export(jax.jit(fn))(*args)
+    data = exported.serialize()
+    return StableHLOSymbol(bytes(data),
+                           [f"data{i}" for i in range(len(in_avals))], names)

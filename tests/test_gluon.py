@@ -1,0 +1,285 @@
+"""Gluon Block/HybridBlock/layer tests (reference:
+tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_shapes_and_values():
+    layer = nn.Dense(5, in_units=3, use_bias=True)
+    layer.initialize()
+    x = nd.ones((2, 3))
+    y = layer(x)
+    assert y.shape == (2, 5)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy() @ w.T + b, rtol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(4)
+    layer.initialize()
+    assert layer.weight.shape == (4, 0)
+    y = layer(nd.ones((2, 7)))
+    assert layer.weight.shape == (4, 7)
+    assert y.shape == (2, 4)
+
+
+def test_dense_flatten():
+    layer = nn.Dense(4, flatten=True)
+    layer.initialize()
+    assert layer(nd.ones((2, 3, 5))).shape == (2, 4)
+    layer2 = nn.Dense(4, flatten=False)
+    layer2.initialize()
+    assert layer2(nd.ones((2, 3, 5))).shape == (2, 3, 4)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    assert len(net) == 2
+    y = net(nd.ones((4, 16)))
+    assert y.shape == (4, 2)
+    params = net.collect_params()
+    assert len(params) == 4  # 2x weight+bias
+    assert any("weight" in k for k in params)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dense(3))
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 8))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_gradients_match():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    with autograd.record():
+        l1 = (net(x) ** 2).sum()
+    l1.backward()
+    g1 = net.weight.grad().asnumpy().copy()
+    net.weight.zero_grad()
+    net.hybridize()
+    with autograd.record():
+        l2 = (net(x) ** 2).sum()
+    l2.backward()
+    g2 = net.weight.grad().asnumpy()
+    onp.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d():
+    layer = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 3, 16, 16))
+    y = layer(x)
+    assert y.shape == (2, 8, 16, 16)
+    # stride 2
+    layer2 = nn.Conv2D(4, kernel_size=3, strides=2, padding=1)
+    layer2.initialize()
+    assert layer2(x).shape == (2, 4, 8, 8)
+
+
+def test_conv2d_groups():
+    layer = nn.Conv2D(8, kernel_size=1, groups=2, in_channels=4)
+    layer.initialize()
+    assert layer.weight.shape == (8, 2, 1, 1)
+    y = layer(nd.ones((1, 4, 5, 5)))
+    assert y.shape == (1, 8, 5, 5)
+
+
+def test_conv_transpose():
+    layer = nn.Conv2DTranspose(3, kernel_size=4, strides=2, padding=1,
+                               in_channels=6)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 6, 8, 8))
+    y = layer(x)
+    assert y.shape == (2, 3, 16, 16)
+
+
+def test_pooling():
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(pool_size=2)
+    assert mp(x).shape == (1, 1, 2, 2)
+    onp.testing.assert_allclose(mp(x).asnumpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(pool_size=2)
+    onp.testing.assert_allclose(ap(x).asnumpy()[0, 0], [[2.5, 4.5],
+                                                        [10.5, 12.5]])
+    gp = nn.GlobalAvgPool2D()
+    assert gp(x).shape == (1, 1, 1, 1)
+    assert float(gp(x).asnumpy()) == 7.5
+
+
+def test_batchnorm_train_and_infer():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.random.normal(2.0, 3.0, shape=(32, 3, 4, 4))
+    with autograd.record():
+        y = bn(x)
+    # normalized output should have ~0 mean ~1 std per channel
+    yn = y.asnumpy()
+    assert abs(yn.mean()) < 0.1
+    assert abs(yn.std() - 1.0) < 0.1
+    # running stats moved toward batch stats
+    rm = bn.running_mean.data().asnumpy()
+    assert abs(rm.mean() - 0.2) < 0.15  # 0.1 * batch_mean(≈2)
+    # inference uses running stats
+    y2 = bn(x)
+    assert not onp.allclose(y2.asnumpy(), yn)
+
+
+def test_layernorm_groupnorm():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = nd.random.uniform(shape=(4, 6))
+    y = ln(x).asnumpy()
+    onp.testing.assert_allclose(y.mean(axis=-1), onp.zeros(4), atol=1e-5)
+    gn = nn.GroupNorm(num_groups=2, in_channels=4)
+    gn.initialize()
+    assert gn(nd.random.uniform(shape=(2, 4, 3, 3))).shape == (2, 4, 3, 3)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array([1, 3, 5])
+    y = emb(idx)
+    assert y.shape == (3, 4)
+    onp.testing.assert_allclose(y.asnumpy(),
+                                emb.weight.data().asnumpy()[[1, 3, 5]])
+
+
+def test_activations():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    assert nn.Activation("relu")(x).asnumpy().tolist() == [0, 0, 0.5, 2.0]
+    lrelu = nn.LeakyReLU(0.1)(x).asnumpy()
+    onp.testing.assert_allclose(lrelu, [-0.2, -0.05, 0.5, 2.0], rtol=1e-6)
+    selu = nn.SELU()(x)
+    swish = nn.Swish()(x)
+    elu = nn.ELU()(x)
+    gelu = nn.GELU()(x)
+    assert selu.shape == swish.shape == elu.shape == gelu.shape == (4,)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    x = nd.random.uniform(shape=(2, 4))
+    onp.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(),
+                                rtol=1e-6)
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+    pred = nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    label = nd.array([2, 0])
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    expected = -onp.log(onp.exp([3.0, 3.0]) /
+                        onp.exp([[1, 2, 3], [3, 2, 1]]).sum(axis=1))
+    onp.testing.assert_allclose(l.asnumpy(), expected, rtol=1e-5)
+
+    l2 = gloss.L2Loss()(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    onp.testing.assert_allclose(l2.asnumpy(), [0.5, 2.0])
+
+    l1 = gloss.L1Loss()(nd.array([1.0, -2.0]), nd.array([0.0, 0.0]))
+    onp.testing.assert_allclose(l1.asnumpy(), [1.0, 2.0])
+
+    bce = gloss.SigmoidBCELoss()(nd.array([0.0]), nd.array([1.0]))
+    onp.testing.assert_allclose(bce.asnumpy(), [onp.log(2)], rtol=1e-5)
+
+    huber = gloss.HuberLoss()(nd.array([0.5, 3.0]), nd.array([0.0, 0.0]))
+    onp.testing.assert_allclose(huber.asnumpy(), [0.125, 2.5], rtol=1e-5)
+
+    kl = gloss.KLDivLoss()(nd.log(nd.array([[0.5, 0.5]])),
+                           nd.array([[0.5, 0.5]]))
+    assert abs(float(kl.asnumpy())) < 1e-6
+
+
+def test_loss_backward():
+    from mxnet_tpu.gluon import loss as gloss
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    x = nd.random.uniform(shape=(4, 5))
+    y = nd.array([0, 1, 2, 0])
+    with autograd.record():
+        l = loss_fn(net(x), y).mean()
+    l.backward()
+    g = net.weight.grad().asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_metrics():
+    from mxnet_tpu import metric
+    acc = metric.Accuracy()
+    acc.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.2, 0.8],
+                                              [0.7, 0.3]]))
+    assert acc.get() == ("accuracy", 2.0 / 3.0)
+    mae = metric.MAE()
+    mae.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.5]))
+    assert abs(mae.get()[1] - 0.5) < 1e-6
+    comp = metric.CompositeEvalMetric(["acc", "mse"])
+    assert len(comp.metrics) == 2
+    topk = metric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([2]), nd.array([[0.3, 0.1, 0.2]]))
+    assert topk.get()[1] == 1.0
+
+
+def test_dropout_layer_modes():
+    drop = nn.Dropout(0.5)
+    x = nd.ones((100,))
+    # inference: identity
+    onp.testing.assert_allclose(drop(x).asnumpy(), x.asnumpy())
+    with autograd.record():
+        y = drop(x)
+    zeros = int((y.asnumpy() == 0).sum())
+    assert 10 < zeros < 90
+
+
+def test_hybridize_dropout_varies_between_calls():
+    drop = nn.Dropout(0.5)
+    drop.hybridize()
+    x = nd.ones((256,))
+    with autograd.record():
+        y1 = drop(x).asnumpy()
+        y2 = drop(x).asnumpy()
+    assert (y1 != y2).any()
+
+
+def test_initializers():
+    from mxnet_tpu import initializer as init
+    net = nn.Dense(16, in_units=64)
+    net.initialize(init=init.Xavier())
+    w = net.weight.data().asnumpy()
+    bound = onp.sqrt(3.0 / ((16 + 64) / 2))
+    assert w.min() >= -bound and w.max() <= bound
+    net2 = nn.Dense(4, in_units=4)
+    net2.initialize(init=init.Constant(0.5))
+    onp.testing.assert_allclose(net2.weight.data().asnumpy(),
+                                onp.full((4, 4), 0.5))
+    # bias always zero-initialized
+    onp.testing.assert_allclose(net2.bias.data().asnumpy(), onp.zeros(4))
+
+
+def test_block_repr_and_apply():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2, in_units=2))
+    net.initialize()
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert "Dense" in seen and "HybridSequential" in seen

@@ -1,0 +1,190 @@
+"""Trainer/KVStore/optimizer integration + the MNIST E2E slice
+(reference: tests/python/unittest/test_gluon_trainer.py, tests/python/train/)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon import loss as gloss
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    w0 = net.weight.data().asnumpy().copy()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=4)
+    w1 = net.weight.data().asnumpy()
+    # dL/dw = sum over batch of x = [4,4]; rescaled by 1/4 -> [1,1]
+    onp.testing.assert_allclose(w0 - 0.1 * onp.ones((1, 2)), w1, rtol=1e-5)
+
+
+def test_trainer_stale_grad_raises():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd")
+    with pytest.raises(mx.MXNetError):
+        trainer.step(1)  # no backward ran
+    # with ignore_stale_grad it proceeds
+    trainer.step(1, ignore_stale_grad=True)
+
+
+def test_trainer_lr_scheduler():
+    from mxnet_tpu import lr_scheduler
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = nd.ones((1, 1))
+    lrs = []
+    for _ in range(5):
+        with autograd.record():
+            l = net(x).sum()
+        l.backward()
+        trainer.step(1)
+        lrs.append(trainer.learning_rate)
+    assert lrs[0] == 1.0 and lrs[-1] < 1.0
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "adam")
+    x = nd.ones((2, 2))
+    for _ in range(3):
+        with autograd.record():
+            l = (net(x) ** 2).sum()
+        l.backward()
+        trainer.step(2)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer2 = Trainer(net.collect_params(), "adam")
+    trainer2.load_states(f)
+    assert len(trainer2._updater.states) == len(trainer._updater.states)
+
+
+def test_kvstore_push_pull():
+    kv = mx.kvstore.create("tpu")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((2, 3)))
+    # push replica list: sums
+    kv.push(3, [nd.ones((2, 3)), nd.ones((2, 3))])
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * onp.ones((2, 3)))
+
+
+def test_kvstore_pushpull_fused():
+    kv = mx.kvstore.create("tpu")
+    a = nd.full((2,), 1.0)
+    b = nd.full((2,), 3.0)
+    kv.pushpull(0, [a, b])
+    onp.testing.assert_allclose(a.asnumpy(), [4.0, 4.0])
+    onp.testing.assert_allclose(b.asnumpy(), [4.0, 4.0])
+
+
+def test_kvstore_broadcast():
+    kv = mx.kvstore.create("tpu")
+    src = nd.full((3,), 5.0)
+    dst = nd.zeros((3,))
+    kv.broadcast("w", src, out=dst)
+    onp.testing.assert_allclose(dst.asnumpy(), [5, 5, 5])
+
+
+def test_kvstore_update_on_store():
+    from mxnet_tpu import optimizer as opt
+    kv = mx.kvstore.create("tpu")
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    kv.init(0, nd.ones((2,)))
+    kv.push(0, nd.ones((2,)))  # grad = 1 -> w = 1 - 0.5
+    out = nd.zeros((2,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.5])
+
+
+def test_kvstore_types():
+    for name in ("local", "device", "tpu", "nccl"):
+        kv = mx.kvstore.create(name)
+        assert kv.num_workers == 1 and kv.rank == 0
+
+
+def _train_mnist(hybridize: bool, epochs=3):
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+    mx.random.seed(0)
+    train_set = MNIST(root="/nonexistent", train=True)  # synthetic fallback
+    to_tensor = transforms.ToTensor()
+    train_set = train_set.transform_first(lambda x: to_tensor(x))
+    loader = DataLoader(train_set, batch_size=256, shuffle=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 1e-3}, kvstore="tpu")
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for _ in range(epochs):
+        metric.reset()
+        for data, label in loader:
+            data = data.reshape(data.shape[0], -1)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update(label, out)
+    return metric.get()[1]
+
+
+def test_mnist_mlp_convergence():
+    """SURVEY §7 stage 5: the minimum end-to-end slice."""
+    acc = _train_mnist(hybridize=False, epochs=2)
+    assert acc > 0.85, f"imperative MLP failed to converge: acc={acc}"
+
+
+def test_mnist_mlp_convergence_hybrid():
+    acc = _train_mnist(hybridize=True, epochs=2)
+    assert acc > 0.85, f"hybrid MLP failed to converge: acc={acc}"
+
+
+def test_dataloader_basics():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = onp.random.rand(50, 4).astype("float32")
+    Y = onp.arange(50).astype("float32")
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 50
+    loader = DataLoader(ds, batch_size=16, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (16, 4) and yb.shape == (16,)
+    onp.testing.assert_allclose(yb.asnumpy(), onp.arange(16))
+    # last_batch discard
+    loader2 = DataLoader(ds, batch_size=16, last_batch="discard")
+    assert len(list(loader2)) == 3
+    # threaded workers
+    loader3 = DataLoader(ds, batch_size=10, num_workers=2)
+    assert sum(b[1].shape[0] for b in loader3) == 50
+
+
+def test_dataloader_sampler_api():
+    from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                      RandomSampler, SequentialSampler)
+    ds = ArrayDataset(onp.arange(10).astype("float32"))
+    bs = BatchSampler(SequentialSampler(10), 3, "keep")
+    loader = DataLoader(ds, batch_sampler=bs)
+    sizes = [b.shape[0] for b in loader]
+    assert sizes == [3, 3, 3, 1]
+    rs = RandomSampler(10)
+    assert sorted(list(rs)) == list(range(10))
