@@ -46,6 +46,15 @@ class Context:
             device_type = "tpu"
         if device_type not in ("cpu", "tpu", "cpu_pinned"):
             raise MXNetError(f"unknown device type {device_type!r}")
+        if device_type == "tpu" and device_id != 0:
+            # eager bounds check: a dangling tpu(i) would otherwise fail
+            # far from its construction site (reference Context is lazy,
+            # but its CUDA calls fail fast at first use on a bad ordinal)
+            n = len(_accelerator_devices())
+            if device_id >= n:
+                raise MXNetError(
+                    f"tpu({device_id}) requested but only {n} accelerator "
+                    "device(s) present")
         self.device_type = device_type
         self.device_id = device_id
         self._old_ctx: Optional["Context"] = None

@@ -102,8 +102,7 @@ class Block:
             self.__dict__.setdefault("_children", OrderedDict())[name] = value
         elif isinstance(value, Parameter):
             self.__dict__.setdefault("_reg_params", {})[name] = value
-            if value._name in ("weight", "bias", "const", "") or \
-                    value._name == "weight":
+            if value._name in ("weight", "bias", "const", ""):
                 value._name = name
         super().__setattr__(name, value)
 
@@ -376,7 +375,12 @@ class HybridBlock(Block):
             for i, s in zip(info["state_idx"], states):
                 # REBIND (not mutate) so an enclosing hybridized parent's
                 # trace detects this as a state update too (id check in its
-                # _build_cache); in-place mutation would be invisible to it
+                # _build_cache); in-place mutation would be invisible to it.
+                # DETACH from the tape: stats updates are non-differentiable
+                # (reference BN aux states bypass autograd), and a retained
+                # entry would chain the next iteration's graph into this
+                # (freed) one via the moving-stats input.
+                s._tape_entry = None
                 params[i]._data = s
         # rebuild output structure around the tape-carrying handles
         return jax.tree_util.tree_unflatten(info["out_treedef"], list(outs))

@@ -1,0 +1,9 @@
+"""Model zoo (reference: python/mxnet/gluon/model_zoo/__init__.py).
+
+Pretrained-weight downloads are not available in this environment; models are
+constructed with random init and support ``load_parameters`` from local files.
+"""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

@@ -1,0 +1,247 @@
+"""MobileNet V1/V2/V3 (reference: python/mxnet/gluon/model_zoo/vision/mobilenet.py
+plus the V3 variant the reference ships in gluon-cv form).
+
+Depthwise convs map to ``feature_group_count=channels`` grouped
+lax.conv_general_dilated, which XLA lowers efficiently on TPU.
+"""
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["MobileNet", "MobileNetV2", "MobileNetV3",
+           "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+           "mobilenet_v2_0_25", "mobilenet_v3_large", "mobilenet_v3_small",
+           "get_mobilenet", "get_mobilenet_v2"]
+
+
+def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=False, act_type="relu"):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        if relu6:
+            out.add(nn.Lambda(lambda x: x.clip(0, 6)))
+        elif act_type == "hswish":
+            out.add(nn.Lambda(lambda x: x * (x + 3).clip(0, 6) / 6))
+        else:
+            out.add(nn.Activation(act_type))
+
+
+def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
+    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
+              num_group=dw_channels, relu6=relu6)
+    _add_conv(out, channels, relu6=relu6)
+
+
+class LinearBottleneck(HybridBlock):
+    """MobileNetV2 inverted residual (expand-depthwise-project)."""
+
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential()
+        if t != 1:
+            _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
+                  num_group=in_channels * t, relu6=True)
+        _add_conv(self.out, channels, active=False)
+
+    def forward(self, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNet(HybridBlock):
+    """MobileNetV1 (Howard et al. 1704.04861)."""
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
+                  pad=1)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2, 1, 2, 1, 2, 1, 1, 1, 1, 1, 2, 1]
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            _add_conv_dw(self.features, dwc, c, s)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class MobileNetV2(HybridBlock):
+    """MobileNetV2 (Sandler et al. 1801.04381)."""
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
+                  pad=1, relu6=True)
+        in_channels_group = [int(x * multiplier) for x in
+                             [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 +
+                             [96] * 3 + [160] * 3]
+        channels_group = [int(x * multiplier) for x in
+                          [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 +
+                          [160] * 3 + [320]]
+        ts = [1] + [6] * 16
+        strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
+        for in_c, c, t, s in zip(in_channels_group, channels_group, ts,
+                                 strides):
+            self.features.add(LinearBottleneck(in_c, c, t, s))
+        last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _add_conv(self.features, last_channels, relu6=True)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class _SE(HybridBlock):
+    """Squeeze-and-excitation used by MobileNetV3."""
+
+    def __init__(self, channels, reduction=4, **kwargs):
+        super().__init__(**kwargs)
+        self.fc1 = nn.Conv2D(channels // reduction, 1, use_bias=True)
+        self.fc2 = nn.Conv2D(channels, 1, use_bias=True)
+
+    def forward(self, x):
+        from ....ndarray import nn_ops as FNN
+        w = FNN.Pooling(x, pool_type="avg", global_pool=True)
+        w = self.fc1(w).relu()
+        w = self.fc2(w)
+        w = (w + 3).clip(0, 6) / 6  # hard-sigmoid
+        return x * w
+
+
+class _V3Bottleneck(HybridBlock):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, se, act,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_c == out_c
+        self.body = nn.HybridSequential()
+        if exp_c != in_c:
+            _add_conv(self.body, exp_c, act_type=act)
+        _add_conv(self.body, exp_c, kernel=kernel, stride=stride,
+                  pad=kernel // 2, num_group=exp_c, act_type=act)
+        if se:
+            self.body.add(_SE(exp_c))
+        _add_conv(self.body, out_c, active=False)
+
+    def forward(self, x):
+        out = self.body(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+# (kernel, exp, out, SE, activation, stride)
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2), (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1), (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1), (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2), (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1), (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1), (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2), (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+class MobileNetV3(HybridBlock):
+    def __init__(self, mode="large", classes=1000, multiplier=1.0, **kwargs):
+        super().__init__(**kwargs)
+        spec = _V3_LARGE if mode == "large" else _V3_SMALL
+        last_exp = 960 if mode == "large" else 576
+        last_ch = 1280 if mode == "large" else 1024
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(16 * multiplier), kernel=3, stride=2,
+                  pad=1, act_type="hswish")
+        in_c = int(16 * multiplier)
+        for k, exp, out_c, se, act, s in spec:
+            exp_c = int(exp * multiplier)
+            o = int(out_c * multiplier)
+            self.features.add(_V3Bottleneck(in_c, exp_c, o, k, s, se, act))
+            in_c = o
+        _add_conv(self.features, int(last_exp * multiplier),
+                  act_type="hswish")
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(last_ch, 1, use_bias=True))
+        self.output.add(nn.Lambda(lambda x: x * (x + 3).clip(0, 6) / 6))
+        self.output.add(nn.Conv2D(classes, 1, use_bias=True))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+    return MobileNet(multiplier, **kwargs)
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    return MobileNetV2(multiplier, **kwargs)
+
+
+def mobilenet1_0(**kwargs):
+    return get_mobilenet(1.0, **kwargs)
+
+
+def mobilenet0_75(**kwargs):
+    return get_mobilenet(0.75, **kwargs)
+
+
+def mobilenet0_5(**kwargs):
+    return get_mobilenet(0.5, **kwargs)
+
+
+def mobilenet0_25(**kwargs):
+    return get_mobilenet(0.25, **kwargs)
+
+
+def mobilenet_v2_1_0(**kwargs):
+    return get_mobilenet_v2(1.0, **kwargs)
+
+
+def mobilenet_v2_0_75(**kwargs):
+    return get_mobilenet_v2(0.75, **kwargs)
+
+
+def mobilenet_v2_0_5(**kwargs):
+    return get_mobilenet_v2(0.5, **kwargs)
+
+
+def mobilenet_v2_0_25(**kwargs):
+    return get_mobilenet_v2(0.25, **kwargs)
+
+
+def mobilenet_v3_large(**kwargs):
+    return MobileNetV3("large", **kwargs)
+
+
+def mobilenet_v3_small(**kwargs):
+    return MobileNetV3("small", **kwargs)

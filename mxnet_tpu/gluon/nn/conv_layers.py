@@ -168,12 +168,14 @@ class _Pool(HybridBlock):
         self._pool_type = pool_type
         self._global = global_pool
         self._cip = count_include_pad
+        self._ceil = ceil_mode
 
     def forward(self, x):
         return FNN.Pooling(x, kernel=self._kernel, pool_type=self._pool_type,
                            stride=self._strides, pad=self._padding,
                            global_pool=self._global,
-                           count_include_pad=self._cip)
+                           count_include_pad=self._cip,
+                           ceil_mode=self._ceil)
 
 
 class MaxPool1D(_Pool):

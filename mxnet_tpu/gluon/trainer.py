@@ -128,12 +128,16 @@ class Trainer:
                 self._kvstore.pull(i, p.list_data())
                 continue
             data = p.data()
-            if not ignore_stale_grad and p.grad_req != "null" \
-                    and data.grad is not None and not data.fresh_grad:
-                raise MXNetError(
-                    f"gradient of parameter {p.name} has not been updated "
-                    "by backward since the last step; set "
-                    "ignore_stale_grad=True to suppress")
+            if p.grad_req != "null" and data.grad is not None \
+                    and not data.fresh_grad:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        f"gradient of parameter {p.name} has not been "
+                        "updated by backward since the last step; set "
+                        "ignore_stale_grad=True to suppress")
+                # reference trainer.py skips stale params entirely rather
+                # than re-applying the old gradient
+                continue
             self._updater(i, p.grad(), data)
             data.fresh_grad = False
 

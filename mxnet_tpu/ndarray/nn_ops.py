@@ -54,14 +54,16 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
 
 def Pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
             global_pool=False, count_include_pad=True, pooling_convention=None,
-            **_ignored):
+            ceil_mode=False, p_value=2, **_ignored):
     data = _wrap(data)
     if global_pool:
         return invoke_raw("global_pool",
                           lambda x: K.global_pool(x, pool_type), [data])
+    ceil = ceil_mode or pooling_convention == "full"
     return invoke_raw(
         "pooling",
-        lambda x: K.pool(x, kernel, pool_type, stride, pad, count_include_pad),
+        lambda x: K.pool(x, kernel, pool_type, stride, pad, count_include_pad,
+                         ceil, p_value),
         [data])
 
 

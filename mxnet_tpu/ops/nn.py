@@ -104,15 +104,26 @@ def conv_transpose(x, w, b=None, stride=None, dilate=None, pad=None,
 
 
 def pool(x, kernel, pool_type: str = "max", stride=None, pad=None,
-         count_include_pad: bool = True):
-    """Max/avg/sum/lp pooling via XLA reduce_window (reference Pooling op)."""
+         count_include_pad: bool = True, ceil_mode: bool = False,
+         p_value: int = 2):
+    """Max/avg/sum/lp pooling via XLA reduce_window (reference Pooling op).
+    ceil_mode ≙ reference pooling_convention='full': extra right-padding so
+    the output size uses ceil instead of floor (src/operator/nn/pooling.cc)."""
     ndim = x.ndim - 2
     kernel = _tup(kernel, ndim)
     stride = _tup(stride if stride is not None else kernel, ndim)
     pad = _tup(pad if pad is not None else 0, ndim)
+    rpad = list(pad)
+    if ceil_mode:
+        for i in range(ndim):
+            span = x.shape[2 + i] + 2 * pad[i] - kernel[i]
+            rem = span % stride[i]
+            if rem:
+                rpad[i] = pad[i] + (stride[i] - rem)
     window = (1, 1) + kernel
     strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, r) for p, r in zip(pad, rpad))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -121,18 +132,26 @@ def pool(x, kernel, pool_type: str = "max", stride=None, pad=None,
         s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
         if pool_type == "sum":
             return s
-        if count_include_pad or all(p == 0 for p in pad):
-            denom = 1.0
-            for k in kernel:
-                denom *= k
-            return s / denom
-        ones = jnp.ones(x.shape, x.dtype)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        # Denominator semantics (reference src/operator/nn/pool.h): with
+        # count_include_pad the window is clipped to the explicitly-padded
+        # extent [0, H+2p) — ceil_mode's extra right-padding never counts;
+        # without it only real elements count. Both reduce to a constant
+        # that XLA folds when no clipping can occur.
+        if count_include_pad:
+            cnt_shape = (1, 1) + tuple(x.shape[2 + i] + 2 * pad[i]
+                                       for i in range(ndim))
+            cnt_pad = ((0, 0), (0, 0)) + tuple(
+                (0, r - p) for p, r in zip(pad, rpad))
+            ones = jnp.ones(cnt_shape, x.dtype)
+        else:
+            ones = jnp.ones((1, 1) + x.shape[2:], x.dtype)
+            cnt_pad = padding
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, cnt_pad)
         return s / cnt
     if pool_type == "lp":
-        s = lax.reduce_window(jnp.abs(x) ** 2, 0.0, lax.add, window, strides,
-                              padding)
-        return jnp.sqrt(s)
+        s = lax.reduce_window(jnp.abs(x) ** p_value, 0.0, lax.add, window,
+                              strides, padding)
+        return s ** (1.0 / p_value)
     raise MXNetError(f"unknown pool_type {pool_type}")
 
 

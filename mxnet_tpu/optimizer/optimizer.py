@@ -684,16 +684,28 @@ class Updater:
             self.optimizer._update_one(i, w, g, self.states[i])
 
     def get_states(self, dump_optimizer=False):
+        """Reference optimizer/updater.py: pickles (states, optimizer) when
+        dump_optimizer so num_update / index counts survive a restart."""
         import pickle
         host = {k: jax.tree_util.tree_map(
                     lambda s: onp.asarray(s._data), v,
                     is_leaf=lambda s: isinstance(s, NDArray))
                 for k, v in self.states.items()}
+        if dump_optimizer:
+            meta = dict(num_update=self.optimizer.num_update,
+                        index_update_count=dict(
+                            self.optimizer._index_update_count))
+            return pickle.dumps((host, type(self.optimizer).__name__, meta))
         return pickle.dumps(host)
 
     def set_states(self, states_bytes):
         import pickle
         loaded = pickle.loads(states_bytes)
+        if isinstance(loaded, tuple):
+            loaded, _opt_name, meta = loaded
+            self.optimizer.num_update = meta["num_update"]
+            self.optimizer._index_update_count.update(
+                meta["index_update_count"])
         self.states = {k: jax.tree_util.tree_map(
                            lambda a: NDArray(jnp.asarray(a)), v)
                        for k, v in loaded.items()}

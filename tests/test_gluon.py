@@ -283,3 +283,64 @@ def test_block_repr_and_apply():
     seen = []
     net.apply(lambda b: seen.append(type(b).__name__))
     assert "Dense" in seen and "HybridSequential" in seen
+
+
+def test_avg_pool_ceil_mode_denominator():
+    """ceil_mode extra padding must not count toward the avg denominator
+    (reference src/operator/nn/pool.h clips the window)."""
+    import numpy as onp
+    from mxnet_tpu import nd
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    from mxnet_tpu.ndarray import nn_ops as FNN
+    y = FNN.Pooling(x, kernel=(3, 3), pool_type="avg", stride=(2, 2),
+                    ceil_mode=True).asnumpy()
+    assert y.shape == (1, 1, 2, 2)
+    xn = onp.arange(16, dtype="float32").reshape(4, 4)
+    # window [2:4, 2:4] has only 4 real elements -> mean over 4, not 9
+    onp.testing.assert_allclose(y[0, 0, 1, 1], xn[2:4, 2:4].mean(), rtol=1e-6)
+    onp.testing.assert_allclose(y[0, 0, 0, 0], xn[0:3, 0:3].mean(), rtol=1e-6)
+
+
+def test_trainer_stale_grad_skips_param():
+    """With ignore_stale_grad=True the stale parameter is skipped, not
+    re-updated with the old gradient (reference trainer.py behavior)."""
+    import numpy as onp
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import Trainer, nn
+    a = nn.Dense(1, in_units=2, use_bias=False)
+    b = nn.Dense(1, in_units=2, use_bias=False)
+    a.initialize()
+    b.initialize()
+    params = {**{f"a.{k}": v for k, v in a.collect_params().items()},
+              **{f"b.{k}": v for k, v in b.collect_params().items()}}
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.1})
+    x = nd.ones((2, 2))
+    with autograd.record():
+        loss = (a(x) + b(x)).sum()
+    loss.backward()
+    trainer.step(1)
+    b0 = b.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = a(x).sum()   # b unused this iteration
+    loss.backward()
+    trainer.step(1, ignore_stale_grad=True)
+    onp.testing.assert_allclose(b.weight.data().asnumpy(), b0)
+
+
+def test_updater_states_keep_update_counts(tmp_path):
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import Trainer, nn
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "adam")
+    x = nd.ones((1, 1))
+    for _ in range(5):
+        with autograd.record():
+            l = net(x).sum()
+        l.backward()
+        trainer.step(1)
+    f = str(tmp_path / "s.states")
+    trainer.save_states(f)
+    trainer2 = Trainer(net.collect_params(), "adam")
+    trainer2.load_states(f)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update

@@ -1,0 +1,85 @@
+"""Model zoo construction + forward smoke tests
+(reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 112), ("resnet18_v2", 112), ("resnet34_v1", 112),
+    ("resnet50_v1", 112), ("resnet50_v2", 112),
+    ("vgg11", 64), ("vgg11_bn", 64),
+    ("alexnet", 224),
+    ("squeezenet1.0", 224), ("squeezenet1.1", 224),
+    ("densenet121", 64),
+    ("mobilenet0.25", 64), ("mobilenetv2_0.25", 64),
+    ("mobilenetv3_small", 64),
+])
+def test_model_forward(name, size):
+    net = get_model(name, classes=10)
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3, size, size))
+    y = net(x)
+    assert y.shape == (1, 10)
+
+
+def test_inception_v3():
+    net = get_model("inceptionv3", classes=10)
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3, 299, 299))
+    y = net(x)
+    assert y.shape == (1, 10)
+
+
+def test_resnet18_hybrid_matches_eager():
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3, 32, 32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_hyb, rtol=2e-4, atol=2e-4)
+
+
+def test_resnet_train_step():
+    """One SGD step through hybridized resnet18 converges the loss."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    net = get_model("resnet18_v1", classes=4, thumbnail=True)
+    net.initialize()
+    net.hybridize()
+    loss_fn = SoftmaxCrossEntropyLoss()
+    x = nd.random.uniform(shape=(8, 3, 16, 16))
+    label = nd.array(onp.random.randint(0, 4, (8,)))
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            loss = loss_fn(net(x), label).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert min(losses[1:]) < losses[0]
+
+
+def test_get_model_unknown_raises():
+    with pytest.raises(mx.MXNetError):
+        get_model("resnet1000_v9")
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    net = get_model("mobilenet0.25", classes=7)
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3, 64, 64))
+    y0 = net(x).asnumpy()
+    f = str(tmp_path / "m.params")
+    net.save_parameters(f)
+    net2 = get_model("mobilenet0.25", classes=7)
+    net2.load_parameters(f)
+    y1 = net2(x).asnumpy()
+    onp.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
