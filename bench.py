@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Headline benchmark: Gluon ResNet-50 training throughput, images/sec.
+
+Baseline: reference MXNet-CUDA ResNet-50 training, bs=128 on V100 =
+363.69 img/s (docs/static_site/src/pages/api/faq/perf.md:254; BASELINE.md).
+The driver runs this on one real TPU chip; vs_baseline is img/s-per-chip
+against the V100 row, per BASELINE.json's north star.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+The whole training step (forward, loss, backward, SGD-momentum update) is one
+donated-buffer XLA computation — the TPU-native answer to the reference's
+CachedOp static_alloc + bulking + fused multi_sgd (SURVEY §3.2/§3.4).
+"""
+import json
+import sys
+import time
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_IMG_S = 363.69  # V100 fp32 training, bs=128
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu import _tape
+    from __graft_entry__ import _functional_apply, _init_net
+
+    backend = jax.default_backend()
+    on_accel = backend != "cpu"
+    bs = 128 if on_accel else 4
+    size = 224 if on_accel else 32
+    warmup = 3 if on_accel else 1
+    steps = 20 if on_accel else 2
+    log(f"bench: backend={backend} bs={bs} size={size} steps={steps}")
+
+    onp.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    params = _init_net(net, (1, 3, size, size))
+    apply_fn = _functional_apply(net, params, train=True)
+    loss_blk = SoftmaxCrossEntropyLoss()
+    lr, momentum = 0.1, 0.9
+
+    def train_step(param_datas, mom, x, y, key):
+        def loss_fn(pd):
+            logits = apply_fn(pd, x, key)
+            prev = _tape.set_recording(False)
+            try:
+                l = loss_blk.forward(NDArray(logits), NDArray(y))
+            finally:
+                _tape.set_recording(prev)
+            return jnp.mean(l._data)
+
+        loss, grads = jax.value_and_grad(loss_fn)(param_datas)
+        new_mom = tuple(momentum * m + g for m, g in zip(mom, grads))
+        new_pd = tuple(d - lr * m for d, m in zip(param_datas, new_mom))
+        return new_pd, new_mom, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    pd = tuple(p._data._data for p in params)
+    mom = tuple(jnp.zeros_like(d) for d in pd)
+    x = jnp.asarray(onp.random.uniform(size=(bs, 3, size, size))
+                    .astype("float32"))
+    y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        pd, mom, loss = step(pd, mom, x, y, key)
+    jax.block_until_ready(loss)
+    log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
+        f"loss={float(loss):.3f}")
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        pd, mom, loss = step(pd, mom, x, y, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    img_s = bs * steps / dt
+
+    print(json.dumps({
+        "metric": "resnet50_v1_train_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

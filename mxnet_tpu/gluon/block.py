@@ -38,6 +38,16 @@ from .parameter import Parameter, DeferredInitializationError
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
+class _TracedSentinel:
+    """Marks a traced-leaf position inside a cached op's static_spec."""
+
+    def __repr__(self):
+        return "<traced>"
+
+
+_TRACED = _TracedSentinel()
+
+
 def _in_trace(args) -> bool:
     """True when any input is a jax tracer — i.e. we are already inside an
     enclosing jit trace and must inline rather than nest cached ops."""
@@ -274,7 +284,7 @@ class HybridBlock(Block):
         return self(x, *args)
 
     # -------- cache construction --------
-    def _ensure_shapes(self, args):
+    def _ensure_shapes(self, args, kwargs=None):
         """Trigger deferred param init by one throwaway eager forward
         (the reference's deferred-compute trace performs shape inference;
         our layers infer shapes inline in forward)."""
@@ -282,10 +292,10 @@ class HybridBlock(Block):
                          for p in self.collect_params().values())
         if incomplete:
             with autograd.pause():
-                self.forward(*args)
+                self.forward(*args, **(kwargs or {}))
 
-    def _build_cache(self, args):
-        self._ensure_shapes(args)
+    def _build_cache(self, args, kwargs=None):
+        self._ensure_shapes(args, kwargs)
         self._cached_out_info = {}
         params = [p for p in self.collect_params().values()
                   if p._data is not None]
@@ -293,10 +303,18 @@ class HybridBlock(Block):
         block = self
         info = self._cached_out_info
 
-        def fn(rng_key, arg_leaves, arg_treedef, train_mode, *param_datas):
-            args_nd = jax.tree_util.tree_unflatten(arg_treedef, list(arg_leaves))
-            if not isinstance(args_nd, (list, tuple)):
-                args_nd = (args_nd,)
+        def fn(rng_key, traced_leaves, arg_treedef, train_mode, static_spec,
+               nd_mask, *param_datas):
+            # (args, kwargs) were flattened with NDArray as LEAF so the
+            # caller could keep the original handles (and their tape entries)
+            # as the recorded op's inputs. static_spec holds non-array leaves
+            # (python flags etc.) verbatim with _TRACED sentinels at traced
+            # positions; nd_mask marks which traced leaves were NDArrays.
+            it = iter(NDArray(l) if m else l
+                      for l, m in zip(traced_leaves, nd_mask))
+            leaves = [next(it) if s is _TRACED else s for s in static_spec]
+            args_nd, kwargs_nd = jax.tree_util.tree_unflatten(
+                arg_treedef, leaves)
             orig = [p._data for p in params]
             bound_ids = []
             for p, d in zip(params, param_datas):
@@ -308,7 +326,7 @@ class HybridBlock(Block):
             prev = _tape.set_recording(False)
             prev_t = _tape.set_training(train_mode)
             try:
-                out = block.forward(*args_nd)
+                out = block.forward(*args_nd, **kwargs_nd)
             finally:
                 _tape.set_recording(prev)
                 _tape.set_training(prev_t)
@@ -328,43 +346,59 @@ class HybridBlock(Block):
             # output handles
             out_leaves, out_treedef = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda t: isinstance(t, NDArray))
-            # per-mode info: train traces may emit extra state outputs
-            info[train_mode] = dict(out_treedef=out_treedef,
-                                    n_out=len(out_leaves),
-                                    state_idx=state_idx)
+            # keyed by the full static-arg signature: jax.jit retraces per
+            # (treedef, train, static_spec, nd_mask), so output metadata must
+            # too — a train-only key would go stale if the structure changes
+            info[(train_mode, arg_treedef, static_spec, nd_mask)] = dict(
+                out_treedef=out_treedef, n_out=len(out_leaves),
+                state_idx=state_idx)
             return tuple(o._data if isinstance(o, NDArray) else o
                          for o in out_leaves) + tuple(state_leaves)
 
-        self._cached_fn = jax.jit(fn, static_argnums=(2, 3))
+        self._cached_fn = jax.jit(fn, static_argnums=(2, 3, 4, 5))
 
-    def _call_cached_op(self, *args):
+    def _call_cached_op(self, *args, **kwargs):
         """Reference block.py:1095 → CachedOp::Forward. One tape node per
         call; backward differentiates the whole compiled computation."""
         if self._cached_fn is None:
-            self._build_cache(args)
+            self._build_cache(args, kwargs)
         params = self._cached_params
-        arg_leaves, arg_treedef = jax.tree_util.tree_flatten(args)
+        # NDArray stays a LEAF here: the original handles carry the tape
+        # entries that link this cached op to upstream recorded ops (a raw
+        # pytree flatten would strip them and sever the autograd chain).
+        # Array leaves become traced inputs; anything else (python flags,
+        # strings, None) is static and baked into the jit signature.
+        all_leaves, arg_treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda t: isinstance(t, NDArray))
+        traced = [l for l in all_leaves
+                  if isinstance(l, (NDArray, onp.ndarray, jax.Array))]
+        static_spec = tuple(
+            _TRACED if isinstance(l, (NDArray, onp.ndarray, jax.Array))
+            else l for l in all_leaves)
+        nd_mask = tuple(isinstance(l, NDArray) for l in traced)
         rng_key = next_key()
         train = _tape.is_training()
 
         fn = self._cached_fn
 
         def op_fn(*leaves_and_params, _fn=fn, _treedef=arg_treedef,
-                  _key=rng_key, _n_args=len(arg_leaves), _train=train):
+                  _key=rng_key, _n_args=len(traced), _train=train,
+                  _static=static_spec, _mask=nd_mask):
             a = leaves_and_params[:_n_args]
             pd = leaves_and_params[_n_args:]
-            return _fn(_key, a, _treedef, _train, *pd)
+            return _fn(_key, a, _treedef, _train, _static, _mask, *pd)
 
-        inputs = ([NDArray(l) if not isinstance(l, NDArray) else l
-                   for l in arg_leaves] +
+        inputs = ([l if isinstance(l, NDArray) else NDArray(l)
+                   for l in traced] +
                   [p._data for p in params])
-        # first call per mode: lower once (traces fn, populating info)
-        if train not in self._cached_out_info:
+        # first call per static signature: lower once (traces fn → info)
+        sig = (train, arg_treedef, static_spec, nd_mask)
+        if sig not in self._cached_out_info:
             fn.lower(rng_key,
-                     tuple(l._data for l in inputs[:len(arg_leaves)]),
-                     arg_treedef, train,
+                     tuple(l._data for l in inputs[:len(traced)]),
+                     arg_treedef, train, static_spec, nd_mask,
                      *[p._data._data for p in params])
-        info = self._cached_out_info[train]
+        info = self._cached_out_info[sig]
         n_total = info["n_out"] + len(info["state_idx"])
         result = invoke_raw(f"cached_op_{self._name}", op_fn, inputs,
                             n_outputs=n_total)
