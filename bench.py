@@ -32,10 +32,7 @@ def log(msg):
 def main():
     import mxnet_tpu as mx  # noqa: F401
     from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
-    from mxnet_tpu.ndarray.ndarray import NDArray
-    from mxnet_tpu import _tape
-    from __graft_entry__ import _functional_apply, _init_net
+    from __graft_entry__ import make_train_step, _init_net
 
     backend = jax.default_backend()
     on_accel = backend != "cpu"
@@ -48,28 +45,12 @@ def main():
     onp.random.seed(0)
     net = vision.resnet50_v1(classes=1000)
     params = _init_net(net, (1, 3, size, size))
-    apply_fn = _functional_apply(net, params, train=True)
-    loss_blk = SoftmaxCrossEntropyLoss()
-    lr, momentum = 0.1, 0.9
-
-    def train_step(param_datas, mom, x, y, key):
-        def loss_fn(pd):
-            logits = apply_fn(pd, x, key)
-            prev = _tape.set_recording(False)
-            try:
-                l = loss_blk.forward(NDArray(logits), NDArray(y))
-            finally:
-                _tape.set_recording(prev)
-            return jnp.mean(l._data)
-
-        loss, grads = jax.value_and_grad(loss_fn)(param_datas)
-        new_mom = tuple(momentum * m + g for m, g in zip(mom, grads))
-        new_pd = tuple(d - lr * m for d, m in zip(param_datas, new_mom))
-        return new_pd, new_mom, loss
-
+    train_step = make_train_step(net, params, lr=0.1)
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
-    pd = tuple(p._data._data for p in params)
+    # copy the initial buffers: donation must not invalidate the live
+    # Parameters still referenced by the Gluon net
+    pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
     mom = tuple(jnp.zeros_like(d) for d in pd)
     x = jnp.asarray(onp.random.uniform(size=(bs, 3, size, size))
                     .astype("float32"))

@@ -420,12 +420,13 @@ class HybridBlock(Block):
         return jax.tree_util.tree_unflatten(info["out_treedef"], list(outs))
 
     def __call__(self, *args, **kwargs):
-        if not _in_trace(args):
+        all_inputs = args + tuple(kwargs.values())
+        if not _in_trace(all_inputs):
             # remember input signature for export (trace_block_to_symbol)
             self._last_input_avals = [
                 jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
-                for a in args if isinstance(a, NDArray)]
-        if self._active and not _in_trace(args):
+                for a in all_inputs if isinstance(a, NDArray)]
+        if self._active and not _in_trace(all_inputs):
             for hook in self._forward_pre_hooks:
                 hook(self, args)
             out = self._call_cached_op(*args, **kwargs)

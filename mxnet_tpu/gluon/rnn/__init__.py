@@ -1,0 +1,5 @@
+"""Gluon recurrent API (reference: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, DropoutCell, ZoneoutCell,
+                       ResidualCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU

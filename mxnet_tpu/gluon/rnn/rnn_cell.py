@@ -1,0 +1,351 @@
+"""Recurrent cells (reference: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are ordinary HybridBlocks stepping one timestep; ``unroll`` is a Python
+loop — under ``hybridize()`` the loop is unrolled into one XLA computation
+(static sequence length), the TPU-idiomatic equivalent of the reference's
+symbolic unroll. For long sequences prefer the fused layers (rnn_layer.py)
+whose ``lax.scan`` compiles the body once.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...base import MXNetError
+from ...ndarray import ndarray as ndmod
+from ...ndarray import ops as F
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalize inputs to a list of (N, C) steps or a merged tensor."""
+    t_axis = layout.find("T")
+    if isinstance(inputs, (list, tuple)):
+        steps = list(inputs)
+        if length is not None and len(steps) != length:
+            raise MXNetError(f"expected {length} steps, got {len(steps)}")
+        merged = None
+    else:
+        merged = inputs
+        if length is None:
+            length = inputs.shape[t_axis]
+        steps = [inputs.take(i, axis=t_axis) for i in range(length)]
+    if merge:
+        stacked = F.stack(*steps, axis=t_axis)
+        return stacked, length, t_axis
+    return steps, length, t_axis
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell: ``cell(input, states) -> (output, new_states)``."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or ndmod.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def reset(self):
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Run the cell over ``length`` steps (reference rnn_cell.py unroll)."""
+        steps, length, t_axis = _format_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            batch = steps[0].shape[0]
+            begin_state = self.begin_state(batch, dtype=str(steps[0].dtype))
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(steps[i], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = F.stack(*outputs, axis=0)  # (T, N, C)
+            masked = F.SequenceMask(stacked, sequence_length=valid_length,
+                                    use_sequence_length=True, value=0.0)
+            outputs = [masked.take(i, axis=0) for i in range(length)]
+        if merge_outputs:
+            return F.stack(*outputs, axis=t_axis), states
+        return outputs, states
+
+
+class _BaseRNNCell(RecurrentCell):
+    """Shared parameter plumbing for the three gated cells."""
+
+    _gates = 1
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = self._gates * hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(ng, input_size),
+                                    dtype=dtype, init=i2h_weight_initializer)
+        self.h2h_weight = Parameter("h2h_weight", shape=(ng, hidden_size),
+                                    dtype=dtype, init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng,), dtype=dtype,
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng,), dtype=dtype,
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _proj(self, x, h):
+        if self._input_size == 0:
+            self._input_size = x.shape[-1]
+            self.i2h_weight.shape = (self.i2h_weight.shape[0], x.shape[-1])
+        for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                  self.h2h_bias):
+            if p._data is None and p._deferred_init_args is not None:
+                p._finish_deferred_init()
+        i2h = F.FullyConnected(x, self.i2h_weight.data(),
+                               self.i2h_bias.data(),
+                               num_hidden=self.i2h_weight.shape[0])
+        h2h = F.FullyConnected(h, self.h2h_weight.data(),
+                               self.h2h_bias.data(),
+                               num_hidden=self.h2h_weight.shape[0])
+        return i2h, h2h
+
+
+class RNNCell(_BaseRNNCell):
+    """Elman cell: h' = act(W_i x + b_i + W_h h + b_h)."""
+
+    _gates = 1
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        super().__init__(hidden_size, **kwargs)
+        self._activation = activation
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states[0])
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseRNNCell):
+    """LSTM cell, gate order [i, f, g, o] (reference rnn_cell.py LSTMCell)."""
+
+    _gates = 4
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        h, c = states
+        i2h, h2h = self._proj(inputs, h)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        i = F.sigmoid(i)
+        f = F.sigmoid(f)
+        g = F.tanh(g)
+        o = F.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(_BaseRNNCell):
+    """GRU cell, gate order [r, z, n] (reference rnn_cell.py GRUCell)."""
+
+    _gates = 3
+
+    def forward(self, inputs, states):
+        h = states[0]
+        i2h, h2h = self._proj(inputs, h)
+        xr, xz, xn = F.split(i2h, num_outputs=3, axis=-1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=-1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.tanh(xn + r * hn)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells; states concatenate (reference SequentialRNNCell)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._cells: List[RecurrentCell] = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell, str(len(self._cells) - 1))
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._cells, batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return _cells_begin_state(self._cells, batch_size=batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[pos:pos + n])
+            next_states.extend(st)
+            pos += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._cells)
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+
+class DropoutCell(RecurrentCell):
+    """Apply dropout to the input (reference DropoutCell)."""
+
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate)
+        return inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    """Zoneout regularization wrapper (reference ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+
+        def mask(rate, like):
+            return F.Dropout(F.ones_like(like), p=rate)
+
+        prev = self._prev_output
+        if prev is None:
+            prev = F.zeros_like(out)
+        if self._zoneout_outputs > 0:
+            m = mask(self._zoneout_outputs, out)
+            out = F.where(m, out, prev)
+        if self._zoneout_states > 0:
+            next_states = [F.where(mask(self._zoneout_states, ns), ns, s)
+                           for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(RecurrentCell):
+    """Add the input to the base cell's output (reference ResidualCell)."""
+
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        return out + inputs, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run two cells over the sequence in opposite directions; only usable
+    via ``unroll`` (reference BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info([self.l_cell, self.r_cell], batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return _cells_begin_state([self.l_cell, self.r_cell],
+                                  batch_size=batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        steps, length, t_axis = _format_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            batch = steps[0].shape[0]
+            begin_state = self.begin_state(batch, dtype=str(steps[0].dtype))
+        n_l = len(self.l_cell.state_info())
+        l_states, r_states = begin_state[:n_l], begin_state[n_l:]
+        l_out, l_states = self.l_cell.unroll(
+            length, steps, l_states, layout="TNC" if t_axis == 0 else "NTC",
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is not None:
+            rev = F.SequenceReverse(F.stack(*steps, axis=0),
+                                    sequence_length=valid_length,
+                                    use_sequence_length=True)
+            rev_steps = [rev.take(i, axis=0) for i in range(length)]
+        else:
+            rev_steps = steps[::-1]
+        r_out, r_states = self.r_cell.unroll(
+            length, rev_steps, r_states,
+            layout="TNC" if t_axis == 0 else "NTC",
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is not None:
+            r_stacked = F.SequenceReverse(F.stack(*r_out, axis=0),
+                                          sequence_length=valid_length,
+                                          use_sequence_length=True)
+            r_out = [r_stacked.take(i, axis=0) for i in range(length)]
+        else:
+            r_out = r_out[::-1]
+        outputs = [F.concat(lo, ro, dim=-1) for lo, ro in zip(l_out, r_out)]
+        if merge_outputs:
+            return F.stack(*outputs, axis=t_axis), l_states + r_states
+        return outputs, l_states + r_states

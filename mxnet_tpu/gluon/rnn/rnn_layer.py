@@ -1,0 +1,173 @@
+"""Fused Gluon recurrent layers: RNN / LSTM / GRU.
+
+Reference analog: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer backed by the
+monolithic ``RNN`` op with a packed parameter vector). TPU-native design:
+parameters stay as separate per-layer/direction arrays (no packing — XLA
+fuses the projections anyway) and the recurrence is ops/rnn.py's
+``fused_rnn``: one MXU matmul for all input projections + ``lax.scan`` for the
+sequential part. Parameter names match the reference
+(``{l|r}{k}_{i2h|h2h}_{weight|bias}``) so converted checkpoints load.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray import ndarray as ndmod
+from ...ndarray.ndarray import NDArray
+from ...ndarray.random import next_key
+from ...ops import rnn as rnn_ops
+from ...ops.registry import invoke_raw
+from ... import _tape
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout!r}; TNC or NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        g = rnn_ops.GATES[mode]
+        ng = g * hidden_size
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * self._dir
+            for d, pre in zip(range(self._dir), ("l", "r")):
+                name = f"{pre}{layer}"
+                setattr(self, f"{name}_i2h_weight", Parameter(
+                    f"{name}_i2h_weight", shape=(ng, in_sz), dtype=dtype,
+                    init=i2h_weight_initializer))
+                setattr(self, f"{name}_h2h_weight", Parameter(
+                    f"{name}_h2h_weight", shape=(ng, hidden_size), dtype=dtype,
+                    init=h2h_weight_initializer))
+                setattr(self, f"{name}_i2h_bias", Parameter(
+                    f"{name}_i2h_bias", shape=(ng,), dtype=dtype,
+                    init=i2h_bias_initializer))
+                setattr(self, f"{name}_h2h_bias", Parameter(
+                    f"{name}_h2h_bias", shape=(ng,), dtype=dtype,
+                    init=h2h_bias_initializer))
+
+    def _ordered_params(self) -> List[Parameter]:
+        out = []
+        for layer in range(self._num_layers):
+            for pre in ("l", "r")[:self._dir]:
+                for sfx in ("i2h_weight", "h2h_weight", "i2h_bias",
+                            "h2h_bias"):
+                    out.append(getattr(self, f"{pre}{layer}_{sfx}"))
+        return out
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape, "__layout__": "LNC"},
+                    {"shape": shape, "__layout__": "LNC"}]
+        return [{"shape": shape, "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or ndmod.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def _infer(self, x):
+        if self._input_size == 0:
+            in_sz = x.shape[-1]
+            self._input_size = in_sz
+            for pre in ("l", "r")[:self._dir]:
+                w = getattr(self, f"{pre}0_i2h_weight")
+                w.shape = (w.shape[0], in_sz)
+        for p in self._ordered_params():
+            if p._data is None and p._deferred_init_args is not None:
+                p._finish_deferred_init()
+
+    def forward(self, inputs, states=None):
+        """inputs: (T, N, C) for TNC / (N, T, C) for NTC. Returns output, or
+        (output, states_out) when states were passed (reference
+        rnn_layer.py forward contract)."""
+        x = inputs
+        if self._layout == "NTC":
+            x = x.transpose((1, 0, 2))
+        self._infer(x)
+        batch = x.shape[1]
+        ret_states = states is not None
+        if states is None:
+            states = self.begin_state(batch, dtype=str(x.dtype))
+        elif isinstance(states, NDArray):
+            states = [states]
+        params = self._ordered_params()
+        h0 = states[0]
+        c0 = states[1] if self._mode == "lstm" else None
+        train = _tape.is_training()
+        key = next_key() if (train and self._dropout > 0) else None
+
+        mode, nl, bi, dr = (self._mode, self._num_layers, self._dir == 2,
+                            self._dropout)
+        n_state = 2 if mode == "lstm" else 1
+
+        def fn(x_, h0_, *rest):
+            if mode == "lstm":
+                c0_, *pk = rest
+            else:
+                c0_, pk = None, list(rest)
+            if key is not None:
+                *pd, k = pk
+            else:
+                pd, k = list(pk), None
+            y, h, c = rnn_ops.fused_rnn(x_, h0_, c0_, pd, mode, nl, bi,
+                                        dropout=dr, train=train, key=k)
+            return (y, h, c) if c is not None else (y, h)
+
+        inputs_nd = [x, h0] + ([c0] if mode == "lstm" else []) + \
+            [p.data() for p in params] + ([NDArray(key)] if key is not None
+                                          else [])
+        res = invoke_raw(f"rnn_{mode}", fn, inputs_nd,
+                         n_outputs=1 + n_state)
+        y, out_states = res[0], list(res[1:])
+        if self._layout == "NTC":
+            y = y.transpose((1, 0, 2))
+        return (y, out_states) if ret_states else y
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers}"
+                f"{', bidirectional' if self._dir == 2 else ''})")
+
+
+class RNN(_RNNLayer):
+    """Vanilla Elman RNN (reference gluon.rnn.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, layout, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference gluon.rnn.LSTM; gate order i,f,g,o
+    matches src/operator/rnn_impl.h)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference gluon.rnn.GRU; gate order r,z,n)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, **kwargs)

@@ -1,0 +1,153 @@
+"""gluon.rnn tests: golden numerics vs torch, cell/fused equivalence, grads.
+
+Mirrors the reference's RNN test strategy (tests/python/unittest/
+test_gluon_rnn.py: consistency of fused layer vs unrolled cells, shape
+checks, hybridize parity).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def _set(p, arr):
+    p.set_data(mx.nd.array(arr))
+
+
+def test_lstm_matches_torch():
+    torch = pytest.importorskip("torch")
+    T, N, C, H = 5, 3, 4, 6
+    x = onp.random.rand(T, N, C).astype("float32")
+    ref = torch.nn.LSTM(C, H, num_layers=1)
+    net = gluon.rnn.LSTM(H, input_size=C)
+    net.initialize()
+    _set(net.l0_i2h_weight, ref.weight_ih_l0.detach().numpy())
+    _set(net.l0_h2h_weight, ref.weight_hh_l0.detach().numpy())
+    _set(net.l0_i2h_bias, ref.bias_ih_l0.detach().numpy())
+    _set(net.l0_h2h_bias, ref.bias_hh_l0.detach().numpy())
+    want, _ = ref(torch.from_numpy(x))
+    got = net(mx.nd.array(x))
+    onp.testing.assert_allclose(got.asnumpy(), want.detach().numpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_gru_matches_torch():
+    torch = pytest.importorskip("torch")
+    T, N, C, H = 5, 3, 4, 6
+    x = onp.random.rand(T, N, C).astype("float32")
+    ref = torch.nn.GRU(C, H, num_layers=1)
+    net = gluon.rnn.GRU(H, input_size=C)
+    net.initialize()
+    _set(net.l0_i2h_weight, ref.weight_ih_l0.detach().numpy())
+    _set(net.l0_h2h_weight, ref.weight_hh_l0.detach().numpy())
+    _set(net.l0_i2h_bias, ref.bias_ih_l0.detach().numpy())
+    _set(net.l0_h2h_bias, ref.bias_hh_l0.detach().numpy())
+    want, _ = ref(torch.from_numpy(x))
+    got = net(mx.nd.array(x))
+    onp.testing.assert_allclose(got.asnumpy(), want.detach().numpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_multilayer_matches_torch():
+    torch = pytest.importorskip("torch")
+    T, N, C, H = 4, 2, 3, 5
+    x = onp.random.rand(T, N, C).astype("float32")
+    ref = torch.nn.LSTM(C, H, num_layers=2, bidirectional=True)
+    net = gluon.rnn.LSTM(H, num_layers=2, bidirectional=True, input_size=C)
+    net.initialize()
+    for layer in range(2):
+        for pre, sfx in (("l", ""), ("r", "_reverse")):
+            _set(getattr(net, f"{pre}{layer}_i2h_weight"),
+                 getattr(ref, f"weight_ih_l{layer}{sfx}").detach().numpy())
+            _set(getattr(net, f"{pre}{layer}_h2h_weight"),
+                 getattr(ref, f"weight_hh_l{layer}{sfx}").detach().numpy())
+            _set(getattr(net, f"{pre}{layer}_i2h_bias"),
+                 getattr(ref, f"bias_ih_l{layer}{sfx}").detach().numpy())
+            _set(getattr(net, f"{pre}{layer}_h2h_bias"),
+                 getattr(ref, f"bias_hh_l{layer}{sfx}").detach().numpy())
+    want, (hn, cn) = ref(torch.from_numpy(x))
+    got, (h, c) = net(mx.nd.array(x), net.begin_state(N))
+    onp.testing.assert_allclose(got.asnumpy(), want.detach().numpy(),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(h.asnumpy(), hn.detach().numpy(),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(c.asnumpy(), cn.detach().numpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_cell_unroll_matches_fused_layer():
+    T, N, C, H = 6, 2, 3, 4
+    x = mx.nd.random.uniform(shape=(T, N, C))
+    layer = gluon.rnn.LSTM(H, input_size=C)
+    layer.initialize()
+    cell = gluon.rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    want = layer(x)
+    onp.testing.assert_allclose(outs.asnumpy(), want.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_layer_gradients_flow():
+    net = gluon.rnn.GRU(8, num_layers=2, bidirectional=True)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 4))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    for name, p in net.collect_params().items():
+        assert p.data().fresh_grad, name
+        assert float(abs(p.grad().asnumpy()).max()) > 0, name
+
+
+def test_rnn_layer_hybridize_consistency():
+    net = gluon.rnn.LSTM(8, num_layers=2)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 4))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_hyb, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_training_converges():
+    """Tiny sequence-sum regression learns (LSTM LM baseline smoke,
+    BASELINE config 4)."""
+    mx.random.seed(42)
+    onp.random.seed(42)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.rnn.LSTM(16))
+    net.add(gluon.nn.Dense(1))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    X = onp.random.rand(8, 10, 2).astype("float32")  # N,T,C -> TNC below
+    Y = X.sum(axis=(1, 2), keepdims=False).reshape(8, 1)
+    x = mx.nd.array(X.transpose(1, 0, 2))
+    y = mx.nd.array(Y)
+    l2 = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            seq = net[0](x)          # (T, N, 16)
+            pred = net[1](seq[-1])   # last step
+            loss = l2(pred, y)
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_zoneout_and_dropout_cells():
+    cell = gluon.rnn.SequentialRNNCell()
+    cell.add(gluon.rnn.DropoutCell(0.3))
+    cell.add(gluon.rnn.ZoneoutCell(gluon.rnn.RNNCell(6), 0.2, 0.2))
+    cell.initialize()
+    outs, st = cell.unroll(4, mx.nd.random.uniform(shape=(2, 4, 3)),
+                           layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 4, 6)
