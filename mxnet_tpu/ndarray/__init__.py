@@ -11,4 +11,5 @@ from .nn_ops import *  # noqa: F401,F403
 from . import ops as op
 from . import random
 from . import sparse
+from . import contrib
 from .utils import save, load

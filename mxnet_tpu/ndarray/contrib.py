@@ -1,0 +1,189 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference analog: src/operator/control_flow.cc (`_foreach` :1094,
+`_while_loop` :1155, `_cond` :1216) — subgraph-holding stateful ops with full
+backward, exposed as ``mx.nd.contrib.*`` (python/mxnet/ndarray/contrib.py).
+
+TPU-native design: the body/cond/branch callables trace into ``lax.scan`` /
+``lax.cond`` — XLA compiles the body ONCE regardless of trip count (the
+reference re-executes the subgraph per step through the engine). while_loop
+lowers to a masked fixed-trip ``lax.scan`` rather than ``lax.while_loop``:
+scan is reverse-differentiable and maps to a static TPU program; the mask
+reproduces data-dependent termination. All three integrate with autograd via
+the op-invoke funnel, so gradients flow through loop bodies and branches.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import _tape, autograd
+from ..base import MXNetError
+from ..ops.registry import invoke_raw
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x) -> Tuple[List, bool]:
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _wrap(arrs):
+    return [NDArray(a) if not isinstance(a, NDArray) else a for a in arrs]
+
+
+def _datas(arrs):
+    return [a._data if isinstance(a, NDArray) else a for a in arrs]
+
+
+def _call_sub(fn, *nd_args):
+    """Run a user subgraph callable with recording off (the whole control-flow
+    op records as ONE tape node; jax.vjp differentiates through the body)."""
+    prev = _tape.set_recording(False)
+    try:
+        return fn(*nd_args)
+    finally:
+        _tape.set_recording(prev)
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over the leading axis of ``data``
+    (reference _foreach, control_flow.cc:1094; python frontend
+    python/mxnet/ndarray/contrib.py foreach).
+
+    body(step_data, states) -> (outputs, new_states). Returns
+    (stacked_outputs, final_states) with input list/single structure
+    preserved.
+    """
+    data_list, data_is_list = _as_list(data)
+    states, states_is_list = _as_list(init_states)
+    n_d, n_s = len(data_list), len(states)
+
+    # probe the body once to learn the output structure (the reference infers
+    # the same from the traced subgraph)
+    step0 = [d.take(0, axis=0) for d in data_list]
+    with autograd.pause():
+        probe_out, probe_states = _call_sub(
+            body,
+            step0 if data_is_list else step0[0],
+            list(states) if states_is_list else states[0])
+    probe_outs, out_is_list = _as_list(probe_out)
+    probe_new_states, _ = _as_list(probe_states)
+    if len(probe_new_states) != n_s:
+        raise MXNetError("foreach body must return the same number of states")
+    n_o = len(probe_outs)
+
+    def fn(*arrs):
+        xs = arrs[:n_d]
+        st = list(arrs[n_d:])
+
+        def step(carry, x_t):
+            d_nd = _wrap(list(x_t))
+            s_nd = _wrap(list(carry))
+            out, new_st = _call_sub(
+                body,
+                d_nd if data_is_list else d_nd[0],
+                s_nd if states_is_list else s_nd[0])
+            outs, _ = _as_list(out)
+            new_states, _ = _as_list(new_st)
+            return tuple(_datas(new_states)), tuple(_datas(outs))
+
+        carry, ys = lax.scan(step, tuple(st), tuple(xs))
+        return tuple(ys) + tuple(carry)
+
+    res = invoke_raw("_foreach", fn, data_list + states,
+                     n_outputs=n_o + n_s)
+    res = res if isinstance(res, tuple) else (res,)
+    outs = list(res[:n_o])
+    fin = list(res[n_o:])
+    return (outs if out_is_list else outs[0],
+            fin if states_is_list else fin[0])
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Differentiable while (reference _while_loop, control_flow.cc:1155).
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output, new_loop_vars). Returns (stacked_outputs, final_loop_vars);
+    outputs rows beyond termination are zero (the reference leaves them
+    undefined). Lowered as a masked fixed-trip lax.scan: reverse-mode
+    differentiable and a static TPU program, unlike lax.while_loop.
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    loop_list, vars_is_list = _as_list(loop_vars)
+    n_v = len(loop_list)
+
+    with autograd.pause():
+        probe_out, probe_vars = _call_sub(func, *loop_list)
+    probe_outs, out_is_list = _as_list(probe_out)
+    probe_new_vars, _ = _as_list(probe_vars)
+    if len(probe_new_vars) != n_v:
+        raise MXNetError("while_loop func must preserve loop_vars arity")
+    n_o = len(probe_outs)
+
+    def fn(*arrs):
+        def step(carry, _):
+            active, vs = carry
+            vs_nd = _wrap(list(vs))
+            c = _call_sub(cond, *vs_nd)
+            c = (c._data if isinstance(c, NDArray) else c).reshape(())
+            active = jnp.logical_and(active, c.astype(bool))
+            out, new_vs = _call_sub(func, *vs_nd)
+            outs = _datas(_as_list(out)[0])
+            new_vs = _datas(_as_list(new_vs)[0])
+            sel = lambda n, o: jnp.where(
+                active.reshape((1,) * n.ndim), n, o)
+            kept = tuple(sel(n, o) for n, o in zip(new_vs, vs))
+            step_out = tuple(jnp.where(active.reshape((1,) * o.ndim), o,
+                                       jnp.zeros_like(o)) for o in outs)
+            return (active, kept), step_out
+
+        init = (jnp.asarray(True), tuple(arrs))
+        (_, final), ys = lax.scan(step, init, None, length=max_iterations)
+        return tuple(ys) + tuple(final)
+
+    res = invoke_raw("_while_loop", fn, loop_list, n_outputs=n_o + n_v)
+    res = res if isinstance(res, tuple) else (res,)
+    outs = list(res[:n_o])
+    fin = list(res[n_o:])
+    return (outs if out_is_list else outs[0],
+            fin if vars_is_list else fin[0])
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Two-branch conditional (reference _cond, control_flow.cc:1216).
+
+    pred: boolean scalar NDArray (or a callable over ``inputs``); both
+    branches must return the same structure. Lowers to ``lax.cond`` — only
+    the taken branch executes on device.
+    """
+    ins, ins_is_list = _as_list(inputs if inputs is not None else [])
+
+    if callable(pred):
+        with autograd.pause():
+            pred = _call_sub(pred, *ins)
+    with autograd.pause():
+        probe = _call_sub(then_func, *ins) if callable(then_func) else None
+    probe_outs, out_is_list = _as_list(probe)
+    n_o = len(probe_outs)
+
+    def fn(p, *arrs):
+        def run(branch):
+            def f(xs):
+                out = _call_sub(branch, *_wrap(list(xs)))
+                return tuple(_datas(_as_list(out)[0]))
+            return f
+
+        return lax.cond(p.reshape(()).astype(bool),
+                        run(then_func), run(else_func), tuple(arrs))
+
+    res = invoke_raw("_cond", fn, [pred] + ins, n_outputs=n_o)
+    res = list(res) if isinstance(res, tuple) else [res]
+    return res if out_is_list else res[0]
