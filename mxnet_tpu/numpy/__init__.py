@@ -1,0 +1,61 @@
+"""mx.np — NumPy-compatible frontend (reference python/mxnet/numpy/).
+
+``from mxnet_tpu import np`` gives a NumPy drop-in whose arrays live on TPU,
+record onto the autograd tape, and trace through jit/pjit. Submodules:
+``np.linalg``, ``np.random``, ``np.fft``.
+"""
+import numpy as _onp
+import jax.numpy as _jnp
+
+import types as _types
+
+from . import multiarray as _ma
+from .multiarray import ndarray, array, _invoke, _DEFAULT_DTYPE  # noqa: F401
+
+_EXCLUDE = {"NDArray", "Context", "current_context", "invoke_raw",
+            "set_np_ndarray_cls", "jx_dtype", "dtype_name", "MXNetError"}
+for _n in dir(_ma):
+    if _n.startswith("_") or _n in _EXCLUDE:
+        continue
+    _v = getattr(_ma, _n)
+    if isinstance(_v, _types.ModuleType) or _v is None:
+        continue
+    globals()[_n] = _v
+del _types, _n, _v
+from . import linalg  # noqa: F401
+from . import random  # noqa: F401
+from . import fft  # noqa: F401
+
+# dtype aliases (reference python/mxnet/numpy/__init__.py re-exports numpy's)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = _jnp.bfloat16
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+bool = _onp.bool_  # noqa: A001
+complex64 = _onp.complex64
+complex128 = _onp.complex128
+intc = _onp.intc
+dtype = _onp.dtype
+
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+PZERO = 0.0
+NZERO = -0.0
+
+finfo = _onp.finfo
+iinfo = _onp.iinfo
+
+_np_version = _onp.__version__

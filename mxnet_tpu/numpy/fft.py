@@ -1,0 +1,61 @@
+"""mx.np.fft — discrete Fourier transforms via the XLA FFT emitter."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from .multiarray import ndarray, array, _invoke
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft2", "irfft2", "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
+
+
+def _arr(a):
+    return a if isinstance(a, NDArray) else array(a)
+
+
+def _fft1(name, jfn):
+    def f(a, n=None, axis=-1, norm=None):
+        return _invoke(name, lambda x: jfn(x, n=n, axis=axis, norm=norm),
+                       [_arr(a)])
+    f.__name__ = name
+    return f
+
+
+def _fftn(name, jfn):
+    def f(a, s=None, axes=None, norm=None):
+        kw = {} if axes is None and name.endswith("2") else {}
+        ax = axes if axes is not None else ((-2, -1) if "2" in name else None)
+        return _invoke(name, lambda x: jfn(x, s=s, axes=ax, norm=norm),
+                       [_arr(a)])
+    f.__name__ = name
+    return f
+
+
+fft = _fft1("fft", jnp.fft.fft)
+ifft = _fft1("ifft", jnp.fft.ifft)
+rfft = _fft1("rfft", jnp.fft.rfft)
+irfft = _fft1("irfft", jnp.fft.irfft)
+fft2 = _fftn("fft2", jnp.fft.fft2)
+ifft2 = _fftn("ifft2", jnp.fft.ifft2)
+fftn = _fftn("fftn", jnp.fft.fftn)
+ifftn = _fftn("ifftn", jnp.fft.ifftn)
+rfft2 = _fftn("rfft2", jnp.fft.rfft2)
+irfft2 = _fftn("irfft2", jnp.fft.irfft2)
+
+
+def fftshift(x, axes=None):
+    return _invoke("fftshift", lambda a: jnp.fft.fftshift(a, axes), [_arr(x)])
+
+
+def ifftshift(x, axes=None):
+    return _invoke("ifftshift", lambda a: jnp.fft.ifftshift(a, axes),
+                   [_arr(x)])
+
+
+def fftfreq(n, d=1.0):
+    return ndarray(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0):
+    return ndarray(jnp.fft.rfftfreq(n, d))

@@ -1,0 +1,143 @@
+"""mx.np.linalg — NumPy-compatible linear algebra.
+
+Reference analog: python/mxnet/numpy/linalg.py over src/operator/numpy/linalg/
+(_npi.svd/inv/cholesky/... CUDA+LAPACK kernels). On TPU each lowers to the
+XLA linalg emitter through jnp.linalg; all routed via the invoke funnel so
+they are tape-recordable and jit-fusable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from .multiarray import ndarray, array, _invoke
+
+__all__ = ["norm", "svd", "svdvals", "inv", "pinv", "det", "slogdet",
+           "eig", "eigh", "eigvals", "eigvalsh", "cholesky", "qr", "solve",
+           "lstsq", "matrix_rank", "matrix_power", "multi_dot", "tensorinv",
+           "tensorsolve", "cond"]
+
+
+def _arr(a):
+    return a if isinstance(a, NDArray) else array(a)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _invoke("linalg_norm",
+                   lambda a: jnp.linalg.norm(a, ord=ord, axis=axis,
+                                             keepdims=keepdims), [_arr(x)])
+
+
+def svd(a):
+    """Returns (u, l, vt) like mx.np.linalg.svd (full_matrices=False)."""
+    u, s, vt = _invoke("linalg_svd",
+                       lambda x: tuple(jnp.linalg.svd(x, full_matrices=False)),
+                       [_arr(a)], n_outputs=3)
+    return u, s, vt
+
+
+def svdvals(a):
+    return _invoke("linalg_svdvals",
+                   lambda x: jnp.linalg.svd(x, compute_uv=False), [_arr(a)])
+
+
+def inv(a):
+    return _invoke("linalg_inv", jnp.linalg.inv, [_arr(a)])
+
+
+def pinv(a, rcond=1e-15, hermitian=False):
+    return _invoke("linalg_pinv",
+                   lambda x: jnp.linalg.pinv(x, rcond=rcond,
+                                             hermitian=hermitian), [_arr(a)])
+
+
+def det(a):
+    return _invoke("linalg_det", jnp.linalg.det, [_arr(a)])
+
+
+def slogdet(a):
+    return _invoke("linalg_slogdet",
+                   lambda x: tuple(jnp.linalg.slogdet(x)), [_arr(a)],
+                   n_outputs=2)
+
+
+def eig(a):
+    # XLA has no device eig for general matrices; compute on host like the
+    # reference's LAPACK path (src/operator/numpy/linalg/np_eig.cc).
+    import numpy as onp
+    w, v = onp.linalg.eig(onp.asarray(_arr(a)._data))
+    return ndarray(w.real.astype(onp.float32) if onp.isrealobj(w) or
+                   onp.allclose(w.imag, 0) else w), \
+        ndarray(v.real.astype(onp.float32) if onp.isrealobj(v) or
+                onp.allclose(v.imag, 0) else v)
+
+
+def eigh(a, UPLO="L"):
+    return _invoke("linalg_eigh",
+                   lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)), [_arr(a)],
+                   n_outputs=2)
+
+
+def eigvals(a):
+    import numpy as onp
+    w = onp.linalg.eigvals(onp.asarray(_arr(a)._data))
+    if onp.isrealobj(w) or onp.allclose(w.imag, 0):
+        w = w.real.astype(onp.float32)
+    return ndarray(w)
+
+
+def eigvalsh(a, UPLO="L"):
+    return _invoke("linalg_eigvalsh",
+                   lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), [_arr(a)])
+
+
+def cholesky(a):
+    return _invoke("linalg_cholesky", jnp.linalg.cholesky, [_arr(a)])
+
+
+def qr(a, mode="reduced"):
+    return _invoke("linalg_qr",
+                   lambda x: tuple(jnp.linalg.qr(x, mode=mode)), [_arr(a)],
+                   n_outputs=2)
+
+
+def solve(a, b):
+    return _invoke("linalg_solve", jnp.linalg.solve, [_arr(a), _arr(b)])
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    x, res, rank, sv = jnp.linalg.lstsq(_arr(a)._data, _arr(b)._data,
+                                        rcond=rc)
+    return ndarray(x), ndarray(res), int(rank), ndarray(sv)
+
+
+def matrix_rank(M, tol=None, hermitian=False):
+    return _invoke("linalg_matrix_rank",
+                   lambda x: jnp.linalg.matrix_rank(x, tol), [_arr(M)])
+
+
+def matrix_power(a, n):
+    return _invoke("linalg_matrix_power",
+                   lambda x: jnp.linalg.matrix_power(x, n), [_arr(a)])
+
+
+def multi_dot(arrays):
+    arrs = [_arr(a) for a in arrays]
+    return _invoke("linalg_multi_dot",
+                   lambda *xs: jnp.linalg.multi_dot(list(xs)), arrs)
+
+
+def tensorinv(a, ind=2):
+    return _invoke("linalg_tensorinv",
+                   lambda x: jnp.linalg.tensorinv(x, ind=ind), [_arr(a)])
+
+
+def tensorsolve(a, b, axes=None):
+    return _invoke("linalg_tensorsolve",
+                   lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                   [_arr(a), _arr(b)])
+
+
+def cond(x, p=None):
+    return _invoke("linalg_cond", lambda a: jnp.linalg.cond(a, p), [_arr(x)])

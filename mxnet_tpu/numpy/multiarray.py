@@ -1,0 +1,1292 @@
+"""mx.np — NumPy-compatible frontend over the TPU NDArray.
+
+Reference analog: python/mxnet/numpy/multiarray.py:264 (``mx.np.ndarray``)
+backed by the ``_npi.*`` C++ ops (reference src/operator/numpy/, 42k LoC).
+In the TPU rebuild every _npi kernel collapses into the matching ``jnp``
+call routed through the imperative invoke funnel (ops/registry.invoke_raw),
+so each op is an XLA computation, autograd-tape-recordable, and jit-fusable.
+
+Semantics follow NumPy with MXNet's deviations:
+- default dtype float32 for creation ops (reference numpy/multiarray.py
+  ``_np.float32`` default),
+- arrays live on the current Context (mx.tpu()/mx.cpu()),
+- ``out=`` rebinds the output handle (functional update under XLA).
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import jx_dtype, dtype_name, MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, _put
+from ..ops.registry import invoke_raw, set_np_ndarray_cls
+
+__all__ = ["ndarray", "array", "asarray", "from_nd"]
+
+_DEFAULT_DTYPE = jnp.float32
+# x64 is disabled on TPU (perf); numpy's int64 defaults map to int32
+_DEFAULT_INT = jnp.int32
+
+
+def _adt(dtype):
+    """Resolve a creation-op dtype: None → float32 (MXNet mx.np default)."""
+    return _DEFAULT_DTYPE if dtype is None else jx_dtype(dtype)
+
+
+class ndarray(NDArray):
+    """mx.np.ndarray — NumPy drop-in array type.
+
+    Subclasses the core NDArray (same XLA buffer + tape slots); the invoke
+    funnel propagates this class to outputs, so inherited methods and all
+    module functions return ``mx.np.ndarray``.
+    """
+    __slots__ = ()
+
+    # ---- numpy-flavoured dunders (binary ops broadcast like numpy) ----
+    def __add__(self, o):
+        return add(self, o)
+
+    def __radd__(self, o):
+        return add(o, self)
+
+    def __sub__(self, o):
+        return subtract(self, o)
+
+    def __rsub__(self, o):
+        return subtract(o, self)
+
+    def __mul__(self, o):
+        return multiply(self, o)
+
+    def __rmul__(self, o):
+        return multiply(o, self)
+
+    def __truediv__(self, o):
+        return true_divide(self, o)
+
+    def __rtruediv__(self, o):
+        return true_divide(o, self)
+
+    def __floordiv__(self, o):
+        return floor_divide(self, o)
+
+    def __rfloordiv__(self, o):
+        return floor_divide(o, self)
+
+    def __mod__(self, o):
+        return mod(self, o)
+
+    def __rmod__(self, o):
+        return mod(o, self)
+
+    def __pow__(self, o):
+        return power(self, o)
+
+    def __rpow__(self, o):
+        return power(o, self)
+
+    def __matmul__(self, o):
+        return matmul(self, o)
+
+    def __rmatmul__(self, o):
+        return matmul(o, self)
+
+    def __neg__(self):
+        return negative(self)
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return absolute(self)
+
+    def __invert__(self):
+        return invert(self)
+
+    def __and__(self, o):
+        return bitwise_and(self, o)
+
+    def __or__(self, o):
+        return bitwise_or(self, o)
+
+    def __xor__(self, o):
+        return bitwise_xor(self, o)
+
+    def __lshift__(self, o):
+        return left_shift(self, o)
+
+    def __rshift__(self, o):
+        return right_shift(self, o)
+
+    def __eq__(self, o):
+        return equal(self, o)
+
+    def __ne__(self, o):
+        return not_equal(self, o)
+
+    def __lt__(self, o):
+        return less(self, o)
+
+    def __le__(self, o):
+        return less_equal(self, o)
+
+    def __gt__(self, o):
+        return greater(self, o)
+
+    def __ge__(self, o):
+        return greater_equal(self, o)
+
+    __hash__ = None  # like numpy arrays
+
+    def __repr__(self):
+        if self._data is None:
+            return "array(<uninitialized>)"
+        try:
+            body = repr(onp.asarray(self._data))
+        except Exception:
+            return f"array(<traced {self.shape} {dtype_name(self._data.dtype)}>)"
+        body = body.replace("Array(", "array(").replace(
+            "\n      ", "\n     ")
+        if not body.startswith("array"):
+            body = f"array({body})"
+        ctx = self.context
+        if str(ctx) != "cpu(0)":
+            body = body[:-1] + f", ctx={ctx})"
+        return body
+
+    def __getitem__(self, key):
+        res = super().__getitem__(key)
+        return res
+
+    # ---- numpy-style methods ----
+    def reshape(self, *shape, order="C"):  # noqa: D102 — numpy semantics
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if len(shape) == 0:
+            shape = ()
+        return invoke_raw("np_reshape",
+                          lambda x, _s=tuple(shape): jnp.reshape(x, _s),
+                          [self])
+
+    def flatten(self, order="C"):
+        return self.reshape(-1)
+
+    def ravel(self, order="C"):
+        return self.reshape(-1)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def copy(self):
+        out = ndarray.__new__(ndarray)
+        out._init_empty()
+        out._data = self._data
+        out._ctx = self._ctx
+        return out
+
+    def detach(self):
+        out = ndarray.__new__(ndarray)
+        out._init_empty()
+        out._data = self._data
+        out._ctx = self._ctx
+        return out
+
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        out = NDArray.__new__(NDArray)
+        out._init_empty()
+        out._data = self._data
+        out._ctx = self._ctx
+        out._grad = self._grad
+        out._tape_entry = self._tape_entry
+        return out
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return std(self, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return var(self, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def cumsum(self, axis=None, dtype=None):
+        return cumsum(self, axis=axis, dtype=dtype)
+
+    def dot(self, b):
+        return dot(self, b)
+
+    def nonzero(self):
+        return nonzero(self)
+
+    def round(self, decimals=0):
+        return around(self, decimals)
+
+    def clip(self, a_min=None, a_max=None):
+        return clip(self, a_min, a_max)
+
+    def argsort(self, axis=-1):
+        return argsort(self, axis=axis)
+
+    def sort(self, axis=-1):
+        # numpy's method sorts in place; XLA buffers are immutable so rebind
+        self._data = jnp.sort(self._data, axis=axis)
+        return None
+
+    def take(self, indices, axis=None, mode="raise"):
+        return take(self, indices, axis=axis)
+
+    def squeeze(self, axis=None):
+        return invoke_raw("np_squeeze", lambda x: jnp.squeeze(x, axis), [self])
+
+    def astype(self, dtype, copy=True):
+        dt = jx_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return invoke_raw("np_cast", lambda x, _d=dt: x.astype(_d), [self])
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    def attach_grad(self, grad_req="write", stype=None):
+        super().attach_grad(grad_req, stype)
+        self._grad = self._grad.as_np_ndarray()
+
+
+set_np_ndarray_cls(ndarray)
+
+
+# ------------------------------------------------------------------
+# helpers
+# ------------------------------------------------------------------
+def _seq_has_nd(x):
+    return isinstance(x, (list, tuple)) and builtins.any(
+        isinstance(e, NDArray) for e in x)
+
+
+def _invoke(name, fn, arrays, n_outputs=1):
+    """Route through the imperative funnel; force np ndarray outputs."""
+    return invoke_raw(name, fn, list(arrays), n_outputs=n_outputs,
+                      out_cls=ndarray)
+
+
+def _maybe_out(res, out):
+    if out is not None:
+        if isinstance(res, tuple):
+            for o, r in zip(out, res):
+                o._data = r._data
+                o._tape_entry = r._tape_entry
+            return out
+        out._data = res._data
+        out._tape_entry = res._tape_entry
+        return out
+    return res
+
+
+def _unary(name, jfn):
+    def f(x, out=None, **kwargs):
+        if isinstance(x, NDArray):
+            res = _invoke(name, functools.partial(jfn, **kwargs) if kwargs
+                          else jfn, [x])
+        else:
+            res = ndarray(jfn(jnp.asarray(x), **kwargs))
+        return _maybe_out(res, out)
+    f.__name__ = name
+    f.__doc__ = f"mx.np.{name} — NumPy-compatible; lowers to jnp.{name} (XLA)."
+    return f
+
+
+def _binary(name, jfn):
+    def f(x1, x2, out=None):
+        a1, a2 = isinstance(x1, NDArray), isinstance(x2, NDArray)
+        if a1 and a2:
+            res = _invoke(name, jfn, [x1, x2])
+        elif a1:
+            # scalar is closure-captured so jnp weak-type promotion applies
+            res = _invoke(name, lambda a, _b=x2: jfn(a, _b), [x1])
+        elif a2:
+            res = _invoke(name, lambda b, _a=x1: jfn(_a, b), [x2])
+        else:
+            res = ndarray(jfn(jnp.asarray(x1), jnp.asarray(x2)))
+        return _maybe_out(res, out)
+    f.__name__ = name
+    f.__doc__ = f"mx.np.{name} — NumPy-compatible; lowers to jnp.{name} (XLA)."
+    return f
+
+
+def _reduction(name, jfn, has_dtype=True):
+    def f(a, axis=None, dtype=None, out=None, keepdims=False, **kw):
+        ax = tuple(axis) if isinstance(axis, list) else axis
+        kwargs = dict(axis=ax, keepdims=keepdims, **kw)
+        if has_dtype and dtype is not None:
+            kwargs["dtype"] = jx_dtype(dtype)
+        res = _invoke(name, lambda x: jfn(x, **kwargs),
+                      [a if isinstance(a, NDArray) else ndarray(a)])
+        return _maybe_out(res, out)
+    f.__name__ = name
+    return f
+
+
+# ------------------------------------------------------------------
+# creation
+# ------------------------------------------------------------------
+def array(object, dtype=None, ctx=None):
+    """Create an mx.np.ndarray (reference numpy/multiarray.py ``array``)."""
+    if isinstance(object, NDArray):
+        data = object._data
+        if dtype is not None:
+            data = data.astype(jx_dtype(dtype))
+        out = ndarray.__new__(ndarray)
+        out._init_empty()
+        out._data = _put(data, ctx) if ctx is not None else data
+        out._ctx = ctx
+        return out
+    keep_dtype = isinstance(object, (onp.ndarray, onp.generic))
+    a = onp.asarray(object, dtype=None if dtype is None else jx_dtype(dtype))
+    if dtype is None:
+        if not keep_dtype and a.dtype != onp.bool_:
+            # reference numpy/multiarray.py array(): python lists/scalars
+            # default to float32 regardless of element type
+            a = a.astype(onp.float32)
+        elif a.dtype == onp.float64:
+            a = a.astype(onp.float32)
+        elif a.dtype == onp.int64:
+            a = a.astype(onp.int32)  # x64 disabled: int64 maps to int32
+    return ndarray(_put(a, ctx), ctx=ctx)
+
+
+def asarray(obj, dtype=None):
+    if isinstance(obj, ndarray) and dtype is None:
+        return obj
+    return array(obj, dtype=dtype)
+
+
+def from_nd(x: NDArray) -> ndarray:
+    return x.as_np_ndarray()
+
+
+def _creation(name, jfn):
+    def f(shape, dtype=None, order="C", ctx=None):
+        if isinstance(shape, (int, onp.integer)):
+            shape = (int(shape),)
+        res = ndarray(jfn(tuple(shape), dtype=_adt(dtype)), ctx=ctx)
+        return res
+    f.__name__ = name
+    return f
+
+
+zeros = _creation("zeros", jnp.zeros)
+ones = _creation("ones", jnp.ones)
+empty = _creation("empty", jnp.zeros)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, out=None):
+    if isinstance(shape, (int, onp.integer)):
+        shape = (int(shape),)
+    if dtype is None:
+        if isinstance(fill_value, (bool, onp.bool_)):
+            dt = jnp.bool_
+        elif isinstance(fill_value, (int, onp.integer)):
+            dt = _DEFAULT_INT
+        else:
+            dt = _DEFAULT_DTYPE
+    else:
+        dt = jx_dtype(dtype)
+    if isinstance(fill_value, NDArray):
+        fill_value = fill_value._data
+    return _maybe_out(ndarray(jnp.full(tuple(shape), fill_value, dtype=dt),
+                              ctx=ctx), out)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None):
+    return _invoke("zeros_like",
+                   lambda x: jnp.zeros_like(x, dtype=None if dtype is None
+                                            else jx_dtype(dtype)),
+                   [a if isinstance(a, NDArray) else ndarray(a)])
+
+
+def ones_like(a, dtype=None, order="C", ctx=None):
+    return _invoke("ones_like",
+                   lambda x: jnp.ones_like(x, dtype=None if dtype is None
+                                           else jx_dtype(dtype)),
+                   [a if isinstance(a, NDArray) else ndarray(a)])
+
+
+def full_like(a, fill_value, dtype=None, order="C", ctx=None):
+    return _invoke("full_like",
+                   lambda x: jnp.full_like(x, fill_value,
+                                           dtype=None if dtype is None
+                                           else jx_dtype(dtype)),
+                   [a if isinstance(a, NDArray) else ndarray(a)])
+
+
+empty_like = zeros_like
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    return ndarray(jnp.eye(N, M, k=k, dtype=_adt(dtype)), ctx=ctx)
+
+
+def identity(n, dtype=None, ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    if dtype is None:
+        if builtins.all(isinstance(v, (int, onp.integer)) or v is None
+                        for v in (start, stop, step)):
+            dt = _DEFAULT_INT
+        else:
+            dt = _DEFAULT_DTYPE
+    else:
+        dt = jx_dtype(dtype)
+    return ndarray(jnp.arange(start, stop, step, dtype=dt), ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    res = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=_adt(dtype), axis=axis)
+    if retstep:
+        return ndarray(res[0], ctx=ctx), float(res[1])
+    return ndarray(res, ctx=ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    return ndarray(jnp.logspace(start, stop, num, endpoint=endpoint,
+                                base=base, dtype=_adt(dtype), axis=axis),
+                   ctx=ctx)
+
+
+def meshgrid(*xi, indexing="xy", **kwargs):
+    datas = [x._data if isinstance(x, NDArray) else jnp.asarray(x) for x in xi]
+    return [ndarray(g) for g in jnp.meshgrid(*datas, indexing=indexing)]
+
+
+def tril(m, k=0):
+    return _invoke("tril", lambda x: jnp.tril(x, k), [m])
+
+
+def triu(m, k=0):
+    return _invoke("triu", lambda x: jnp.triu(x, k), [m])
+
+
+def tri(N, M=None, k=0, dtype=None, ctx=None):
+    return ndarray(jnp.tri(N, M, k, dtype=_adt(dtype)), ctx=ctx)
+
+
+def indices(dimensions, dtype=None, ctx=None):
+    return ndarray(jnp.indices(tuple(dimensions),
+                               dtype=_DEFAULT_INT if dtype is None
+                               else jx_dtype(dtype)), ctx=ctx)
+
+
+def diag(v, k=0):
+    return _invoke("diag", lambda x: jnp.diag(x, k), [v])
+
+
+def diagflat(v, k=0):
+    return _invoke("diagflat", lambda x: jnp.diagflat(x, k), [v])
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _invoke("diagonal",
+                   lambda x: jnp.diagonal(x, offset, axis1, axis2), [a])
+
+
+def atleast_1d(*arys):
+    res = [_invoke("atleast_1d", jnp.atleast_1d, [a]) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_2d(*arys):
+    res = [_invoke("atleast_2d", jnp.atleast_2d, [a]) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_3d(*arys):
+    res = [_invoke("atleast_3d", jnp.atleast_3d, [a]) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def copy(a):
+    return a.copy() if isinstance(a, ndarray) else array(a)
+
+
+# ------------------------------------------------------------------
+# ufuncs — unary
+# ------------------------------------------------------------------
+negative = _unary("negative", jnp.negative)
+positive = _unary("positive", jnp.positive)
+absolute = _unary("absolute", jnp.abs)
+abs = absolute  # noqa: A001
+fabs = _unary("fabs", jnp.fabs)
+sign = _unary("sign", jnp.sign)
+rint = _unary("rint", jnp.rint)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+trunc = _unary("trunc", jnp.trunc)
+fix = _unary("fix", jnp.trunc)  # round toward zero (jnp.fix deprecated)
+sqrt = _unary("sqrt", jnp.sqrt)
+cbrt = _unary("cbrt", jnp.cbrt)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+exp2 = _unary("exp2", jnp.exp2)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+arcsin = _unary("arcsin", jnp.arcsin)
+arccos = _unary("arccos", jnp.arccos)
+arctan = _unary("arctan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+arcsinh = _unary("arcsinh", jnp.arcsinh)
+arccosh = _unary("arccosh", jnp.arccosh)
+arctanh = _unary("arctanh", jnp.arctanh)
+degrees = _unary("degrees", jnp.degrees)
+radians = _unary("radians", jnp.radians)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+invert = _unary("invert", jnp.invert)
+bitwise_not = invert
+logical_not = _unary("logical_not", jnp.logical_not)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+isposinf = _unary("isposinf", jnp.isposinf)
+isneginf = _unary("isneginf", jnp.isneginf)
+conj = _unary("conj", jnp.conj)
+conjugate = conj
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+angle = _unary("angle", jnp.angle)
+sinc = _unary("sinc", jnp.sinc)
+nan_to_num = _unary("nan_to_num", jnp.nan_to_num)
+i0 = _unary("i0", jnp.i0)
+
+# ------------------------------------------------------------------
+# ufuncs — binary
+# ------------------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+true_divide = _binary("true_divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+fmod = _binary("fmod", jnp.fmod)
+divmod_ = None  # not in mx.np
+power = _binary("power", jnp.power)
+float_power = _binary("float_power", jnp.float_power)
+arctan2 = _binary("arctan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+copysign = _binary("copysign", jnp.copysign)
+ldexp = _binary("ldexp", jnp.ldexp)
+nextafter = _binary("nextafter", jnp.nextafter)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+logaddexp2 = _binary("logaddexp2", jnp.logaddexp2)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+left_shift = _binary("left_shift", jnp.left_shift)
+right_shift = _binary("right_shift", jnp.right_shift)
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+heaviside = _binary("heaviside", jnp.heaviside)
+equal = _binary("equal", jnp.equal)
+not_equal = _binary("not_equal", jnp.not_equal)
+greater = _binary("greater", jnp.greater)
+greater_equal = _binary("greater_equal", jnp.greater_equal)
+less = _binary("less", jnp.less)
+less_equal = _binary("less_equal", jnp.less_equal)
+
+# ------------------------------------------------------------------
+# reductions
+# ------------------------------------------------------------------
+sum = _reduction("sum", jnp.sum)  # noqa: A001
+prod = _reduction("prod", jnp.prod)
+mean = _reduction("mean", jnp.mean)
+nansum = _reduction("nansum", jnp.nansum)
+nanprod = _reduction("nanprod", jnp.nanprod)
+nanmean = _reduction("nanmean", jnp.nanmean)
+
+
+def _reduction_nd(name, jfn):
+    def f(a, axis=None, out=None, keepdims=False):
+        ax = tuple(axis) if isinstance(axis, list) else axis
+        res = _invoke(name, lambda x: jfn(x, axis=ax, keepdims=keepdims),
+                      [a if isinstance(a, NDArray) else ndarray(a)])
+        return _maybe_out(res, out)
+    f.__name__ = name
+    return f
+
+
+amax = _reduction_nd("max", jnp.max)
+amin = _reduction_nd("min", jnp.min)
+max = amax  # noqa: A001
+min = amin  # noqa: A001
+nanmax = _reduction_nd("nanmax", jnp.nanmax)
+nanmin = _reduction_nd("nanmin", jnp.nanmin)
+all = _reduction_nd("all", jnp.all)  # noqa: A001
+any = _reduction_nd("any", jnp.any)  # noqa: A001
+
+
+def std(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    res = _invoke("std", lambda x: jnp.std(x, axis=ax, ddof=ddof,
+                                           keepdims=keepdims),
+                  [a if isinstance(a, NDArray) else ndarray(a)])
+    return _maybe_out(res, out)
+
+
+def var(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    res = _invoke("var", lambda x: jnp.var(x, axis=ax, ddof=ddof,
+                                           keepdims=keepdims),
+                  [a if isinstance(a, NDArray) else ndarray(a)])
+    return _maybe_out(res, out)
+
+
+def argmax(a, axis=None, out=None):
+    res = _invoke("argmax", lambda x: jnp.argmax(x, axis=axis), [a])
+    return _maybe_out(res, out)
+
+
+def argmin(a, axis=None, out=None):
+    res = _invoke("argmin", lambda x: jnp.argmin(x, axis=axis), [a])
+    return _maybe_out(res, out)
+
+
+def nanargmax(a, axis=None):
+    return _invoke("nanargmax", lambda x: jnp.nanargmax(x, axis=axis), [a])
+
+
+def nanargmin(a, axis=None):
+    return _invoke("nanargmin", lambda x: jnp.nanargmin(x, axis=axis), [a])
+
+
+def median(a, axis=None, out=None, keepdims=False):
+    res = _invoke("median",
+                  lambda x: jnp.median(x, axis=axis, keepdims=keepdims), [a])
+    return _maybe_out(res, out)
+
+
+def quantile(a, q, axis=None, out=None, interpolation="linear",
+             keepdims=False):
+    qv = q._data if isinstance(q, NDArray) else q
+    res = _invoke("quantile",
+                  lambda x: jnp.quantile(x, jnp.asarray(qv), axis=axis,
+                                         method=interpolation,
+                                         keepdims=keepdims), [a])
+    return _maybe_out(res, out)
+
+
+def percentile(a, q, axis=None, out=None, interpolation="linear",
+               keepdims=False):
+    qv = q._data if isinstance(q, NDArray) else q
+    res = _invoke("percentile",
+                  lambda x: jnp.percentile(x, jnp.asarray(qv), axis=axis,
+                                           method=interpolation,
+                                           keepdims=keepdims), [a])
+    return _maybe_out(res, out)
+
+
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        res = mean(a, axis=axis)
+        if returned:
+            cnt = a.size if axis is None else a.shape[axis]
+            return res, full(res.shape, float(cnt))
+        return res
+    arrs = [a, weights] if isinstance(weights, NDArray) else [a]
+    if isinstance(weights, NDArray):
+        res = _invoke("average",
+                      lambda x, w: jnp.average(x, axis=axis, weights=w), arrs)
+    else:
+        res = _invoke("average",
+                      lambda x: jnp.average(x, axis=axis,
+                                            weights=jnp.asarray(weights)),
+                      arrs)
+    if returned:
+        if isinstance(weights, NDArray):
+            sw = sum(weights, axis=axis)
+        else:
+            sw = ndarray(jnp.sum(jnp.asarray(weights), axis=axis))
+        if sw.shape != res.shape:
+            sw = broadcast_to(sw, res.shape)
+        return res, sw
+    return res
+
+
+def cumsum(a, axis=None, dtype=None, out=None):
+    res = _invoke("cumsum",
+                  lambda x: jnp.cumsum(x, axis=axis,
+                                       dtype=None if dtype is None
+                                       else jx_dtype(dtype)), [a])
+    return _maybe_out(res, out)
+
+
+def cumprod(a, axis=None, dtype=None):
+    return _invoke("cumprod",
+                   lambda x: jnp.cumprod(x, axis=axis,
+                                         dtype=None if dtype is None
+                                         else jx_dtype(dtype)), [a])
+
+
+def count_nonzero(a, axis=None):
+    return _invoke("count_nonzero",
+                   lambda x: jnp.count_nonzero(x, axis=axis), [a])
+
+
+def ptp(a, axis=None, keepdims=False):
+    return _invoke("ptp", lambda x: jnp.ptp(x, axis=axis, keepdims=keepdims),
+                   [a])
+
+
+# ------------------------------------------------------------------
+# manipulation
+# ------------------------------------------------------------------
+def reshape(a, newshape, order="C"):
+    if isinstance(newshape, (int, onp.integer)):
+        newshape = (int(newshape),)
+    return _invoke("np_reshape",
+                   lambda x, _s=tuple(newshape): jnp.reshape(x, _s), [a])
+
+
+def ravel(a, order="C"):
+    return reshape(a, -1)
+
+
+def transpose(a, axes=None):
+    return _invoke("np_transpose", lambda x: jnp.transpose(x, axes), [a])
+
+
+def swapaxes(a, axis1, axis2):
+    return _invoke("np_swapaxes", lambda x: jnp.swapaxes(x, axis1, axis2),
+                   [a])
+
+
+def moveaxis(a, source, destination):
+    return _invoke("np_moveaxis",
+                   lambda x: jnp.moveaxis(x, source, destination), [a])
+
+
+def rollaxis(a, axis, start=0):
+    return _invoke("np_rollaxis", lambda x: jnp.rollaxis(x, axis, start), [a])
+
+
+def expand_dims(a, axis):
+    return _invoke("np_expand_dims", lambda x: jnp.expand_dims(x, axis), [a])
+
+
+def squeeze(a, axis=None):
+    return _invoke("np_squeeze", lambda x: jnp.squeeze(x, axis), [a])
+
+
+def broadcast_to(array_, shape):
+    a = array_ if isinstance(array_, NDArray) else array(array_)
+    return _invoke("np_broadcast_to",
+                   lambda x, _s=tuple(shape): jnp.broadcast_to(x, _s), [a])
+
+
+def broadcast_arrays(*args):
+    arrs = [a if isinstance(a, NDArray) else array(a) for a in args]
+    shp = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [broadcast_to(a, shp) for a in arrs]
+
+
+def _join(name, jfn):
+    def f(seq, axis=0, out=None):
+        arrs = [a if isinstance(a, NDArray) else array(a) for a in seq]
+        if name in ("vstack", "hstack", "dstack", "column_stack"):
+            res = _invoke(name, lambda *xs: jfn(xs), arrs)
+        else:
+            res = _invoke(name, lambda *xs: jfn(xs, axis=axis), arrs)
+        return _maybe_out(res, out)
+    f.__name__ = name
+    return f
+
+
+concatenate = _join("concatenate", jnp.concatenate)
+stack = _join("stack", jnp.stack)
+vstack = _join("vstack", jnp.vstack)
+hstack = _join("hstack", jnp.hstack)
+dstack = _join("dstack", jnp.dstack)
+column_stack = _join("column_stack", jnp.column_stack)
+
+
+def concat(seq, axis=0, out=None):
+    return concatenate(seq, axis=axis, out=out)
+
+
+def append(arr, values, axis=None):
+    a = arr if isinstance(arr, NDArray) else array(arr)
+    v = values if isinstance(values, NDArray) else array(values)
+    return _invoke("append", lambda x, y: jnp.append(x, y, axis=axis), [a, v])
+
+
+def _split_impl(name, a, indices_or_sections, axis):
+    data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    if isinstance(indices_or_sections, NDArray):
+        indices_or_sections = tuple(indices_or_sections.asnumpy().tolist())
+    if name == "split":
+        def fn(x):
+            return tuple(jnp.split(x, indices_or_sections, axis=axis))
+    else:
+        def fn(x):
+            return tuple(getattr(jnp, name)(x, indices_or_sections))
+    n = len(fn(jnp.zeros(data.shape, data.dtype)))  # static split count
+    res = _invoke(name, fn, [a if isinstance(a, NDArray) else ndarray(a)],
+                  n_outputs=n)
+    return builtins.list(res) if isinstance(res, tuple) else [res]
+
+
+def split(ary, indices_or_sections, axis=0):
+    return _split_impl("split", ary, indices_or_sections, axis)
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    data = ary._data if isinstance(ary, NDArray) else jnp.asarray(ary)
+    n = len(jnp.array_split(data, indices_or_sections, axis=axis))
+    res = _invoke("array_split",
+                  lambda x: tuple(jnp.array_split(x, indices_or_sections,
+                                                  axis=axis)),
+                  [ary if isinstance(ary, NDArray) else ndarray(ary)],
+                  n_outputs=n)
+    return builtins.list(res) if isinstance(res, tuple) else [res]
+
+
+def hsplit(ary, indices_or_sections):
+    return _split_impl("hsplit", ary, indices_or_sections, None)
+
+
+def vsplit(ary, indices_or_sections):
+    return _split_impl("vsplit", ary, indices_or_sections, None)
+
+
+def dsplit(ary, indices_or_sections):
+    return _split_impl("dsplit", ary, indices_or_sections, None)
+
+
+def tile(A, reps):
+    return _invoke("np_tile", lambda x: jnp.tile(x, reps),
+                   [A if isinstance(A, NDArray) else ndarray(A)])
+
+
+def repeat(a, repeats, axis=None):
+    return _invoke("np_repeat", lambda x: jnp.repeat(x, repeats, axis), [a])
+
+
+def flip(m, axis=None):
+    return _invoke("np_flip", lambda x: jnp.flip(x, axis), [m])
+
+
+def fliplr(m):
+    return _invoke("fliplr", jnp.fliplr, [m])
+
+
+def flipud(m):
+    return _invoke("flipud", jnp.flipud, [m])
+
+
+def roll(a, shift, axis=None):
+    return _invoke("roll", lambda x: jnp.roll(x, shift, axis), [a])
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    return _invoke("rot90", lambda x: jnp.rot90(x, k, axes), [m])
+
+
+def pad(array_, pad_width, mode="constant", **kwargs):
+    a = array_ if isinstance(array_, NDArray) else array(array_)
+    return _invoke("np_pad",
+                   lambda x: jnp.pad(x, pad_width, mode=mode, **kwargs), [a])
+
+
+def insert(arr, obj, values, axis=None):
+    a = arr if isinstance(arr, NDArray) else array(arr)
+    v = values._data if isinstance(values, NDArray) else values
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.asnumpy(), dtype=onp.int32)
+    return _invoke("insert", lambda x: jnp.insert(x, obj, v, axis=axis), [a])
+
+
+def delete(arr, obj, axis=None):
+    a = arr if isinstance(arr, NDArray) else array(arr)
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.asnumpy(), dtype=onp.int32)
+    return _invoke("delete", lambda x: jnp.delete(x, obj, axis=axis), [a])
+
+
+def unique(ar, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    data = ar._data if isinstance(ar, NDArray) else jnp.asarray(ar)
+    res = jnp.unique(data, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(ndarray(r) for r in res)
+    return ndarray(res)
+
+
+def sort(a, axis=-1, kind=None, order=None):
+    return _invoke("np_sort", lambda x: jnp.sort(x, axis=axis), [a])
+
+
+def argsort(a, axis=-1, kind=None, order=None):
+    return _invoke("np_argsort", lambda x: jnp.argsort(x, axis=axis), [a])
+
+
+def searchsorted(a, v, side="left", sorter=None):
+    arrs = [a, v] if isinstance(v, NDArray) else [a]
+    if isinstance(v, NDArray):
+        return _invoke("searchsorted",
+                       lambda x, y: jnp.searchsorted(x, y, side=side), arrs)
+    return _invoke("searchsorted",
+                   lambda x: jnp.searchsorted(x, jnp.asarray(v), side=side),
+                   arrs)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    c = condition if isinstance(condition, NDArray) else array(condition)
+    arrs = [c]
+    xin = yin = None
+    if isinstance(x, NDArray):
+        xin = len(arrs)
+        arrs.append(x)
+    if isinstance(y, NDArray):
+        yin = len(arrs)
+        arrs.append(y)
+
+    def fn(*datas):
+        xv = datas[xin] if xin is not None else x
+        yv = datas[yin] if yin is not None else y
+        return jnp.where(datas[0], xv, yv)
+    return _invoke("where", fn, arrs)
+
+
+def clip(a, a_min=None, a_max=None, out=None):
+    res = _invoke("np_clip", lambda x: jnp.clip(x, a_min, a_max),
+                  [a if isinstance(a, NDArray) else ndarray(a)])
+    return _maybe_out(res, out)
+
+
+def around(a, decimals=0, out=None):
+    res = _invoke("around", lambda x: jnp.around(x, decimals),
+                  [a if isinstance(a, NDArray) else ndarray(a)])
+    return _maybe_out(res, out)
+
+
+round = around  # noqa: A001
+round_ = around
+
+
+def take(a, indices, axis=None, mode="raise", out=None):
+    arr = a if isinstance(a, NDArray) else array(a)
+    jmode = None if mode == "raise" else mode
+    if isinstance(indices, NDArray):
+        res = _invoke("np_take",
+                      lambda x, i: jnp.take(x, i.astype(_DEFAULT_INT)
+                                            if i.dtype not in (jnp.int32, _DEFAULT_INT)
+                                            else i, axis=axis, mode=jmode),
+                      [arr, indices])
+    else:
+        idx = jnp.asarray(onp.asarray(indices, dtype=onp.int64))
+        res = _invoke("np_take",
+                      lambda x: jnp.take(x, idx, axis=axis, mode=jmode),
+                      [arr])
+    return _maybe_out(res, out)
+
+
+def take_along_axis(arr, indices, axis):
+    return _invoke("take_along_axis",
+                   lambda x, i: jnp.take_along_axis(
+                       x, i.astype(_DEFAULT_INT), axis=axis),
+                   [arr, indices])
+
+
+def nonzero(a):
+    data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    return tuple(ndarray(r) for r in onp.nonzero(onp.asarray(data)))
+
+
+def flatnonzero(a):
+    data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    return ndarray(onp.flatnonzero(onp.asarray(data)))
+
+
+def argwhere(a):
+    data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    return ndarray(onp.argwhere(onp.asarray(data)))
+
+
+def diff(a, n=1, axis=-1):
+    return _invoke("diff", lambda x: jnp.diff(x, n=n, axis=axis), [a])
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    return _invoke("ediff1d",
+                   lambda x: jnp.ediff1d(x, to_end=to_end,
+                                         to_begin=to_begin), [ary])
+
+
+def gradient(f, *varargs, axis=None, edge_order=1):
+    data = f._data if isinstance(f, NDArray) else jnp.asarray(f)
+    res = jnp.gradient(data, *varargs, axis=axis)
+    if isinstance(res, (builtins.list, tuple)):
+        return [ndarray(d) for d in res]
+    return ndarray(res)
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    if x is not None and isinstance(x, NDArray):
+        return _invoke("trapz",
+                       lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                       [y, x])
+    xv = None if x is None else jnp.asarray(x)
+    return _invoke("trapz",
+                   lambda yy: jnp.trapezoid(yy, xv, dx=dx, axis=axis), [y])
+
+
+def interp(x, xp, fp, left=None, right=None, period=None):
+    datas = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
+             for v in (x, xp, fp)]
+    return ndarray(jnp.interp(*datas, left=left, right=right, period=period))
+
+
+def cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    return _invoke("cross",
+                   lambda x, y: jnp.cross(x, y, axisa, axisb, axisc,
+                                          axis=axis),
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def convolve(a, v, mode="full"):
+    return _invoke("convolve", lambda x, y: jnp.convolve(x, y, mode=mode),
+                   [a if isinstance(a, NDArray) else array(a),
+                    v if isinstance(v, NDArray) else array(v)])
+
+
+def correlate(a, v, mode="valid"):
+    return _invoke("correlate", lambda x, y: jnp.correlate(x, y, mode=mode),
+                   [a if isinstance(a, NDArray) else array(a),
+                    v if isinstance(v, NDArray) else array(v)])
+
+
+def resize(a, new_shape):
+    return _invoke("np_resize",
+                   lambda x: jnp.resize(x, new_shape),
+                   [a if isinstance(a, NDArray) else ndarray(a)])
+
+
+# ------------------------------------------------------------------
+# linear algebra (top-level)
+# ------------------------------------------------------------------
+def dot(a, b, out=None):
+    res = _invoke("np_dot", jnp.dot,
+                  [a if isinstance(a, NDArray) else array(a),
+                   b if isinstance(b, NDArray) else array(b)])
+    return _maybe_out(res, out)
+
+
+def matmul(a, b, out=None):
+    res = _invoke("np_matmul", jnp.matmul,
+                  [a if isinstance(a, NDArray) else array(a),
+                   b if isinstance(b, NDArray) else array(b)])
+    return _maybe_out(res, out)
+
+
+def inner(a, b):
+    return _invoke("inner", jnp.inner,
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def outer(a, b):
+    return _invoke("outer", jnp.outer,
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def vdot(a, b):
+    return _invoke("vdot", jnp.vdot,
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def tensordot(a, b, axes=2):
+    return _invoke("tensordot", lambda x, y: jnp.tensordot(x, y, axes=axes),
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def einsum(subscripts, *operands, out=None, optimize=False):
+    arrs = [o if isinstance(o, NDArray) else array(o) for o in operands]
+    res = _invoke("einsum",
+                  lambda *datas: jnp.einsum(subscripts, *datas), arrs)
+    return _maybe_out(res, out)
+
+
+def kron(a, b):
+    return _invoke("kron", jnp.kron,
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _invoke("trace", lambda x: jnp.trace(x, offset, axis1, axis2), [a])
+
+
+def matrix_power(a, n):
+    from . import linalg
+    return linalg.matrix_power(a, n)
+
+
+def vander(x, N=None, increasing=False):
+    return _invoke("vander",
+                   lambda v: jnp.vander(v, N, increasing=increasing),
+                   [x if isinstance(x, NDArray) else array(x)])
+
+
+# ------------------------------------------------------------------
+# statistics / histograms
+# ------------------------------------------------------------------
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    b = bins._data if isinstance(bins, NDArray) else bins
+    hist, edges = jnp.histogram(data, bins=b, range=range,
+                                weights=None if weights is None
+                                else jnp.asarray(weights), density=density)
+    return ndarray(hist), ndarray(edges)
+
+
+def bincount(x, weights=None, minlength=0):
+    data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    return ndarray(jnp.bincount(
+        data.astype(jnp.int32),
+        weights=None if weights is None else jnp.asarray(
+            weights._data if isinstance(weights, NDArray) else weights),
+        minlength=minlength))
+
+
+def digitize(x, bins, right=False):
+    data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    b = bins._data if isinstance(bins, NDArray) else jnp.asarray(bins)
+    return ndarray(jnp.digitize(data, b, right=right))
+
+
+def cov(m, y=None, rowvar=True, bias=False, ddof=None):
+    data = m._data if isinstance(m, NDArray) else jnp.asarray(m)
+    yv = None if y is None else (y._data if isinstance(y, NDArray)
+                                 else jnp.asarray(y))
+    return ndarray(jnp.cov(data, yv, rowvar=rowvar, bias=bias, ddof=ddof))
+
+
+def corrcoef(x, y=None, rowvar=True):
+    data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    yv = None if y is None else (y._data if isinstance(y, NDArray)
+                                 else jnp.asarray(y))
+    return ndarray(jnp.corrcoef(data, yv, rowvar=rowvar))
+
+
+# ------------------------------------------------------------------
+# logic
+# ------------------------------------------------------------------
+def array_equal(a1, a2, equal_nan=False):
+    d1 = a1._data if isinstance(a1, NDArray) else jnp.asarray(a1)
+    d2 = a2._data if isinstance(a2, NDArray) else jnp.asarray(a2)
+    return builtins.bool(jnp.array_equal(d1, d2, equal_nan=equal_nan))
+
+
+def array_equiv(a1, a2):
+    d1 = a1._data if isinstance(a1, NDArray) else jnp.asarray(a1)
+    d2 = a2._data if isinstance(a2, NDArray) else jnp.asarray(a2)
+    return builtins.bool(jnp.array_equiv(d1, d2))
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    d1 = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    d2 = b._data if isinstance(b, NDArray) else jnp.asarray(b)
+    return builtins.bool(jnp.allclose(d1, d2, rtol, atol, equal_nan))
+
+
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _invoke("isclose",
+                   lambda x, y: jnp.isclose(x, y, rtol, atol, equal_nan),
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)])
+
+
+def isscalar(element):
+    return onp.isscalar(element)
+
+
+def shares_memory(a, b, max_work=None):
+    da = a._data if isinstance(a, NDArray) else a
+    db = b._data if isinstance(b, NDArray) else b
+    return da is db
+
+
+may_share_memory = shares_memory
+
+
+def result_type(*arrays_and_dtypes):
+    args = [a._data if isinstance(a, NDArray)
+            else (jx_dtype(a) if isinstance(a, (str, type, onp.dtype))
+                  else a)
+            for a in arrays_and_dtypes]
+    return onp.dtype(str(jnp.result_type(*args)))
+
+
+def promote_types(t1, t2):
+    return onp.dtype(str(jnp.promote_types(jx_dtype(t1), jx_dtype(t2))))
+
+
+def can_cast(from_, to, casting="safe"):
+    f = from_._data.dtype if isinstance(from_, NDArray) else jx_dtype(from_)
+    return onp.can_cast(onp.dtype(str(f)), onp.dtype(str(jx_dtype(to))),
+                        casting=casting)
+
+
+def ndim(a):
+    return a.ndim if isinstance(a, NDArray) else onp.ndim(a)
+
+
+def shape(a):
+    return a.shape if isinstance(a, NDArray) else onp.shape(a)
+
+
+def size(a, axis=None):
+    if isinstance(a, NDArray):
+        return a.size if axis is None else a.shape[axis]
+    return onp.size(a, axis)
+
+
+def may_apply_along(a):  # pragma: no cover — placeholder
+    raise NotImplementedError

@@ -1,0 +1,379 @@
+"""mx.np.random — NumPy-compatible sampling over the stateless key chain.
+
+Reference analog: python/mxnet/numpy/random.py (_npi random kernels,
+src/operator/numpy/random/). TPU design: every sampler is a pure
+counter-based jax.random kernel; statefulness (numpy's global RandomState)
+is emulated by the framework-wide key chain in ndarray/random.py, which is
+trace-aware so samplers inside a hybridized block derive from the per-call
+key (fresh randomness per step, one compiled program).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import jx_dtype
+from ..ndarray.ndarray import NDArray
+from ..ndarray import random as _ndrandom
+from ..ops.registry import invoke_raw
+from .multiarray import ndarray, array, _invoke
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "permutation", "beta", "gamma", "exponential",
+           "poisson", "binomial", "multinomial", "multivariate_normal",
+           "chisquare", "geometric", "gumbel", "laplace", "logistic",
+           "lognormal", "pareto", "power", "rayleigh", "weibull", "f",
+           "standard_normal", "standard_cauchy", "standard_exponential",
+           "standard_gamma", "standard_t", "negative_binomial", "bernoulli"]
+
+seed = _ndrandom.seed
+
+
+def _size(size):
+    if size is None:
+        return None
+    return (size,) if isinstance(size, (int, onp.integer)) else tuple(size)
+
+
+def _sample(name, sampler, size, dtype=None, param_arrays=()):
+    """Run a key-consuming sampler through the invoke funnel."""
+    key = _ndrandom.next_key()
+    arrs = [p for p in param_arrays if isinstance(p, NDArray)]
+
+    def fn(*datas):
+        return sampler(key, *datas)
+    res = invoke_raw(name, fn, list(arrs), out_cls=ndarray)
+    if dtype is not None and res._data.dtype != jx_dtype(dtype):
+        res._data = res._data.astype(jx_dtype(dtype))
+    return res
+
+
+def _broadcast_shape(size, *params):
+    if size is not None:
+        return _size(size)
+    shapes = [p.shape if isinstance(p, NDArray) else onp.shape(p)
+              for p in params]
+    return tuple(jnp.broadcast_shapes(*shapes)) if shapes else ()
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None):
+    shp = _broadcast_shape(size, low, high)
+    lo = low._data if isinstance(low, NDArray) else low
+    hi = high._data if isinstance(high, NDArray) else high
+    res = _sample("np_uniform",
+                  lambda k: jax.random.uniform(
+                      k, shp, dtype=jnp.float32,
+                      minval=jnp.asarray(lo, jnp.float32),
+                      maxval=jnp.asarray(hi, jnp.float32)),
+                  size, dtype)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    shp = _broadcast_shape(size, loc, scale)
+    lo = loc._data if isinstance(loc, NDArray) else loc
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    res = _sample("np_normal",
+                  lambda k: jax.random.normal(k, shp, dtype=jnp.float32)
+                  * jnp.asarray(sc, jnp.float32)
+                  + jnp.asarray(lo, jnp.float32),
+                  size, dtype)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def standard_normal(size=None, dtype=None):
+    return normal(0.0, 1.0, size=size, dtype=dtype)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size=size if size else ())
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size=size if size else ())
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    shp = _size(size) or ()
+    dt = jnp.int32 if dtype is None else jx_dtype(dtype)
+    res = _sample("np_randint",
+                  lambda k: jax.random.randint(k, shp, int(low), int(high),
+                                               dtype=jnp.int32).astype(dt),
+                  size)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    shp = _size(size) or ()
+    key = _ndrandom.next_key()
+    if isinstance(a, (int, onp.integer)):
+        pool = jnp.arange(int(a))
+    else:
+        pool = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    pv = None
+    if p is not None:
+        pv = p._data if isinstance(p, NDArray) else jnp.asarray(p)
+    res = jax.random.choice(key, pool, shp, replace=replace, p=pv)
+    r = ndarray(res)
+    if out is not None:
+        out._data = r._data
+        return out
+    return r
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (functional rebind)."""
+    key = _ndrandom.next_key()
+    x._data = jax.random.permutation(key, x._data, axis=0)
+
+
+def permutation(x):
+    key = _ndrandom.next_key()
+    if isinstance(x, (int, onp.integer)):
+        return ndarray(jax.random.permutation(key, int(x)))
+    data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    return ndarray(jax.random.permutation(key, data, axis=0))
+
+
+def beta(a, b, size=None):
+    shp = _broadcast_shape(size, a, b)
+    av = a._data if isinstance(a, NDArray) else a
+    bv = b._data if isinstance(b, NDArray) else b
+    return _sample("np_beta",
+                   lambda k: jax.random.beta(
+                       k, jnp.asarray(av, jnp.float32),
+                       jnp.asarray(bv, jnp.float32), shp), size)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    shp = _broadcast_shape(size, shape, scale)
+    sv = shape._data if isinstance(shape, NDArray) else shape
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    res = _sample("np_gamma",
+                  lambda k: jax.random.gamma(
+                      k, jnp.asarray(sv, jnp.float32), shp)
+                  * jnp.asarray(sc, jnp.float32), size, dtype)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def standard_gamma(shape, size=None):
+    return gamma(shape, 1.0, size=size)
+
+
+def exponential(scale=1.0, size=None):
+    shp = _broadcast_shape(size, scale)
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    return _sample("np_exponential",
+                   lambda k: jax.random.exponential(k, shp)
+                   * jnp.asarray(sc, jnp.float32), size)
+
+
+standard_exponential = exponential
+
+
+def poisson(lam=1.0, size=None):
+    shp = _broadcast_shape(size, lam)
+    lv = lam._data if isinstance(lam, NDArray) else lam
+    return _sample("np_poisson",
+                   lambda k: jax.random.poisson(
+                       k, jnp.asarray(lv, jnp.float32), shp).astype(
+                           jnp.float32), size)
+
+
+def binomial(n, p, size=None):
+    shp = _broadcast_shape(size, n, p)
+    nv = n._data if isinstance(n, NDArray) else n
+    pv = p._data if isinstance(p, NDArray) else p
+
+    def sampler(k):
+        # sum of Bernoulli draws via uniform comparison, vectorized over n
+        nmax = int(onp.max(onp.asarray(nv)))
+        u = jax.random.uniform(k, (nmax,) + shp)
+        counts = jnp.sum(
+            (u < jnp.asarray(pv, jnp.float32))
+            & (jnp.arange(nmax).reshape((nmax,) + (1,) * len(shp))
+               < jnp.asarray(nv)), axis=0)
+        return counts.astype(jnp.float32)
+    return _sample("np_binomial", sampler, size)
+
+
+def negative_binomial(n, p, size=None):
+    shp = _broadcast_shape(size, n, p)
+    nv = n._data if isinstance(n, NDArray) else n
+    pv = p._data if isinstance(p, NDArray) else p
+
+    def sampler(k):
+        k1, k2 = jax.random.split(k)
+        lam = jax.random.gamma(k1, jnp.broadcast_to(
+            jnp.asarray(nv, jnp.float32), shp)) \
+            * (1.0 - jnp.asarray(pv, jnp.float32)) / jnp.asarray(
+                pv, jnp.float32)
+        return jax.random.poisson(k2, lam, shp).astype(jnp.float32)
+    return _sample("np_negative_binomial", sampler, size)
+
+
+def multinomial(n, pvals, size=None):
+    key = _ndrandom.next_key()
+    pv = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(
+        pvals, jnp.float32)
+    shp = _size(size) or ()
+    draws = jax.random.categorical(
+        key, jnp.log(jnp.maximum(pv, 1e-30)), shape=shp + (int(n),))
+    out = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int32).sum(axis=-2)
+    return ndarray(out)
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    key = _ndrandom.next_key()
+    m = mean._data if isinstance(mean, NDArray) else jnp.asarray(
+        mean, jnp.float32)
+    c = cov._data if isinstance(cov, NDArray) else jnp.asarray(
+        cov, jnp.float32)
+    shp = _size(size) or ()
+    return ndarray(jax.random.multivariate_normal(key, m, c, shape=shp or
+                                                  None))
+
+
+def chisquare(df, size=None):
+    return gamma(jnp.asarray(df, jnp.float32) / 2.0, 2.0, size=size) \
+        if not isinstance(df, NDArray) else gamma(df / 2.0, 2.0, size=size)
+
+
+def f(dfnum, dfden, size=None):
+    x1 = chisquare(dfnum, size=size)
+    x2 = chisquare(dfden, size=size)
+    return (x1 / dfnum) / (x2 / dfden)
+
+
+def standard_t(df, size=None):
+    shp = _broadcast_shape(size, df)
+    dv = df._data if isinstance(df, NDArray) else df
+    return _sample("np_standard_t",
+                   lambda k: jax.random.t(k, jnp.asarray(dv, jnp.float32),
+                                          shp), size)
+
+
+def standard_cauchy(size=None):
+    shp = _size(size) or ()
+    return _sample("np_standard_cauchy",
+                   lambda k: jax.random.cauchy(k, shp), size)
+
+
+def geometric(p, size=None):
+    shp = _broadcast_shape(size, p)
+    pv = p._data if isinstance(p, NDArray) else p
+    return _sample("np_geometric",
+                   lambda k: jnp.ceil(
+                       jnp.log1p(-jax.random.uniform(k, shp))
+                       / jnp.log1p(-jnp.asarray(pv, jnp.float32))).astype(
+                           jnp.int32), size)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None):
+    shp = _broadcast_shape(size, loc, scale)
+    lo = loc._data if isinstance(loc, NDArray) else loc
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    return _sample("np_gumbel",
+                   lambda k: jax.random.gumbel(k, shp)
+                   * jnp.asarray(sc, jnp.float32)
+                   + jnp.asarray(lo, jnp.float32), size)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    shp = _broadcast_shape(size, loc, scale)
+    lo = loc._data if isinstance(loc, NDArray) else loc
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    res = _sample("np_laplace",
+                  lambda k: jax.random.laplace(k, shp)
+                  * jnp.asarray(sc, jnp.float32)
+                  + jnp.asarray(lo, jnp.float32), size, dtype)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def logistic(loc=0.0, scale=1.0, size=None):
+    shp = _broadcast_shape(size, loc, scale)
+    lo = loc._data if isinstance(loc, NDArray) else loc
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    return _sample("np_logistic",
+                   lambda k: jax.random.logistic(k, shp)
+                   * jnp.asarray(sc, jnp.float32)
+                   + jnp.asarray(lo, jnp.float32), size)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None):
+    return exp_of_normal(mean, sigma, size)
+
+
+def exp_of_normal(mean, sigma, size):
+    shp = _broadcast_shape(size, mean, sigma)
+    mv = mean._data if isinstance(mean, NDArray) else mean
+    sv = sigma._data if isinstance(sigma, NDArray) else sigma
+    return _sample("np_lognormal",
+                   lambda k: jnp.exp(
+                       jax.random.normal(k, shp)
+                       * jnp.asarray(sv, jnp.float32)
+                       + jnp.asarray(mv, jnp.float32)), size)
+
+
+def pareto(a, size=None):
+    shp = _broadcast_shape(size, a)
+    av = a._data if isinstance(a, NDArray) else a
+    return _sample("np_pareto",
+                   lambda k: jax.random.pareto(
+                       k, jnp.asarray(av, jnp.float32), shp) - 1.0, size)
+
+
+def power(a, size=None):
+    shp = _broadcast_shape(size, a)
+    av = a._data if isinstance(a, NDArray) else a
+    return _sample("np_power",
+                   lambda k: jax.random.uniform(k, shp)
+                   ** (1.0 / jnp.asarray(av, jnp.float32)), size)
+
+
+def rayleigh(scale=1.0, size=None):
+    shp = _broadcast_shape(size, scale)
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    return _sample("np_rayleigh",
+                   lambda k: jnp.sqrt(-2.0 * jnp.log1p(
+                       -jax.random.uniform(k, shp)))
+                   * jnp.asarray(sc, jnp.float32), size)
+
+
+def weibull(a, size=None):
+    shp = _broadcast_shape(size, a)
+    av = a._data if isinstance(a, NDArray) else a
+    return _sample("np_weibull",
+                   lambda k: (-jnp.log1p(-jax.random.uniform(k, shp)))
+                   ** (1.0 / jnp.asarray(av, jnp.float32)), size)
+
+
+def bernoulli(prob=0.5, size=None, dtype=None):
+    shp = _broadcast_shape(size, prob)
+    pv = prob._data if isinstance(prob, NDArray) else prob
+    return _sample("np_bernoulli",
+                   lambda k: jax.random.bernoulli(
+                       k, jnp.asarray(pv, jnp.float32), shp).astype(
+                           jnp.float32 if dtype is None else jx_dtype(dtype)),
+                   size)

@@ -1,0 +1,147 @@
+"""mx.npx — NumPy-extension namespace (operators beyond the NumPy standard).
+
+Reference analog: python/mxnet/numpy_extension/ + ndarray/numpy_extension/
+(`_npx.*` ops). Because the op funnel propagates the mx.np ndarray class to
+outputs whenever an input is an mx.np array (ops/registry.set_np_ndarray_cls),
+the npx surface simply re-exports the framework's nd-level kernels — calling
+them with np arrays yields np arrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..base import jx_dtype
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
+from ..ndarray.ndarray import NDArray, waitall
+from ..ndarray.ops import (  # noqa: F401
+    softmax, log_softmax, softmin, pick, topk, one_hot, gather_nd,
+    scatter_nd, FullyConnected as fully_connected, Dropout as dropout,
+    Embedding as embedding, Activation as activation, LeakyReLU as leaky_relu,
+    SequenceMask as sequence_mask, batch_dot, cast, clip, shape_array,
+    boolean_mask, stop_gradient, reshape_like, broadcast_like,
+)
+from ..ndarray.nn_ops import (  # noqa: F401
+    Convolution as convolution, Deconvolution as deconvolution,
+    Pooling as pooling, BatchNorm as batch_norm, LayerNorm as layer_norm,
+    GroupNorm as group_norm, InstanceNorm as instance_norm,
+)
+from ..ops.registry import invoke_raw
+from ..util import (  # noqa: F401
+    set_np, reset_np, is_np_array, is_np_shape, set_np_shape, np_shape,
+    np_array, use_np, use_np_array)
+from ..numpy.multiarray import ndarray, array, _invoke
+
+__all__ = [
+    "set_np", "reset_np", "is_np_array", "is_np_shape", "softmax",
+    "log_softmax", "masked_softmax", "masked_log_softmax", "pick", "topk",
+    "one_hot", "gather_nd", "scatter_nd", "fully_connected", "convolution",
+    "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "dropout", "embedding", "activation", "leaky_relu",
+    "sequence_mask", "batch_dot", "relu", "sigmoid", "erf", "erfinv",
+    "gamma", "gammaln", "digamma", "smooth_l1", "arange_like", "waitall",
+    "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
+    "reshape_like", "broadcast_like", "stop_gradient", "boolean_mask",
+    "cast", "clip", "shape_array", "seed", "index_update", "index_add",
+]
+
+from ..ndarray.random import seed  # noqa: F401,E402
+
+
+def _arr(a):
+    return a if isinstance(a, NDArray) else array(a)
+
+
+def relu(data):
+    return _invoke("npx_relu", lambda x: jnp.maximum(x, 0), [_arr(data)])
+
+
+def sigmoid(data):
+    return _invoke("npx_sigmoid", lambda x: 1.0 / (1.0 + jnp.exp(-x)),
+                   [_arr(data)])
+
+
+def erf(data):
+    return _invoke("npx_erf", jsp.erf, [_arr(data)])
+
+
+def erfinv(data):
+    return _invoke("npx_erfinv", jsp.erfinv, [_arr(data)])
+
+
+def gamma(data):
+    """Gamma function Γ(x) (reference _npx.gamma)."""
+    return _invoke("npx_gamma", lambda x: jnp.exp(jsp.gammaln(x)),
+                   [_arr(data)])
+
+
+def gammaln(data):
+    return _invoke("npx_gammaln", jsp.gammaln, [_arr(data)])
+
+
+def digamma(data):
+    return _invoke("npx_digamma", jsp.digamma, [_arr(data)])
+
+
+def smooth_l1(data, scalar=1.0):
+    """Reference smooth_l1 (src/operator/tensor/elemwise_unary_op.cc):
+    0.5 (σx)² if |x| < 1/σ² else |x| - 0.5/σ²."""
+    s2 = scalar * scalar
+
+    def fn(x):
+        return jnp.where(jnp.abs(x) < 1.0 / s2,
+                         0.5 * s2 * x * x,
+                         jnp.abs(x) - 0.5 / s2)
+    return _invoke("npx_smooth_l1", fn, [_arr(data)])
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
+    def fn(x):
+        n = x.shape[axis] if axis is not None else x.size
+        vals = start + step * jnp.arange(n, dtype=jnp.float32)
+        if axis is None:
+            return vals.reshape(x.shape)
+        return vals
+    return _invoke("npx_arange_like", fn, [_arr(data)])
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
+    if mask is None:
+        return softmax(_arr(data), axis=axis, temperature=temperature)
+
+    def fn(x, m):
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(
+            x.dtype, jnp.floating) else -1e30
+        masked = jnp.where(m.astype(bool), x / temperature, neg)
+        e = jnp.exp(masked - jnp.max(masked, axis=axis, keepdims=True))
+        e = jnp.where(m.astype(bool), e, 0.0)
+        return e / jnp.maximum(jnp.sum(e, axis=axis, keepdims=True), 1e-30)
+    return _invoke("npx_masked_softmax", fn, [_arr(data), _arr(mask)])
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0):
+    if mask is None:
+        return log_softmax(_arr(data), axis=axis, temperature=temperature)
+
+    def fn(x, m):
+        neg = jnp.finfo(x.dtype).min
+        masked = jnp.where(m.astype(bool), x / temperature, neg)
+        lse = jsp.logsumexp(masked, axis=axis, keepdims=True,
+                            where=m.astype(bool))
+        return jnp.where(m.astype(bool), masked - lse, -jnp.inf)
+    return _invoke("npx_masked_log_softmax", fn, [_arr(data), _arr(mask)])
+
+
+def index_update(data, indices, values):
+    """Functional scatter-update: data[indices] = values (XLA scatter)."""
+    v = values._data if isinstance(values, NDArray) else values
+    idx = indices._data if isinstance(indices, NDArray) else indices
+    return _invoke("npx_index_update",
+                   lambda x: x.at[idx].set(v), [_arr(data)])
+
+
+def index_add(data, indices, values):
+    v = values._data if isinstance(values, NDArray) else values
+    idx = indices._data if isinstance(indices, NDArray) else indices
+    return _invoke("npx_index_add",
+                   lambda x: x.at[idx].add(v), [_arr(data)])

@@ -16,9 +16,20 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from .. import _tape, engine
 from ..base import MXNetError
 
-__all__ = ["Op", "register", "get_op", "invoke", "invoke_raw", "list_ops"]
+__all__ = ["Op", "register", "get_op", "invoke", "invoke_raw", "list_ops",
+           "set_np_ndarray_cls"]
 
 _OP_REGISTRY: Dict[str, "Op"] = {}
+
+# The mx.np ndarray class, registered by mxnet_tpu.numpy at import. When any
+# input to an op is an mx.np array, outputs are mx.np arrays — the analog of
+# the reference's _set_np_ndarray_class hook (python/mxnet/ndarray/register.py).
+_NP_CLS = None
+
+
+def set_np_ndarray_cls(cls):
+    global _NP_CLS
+    _NP_CLS = cls
 
 
 class Op:
@@ -68,7 +79,8 @@ def list_ops() -> List[str]:
 
 
 def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
-               n_outputs: int = 1, record: Optional[bool] = None):
+               n_outputs: int = 1, record: Optional[bool] = None,
+               out_cls=None):
     """Invoke a pure function on NDArray inputs, returning NDArray outputs.
 
     This is the single funnel every imperative op goes through — the analog
@@ -76,13 +88,18 @@ def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
     """
     from ..ndarray.ndarray import NDArray  # lazy to break import cycle
 
+    cls = out_cls
+    if cls is None:
+        cls = NDArray
+        if _NP_CLS is not None and any(isinstance(x, _NP_CLS) for x in inputs):
+            cls = _NP_CLS
     in_datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
     should_record = _tape.is_recording() if record is None else record
 
     if should_record:
         nd_inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
         # Allocate output handles; record_op fills data + tape entries.
-        outs = [NDArray.__new__(NDArray) for _ in range(n_outputs)]
+        outs = [cls.__new__(cls) for _ in range(n_outputs)]
         for o in outs:
             o._init_empty()
         node = _tape.record_op(name, fn, nd_inputs, outs)
@@ -91,10 +108,10 @@ def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
     else:
         raw = fn(*in_datas)
         if n_outputs == 1 and not isinstance(raw, (tuple, list)):
-            result = NDArray(raw)
+            result = cls(raw)
         else:
             raw = raw if isinstance(raw, (tuple, list)) else (raw,)
-            result = tuple(NDArray(r) for r in raw)
+            result = tuple(cls(r) for r in raw)
 
     eng = engine.get()
     if eng.is_naive:

@@ -1,0 +1,241 @@
+"""Testing utilities: golden comparison + finite-difference gradient checks.
+
+Reference analog: python/mxnet/test_utils.py (assert_almost_equal,
+check_numeric_gradient, check_consistency, rand_ndarray, same). The TPU
+rebuild keeps the same numerics methodology (SURVEY §4): golden values vs
+NumPy plus central-difference gradient verification against the tape/vjp
+backward, and cross-context consistency (cpu vs tpu).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as onp
+
+from . import autograd
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "rand_ndarray",
+           "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
+           "check_numeric_gradient", "numeric_grad", "check_symbolic_forward",
+           "check_consistency", "default_context", "default_rtol",
+           "default_atol", "effective_dtype", "environment", "random_seed"]
+
+_DEFAULT_RTOL = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-4,
+                 onp.dtype(onp.float64): 1e-5}
+_DEFAULT_ATOL = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-5,
+                 onp.dtype(onp.float64): 1e-7}
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def default_rtol(dtype) -> float:
+    return _DEFAULT_RTOL.get(onp.dtype(dtype), 1e-4)
+
+
+def default_atol(dtype) -> float:
+    return _DEFAULT_ATOL.get(onp.dtype(dtype), 1e-5)
+
+
+def effective_dtype(x):
+    return onp.dtype(getattr(x, "dtype", onp.float32))
+
+
+def _as_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def same(a, b) -> bool:
+    return onp.array_equal(_as_numpy(a), _as_numpy(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
+    a, b = _as_numpy(a), _as_numpy(b)
+    rtol = default_rtol(a.dtype) if rtol is None else rtol
+    atol = default_atol(a.dtype) if atol is None else atol
+    return onp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Assert |a-b| <= atol + rtol*|b| elementwise, with a max-error report
+    (reference test_utils.assert_almost_equal)."""
+    a_np, b_np = _as_numpy(a), _as_numpy(b)
+    rtol = default_rtol(a_np.dtype) if rtol is None else rtol
+    atol = default_atol(a_np.dtype) if atol is None else atol
+    if onp.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    a_f, b_f = a_np.astype(onp.float64), b_np.astype(onp.float64)
+    err = onp.abs(a_f - b_f)
+    tol = atol + rtol * onp.abs(b_f)
+    bad = err > tol
+    with onp.errstate(divide="ignore", invalid="ignore"):
+        rel = onp.where(onp.abs(b_f) > 0, err / onp.abs(b_f), err)
+    idx = onp.unravel_index(onp.argmax(onp.where(bad, err, -onp.inf)),
+                            err.shape) if bad.any() else None
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ beyond rtol={rtol}, atol={atol}: "
+        f"max abs err {err.max():.6g}, max rel err {onp.nanmax(rel):.6g}, "
+        f"{int(bad.sum())}/{bad.size} elements out of tolerance, "
+        f"worst at {idx}: {a_f[idx] if idx else ''} vs "
+        f"{b_f[idx] if idx else ''}")
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, scale=1.0):
+    data = onp.random.uniform(-scale, scale, size=shape).astype(
+        dtype or onp.float32)
+    arr = array(data, ctx=ctx)
+    if stype != "default":
+        return arr.tostype(stype)
+    return arr
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (onp.random.randint(1, dim0 + 1), onp.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (onp.random.randint(1, dim0 + 1), onp.random.randint(1, dim1 + 1),
+            onp.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=ndim))
+
+
+def numeric_grad(f: Callable, inputs: List[onp.ndarray], eps: float = 1e-4
+                 ) -> List[onp.ndarray]:
+    """Central-difference numeric gradient of sum(f(inputs)) w.r.t. each
+    input (reference test_utils.numeric_grad)."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = onp.zeros_like(x, dtype=onp.float64)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(onp.sum(_as_numpy(f(*inputs))))
+            flat[j] = orig - eps
+            fm = float(onp.sum(_as_numpy(f(*inputs))))
+            flat[j] = orig
+            gflat[j] = (fp - fm) / (2 * eps)
+        grads.append(g.astype(x.dtype))
+    return grads
+
+
+def check_numeric_gradient(f: Callable, inputs: Sequence, eps: float = 1e-3,
+                           rtol: float = 1e-2, atol: float = 1e-3,
+                           grad_nodes: Optional[Sequence[int]] = None):
+    """Verify the tape/vjp backward of ``f`` against finite differences.
+
+    ``f`` takes NDArrays and returns one NDArray; gradients of sum(f) are
+    compared (reference test_utils.check_numeric_gradient methodology).
+    """
+    nds = [x if isinstance(x, NDArray) else array(onp.asarray(x))
+           for x in inputs]
+    which = list(grad_nodes) if grad_nodes is not None else list(
+        range(len(nds)))
+    for i in which:
+        nds[i].attach_grad()
+    with autograd.record():
+        out = f(*nds)
+        s = out.sum()
+    s.backward()
+    analytic = [nds[i].grad.asnumpy() for i in which]
+
+    raws = [x.asnumpy().astype(onp.float64) for x in nds]
+
+    def fnp(*arrays):
+        return f(*[array(a.astype(onp.float32)) for a in arrays])
+
+    numeric = numeric_grad(fnp, raws, eps=eps)
+    for i, gi in zip(which, range(len(which))):
+        assert_almost_equal(analytic[gi], numeric[i], rtol=rtol, atol=atol,
+                            names=(f"analytic_grad[{i}]",
+                                   f"numeric_grad[{i}]"))
+
+
+def check_symbolic_forward(fn, inputs, expected, rtol=1e-4, atol=1e-5):
+    """Run fn eagerly and hybridized (jit) and compare both to expected."""
+    nds = [x if isinstance(x, NDArray) else array(onp.asarray(x))
+           for x in inputs]
+    out = fn(*nds)
+    assert_almost_equal(out, expected, rtol=rtol, atol=atol,
+                        names=("eager", "expected"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
+    """Cross-context consistency (reference: CPU-vs-GPU check_consistency;
+    here cpu vs tpu when hardware is present)."""
+    from .context import num_tpus, tpu
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if num_tpus() > 0:
+            ctx_list.append(tpu(0))
+    results = []
+    for ctx in ctx_list:
+        nds = [array(onp.asarray(x), ctx=ctx) for x in inputs]
+        results.append(_as_numpy(fn(*nds)))
+    for r in results[1:]:
+        assert_almost_equal(results[0], r, rtol=rtol, atol=atol,
+                            names=("ctx0", "ctxN"))
+
+
+class environment:
+    """Context manager to scope env-var changes (reference
+    test_utils.environment)."""
+
+    def __init__(self, *args):
+        import os
+        self._os = os
+        if len(args) == 2 and isinstance(args[0], str):
+            self._vars = {args[0]: args[1]}
+        else:
+            self._vars = dict(args[0])
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self._vars.items():
+            self._saved[k] = self._os.environ.get(k)
+            if v is None:
+                self._os.environ.pop(k, None)
+            else:
+                self._os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                self._os.environ.pop(k, None)
+            else:
+                self._os.environ[k] = old
+
+
+class random_seed:
+    """Scope with a fixed framework seed, restoring entropy after
+    (reference common.py random_seed)."""
+
+    def __init__(self, seed=None):
+        self._seed = seed
+
+    def __enter__(self):
+        from .ndarray import random as _r
+        import random as pyrandom
+        self._next = onp.random.randint(0, 2**31)
+        seed = self._seed if self._seed is not None else self._next
+        _r.seed(seed)
+        pyrandom.seed(seed)
+        return self
+
+    def __exit__(self, *exc):
+        from .ndarray import random as _r
+        _r.seed(self._next)

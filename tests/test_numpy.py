@@ -1,0 +1,201 @@
+"""mx.np / mx.npx frontend tests.
+
+Mirrors the reference's tests/python/unittest/test_numpy_op.py /
+test_numpy_ndarray.py strategy: golden values vs real NumPy plus autograd
+checks through the np frontend.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_array_creation():
+    a = np.array([[1, 2], [3, 4]])
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert np.zeros((2, 3)).asnumpy().sum() == 0
+    assert np.ones(4).asnumpy().sum() == 4
+    assert np.full((2,), 7.0).asnumpy().tolist() == [7.0, 7.0]
+    assert np.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+    assert np.eye(3).asnumpy().trace() == 3.0
+    assert np.linspace(0, 1, 5).shape == (5,)
+    assert np.zeros_like(a).shape == (2, 2)
+
+
+def test_ufuncs_match_numpy():
+    x = onp.random.uniform(0.1, 2.0, size=(3, 4)).astype(onp.float32)
+    mxx = np.array(x)
+    for name in ["exp", "log", "sqrt", "sin", "cos", "tanh", "floor",
+                 "ceil", "square", "sign", "log1p", "expm1", "arctan"]:
+        assert_almost_equal(getattr(np, name)(mxx), getattr(onp, name)(x),
+                            rtol=1e-5, atol=1e-5, names=(name, "numpy"))
+
+
+def test_binary_broadcast_and_scalars():
+    a = onp.random.uniform(-1, 1, (2, 3)).astype(onp.float32)
+    b = onp.random.uniform(0.5, 1.5, (3,)).astype(onp.float32)
+    ma, mb = np.array(a), np.array(b)
+    assert_almost_equal(ma + mb, a + b)
+    assert_almost_equal(ma * mb, a * b)
+    assert_almost_equal(ma / mb, a / b)
+    assert_almost_equal(ma ** 2, a ** 2)
+    assert_almost_equal(2 - ma, 2 - a)
+    assert_almost_equal(np.maximum(ma, 0.0), onp.maximum(a, 0))
+    assert ((ma > 0).asnumpy() == (a > 0)).all()
+
+
+def test_reductions():
+    x = onp.random.uniform(-1, 1, (4, 5)).astype(onp.float32)
+    mxx = np.array(x)
+    assert_almost_equal(np.sum(mxx), onp.sum(x), rtol=1e-4)
+    assert_almost_equal(np.mean(mxx, axis=0), onp.mean(x, axis=0))
+    assert_almost_equal(np.var(mxx, axis=1), onp.var(x, axis=1), rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(np.std(mxx), onp.std(x), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mxx.max(axis=1), x.max(axis=1))
+    assert int(np.argmax(mxx)) == int(onp.argmax(x))
+    assert_almost_equal(np.cumsum(mxx, axis=0), onp.cumsum(x, axis=0),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_manipulation():
+    x = onp.arange(24, dtype=onp.float32).reshape(2, 3, 4)
+    mxx = np.array(x)
+    assert np.transpose(mxx).shape == (4, 3, 2)
+    assert np.swapaxes(mxx, 0, 2).shape == (4, 3, 2)
+    assert np.moveaxis(mxx, 0, -1).shape == (3, 4, 2)
+    assert np.expand_dims(mxx, 1).shape == (2, 1, 3, 4)
+    assert np.squeeze(np.expand_dims(mxx, 0)).shape == (2, 3, 4)
+    assert np.reshape(mxx, (6, 4)).shape == (6, 4)
+    assert np.concatenate([mxx, mxx], axis=2).shape == (2, 3, 8)
+    assert np.stack([mxx, mxx]).shape == (2, 2, 3, 4)
+    parts = np.split(mxx, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (1, 3, 4)
+    assert_almost_equal(np.flip(mxx, 0), onp.flip(x, 0))
+    assert_almost_equal(np.roll(mxx, 1, axis=1), onp.roll(x, 1, axis=1))
+    assert np.tile(mxx, (2, 1, 1)).shape == (4, 3, 4)
+    assert np.repeat(mxx, 2, axis=1).shape == (2, 6, 4)
+    assert_almost_equal(np.where(mxx > 10, mxx, 0.0),
+                        onp.where(x > 10, x, 0))
+    assert_almost_equal(np.clip(mxx, 2, 10), onp.clip(x, 2, 10))
+
+
+def test_linalg():
+    a = onp.random.uniform(-1, 1, (4, 4)).astype(onp.float32)
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    msp = np.array(spd)
+    assert_almost_equal(np.linalg.inv(msp) @ msp, onp.eye(4), rtol=1e-2,
+                        atol=1e-3)
+    L = np.linalg.cholesky(msp)
+    assert_almost_equal(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+    w, v = np.linalg.eigh(msp)
+    assert (onp.diff(w.asnumpy()) >= -1e-4).all()
+    q, r = np.linalg.qr(np.array(a))
+    assert_almost_equal(q @ r, a, rtol=1e-3, atol=1e-4)
+    u, s, vt = np.linalg.svd(np.array(a))
+    assert_almost_equal((u * s) @ vt, a, rtol=1e-3, atol=1e-4)
+    b = onp.random.uniform(-1, 1, (4,)).astype(onp.float32)
+    xs = np.linalg.solve(msp, np.array(b))
+    assert_almost_equal(msp @ xs, b, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(np.linalg.norm(np.array(a)), onp.linalg.norm(a),
+                        rtol=1e-4)
+    assert_almost_equal(np.linalg.det(msp), onp.linalg.det(spd), rtol=1e-2)
+
+
+def test_np_random():
+    np.random.seed(42)
+    u = np.random.uniform(0, 1, size=(1000,))
+    assert 0.4 < float(u.asnumpy().mean()) < 0.6
+    n = np.random.normal(2.0, 0.5, size=(1000,))
+    assert 1.8 < float(n.asnumpy().mean()) < 2.2
+    r = np.random.randint(0, 10, size=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    g = np.random.gamma(3.0, 2.0, size=(2000,))
+    assert 5.0 < float(g.asnumpy().mean()) < 7.0
+    # reproducibility
+    np.random.seed(7)
+    a = np.random.uniform(size=(5,)).asnumpy()
+    np.random.seed(7)
+    b = np.random.uniform(size=(5,)).asnumpy()
+    assert (a == b).all()
+
+
+def test_np_autograd():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(x * x) + np.mean(x)
+    y.backward()
+    assert isinstance(x.grad, np.ndarray)
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 0.25)
+
+
+def test_np_autograd_matmul_chain():
+    a = np.array(onp.random.uniform(-1, 1, (3, 4)).astype(onp.float32))
+    b = np.array(onp.random.uniform(-1, 1, (4, 2)).astype(onp.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        out = np.sum(np.tanh(a @ b))
+    out.backward()
+    assert a.grad.shape == (3, 4) and b.grad.shape == (4, 2)
+    check_numeric_gradient(lambda p, q: np.tanh(p @ q), [a, b])
+
+
+def test_npx_ops():
+    x = np.array([[-1.0, 2.0, -3.0]])
+    assert_almost_equal(npx.relu(x), [[0.0, 2.0, 0.0]])
+    assert_almost_equal(npx.sigmoid(np.array([0.0])), [0.5])
+    s = npx.softmax(np.array([[1.0, 2.0, 3.0]]))
+    assert_almost_equal(np.sum(s), 1.0, rtol=1e-5)
+    oh = npx.one_hot(np.array([0, 2], dtype='int32'), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    e = npx.erf(np.array([0.0, 1e8]))
+    assert_almost_equal(e, [0.0, 1.0])
+    m = npx.masked_softmax(np.array([[1.0, 2.0, 3.0]]),
+                           np.array([[1, 1, 0]]))
+    assert abs(float(np.sum(m)) - 1.0) < 1e-5
+    assert float(m[0, 2]) == 0.0
+
+
+def test_np_nd_interop():
+    a = mx.nd.array([1.0, 2.0])
+    b = a.as_np_ndarray()
+    assert isinstance(b, np.ndarray)
+    c = b.as_nd_ndarray()
+    assert type(c).__name__ == "NDArray"
+    assert_almost_equal(b + 1, [2.0, 3.0])
+
+
+def test_einsum_tensordot_grad():
+    a = np.array(onp.random.uniform(-1, 1, (2, 3)).astype(onp.float32))
+    b = np.array(onp.random.uniform(-1, 1, (3, 4)).astype(onp.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.einsum("ij,jk->ik", a, b))
+    y.backward()
+    assert_almost_equal(a.grad, onp.broadcast_to(
+        b.asnumpy().sum(axis=1), (2, 3)))
+    td = np.tensordot(a, b, axes=1)
+    assert td.shape == (2, 4)
+
+
+def test_sort_take_unique():
+    x = np.array([3.0, 1.0, 2.0, 1.0])
+    assert np.sort(x).asnumpy().tolist() == [1.0, 1.0, 2.0, 3.0]
+    assert np.argsort(x).asnumpy().tolist() == [1, 3, 2, 0]
+    u = np.unique(x)
+    assert u.asnumpy().tolist() == [1.0, 2.0, 3.0]
+    t = np.take(x, np.array([0, 3], dtype='int32'))
+    assert t.asnumpy().tolist() == [3.0, 1.0]
+
+
+def test_fft():
+    x = onp.random.uniform(-1, 1, (8,)).astype(onp.float32)
+    got = np.fft.fft(np.array(x)).asnumpy()
+    want = onp.fft.fft(x)
+    assert onp.allclose(got, want, rtol=1e-4, atol=1e-4)
