@@ -4,6 +4,7 @@ Pretrained-weight downloads are not available in this environment; models are
 constructed with random init and support ``load_parameters`` from local files.
 """
 from . import vision
+from . import bert
 from .vision import get_model
 
-__all__ = ["vision", "get_model"]
+__all__ = ["vision", "bert", "get_model"]

@@ -1,0 +1,122 @@
+"""BERT model family (flagship language model; BASELINE.md north star
+"BERT-base tokens/sec/chip").
+
+Reference parity note: the reference keeps BERT in gluon-nlp (out of tree);
+its in-tree model zoo is vision-only (python/mxnet/gluon/model_zoo/). The
+TPU build promotes BERT in-tree because the attention stack (Pallas flash
+attention, ring attention) is a core framework feature here, not an add-on.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import ops as F
+from ...ndarray.ndarray import arange
+from ...ops.registry import invoke_raw
+from ..block import HybridBlock
+from ..nn.basic_layers import Dense, Dropout, Embedding, LayerNorm
+from ..nn.transformer import TransformerEncoder
+
+__all__ = ["BERTModel", "BERTClassifier", "bert_base", "bert_large",
+           "bert_small_test"]
+
+
+class BERTModel(HybridBlock):
+    """BERT encoder: token+position+segment embeddings → transformer stack
+    → (sequence output, pooled [CLS] output [, masked-LM scores])."""
+
+    def __init__(self, vocab_size: int = 30522, units: int = 768,
+                 hidden_size: int = 3072, num_layers: int = 12,
+                 num_heads: int = 12, max_length: int = 512,
+                 token_type_vocab_size: int = 2, dropout: float = 0.1,
+                 use_pooler: bool = True, use_decoder: bool = False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self.word_embed = Embedding(vocab_size, units)
+        self.token_type_embed = Embedding(token_type_vocab_size, units)
+        self.position_embed = Embedding(max_length, units)
+        self.embed_ln = LayerNorm(in_channels=units)
+        self.embed_dropout = Dropout(dropout)
+        self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                          num_heads, dropout=dropout)
+        self.pooler = Dense(units, activation="tanh", flatten=False,
+                            in_units=units) if use_pooler else None
+        if use_decoder:
+            self.decoder_transform = Dense(units, flatten=False,
+                                           in_units=units)
+            self.decoder_ln = LayerNorm(in_channels=units)
+            # output projection ties to word_embed.weight at forward time
+        else:
+            self.decoder_transform = None
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        b, s = inputs.shape
+        if s > self._max_length:
+            raise MXNetError(
+                f"sequence length {s} exceeds max_length {self._max_length}")
+        pos = arange(0, s, dtype="int32")
+        x = self.word_embed(inputs)
+        x = x + F.broadcast_like(
+            F.reshape(self.position_embed(pos), (1, s, self._units)), x)
+        if token_types is None:
+            token_types = F.zeros_like(inputs)
+        x = x + self.token_type_embed(token_types)
+        x = self.embed_dropout(self.embed_ln(x))
+        # valid_length rides the fused flash path (blockwise key-padding
+        # mask) — no S×S additive mask is ever materialized.
+        seq = self.encoder(x, valid_length=valid_length)
+        outs = [seq]
+        if self.pooler is not None:
+            cls = F.reshape(F.slice_axis(seq, axis=1, begin=0, end=1),
+                            (b, self._units))
+            outs.append(self.pooler(cls))
+        if self.decoder_transform is not None:
+            h = self.decoder_ln(F.gelu(self.decoder_transform(seq)))
+            w = self.word_embed.weight.data()
+            scores = invoke_raw(
+                "bert_decoder_proj",
+                lambda hh, ww: jnp.einsum("bsu,vu->bsv", hh, ww), [h, w])
+            outs.append(scores)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class BERTClassifier(HybridBlock):
+    """BERT + dropout + dense head over the pooled output."""
+
+    def __init__(self, bert: BERTModel, num_classes: int = 2,
+                 dropout: float = 0.1, **kwargs):
+        super().__init__(**kwargs)
+        if bert.pooler is None:
+            raise MXNetError("BERTClassifier requires a BERTModel built "
+                             "with use_pooler=True")
+        self.bert = bert
+        self.dropout = Dropout(dropout)
+        self.classifier = Dense(num_classes, in_units=bert._units)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        out = self.bert(inputs, token_types, valid_length)
+        pooled = out[1]  # (seq, pooled[, mlm_scores]); pooler checked above
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_base(**kwargs):
+    """BERT-base: 12 layers, 768 units, 12 heads (110M params)."""
+    return BERTModel(units=768, hidden_size=3072, num_layers=12,
+                     num_heads=12, **kwargs)
+
+
+def bert_large(**kwargs):
+    """BERT-large: 24 layers, 1024 units, 16 heads (340M params)."""
+    return BERTModel(units=1024, hidden_size=4096, num_layers=24,
+                     num_heads=16, **kwargs)
+
+
+def bert_small_test(**kwargs):
+    """Tiny config for tests/CI."""
+    kwargs.setdefault("vocab_size", 128)
+    kwargs.setdefault("max_length", 64)
+    return BERTModel(units=32, hidden_size=64, num_layers=2, num_heads=4,
+                     **kwargs)

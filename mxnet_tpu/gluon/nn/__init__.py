@@ -1,8 +1,10 @@
 """Gluon neural-network layers (reference: python/mxnet/gluon/nn/)."""
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
-from . import basic_layers, conv_layers
+from .transformer import *  # noqa: F401,F403
+from . import basic_layers, conv_layers, transformer
 from .basic_layers import __all__ as _b
 from .conv_layers import __all__ as _c
+from .transformer import __all__ as _t
 
-__all__ = list(_b) + list(_c)
+__all__ = list(_b) + list(_c) + list(_t)

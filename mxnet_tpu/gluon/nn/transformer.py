@@ -1,0 +1,178 @@
+"""Transformer layers: MultiHeadAttention, FFN, encoder stack.
+
+Reference parity note: MXNet 2.0-dev keeps attention out-of-tree (gluon-nlp
+composed it from batch_dot + softmax — no fused kernel, SURVEY.md §2.3/§5).
+Here attention is a first-class fused op (ops/attention.py: Pallas flash
+kernel on TPU, ring attention for context parallelism), and these layers are
+the Gluon-API building blocks over it, used by model_zoo.bert.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import ops as F
+from ...ndarray.ndarray import NDArray
+from ...ops import attention as ATT
+from ...ops.registry import invoke_raw
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import Dense, Dropout, LayerNorm
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+           "TransformerEncoder"]
+
+
+def _masked_attention(q, k, v, mask, sm_scale, causal=False):
+    """Arbitrary-additive-mask attention (unfused; XLA fuses the softmax).
+    Only used for masks that aren't expressible as valid_length — padded
+    batches should pass ``valid_length`` and stay on the flash path."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = s + mask.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(tri, s, -1e30)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention over (batch, seq, units) inputs.
+
+    ``forward(q, k=None, v=None, mask=None, valid_length=None)``:
+    self-attention when k/v are omitted. ``valid_length`` (B,) masks padded
+    keys and stays on the fused flash path (blockwise, O(S·block) memory).
+    ``mask`` is an arbitrary additive float mask broadcastable to
+    (batch, heads, seq_q, seq_k) (0 keep / -inf drop) — that path is
+    unfused; prefer valid_length for plain padding.
+    """
+
+    def __init__(self, units: int, num_heads: int, dropout: float = 0.0,
+                 use_bias: bool = True, causal: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        self.query_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=units)
+        self.key_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=units)
+        self.value_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=units)
+        self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=units)
+        self.dropout = Dropout(dropout)
+
+    def _split(self, x):
+        b, s, _ = x.shape
+        return F.transpose(
+            F.reshape(x, (b, s, self._num_heads,
+                          self._units // self._num_heads)),
+            axes=(0, 2, 1, 3))
+
+    def forward(self, q, k=None, v=None, mask=None, valid_length=None):
+        k = q if k is None else k
+        v = k if v is None else v
+        qh = self._split(self.query_proj(q))
+        kh = self._split(self.key_proj(k))
+        vh = self._split(self.value_proj(v))
+        d = self._units // self._num_heads
+        scale = 1.0 / math.sqrt(d)
+        if mask is not None:
+            fn = functools.partial(_masked_attention, sm_scale=scale,
+                                   causal=self._causal)
+            out = invoke_raw("masked_attention", fn,
+                             [qh, kh, vh, mask if isinstance(mask, NDArray)
+                              else NDArray(jnp.asarray(mask))])
+        elif valid_length is not None:
+            def fn(q_, k_, v_, vl_):
+                return ATT.flash_attention(q_, k_, v_, causal=self._causal,
+                                           sm_scale=scale, valid_length=vl_)
+            vl_data = valid_length._data if isinstance(valid_length, NDArray) \
+                else jnp.asarray(valid_length)
+            # float32: integer tape inputs would get float0 cotangents
+            vl = NDArray(jnp.asarray(vl_data, jnp.float32))
+            out = invoke_raw("flash_attention_vl", fn, [qh, kh, vh, vl])
+        else:
+            fn = functools.partial(ATT.flash_attention, causal=self._causal,
+                                   sm_scale=scale)
+            out = invoke_raw("flash_attention", fn, [qh, kh, vh])
+        b, _, s, _ = out.shape
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        (b, s, self._units))
+        return self.dropout(self.out_proj(out))
+
+
+class PositionwiseFFN(HybridBlock):
+    """Transformer FFN: dense → activation → dense (+ dropout)."""
+
+    def __init__(self, units: int, hidden_size: int, dropout: float = 0.0,
+                 activation: str = "gelu", **kwargs):
+        super().__init__(**kwargs)
+        self.ffn_1 = Dense(hidden_size, flatten=False, in_units=units)
+        self.ffn_2 = Dense(units, flatten=False, in_units=hidden_size)
+        self._activation = activation
+        self.dropout = Dropout(dropout)
+
+    def forward(self, x):
+        h = self.ffn_1(x)
+        h = F.Activation(h, act_type=self._activation) \
+            if self._activation != "gelu" else F.gelu(h)
+        return self.dropout(self.ffn_2(h))
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN (BERT-style) or pre-LN transformer encoder layer."""
+
+    def __init__(self, units: int, hidden_size: int, num_heads: int,
+                 dropout: float = 0.0, pre_norm: bool = False,
+                 activation: str = "gelu", causal: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self._pre_norm = pre_norm
+        self.attention = MultiHeadAttention(units, num_heads, dropout=dropout,
+                                            causal=causal)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                   activation=activation)
+        self.ln_1 = LayerNorm(in_channels=units)
+        self.ln_2 = LayerNorm(in_channels=units)
+
+    def forward(self, x, mask=None, valid_length=None):
+        # MultiHeadAttention/PositionwiseFFN already apply output dropout —
+        # no extra dropout here (rate would compound past the configured p).
+        if self._pre_norm:
+            x = x + self.attention(self.ln_1(x), mask=mask,
+                                   valid_length=valid_length)
+            return x + self.ffn(self.ln_2(x))
+        x = self.ln_1(x + self.attention(x, mask=mask,
+                                         valid_length=valid_length))
+        return self.ln_2(x + self.ffn(x))
+
+
+class TransformerEncoder(HybridBlock):
+    """Stack of encoder cells."""
+
+    def __init__(self, num_layers: int, units: int, hidden_size: int,
+                 num_heads: int, dropout: float = 0.0, pre_norm: bool = False,
+                 activation: str = "gelu", causal: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = []
+        for i in range(num_layers):
+            cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                          dropout=dropout, pre_norm=pre_norm,
+                                          activation=activation, causal=causal)
+            setattr(self, f"layer{i}", cell)
+            self.layers.append(cell)
+
+    def forward(self, x, mask=None, valid_length=None):
+        for cell in self.layers:
+            x = cell(x, mask=mask, valid_length=valid_length)
+        return x

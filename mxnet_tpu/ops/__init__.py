@@ -7,4 +7,5 @@ registered into a Python registry that drives the imperative invoke path, the
 autograd tape, and symbolic/deferred-compute tracing.
 """
 from . import registry
+from . import attention
 from .registry import Op, register, get_op, invoke, invoke_raw, list_ops

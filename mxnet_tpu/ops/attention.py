@@ -1,0 +1,356 @@
+"""Attention ops: Pallas flash attention + ring attention (context parallel).
+
+Reference parity note: the reference (Apache MXNet 2.0-dev) ships NO fused
+attention and NO sequence/context parallelism (SURVEY.md §2.3, §5 "long-
+context: none in the reference") — attention lived in gluon-nlp as unfused
+batch_dot+softmax. This module is the TPU-idiomatic superset the build plan
+(SURVEY.md §7 stage 10) calls for:
+
+- ``flash_attention``: O(S) memory online-softmax attention. On TPU the
+  forward is a Pallas kernel (grid over (batch*heads, q-blocks, k-blocks),
+  f32 accumulators in VMEM scratch, MXU-shaped 128x128 tiles); elsewhere a
+  blockwise ``lax.scan`` XLA implementation with identical math. Backward is
+  recompute-based (flash-attention-style: no S×S residuals are saved).
+- ``ring_attention``: context parallelism over a mesh axis. Each device
+  holds a sequence shard of Q/K/V; K/V blocks rotate around the ring via
+  ``lax.ppermute`` (ICI neighbor exchange) while online-softmax accumulators
+  merge partial results — sequence length scales with the number of chips.
+
+Math convention: inputs are (batch, heads, seq, head_dim); softmax scale
+defaults to head_dim**-0.5; masking uses a large negative finite value so
+fully-masked rows stay NaN-free through exp/renormalization.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["flash_attention", "ring_attention", "ring_attention_sharded",
+           "attention_reference"]
+
+_NEG_INF = -1e30  # finite mask value: keeps exp() NaN-free for masked rows
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """Unfused softmax(QK^T)V — the numeric oracle for tests."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise XLA implementation (fallback forward + backward recompute target)
+# ---------------------------------------------------------------------------
+
+def _attention_xla(q, k, v, causal: bool, sm_scale: float,
+                   block_k: int = 512, valid_length=None):
+    """Online-softmax attention scanning over K/V blocks: O(Sq·block_k)
+    live memory instead of O(Sq·Sk). Pure lax.scan — XLA pipelines the
+    blocks and keeps the matmuls on the MXU. ``valid_length`` is an
+    optional (B,) per-sample key length (padding mask)."""
+    orig_dtype = q.dtype
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_k = min(block_k, sk)
+    nk = -(-sk // block_k)
+    pad = nk * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    qf = q.astype(jnp.float32) * sm_scale
+    kb = jnp.moveaxis(k.reshape(b, h, nk, block_k, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, nk, block_k, d), 2, 0)
+    q_pos = jnp.arange(sq) + (sk - sq)  # align causal diagonal to the end
+
+    def body(carry, inp):
+        acc, m, l = carry
+        kblk, vblk, ki = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk.astype(jnp.float32))
+        k_pos = ki * block_k + jnp.arange(block_k)
+        valid = (k_pos < sk)[None, None, None, :]
+        if causal:
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        if valid_length is not None:
+            valid = valid & (k_pos[None, None, None, :]
+                             < valid_length[:, None, None, None])
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32))
+        return (acc, m_new, l), None
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (acc, _, l), _ = lax.scan(body, (acc0, m0, l0),
+                              (kb, vb, jnp.arange(nk)))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+                  sm_scale, causal, block_q, block_k, nk, seq_q, seq_k):
+    from jax.experimental import pallas as pl
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # Causal: skip blocks strictly above the diagonal (no valid entries).
+    diag_off = seq_k - seq_q
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1 + diag_off
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, block_k), 1)
+        valid = k_pos < seq_k
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + diag_off
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]                       # (block_q, 1)
+        m_cur = s.max(axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_s[:, :1] * alpha + p.sum(axis=1, keepdims=True)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+        acc_s[...] = acc_s[...] * alpha + lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, :1], 1e-30)
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
+                      block_q: int = 128, block_k: int = 128,
+                      interpret: bool = False):
+    """Pallas flash attention forward. Pads seq to block multiples and
+    head_dim to the 128-lane tile (zero-padded dims cancel in QK^T and are
+    sliced off the output)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+    dp = max(128, -(-d // 128) * 128)
+    sqp = -(-sq // block_q) * block_q
+    skp = -(-sk // block_k) * block_k
+
+    def pad3(x, s_to, d_to):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, s_to - x.shape[2]),
+                           (0, d_to - x.shape[3])))
+
+    qp = pad3(q, sqp, dp).reshape(b * h, sqp, dp)
+    kp = pad3(k, skp, dp).reshape(b * h, skp, dp)
+    vp = pad3(v, skp, dp).reshape(b * h, skp, dp)
+    nq, nk = sqp // block_q, skp // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, nk=nk, seq_q=sq, seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out.reshape(b, h, sqp, dp)[:, :, :sq, :d]
+
+
+# ---------------------------------------------------------------------------
+# Public flash_attention with recompute backward
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, use_pallas):
+    if use_pallas:
+        return _flash_fwd_pallas(q, k, v, causal, sm_scale)
+    return _attention_xla(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
+    return _flash(q, k, v, causal, sm_scale, use_pallas), (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, use_pallas, res, g):
+    q, k, v = res
+    # Flash-style backward: recompute attention blockwise (no S×S residual).
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_xla(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_vl(q, k, v, vl, causal, sm_scale):
+    return _attention_xla(q, k, v, causal, sm_scale, valid_length=vl)
+
+
+def _flash_vl_fwd(q, k, v, vl, causal, sm_scale):
+    return _flash_vl(q, k, v, vl, causal, sm_scale), (q, k, v, vl)
+
+
+def _flash_vl_bwd(causal, sm_scale, res, g):
+    q, k, v, vl = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_xla(q_, k_, v_, causal, sm_scale,
+                                          valid_length=vl), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(vl)
+
+
+_flash_vl.defvjp(_flash_vl_fwd, _flash_vl_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None,
+                    valid_length=None):
+    """Fused memory-efficient attention on (B, H, S, D) tensors.
+
+    On TPU the forward runs as a Pallas kernel; everywhere else (and for
+    the backward pass) a blockwise lax.scan implementation with identical
+    online-softmax math is used. ``valid_length`` (B,) masks padded keys;
+    that path always uses the blockwise implementation (still O(S·block)
+    memory, never an S×S score matrix).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise MXNetError("flash_attention expects (batch, heads, seq, dim)")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if valid_length is not None:
+        vl = jnp.asarray(valid_length, jnp.float32)
+        return _flash_vl(q, k, v, vl, causal, float(sm_scale))
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    return _flash(q, k, v, causal, float(sm_scale), bool(use_pallas))
+
+
+# ---------------------------------------------------------------------------
+# Ring attention: context parallelism over a mesh axis
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Per-shard ring attention body — call under shard_map with the
+    sequence dimension sharded over ``axis_name``.
+
+    Each of the N devices holds S/N of the sequence. K/V shards rotate
+    around the ring (lax.ppermute = ICI neighbor exchange, overlapping with
+    the local attention block), and online-softmax stats merge the partial
+    results — the TPU-native form of sequence/context parallelism.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    axis_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s, d = q.shape
+    orig_dtype = q.dtype
+    qf = q.astype(jnp.float32) * sm_scale
+    q_pos = idx * s + jnp.arange(s)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def _merge(acc, m, l, kc, vc, src):
+        """Online-softmax merge of one K/V chunk (chunk id ``src``)."""
+        s_ij = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            k_pos = src * s + jnp.arange(s)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            s_ij = jnp.where(mask, s_ij, _NEG_INF)
+        m_new = jnp.maximum(m, s_ij.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s_ij - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        return acc, m_new, l
+
+    def body(carry, i):
+        acc, m, l, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm)   # rotate, then merge: the
+        vc = lax.ppermute(vc, axis_name, perm)   # local chunk was step 0
+        acc, m, l = _merge(acc, m, l, kc, vc, (idx - i) % axis_size)
+        return (acc, m, l, kc, vc), None
+
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    # Step 0 = local chunk; steps 1..N-1 rotate first, so exactly N-1
+    # neighbor exchanges happen in total.
+    acc0, m0, l0 = _merge(acc0, m0, l0, k, v, idx)
+    (acc, _, l, _, _), _ = lax.scan(body, (acc0, m0, l0, k, v),
+                                    jnp.arange(1, axis_size))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
+                           causal: bool = False,
+                           sm_scale: Optional[float] = None):
+    """shard_map wrapper: jax arrays in, sequence dim sharded over ``axis``
+    of ``mesh`` (a jax.sharding.Mesh or mxnet_tpu DeviceMesh)."""
+    from jax.sharding import PartitionSpec as P
+    m = getattr(mesh, "mesh", mesh)
+    spec = P(None, None, axis, None)
+    fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
+                           sm_scale=sm_scale)
+    return jax.shard_map(lambda a, b_, c: fn(a, b_, c), mesh=m,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
