@@ -73,6 +73,25 @@ class Engine:
         return self._bulk_size
 
 
+_host_engine = None
+_host_lock = threading.Lock()
+
+
+def host():
+    """The native C++ host-task engine (src/native/engine.cc) — versioned-
+    variable dependency scheduling for host work (IO, decode, checkpoint
+    writes), the part of the reference's ThreadedEngine that XLA does NOT
+    absorb. Returns None when the native lib is unavailable."""
+    global _host_engine
+    if _host_engine is None:
+        with _host_lock:
+            if _host_engine is None:
+                from . import _native
+                if _native.available():
+                    _host_engine = _native.NativeEngine()
+    return _host_engine
+
+
 def get() -> Engine:
     if Engine._instance is None:
         with Engine._lock:

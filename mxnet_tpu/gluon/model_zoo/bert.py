@@ -74,7 +74,8 @@ class BERTModel(HybridBlock):
                             (b, self._units))
             outs.append(self.pooler(cls))
         if self.decoder_transform is not None:
-            h = self.decoder_ln(F.gelu(self.decoder_transform(seq)))
+            h = self.decoder_ln(F.Activation(self.decoder_transform(seq),
+                                             act_type="gelu"))
             w = self.word_embed.weight.data()
             scores = invoke_raw(
                 "bert_decoder_proj",

@@ -27,20 +27,19 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "TransformerEncoder"]
 
 
-def _masked_attention(q, k, v, mask, sm_scale, causal=False):
-    """Arbitrary-additive-mask attention (unfused; XLA fuses the softmax).
-    Only used for masks that aren't expressible as valid_length — padded
-    batches should pass ``valid_length`` and stay on the flash path."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
-    s = s + mask.astype(jnp.float32)
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(tri, s, -1e30)
-    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
-    p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+def _masked_attention(q, k, v, mask, sm_scale, causal=False,
+                      valid_length=None):
+    """Arbitrary-additive-mask attention — delegates to the shared oracle
+    impl in ops/attention.py (unfused; XLA fuses the softmax). Only used
+    for masks that aren't expressible as valid_length — padding alone
+    should pass ``valid_length`` and stay on the flash path. When both are
+    given, padding is folded into the additive mask here."""
+    if valid_length is not None:
+        sk = k.shape[2]
+        keep = jnp.arange(sk)[None, :] < valid_length[:, None]
+        mask = mask + jnp.where(keep, 0.0, ATT._NEG_INF)[:, None, None, :]
+    return ATT.attention_reference(q, k, v, causal=causal,
+                                   sm_scale=sm_scale, mask=mask)
 
 
 class MultiHeadAttention(HybridBlock):
@@ -88,11 +87,22 @@ class MultiHeadAttention(HybridBlock):
         d = self._units // self._num_heads
         scale = 1.0 / math.sqrt(d)
         if mask is not None:
-            fn = functools.partial(_masked_attention, sm_scale=scale,
-                                   causal=self._causal)
-            out = invoke_raw("masked_attention", fn,
-                             [qh, kh, vh, mask if isinstance(mask, NDArray)
-                              else NDArray(jnp.asarray(mask))])
+            inputs = [qh, kh, vh, mask if isinstance(mask, NDArray)
+                      else NDArray(jnp.asarray(mask))]
+            if valid_length is not None:
+                vl_data = valid_length._data \
+                    if isinstance(valid_length, NDArray) \
+                    else jnp.asarray(valid_length)
+                inputs.append(NDArray(jnp.asarray(vl_data, jnp.float32)))
+
+                def fn(q_, k_, v_, m_, vl_):
+                    return _masked_attention(q_, k_, v_, m_, scale,
+                                             causal=self._causal,
+                                             valid_length=vl_)
+            else:
+                fn = functools.partial(_masked_attention, sm_scale=scale,
+                                       causal=self._causal)
+            out = invoke_raw("masked_attention", fn, inputs)
         elif valid_length is not None:
             def fn(q_, k_, v_, vl_):
                 return ATT.flash_attention(q_, k_, v_, causal=self._causal,
@@ -124,9 +134,7 @@ class PositionwiseFFN(HybridBlock):
         self.dropout = Dropout(dropout)
 
     def forward(self, x):
-        h = self.ffn_1(x)
-        h = F.Activation(h, act_type=self._activation) \
-            if self._activation != "gelu" else F.gelu(h)
+        h = F.Activation(self.ffn_1(x), act_type=self._activation)
         return self.dropout(self.ffn_2(h))
 
 

@@ -809,7 +809,13 @@ def LeakyReLU(data, act_type="leaky", slope=0.25, gamma=None,
 def Activation(data, act_type="relu"):
     fns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
            "softrelu": jax.nn.softplus, "softsign": jax.nn.soft_sign,
-           "log_sigmoid": jax.nn.log_sigmoid, "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x))}
+           "log_sigmoid": jax.nn.log_sigmoid,
+           "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+           "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+           "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+           "silu": jax.nn.silu, "swish": jax.nn.silu}
+    if act_type not in fns:
+        raise MXNetError(f"unknown Activation act_type {act_type!r}")
     return invoke_raw(f"activation_{act_type}", fns[act_type], [_wrap(data)])
 
 

@@ -39,18 +39,28 @@ _NEG_INF = -1e30  # finite mask value: keeps exp() NaN-free for masked rows
 
 
 def attention_reference(q, k, v, causal: bool = False,
-                        sm_scale: Optional[float] = None):
-    """Unfused softmax(QK^T)V — the numeric oracle for tests."""
+                        sm_scale: Optional[float] = None, mask=None):
+    """Unfused softmax(QK^T)V — the numeric oracle for tests and the
+    arbitrary-additive-mask path (XLA fuses the softmax). ``mask`` is an
+    additive float mask broadcastable to (B, H, Sq, Sk). Convention shared
+    by every attention path in this module: a query row with NO valid key
+    outputs exactly zero (the flash-kernel convention)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
+    if mask is not None:
+        s = s + mask.astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(tri, s, _NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = out / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+    out = jnp.where(m > _NEG_INF / 2, out, 0.0)  # fully-masked rows → 0
+    return out.astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -100,9 +110,11 @@ def _attention_xla(q, k, v, causal: bool, sm_scale: float,
     acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
     m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
-    (acc, _, l), _ = lax.scan(body, (acc0, m0, l0),
+    (acc, m, l), _ = lax.scan(body, (acc0, m0, l0),
                               (kb, vb, jnp.arange(nk)))
-    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((m > _NEG_INF / 2)[..., None], out, 0.0)  # no-key rows
+    return out.astype(orig_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +169,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
     @pl.when(ki == nk - 1)
     def _finalize():
         l = jnp.maximum(l_s[:, :1], 1e-30)
-        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        out = acc_s[...] / l
+        # rows that never saw a valid key (m still at init) output zero —
+        # the shared convention across every path in this module
+        out = jnp.where(m_s[:, :1] > _NEG_INF / 2, out, 0.0)
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
@@ -336,9 +352,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # Step 0 = local chunk; steps 1..N-1 rotate first, so exactly N-1
     # neighbor exchanges happen in total.
     acc0, m0, l0 = _merge(acc0, m0, l0, k, v, idx)
-    (acc, _, l, _, _), _ = lax.scan(body, (acc0, m0, l0, k, v),
+    (acc, m, l, _, _), _ = lax.scan(body, (acc0, m0, l0, k, v),
                                     jnp.arange(1, axis_size))
-    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((m > _NEG_INF / 2)[..., None], out, 0.0)  # no-key rows
+    return out.astype(orig_dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",

@@ -1,0 +1,234 @@
+"""RecordIO container (reference: python/mxnet/recordio.py).
+
+``MXRecordIO`` / ``MXIndexedRecordIO`` expose the reference API over the
+native C++ reader/writer (src/native/recordio.cc) when available, with a
+pure-Python implementation of the same dmlc wire format otherwise — the
+two interoperate byte-for-byte.
+
+``IRHeader``/``pack``/``unpack``/``pack_img``-style helpers mirror the
+reference's image-record framing (reference recordio.py IRHeader struct
+'IfQQ': flag, label, id, id2; multi-label via flag>0).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+from typing import Optional
+
+import numpy as onp
+
+from . import _native
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LREC_MASK = (1 << 29) - 1
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class _PyWriter:
+    def __init__(self, path):
+        self._f = open(path, "wb")
+        self._pos = 0
+
+    def write(self, data: bytes) -> int:
+        if len(data) >= (1 << 29):
+            raise MXNetError("recordio: record too large (>512MB)")
+        pos = self._pos
+        pad = (4 - (len(data) & 3)) & 3
+        self._f.write(struct.pack("<II", _MAGIC, len(data)))
+        self._f.write(data)
+        if pad:
+            self._f.write(b"\x00" * pad)
+        self._pos += 8 + len(data) + pad
+        return pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def close(self):
+        self._f.close()
+
+
+class _PyReader:
+    def __init__(self, path):
+        self._f = open(path, "rb")
+
+    def read(self) -> Optional[bytes]:
+        hdr = self._f.read(4)
+        if not hdr:
+            return None
+        if len(hdr) != 4 or struct.unpack("<I", hdr)[0] != _MAGIC:
+            raise MXNetError("recordio: bad magic (corrupt or misaligned)")
+        lbytes = self._f.read(4)
+        if len(lbytes) != 4:
+            raise MXNetError("recordio: truncated header")
+        (lrec,) = struct.unpack("<I", lbytes)
+        length = lrec & _LREC_MASK
+        data = self._f.read(length)
+        if len(data) != length:
+            raise MXNetError("recordio: truncated payload")
+        pad = (4 - (length & 3)) & 3
+        if pad:
+            self._f.read(pad)
+        return data
+
+    def seek(self, pos):
+        self._f.seek(pos)
+
+    def tell(self):
+        return self._f.tell()
+
+    def close(self):
+        self._f.close()
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py:37).
+
+    Parameters: ``uri`` file path, ``flag`` 'r' or 'w'.
+    """
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self._rec = None
+        self.open()
+
+    def open(self):
+        use_native = _native.available()
+        if self.flag == "w":
+            self._rec = (_native.NativeRecordIOWriter(self.uri) if use_native
+                         else _PyWriter(self.uri))
+        elif self.flag == "r":
+            self._rec = (_native.NativeRecordIOReader(self.uri) if use_native
+                         else _PyReader(self.uri))
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r}, expected 'r'/'w'")
+        self.is_open = True
+
+    def write(self, buf: bytes):
+        if self.flag != "w":
+            raise MXNetError("recordio: not opened for writing")
+        return self._rec.write(bytes(buf))
+
+    def read(self) -> Optional[bytes]:
+        if self.flag != "r":
+            raise MXNetError("recordio: not opened for reading")
+        return self._rec.read()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def close(self):
+        if self._rec is not None:
+            self._rec.close()
+            self._rec = None
+        self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with a text index file (reference
+    recordio.py:169: lines of "key\\tpos")."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.key_type = key_type
+        self.idx = {}
+        self.keys = []
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) == 2:
+                        k = key_type(parts[0])
+                        self.idx[k] = int(parts[1])
+                        self.keys.append(k)
+
+    def close(self):
+        if self.flag == "w" and self.idx:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        if self.flag != "r":
+            raise MXNetError("recordio: seek requires read mode")
+        self._rec.seek(self.idx[idx])
+
+    def tell(self) -> int:
+        return self._rec.tell()
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        pos = self.write(buf)
+        self.idx[self.key_type(idx)] = pos
+        self.keys.append(self.key_type(idx))
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a label header + payload into one record (reference
+    recordio.py pack: flag>0 means `label` is a flag-length vector)."""
+    label = header.label
+    if isinstance(label, (onp.ndarray, list, tuple)):
+        label = onp.asarray(label, onp.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        return struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, float(label), header.id,
+                       header.id2) + s
+
+
+def unpack(s: bytes):
+    """Inverse of pack → (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = onp.frombuffer(s[:header.flag * 4], onp.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s: bytes, iscolor=1):
+    """unpack + image decode (reference recordio.py unpack_img). Uses
+    PIL/raw numpy fallback since OpenCV isn't in this environment."""
+    header, img_bytes = unpack(s)
+    img = _decode_img(img_bytes, iscolor)
+    return header, img
+
+
+def _decode_img(img_bytes: bytes, iscolor=1):
+    try:
+        import io as _io
+        from PIL import Image  # optional dependency
+        im = Image.open(_io.BytesIO(img_bytes))
+        if iscolor:
+            im = im.convert("RGB")
+        return onp.asarray(im)
+    except ImportError:
+        raise MXNetError("image decoding requires PIL (not installed); "
+                         "store raw arrays or install pillow")

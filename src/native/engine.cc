@@ -1,0 +1,322 @@
+/*
+ * Threaded dependency engine for host-side work.
+ *
+ * Reference analog: src/engine/threaded_engine.{h,cc} — versioned variables
+ * with shared-read/exclusive-write scheduling, per-op wait counters, and
+ * exception capture surfaced at sync points. Device work is XLA's job on
+ * TPU; this engine orders host tasks (IO, decode, checkpointing, Python
+ * callbacks) with the same semantics the reference's engine guaranteed.
+ */
+#include "mxt_native.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+thread_local std::string tls_error;
+thread_local std::string tls_callback_error;
+
+void set_error(const std::string &msg) { tls_error = msg; }
+
+struct Op;
+
+/* A versioned variable: a FIFO of pending ops with shared-read /
+ * exclusive-write admission (reference ThreadedVar, threaded_engine.h:120). */
+struct Var {
+  std::mutex m;
+  std::deque<std::pair<Op *, bool>> q;  // (op, is_write) in push order
+  int active_readers = 0;
+  bool active_writer = false;
+  std::atomic<uint64_t> version{0};
+  bool to_delete = false;               // delete after queue drains
+};
+
+struct Engine;
+
+struct Op {
+  MXTOpFn fn = nullptr;
+  void *ctx = nullptr;
+  MXTOpDeleter deleter = nullptr;
+  std::vector<Var *> const_vars, mut_vars;
+  std::atomic<int> wait{0};
+  Engine *engine = nullptr;
+  std::function<void()> on_complete;    // optional (sync ops)
+};
+
+struct Engine {
+  std::vector<std::thread> workers;
+  std::deque<Op *> tasks;
+  std::mutex task_m;
+  std::condition_variable task_cv;
+  bool shutdown = false;
+
+  std::atomic<long> outstanding{0};
+  std::mutex done_m;
+  std::condition_variable done_cv;
+
+  std::mutex err_m;
+  std::string first_error;              // first async failure, kept until read
+
+  explicit Engine(int n) {
+    for (int i = 0; i < n; ++i)
+      workers.emplace_back([this] { worker_loop(); });
+  }
+
+  ~Engine() {
+    {
+      std::lock_guard<std::mutex> lk(task_m);
+      shutdown = true;
+    }
+    task_cv.notify_all();
+    for (auto &t : workers) t.join();
+  }
+
+  void enqueue_ready(Op *op) {
+    {
+      std::lock_guard<std::mutex> lk(task_m);
+      tasks.push_back(op);
+    }
+    task_cv.notify_one();
+  }
+
+  void record_error(const std::string &msg) {
+    std::lock_guard<std::mutex> lk(err_m);
+    if (first_error.empty()) first_error = msg;
+  }
+
+  /* Returns and clears the stored async error ("" if none). */
+  std::string take_error() {
+    std::lock_guard<std::mutex> lk(err_m);
+    std::string e;
+    std::swap(e, first_error);
+    return e;
+  }
+
+  void worker_loop() {
+    for (;;) {
+      Op *op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(task_m);
+        task_cv.wait(lk, [this] { return shutdown || !tasks.empty(); });
+        if (shutdown && tasks.empty()) return;
+        op = tasks.front();
+        tasks.pop_front();
+      }
+      run_op(op);
+    }
+  }
+
+  void grant(Var *v, std::vector<Op *> &ready_out) {
+    // called with v->m held: admit queue head(s) per read/write rules
+    while (!v->q.empty()) {
+      Op *op = v->q.front().first;
+      bool is_write = v->q.front().second;
+      if (is_write) {
+        if (v->active_readers == 0 && !v->active_writer) {
+          v->active_writer = true;
+          v->q.pop_front();
+          if (op->wait.fetch_sub(1) == 1) ready_out.push_back(op);
+        }
+        break;
+      }
+      if (v->active_writer) break;
+      v->active_readers++;
+      v->q.pop_front();
+      if (op->wait.fetch_sub(1) == 1) ready_out.push_back(op);
+    }
+  }
+
+  void complete_on_var(Var *v, bool was_write, std::vector<Op *> &ready_out,
+                       std::vector<Var *> &dead_vars) {
+    std::lock_guard<std::mutex> lk(v->m);
+    if (was_write) {
+      v->active_writer = false;
+      v->version.fetch_add(1);
+    } else {
+      v->active_readers--;
+    }
+    grant(v, ready_out);
+    if (v->to_delete && v->q.empty() && v->active_readers == 0 &&
+        !v->active_writer)
+      dead_vars.push_back(v);
+  }
+
+  void run_op(Op *op) {
+    tls_callback_error.clear();
+    int rc = 0;
+    if (op->fn) rc = op->fn(op->ctx);
+    if (rc != 0) {
+      record_error(tls_callback_error.empty()
+                       ? "async engine op failed"
+                       : tls_callback_error);
+    }
+    if (op->deleter) op->deleter(op->ctx);
+
+    std::vector<Op *> ready;
+    std::vector<Var *> dead;
+    for (Var *v : op->const_vars) complete_on_var(v, false, ready, dead);
+    for (Var *v : op->mut_vars) complete_on_var(v, true, ready, dead);
+    if (op->on_complete) op->on_complete();
+    delete op;
+    for (Var *v : dead) delete v;
+    for (Op *r : ready) enqueue_ready(r);
+    if (outstanding.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(done_m);
+      done_cv.notify_all();
+    }
+  }
+
+  void push(Op *op) {
+    outstanding.fetch_add(1);
+    op->engine = this;
+    int total = static_cast<int>(op->const_vars.size() + op->mut_vars.size());
+    if (total == 0) {
+      enqueue_ready(op);
+      return;
+    }
+    op->wait.store(total + 1);  // +1 guard: full registration before launch
+    std::vector<Op *> ready;
+    for (Var *v : op->const_vars) {
+      std::lock_guard<std::mutex> lk(v->m);
+      v->q.emplace_back(op, false);
+      grant(v, ready);
+    }
+    for (Var *v : op->mut_vars) {
+      std::lock_guard<std::mutex> lk(v->m);
+      v->q.emplace_back(op, true);
+      grant(v, ready);
+    }
+    if (op->wait.fetch_sub(1) == 1) ready.push_back(op);  // drop guard
+    for (Op *r : ready) enqueue_ready(r);
+  }
+};
+
+/* Dedup vars; a var appearing in both lists is treated as a write
+ * (reference engine.h:291 dedup contract). */
+void normalize_vars(std::vector<Var *> &cv, std::vector<Var *> &mv) {
+  auto uniq = [](std::vector<Var *> &v) {
+    std::sort(v.begin(), v.end());
+    v.erase(std::unique(v.begin(), v.end()), v.end());
+  };
+  uniq(cv);
+  uniq(mv);
+  std::vector<Var *> cv2;
+  for (Var *v : cv)
+    if (!std::binary_search(mv.begin(), mv.end(), v)) cv2.push_back(v);
+  cv.swap(cv2);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTGetLastError(void) { return tls_error.c_str(); }
+
+void MXTSetLastError(const char *msg) { set_error(msg ? msg : ""); }
+
+void MXTSetCallbackError(const char *msg) {
+  tls_callback_error = msg ? msg : "";
+}
+
+int MXTEngineCreate(int num_threads, MXTEngineHandle *out) {
+  if (num_threads <= 0) num_threads = std::thread::hardware_concurrency();
+  if (num_threads <= 0) num_threads = 2;
+  *out = new Engine(num_threads);
+  return 0;
+}
+
+int MXTEngineDestroy(MXTEngineHandle h) {
+  auto *eng = static_cast<Engine *>(h);
+  MXTEngineWaitForAll(h);
+  delete eng;
+  return 0;
+}
+
+int MXTEngineNewVar(MXTEngineHandle, MXTVarHandle *out) {
+  *out = new Var();
+  return 0;
+}
+
+int MXTEngineDeleteVar(MXTEngineHandle h, MXTVarHandle var) {
+  auto *v = static_cast<Var *>(var);
+  bool now;
+  {
+    std::lock_guard<std::mutex> lk(v->m);
+    v->to_delete = true;
+    now = v->q.empty() && v->active_readers == 0 && !v->active_writer;
+  }
+  if (now) delete v;
+  (void)h;
+  return 0;
+}
+
+int MXTEnginePushAsync(MXTEngineHandle h, MXTOpFn fn, void *ctx,
+                       MXTOpDeleter del, MXTVarHandle *const_vars, int n_const,
+                       MXTVarHandle *mutable_vars, int n_mut) {
+  auto *eng = static_cast<Engine *>(h);
+  auto *op = new Op();
+  op->fn = fn;
+  op->ctx = ctx;
+  op->deleter = del;
+  for (int i = 0; i < n_const; ++i)
+    op->const_vars.push_back(static_cast<Var *>(const_vars[i]));
+  for (int i = 0; i < n_mut; ++i)
+    op->mut_vars.push_back(static_cast<Var *>(mutable_vars[i]));
+  normalize_vars(op->const_vars, op->mut_vars);
+  eng->push(op);
+  return 0;
+}
+
+int MXTEngineWaitForVar(MXTEngineHandle h, MXTVarHandle var) {
+  auto *eng = static_cast<Engine *>(h);
+  auto *v = static_cast<Var *>(var);
+  std::mutex m;
+  std::condition_variable cv;
+  bool done = false;
+  auto *op = new Op();
+  op->on_complete = [&] {
+    std::lock_guard<std::mutex> lk(m);
+    done = true;
+    cv.notify_all();
+  };
+  op->const_vars.push_back(v);
+  eng->push(op);
+  std::unique_lock<std::mutex> lk(m);
+  cv.wait(lk, [&] { return done; });
+  std::string e = eng->take_error();
+  if (!e.empty()) {
+    set_error(e);
+    return -1;
+  }
+  return 0;
+}
+
+int MXTEngineWaitForAll(MXTEngineHandle h) {
+  auto *eng = static_cast<Engine *>(h);
+  std::unique_lock<std::mutex> lk(eng->done_m);
+  eng->done_cv.wait(lk, [&] { return eng->outstanding.load() == 0; });
+  lk.unlock();
+  std::string e = eng->take_error();
+  if (!e.empty()) {
+    set_error(e);
+    return -1;
+  }
+  return 0;
+}
+
+int MXTEngineVarVersion(MXTEngineHandle, MXTVarHandle var, uint64_t *out) {
+  *out = static_cast<Var *>(var)->version.load();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+/*
+ * mxnet_tpu native runtime — C ABI.
+ *
+ * Reference analog: include/mxnet/engine.h (dependency engine),
+ * dmlc-core recordio (src/io/), iter_prefetcher.h (threaded prefetch).
+ *
+ * TPU-native division of labor: XLA/PjRt already schedules *device* work
+ * asynchronously, so this engine schedules *host* work — file IO, decode,
+ * checkpoint writes, Python callbacks — with the reference's versioned-
+ * variable semantics (shared reads, exclusive writes, exception capture at
+ * sync points). The RecordIO reader/writer and prefetcher give the data
+ * pipeline GIL-free C++ threads, the job OpenCV/dmlc threads did in the
+ * reference (src/io/iter_image_recordio_2.cc).
+ */
+#ifndef MXT_NATIVE_H_
+#define MXT_NATIVE_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTEngineHandle;
+typedef void *MXTVarHandle;
+typedef void *MXTRecordIOHandle;
+typedef void *MXTPrefetchHandle;
+
+/* Async op body: runs on an engine worker thread. Return 0 on success,
+ * nonzero on failure (an error recorded with MXTSetCallbackError is
+ * rethrown at the next sync point). */
+typedef int (*MXTOpFn)(void *ctx);
+/* Called exactly once after the op completes (success or failure) — used
+ * by bindings to release the closure. May be NULL. */
+typedef void (*MXTOpDeleter)(void *ctx);
+
+const char *MXTGetLastError(void);
+void MXTSetLastError(const char *msg);
+void MXTSetCallbackError(const char *msg);
+
+/* ---- dependency engine ---- */
+int MXTEngineCreate(int num_threads, MXTEngineHandle *out);
+int MXTEngineDestroy(MXTEngineHandle h);
+int MXTEngineNewVar(MXTEngineHandle h, MXTVarHandle *out);
+/* Deletes the var once all pending ops on it complete. */
+int MXTEngineDeleteVar(MXTEngineHandle h, MXTVarHandle var);
+int MXTEnginePushAsync(MXTEngineHandle h, MXTOpFn fn, void *ctx,
+                       MXTOpDeleter del, MXTVarHandle *const_vars,
+                       int n_const, MXTVarHandle *mutable_vars, int n_mut);
+/* Blocks until every op that writes `var` (pushed before this call) has
+ * completed; returns -1 and sets the error if any async op failed. */
+int MXTEngineWaitForVar(MXTEngineHandle h, MXTVarHandle var);
+int MXTEngineWaitForAll(MXTEngineHandle h);
+/* Var version counter: bumps on each completed write (reference
+ * engine.h:44 Var::version). */
+int MXTEngineVarVersion(MXTEngineHandle h, MXTVarHandle var, uint64_t *out);
+
+/* ---- RecordIO (dmlc wire format: magic 0xced7230a framing) ---- */
+int MXTRecordIOWriterCreate(const char *path, MXTRecordIOHandle *out);
+int MXTRecordIOWriterWrite(MXTRecordIOHandle h, const char *data, size_t len,
+                           uint64_t *out_pos);
+int MXTRecordIOWriterTell(MXTRecordIOHandle h, uint64_t *out);
+int MXTRecordIOWriterClose(MXTRecordIOHandle h);
+int MXTRecordIOReaderCreate(const char *path, MXTRecordIOHandle *out);
+/* *out_data points into an internal buffer valid until the next call.
+ * Returns 0 with *out_len == 0 and *out_data == NULL at EOF. */
+int MXTRecordIOReaderNext(MXTRecordIOHandle h, const char **out_data,
+                          size_t *out_len);
+int MXTRecordIOReaderSeek(MXTRecordIOHandle h, uint64_t pos);
+int MXTRecordIOReaderTell(MXTRecordIOHandle h, uint64_t *out);
+int MXTRecordIOReaderClose(MXTRecordIOHandle h);
+
+/* ---- threaded prefetching reader ---- */
+int MXTPrefetchCreate(const char *path, int capacity, MXTPrefetchHandle *out);
+/* Blocking pop; at EOF returns 0 with *out_len == 0. The buffer is owned
+ * by the handle and valid until the next MXTPrefetchNext call. */
+int MXTPrefetchNext(MXTPrefetchHandle h, const char **out_data,
+                    size_t *out_len);
+int MXTPrefetchDestroy(MXTPrefetchHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXT_NATIVE_H_ */

@@ -1,0 +1,270 @@
+/*
+ * RecordIO reader/writer + threaded prefetcher.
+ *
+ * Wire format is dmlc RecordIO (the reference's dataset container,
+ * 3rdparty/dmlc-core recordio.h semantics as used by src/io/): each record
+ * is framed as
+ *   uint32 magic = 0xced7230a
+ *   uint32 lrec  = (cflag << 29) | length      (cflag 0 = whole record)
+ *   payload, zero-padded to a 4-byte boundary
+ * Long records that would need continuation flags are written whole here
+ * (cflag 0) — readers of both implementations accept that; payloads
+ * containing the magic are still unambiguous because framing is
+ * length-driven on read.
+ *
+ * The prefetcher is the reference's iter_prefetcher.h idea: a C++ IO
+ * thread reads ahead into a bounded queue so Python-side decode/transform
+ * overlaps with file IO without holding the GIL.
+ */
+#include "mxt_native.h"
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Writer {
+  FILE *fp;
+  uint64_t pos = 0;
+};
+
+struct Reader {
+  FILE *fp;
+  std::string buf;
+};
+
+bool read_record(FILE *fp, std::string *out, std::string *err) {
+  uint32_t magic, lrec;
+  size_t n = fread(&magic, 1, 4, fp);
+  if (n == 0) return false;  // clean EOF
+  if (n != 4 || magic != kMagic) {
+    *err = "recordio: bad magic (corrupt or misaligned file)";
+    return false;
+  }
+  if (fread(&lrec, 1, 4, fp) != 4) {
+    *err = "recordio: truncated header";
+    return false;
+  }
+  uint32_t len = lrec & ((1u << 29) - 1);
+  out->resize(len);
+  if (len && fread(&(*out)[0], 1, len, fp) != len) {
+    *err = "recordio: truncated payload";
+    return false;
+  }
+  size_t pad = (4 - (len & 3)) & 3;
+  if (pad) {
+    char junk[4];
+    if (fread(junk, 1, pad, fp) != pad) {
+      *err = "recordio: truncated padding";
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+static void set_err(const char *msg) { MXTSetLastError(msg); }
+
+int MXTRecordIOWriterCreate(const char *path, MXTRecordIOHandle *out) {
+  FILE *fp = fopen(path, "wb");
+  if (!fp) {
+    set_err("recordio: cannot open file for writing");
+    return -1;
+  }
+  auto *w = new Writer();
+  w->fp = fp;
+  *out = w;
+  return 0;
+}
+
+int MXTRecordIOWriterWrite(MXTRecordIOHandle h, const char *data, size_t len,
+                           uint64_t *out_pos) {
+  auto *w = static_cast<Writer *>(h);
+  if (out_pos) *out_pos = w->pos;
+  uint32_t magic = kMagic;
+  uint32_t lrec = static_cast<uint32_t>(len) & ((1u << 29) - 1);
+  if (len >= (1u << 29)) {
+    set_err("recordio: record too large (>512MB)");
+    return -1;
+  }
+  if (fwrite(&magic, 1, 4, w->fp) != 4 || fwrite(&lrec, 1, 4, w->fp) != 4 ||
+      (len && fwrite(data, 1, len, w->fp) != len)) {
+    set_err("recordio: write failed");
+    return -1;
+  }
+  size_t pad = (4 - (len & 3)) & 3;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, w->fp) != pad) {
+    set_err("recordio: write failed");
+    return -1;
+  }
+  w->pos += 8 + len + pad;
+  return 0;
+}
+
+int MXTRecordIOWriterTell(MXTRecordIOHandle h, uint64_t *out) {
+  *out = static_cast<Writer *>(h)->pos;
+  return 0;
+}
+
+int MXTRecordIOWriterClose(MXTRecordIOHandle h) {
+  auto *w = static_cast<Writer *>(h);
+  fclose(w->fp);
+  delete w;
+  return 0;
+}
+
+int MXTRecordIOReaderCreate(const char *path, MXTRecordIOHandle *out) {
+  FILE *fp = fopen(path, "rb");
+  if (!fp) {
+    set_err("recordio: cannot open file for reading");
+    return -1;
+  }
+  auto *r = new Reader();
+  r->fp = fp;
+  *out = r;
+  return 0;
+}
+
+int MXTRecordIOReaderNext(MXTRecordIOHandle h, const char **out_data,
+                          size_t *out_len) {
+  auto *r = static_cast<Reader *>(h);
+  std::string err;
+  if (!read_record(r->fp, &r->buf, &err)) {
+    if (!err.empty()) {
+      set_err(err.c_str());
+      return -1;
+    }
+    *out_data = nullptr;
+    *out_len = 0;
+    return 0;
+  }
+  *out_data = r->buf.data();
+  *out_len = r->buf.size();
+  return 0;
+}
+
+int MXTRecordIOReaderSeek(MXTRecordIOHandle h, uint64_t pos) {
+  auto *r = static_cast<Reader *>(h);
+  if (fseek(r->fp, static_cast<long>(pos), SEEK_SET) != 0) {
+    set_err("recordio: seek failed");
+    return -1;
+  }
+  return 0;
+}
+
+int MXTRecordIOReaderTell(MXTRecordIOHandle h, uint64_t *out) {
+  auto *r = static_cast<Reader *>(h);
+  long p = ftell(r->fp);
+  if (p < 0) {
+    set_err("recordio: tell failed");
+    return -1;
+  }
+  *out = static_cast<uint64_t>(p);
+  return 0;
+}
+
+int MXTRecordIOReaderClose(MXTRecordIOHandle h) {
+  auto *r = static_cast<Reader *>(h);
+  fclose(r->fp);
+  delete r;
+  return 0;
+}
+
+/* ---- threaded prefetcher ---- */
+
+namespace {
+
+struct Prefetcher {
+  FILE *fp = nullptr;
+  std::thread th;
+  std::deque<std::string> queue;
+  size_t capacity;
+  std::mutex m;
+  std::condition_variable cv_pop, cv_push;
+  bool eof = false, stop = false;
+  std::string error;
+  std::string cur;  // buffer handed to the consumer
+
+  void loop() {
+    for (;;) {
+      std::string rec, err;
+      bool ok = read_record(fp, &rec, &err);
+      std::unique_lock<std::mutex> lk(m);
+      if (!ok) {
+        if (!err.empty()) error = err;
+        eof = true;
+        cv_pop.notify_all();
+        return;
+      }
+      cv_push.wait(lk, [this] { return queue.size() < capacity || stop; });
+      if (stop) return;
+      queue.push_back(std::move(rec));
+      cv_pop.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+int MXTPrefetchCreate(const char *path, int capacity, MXTPrefetchHandle *out) {
+  FILE *fp = fopen(path, "rb");
+  if (!fp) {
+    set_err("prefetch: cannot open file");
+    return -1;
+  }
+  auto *p = new Prefetcher();
+  p->fp = fp;
+  p->capacity = capacity > 0 ? capacity : 64;
+  p->th = std::thread([p] { p->loop(); });
+  *out = p;
+  return 0;
+}
+
+int MXTPrefetchNext(MXTPrefetchHandle h, const char **out_data,
+                    size_t *out_len) {
+  auto *p = static_cast<Prefetcher *>(h);
+  std::unique_lock<std::mutex> lk(p->m);
+  p->cv_pop.wait(lk, [p] { return !p->queue.empty() || p->eof; });
+  if (p->queue.empty()) {
+    if (!p->error.empty()) {
+      set_err(p->error.c_str());
+      return -1;
+    }
+    *out_data = nullptr;
+    *out_len = 0;
+    return 0;
+  }
+  p->cur = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_push.notify_one();
+  *out_data = p->cur.data();
+  *out_len = p->cur.size();
+  return 0;
+}
+
+int MXTPrefetchDestroy(MXTPrefetchHandle h) {
+  auto *p = static_cast<Prefetcher *>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->m);
+    p->stop = true;
+  }
+  p->cv_push.notify_all();
+  p->th.join();
+  fclose(p->fp);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

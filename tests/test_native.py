@@ -1,0 +1,203 @@
+"""Native runtime tests: C++ dependency engine, RecordIO, prefetcher.
+
+Reference analog: tests/cpp/engine/threaded_engine_test.cc (ordering,
+exception semantics) and python recordio round-trip tests. The engine
+orders *host* tasks here (device work is XLA's job on TPU).
+"""
+import os
+import struct
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native, recordio
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="native lib unavailable (no g++?)")
+
+
+def test_engine_write_ordering():
+    # Ops writing the same var must run exclusively and in push order.
+    eng = _native.NativeEngine(num_threads=4)
+    var = eng.new_var()
+    log = []
+    for i in range(50):
+        eng.push(lambda i=i: log.append(i), mutable_vars=[var])
+    eng.wait_for_var(var)
+    assert log == list(range(50))
+    assert eng.var_version(var) == 50
+    eng.close()
+
+
+def test_engine_reads_parallel_writes_exclusive():
+    eng = _native.NativeEngine(num_threads=4)
+    var = eng.new_var()
+    state = {"active": 0, "max_active": 0}
+    lock = threading.Lock()
+
+    def reader():
+        with lock:
+            state["active"] += 1
+            state["max_active"] = max(state["max_active"], state["active"])
+        time.sleep(0.01)
+        with lock:
+            state["active"] -= 1
+
+    for _ in range(8):
+        eng.push(reader, const_vars=[var])
+    eng.wait_for_all()
+    assert state["max_active"] > 1  # reads overlapped
+    # now interleave a write: everything pushed after must see it done
+    order = []
+    eng.push(lambda: (time.sleep(0.02), order.append("w")), mutable_vars=[var])
+    eng.push(lambda: order.append("r"), const_vars=[var])
+    eng.wait_for_all()
+    assert order == ["w", "r"]
+    eng.close()
+
+
+def test_engine_dependency_chain():
+    # writer(a) -> reader(a) writer(b) -> reader(b); cross-var ordering
+    eng = _native.NativeEngine(num_threads=4)
+    a, b = eng.new_var(), eng.new_var()
+    out = []
+    eng.push(lambda: (time.sleep(0.02), out.append("wa")), mutable_vars=[a])
+    eng.push(lambda: out.append("ra_wb"), const_vars=[a], mutable_vars=[b])
+    eng.push(lambda: out.append("rb"), const_vars=[b])
+    eng.wait_for_all()
+    assert out == ["wa", "ra_wb", "rb"]
+    eng.close()
+
+
+def test_engine_exception_at_sync_point():
+    # Async failures surface at wait_for_* (reference
+    # threaded_engine.cc:422-436 exception propagation).
+    eng = _native.NativeEngine(num_threads=2)
+    var = eng.new_var()
+
+    def boom():
+        raise ValueError("kaboom from worker")
+
+    eng.push(boom, mutable_vars=[var])
+    with pytest.raises(MXNetError, match="kaboom"):
+        eng.wait_for_var(var)
+    # error is consumed; engine remains usable
+    eng.push(lambda: None, mutable_vars=[var])
+    eng.wait_for_var(var)
+    eng.close()
+
+
+@pytest.mark.parametrize("native_write,native_read",
+                         [(True, True), (True, False), (False, True)])
+def test_recordio_cross_compat(tmp_path, native_write, native_read,
+                               monkeypatch):
+    # native and pure-Python impls must interoperate byte-for-byte
+    path = str(tmp_path / "data.rec")
+    records = [b"hello", b"x" * 1021, b"", os.urandom(4096),
+               struct.pack("<I", 0xced7230a)]  # payload containing magic
+    w = (_native.NativeRecordIOWriter(path) if native_write
+         else recordio._PyWriter(path))
+    for r in records:
+        w.write(r)
+    w.close()
+    r_ = (_native.NativeRecordIOReader(path) if native_read
+          else recordio._PyReader(path))
+    got = []
+    while True:
+        rec = r_.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r_.close()
+    assert got == records
+
+
+def test_mxrecordio_api(tmp_path):
+    path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(10):
+        rec.write(f"record{i}".encode())
+    rec.close()
+    rec = recordio.MXRecordIO(path, "r")
+    for i in range(10):
+        assert rec.read() == f"record{i}".encode()
+    assert rec.read() is None
+    rec.reset()
+    assert rec.read() == b"record0"
+    rec.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    rec = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(20):
+        rec.write_idx(i, f"rec{i}".encode())
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert rec.keys == list(range(20))
+    assert rec.read_idx(13) == b"rec13"
+    assert rec.read_idx(4) == b"rec4"
+    rec.close()
+
+
+def test_indexed_writer_tell(tmp_path):
+    # tell() in write mode must advance identically native vs pure-Python
+    # (reference index-building pattern: pos = tell(); write_idx(...)).
+    paths = [(str(tmp_path / "n.rec"), _native.NativeRecordIOWriter),
+             (str(tmp_path / "p.rec"), recordio._PyWriter)]
+    tells = []
+    for path, cls in paths:
+        w = cls(path)
+        t = [w.tell()]
+        for i in range(5):
+            w.write(b"x" * (i * 3 + 1))
+            t.append(w.tell())
+        w.close()
+        tells.append(t)
+    assert tells[0] == tells[1]
+    assert tells[0][0] == 0 and sorted(tells[0]) == tells[0]
+
+
+def test_pyreader_truncated_header(tmp_path):
+    path = str(tmp_path / "trunc.rec")
+    w = recordio._PyWriter(path)
+    w.write(b"full record")
+    w.close()
+    with open(path, "ab") as f:
+        f.write(struct.pack("<I", 0xced7230a) + b"\x01\x02")  # cut mid-header
+    r = recordio._PyReader(path)
+    assert r.read() == b"full record"
+    with pytest.raises(MXNetError, match="truncated header"):
+        r.read()
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(flag=0, label=3.5, id=42, id2=0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload" and h2.label == 3.5 and h2.id == 42
+    # multi-label
+    h = recordio.IRHeader(flag=0, label=onp.array([1.0, 2.0, 3.0]), id=7, id2=0)
+    s = recordio.pack(h, b"xyz")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"xyz"
+    onp.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+
+
+def test_prefetcher(tmp_path):
+    path = str(tmp_path / "big.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [os.urandom(onp.random.randint(1, 2000)) for _ in range(200)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    pf = _native.NativePrefetchReader(path, capacity=16)
+    got = list(pf)
+    pf.close()
+    assert got == payloads
