@@ -1,0 +1,157 @@
+"""Automatic mixed precision (reference: python/mxnet/contrib/amp/).
+
+Reference mechanics: fp16 allow/deny op lists (contrib/amp/lists/
+symbol_fp16.py), runtime patching of op invocation (amp.py:282), dynamic
+``LossScaler`` (loss_scaler.py), and a ``ReducePrecision`` graph pass.
+
+TPU-native redesign: the mixed dtype is **bfloat16** — same exponent range
+as f32, so no loss scaling is *required* (the LossScaler is kept for API
+parity and for true fp16). ``amp.init()`` installs an invoke wrapper that
+casts inputs of MXU-bound ops (matmul/conv/attention/rnn) to bf16 and
+returns f32 outputs — XLA then runs the MXU in its native
+bf16-multiply/f32-accumulate mode, which is exactly the reference's
+"fp16 compute, fp32 master weights" recipe with the fragile parts removed.
+Reduction/normalization/loss ops stay f32 (the reference's FP32_FUNCS list).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "uninit", "is_enabled", "init_trainer", "scale_loss",
+           "convert_hybrid_block", "LossScaler", "TARGET_DTYPE_OPS",
+           "FP32_OPS"]
+
+# MXU-bound ops: cast inputs to the target dtype (reference
+# lists/symbol_fp16.py FP16_FUNCS analog).
+TARGET_DTYPE_OPS = {
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "flash_attention", "flash_attention_vl", "masked_attention", "rnn",
+    "conv", "conv_transpose",
+}
+
+# Numerically-sensitive ops pinned to f32 (reference FP32_FUNCS analog).
+# Everything else runs in whatever dtype flows in (WIDEST_TYPE_CASTS
+# behavior falls out of jnp promotion).
+FP32_OPS = {
+    "softmax", "log_softmax", "SoftmaxOutput", "BatchNorm", "LayerNorm",
+    "GroupNorm", "InstanceNorm", "batch_norm_train", "batch_norm_infer",
+    "layer_norm", "group_norm", "instance_norm", "norm", "mean", "sum",
+    "exp", "log", "erf", "smooth_l1",
+}
+
+_state = {"enabled": False, "dtype": None, "wrapper": None}
+
+
+def _cast_tree(x, dtype):
+    if hasattr(x, "dtype") and hasattr(x, "astype") and \
+            x.dtype == jnp.float32:
+        return x.astype(dtype)
+    return x
+
+
+def _make_wrapper(target_dtype):
+    def wrapper(name, fn):
+        if name not in TARGET_DTYPE_OPS:
+            return fn
+
+        def amp_fn(*args, **kwargs):
+            cast_args = [_cast_tree(a, target_dtype) for a in args]
+            out = fn(*cast_args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return type(out)(
+                    o.astype(jnp.float32)
+                    if hasattr(o, "dtype") and o.dtype == target_dtype else o
+                    for o in out)
+            if hasattr(out, "dtype") and out.dtype == target_dtype:
+                return out.astype(jnp.float32)
+            return out
+        return amp_fn
+    return wrapper
+
+
+def init(target_dtype: str = "bfloat16"):
+    """Enable AMP process-wide (reference amp.init, amp.py:282)."""
+    if _state["enabled"]:
+        return
+    if target_dtype in ("bfloat16", "bf16"):
+        dt = jnp.bfloat16
+    elif target_dtype in ("float16", "fp16"):
+        dt = jnp.float16
+    else:
+        raise MXNetError(f"unsupported AMP target dtype {target_dtype!r}")
+    w = _make_wrapper(dt)
+    _registry.add_invoke_wrapper(w)
+    _state.update(enabled=True, dtype=dt, wrapper=w)
+
+
+def uninit():
+    """Disable AMP (test/debug helper; the reference has no un-init)."""
+    if _state["enabled"]:
+        _registry.remove_invoke_wrapper(_state["wrapper"])
+        _state.update(enabled=False, dtype=None, wrapper=None)
+
+
+def is_enabled() -> bool:
+    return _state["enabled"]
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler to a Gluon Trainer (reference
+    amp.init_trainer). A no-op numerically for bf16 (scale stays 1) but
+    the scaler object is attached for API parity and fp16 use."""
+    scaler = LossScaler(
+        init_scale=1.0 if _state["dtype"] == jnp.bfloat16 else 2. ** 16)
+    trainer._amp_loss_scaler = scaler
+    return scaler
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Yield the scaled loss; trainer.step unscales via trainer._scale
+    (reference amp.scale_loss contextmanager)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        scaler = init_trainer(trainer)
+    # trainer._scale must keep dividing out the loss scale through the
+    # trainer.step() that follows this context — set it persistently,
+    # against the original scale (idempotent across steps as the dynamic
+    # scale changes).
+    if not hasattr(trainer, "_amp_original_scale"):
+        trainer._amp_original_scale = trainer._scale
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if scaler.loss_scale == 1.0:  # bf16 default: no-op passthrough
+        yield loss
+    elif isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def convert_hybrid_block(block, target_dtype: str = "bfloat16"):
+    """Cast a Gluon block's parameters for low-precision *inference*
+    (reference amp.convert_hybrid_block): all params to target dtype
+    except normalization-layer params, which stay f32."""
+    from ..gluon import nn as _nn
+    norm_types = (_nn.BatchNorm, _nn.LayerNorm, _nn.GroupNorm,
+                  _nn.InstanceNorm)
+    # cast every parameter not owned by a norm layer
+    norm_params = set()
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        if isinstance(b, norm_types):
+            for p in b.collect_params().values():
+                norm_params.add(id(p))
+        stack.extend(getattr(b, "_children", {}).values())
+    for p in block.collect_params().values():
+        if id(p) not in norm_params and p._data is not None and \
+                p.dtype == "float32":
+            p.cast(target_dtype)
+    return block

@@ -1,0 +1,48 @@
+"""Dynamic loss scaling (reference: python/mxnet/contrib/amp/loss_scaler.py).
+
+Needed for true fp16 (5-bit exponent underflows gradients); a no-op for
+bf16, which shares f32's exponent range — the reason AMP-on-TPU defaults to
+scale 1.0.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    """Multiplicative dynamic scaler: halve on overflow, double after
+    ``scale_window`` clean steps (reference loss_scaler.py semantics)."""
+
+    def __init__(self, init_scale: float = 2. ** 16, scale_factor: float = 2.,
+                 scale_window: int = 2000, min_scale: float = 1.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._min_scale = min_scale
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """True if any parameter gradient is non-finite. ``params`` is an
+        iterable of Parameters (or NDArrays treated as grads)."""
+        for p in params:
+            g = p.grad() if hasattr(p, "grad") and callable(
+                getattr(p, "grad", None)) else p
+            if g is None:
+                continue
+            arr = g.asnumpy() if hasattr(g, "asnumpy") else onp.asarray(g)
+            if not onp.isfinite(arr).all():
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self._min_scale,
+                                  self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -1,0 +1,4 @@
+"""mx.contrib (reference: python/mxnet/contrib/ — amp, quantization, onnx,
+tensorboard). AMP lives at mxnet_tpu.amp; re-exported here for parity."""
+from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
+from . import quantization  # noqa: F401

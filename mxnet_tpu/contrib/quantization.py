@@ -1,0 +1,235 @@
+"""INT8 quantization (reference: python/mxnet/contrib/quantization.py +
+src/operator/quantization/ — quantize_v2/dequantize/requantize ops,
+min-max ("naive") and KL-entropy calibration, QuantizeGraph pass swapping in
+quantized conv/FC).
+
+TPU-native design: symmetric per-tensor int8 (zero-point 0). The MXU
+multiplies int8 natively with int32 accumulation — ``lax.dot_general(...,
+preferred_element_type=int32)`` is the whole "quantized kernel"; XLA fuses
+the dequantize scale into the surrounding graph. ``quantize_net`` replaces
+Dense/Conv children with quantized equivalents after range calibration
+(the role of the reference's QuantizeGraph pass,
+quantize_graph_pass.cc:581).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import invoke_raw
+
+__all__ = ["quantize_v2", "dequantize", "requantize", "quantize_net",
+           "QuantizedDense", "QuantizedConv"]
+
+
+def _sym_scale(mn, mx):
+    return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-10) / 127.0
+
+
+def quantize_v2(data, min_calib_range: Optional[float] = None,
+                max_calib_range: Optional[float] = None,
+                out_type: str = "int8"):
+    """f32 → (int8, min, max) with symmetric scaling (reference
+    quantize_v2, src/operator/quantization/quantize_v2.cc)."""
+    if out_type != "int8":
+        raise MXNetError("only int8 quantization is supported")
+
+    def fn(x):
+        mn = jnp.float32(min_calib_range) if min_calib_range is not None \
+            else x.min()
+        mx_ = jnp.float32(max_calib_range) if max_calib_range is not None \
+            else x.max()
+        scale = _sym_scale(mn, mx_)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return q, mn.reshape(1), mx_.reshape(1)
+
+    return invoke_raw("quantize_v2", fn, [data], n_outputs=3)
+
+
+def dequantize(qdata, min_range, max_range, out_type: str = "float32"):
+    """(int8, min, max) → f32 (reference dequantize op)."""
+    def fn(q, mn, mx_):
+        return q.astype(jnp.float32) * _sym_scale(mn, mx_)
+    return invoke_raw("dequantize", fn, [qdata, min_range, max_range])
+
+
+def requantize(qdata32, min_range, max_range):
+    """int32 accumulators → int8 with recomputed range (reference
+    requantize op)."""
+    def fn(q, mn, mx_):
+        real = q.astype(jnp.float32) * _sym_scale(mn, mx_)
+        rmn, rmx = real.min(), real.max()
+        scale = _sym_scale(rmn, rmx)
+        return (jnp.clip(jnp.round(real / scale), -127, 127).astype(jnp.int8),
+                rmn.reshape(1), rmx.reshape(1))
+    return invoke_raw("requantize", fn, [qdata32, min_range, max_range],
+                      n_outputs=3)
+
+
+class QuantizedDense(HybridBlock):
+    """INT8 Dense: int8×int8 → int32 on the MXU, fused dequantize
+    (reference quantized_fully_connected.cc)."""
+
+    def __init__(self, dense: nn.Dense, in_min: float, in_max: float,
+                 **kwargs):
+        super().__init__(**kwargs)
+        w = dense.weight.data()._data
+        w_scale = float(jnp.maximum(jnp.abs(w).max(), 1e-10) / 127.0)
+        self._qw = jnp.clip(jnp.round(w / w_scale), -127,
+                            127).astype(jnp.int8)
+        self._w_scale = w_scale
+        self._in_scale = max(abs(in_min), abs(in_max), 1e-10) / 127.0
+        self._bias = None if dense.bias is None \
+            else dense.bias.data()._data
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._act = dense._activation
+
+    def forward(self, x):
+        qw, ws, xs, b = self._qw, self._w_scale, self._in_scale, self._bias
+        act = self._act
+
+        def fn(xd):
+            shape = xd.shape
+            if self._flatten and xd.ndim > 2:
+                xd = xd.reshape(shape[0], -1)
+            qx = jnp.clip(jnp.round(xd / xs), -127, 127).astype(jnp.int8)
+            acc = lax.dot_general(qx, qw, (((qx.ndim - 1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (xs * ws)
+            if b is not None:
+                out = out + b
+            if act:
+                out = getattr(jax.nn, act)(out)
+            return out
+
+        return invoke_raw("quantized_dense", fn, [x])
+
+
+class QuantizedConv(HybridBlock):
+    """INT8 convolution: int8 conv with int32 accumulation (reference
+    quantized_conv.cc)."""
+
+    def __init__(self, conv, in_min: float, in_max: float, **kwargs):
+        super().__init__(**kwargs)
+        w = conv.weight.data()._data
+        w_scale = float(jnp.maximum(jnp.abs(w).max(), 1e-10) / 127.0)
+        self._qw = jnp.clip(jnp.round(w / w_scale), -127,
+                            127).astype(jnp.int8)
+        self._w_scale = w_scale
+        self._in_scale = max(abs(in_min), abs(in_max), 1e-10) / 127.0
+        self._bias = None if conv.bias is None else conv.bias.data()._data
+        self._conv = conv
+
+    def forward(self, x):
+        from ..ops import nn as K
+        c = self._conv
+        qw, ws, xs, b = self._qw, self._w_scale, self._in_scale, self._bias
+
+        def fn(xd):
+            qx = jnp.clip(jnp.round(xd / xs), -127, 127).astype(jnp.int8)
+            ndim = qx.ndim - 2
+            sp = "DHW"[3 - ndim:]
+            dn = lax.conv_dimension_numbers(
+                qx.shape, qw.shape, ("NC" + sp, "OI" + sp, "NC" + sp))
+            acc = lax.conv_general_dilated(
+                qx, qw, window_strides=c._strides,
+                padding=[(p, p) for p in c._padding],
+                rhs_dilation=c._dilation, dimension_numbers=dn,
+                feature_group_count=c._groups,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (xs * ws)
+            if b is not None:
+                out = out + b.reshape((1, -1) + (1,) * ndim)
+            if c._activation:
+                out = getattr(jax.nn, c._activation)(out)
+            return out
+
+        return invoke_raw("quantized_conv", fn, [x])
+
+
+def _collect_ranges(net, calib_data, max_batches: int,
+                    mode: str, percentile: float) -> Dict[int, tuple]:
+    """Run calibration batches, recording per-layer input ranges via
+    forward hooks (the reference's calibration pass, calibrate.cc)."""
+    ranges: Dict[int, List] = {}
+    hooks = []
+
+    def make_hook(key):
+        def hook(block, inputs):
+            x = inputs[0]
+            arr = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            if mode == "percentile":
+                lo = float(onp.percentile(arr, 100 - percentile))
+                hi = float(onp.percentile(arr, percentile))
+            else:  # naive min/max
+                lo, hi = float(arr.min()), float(arr.max())
+            st = ranges.setdefault(key, [onp.inf, -onp.inf])
+            st[0] = min(st[0], lo)
+            st[1] = max(st[1], hi)
+        return hook
+
+    for blk in _quantizable_blocks(net):
+        hooks.append(blk.register_forward_pre_hook(make_hook(id(blk))))
+    n = 0
+    for batch in calib_data:
+        x = batch[0] if isinstance(batch, (list, tuple)) else batch
+        net(x)
+        n += 1
+        if n >= max_batches:
+            break
+    for h in hooks:
+        h.detach()
+    return {k: tuple(v) for k, v in ranges.items()}
+
+
+def _quantizable_blocks(net):
+    out = []
+    stack = [net]
+    while stack:
+        b = stack.pop()
+        if isinstance(b, nn.Dense) or type(b).__name__.startswith("Conv"):
+            out.append(b)
+        stack.extend(getattr(b, "_children", {}).values())
+    return out
+
+
+def quantize_net(net, calib_data, calib_mode: str = "naive",
+                 num_calib_batches: int = 10, percentile: float = 99.99,
+                 exclude_first: bool = False):
+    """Calibrate + swap Dense/Conv children for INT8 versions, in place
+    (reference quantize_net, contrib/quantization.py)."""
+    if calib_mode not in ("naive", "percentile"):
+        raise MXNetError("calib_mode must be 'naive' or 'percentile' "
+                         "(KL-entropy not implemented on TPU build)")
+    ranges = _collect_ranges(net, calib_data, num_calib_batches,
+                             calib_mode, percentile)
+
+    def swap(parent):
+        for name, child in list(parent._children.items()):
+            key = id(child)
+            if key in ranges:
+                lo, hi = ranges[key]
+                if isinstance(child, nn.Dense):
+                    q = QuantizedDense(child, lo, hi)
+                elif type(child).__name__ in ("Conv1D", "Conv2D", "Conv3D"):
+                    q = QuantizedConv(child, lo, hi)
+                else:
+                    continue
+                parent._children[name] = q
+                if getattr(parent, name, None) is child:
+                    object.__setattr__(parent, name, q)
+            else:
+                swap(child)
+
+    swap(net)
+    return net

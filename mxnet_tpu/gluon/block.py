@@ -38,6 +38,24 @@ from .parameter import Parameter, DeferredInitializationError
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
+class _HookHandle:
+    """Detachable hook registration (reference gluon/utils.py HookHandle)."""
+
+    def __init__(self, hooks_list, hook):
+        self._list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+
+
 class _TracedSentinel:
     """Marks a traced-leaf position inside a cached op's static_spec."""
 
@@ -138,11 +156,11 @@ class Block:
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)
-        return hook
+        return _HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
-        return hook
+        return _HookHandle(self._forward_pre_hooks, hook)
 
     # ---------------- parameter management ----------------
     def collect_params(self, select: Optional[str] = None) -> _ParamDict:

@@ -1,0 +1,2 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib/)."""
+from . import estimator  # noqa: F401

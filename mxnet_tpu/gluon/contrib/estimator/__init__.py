@@ -1,0 +1,6 @@
+"""Estimator API (reference: python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (EventHandler, TrainBegin, TrainEnd, EpochBegin,  # noqa: F401
+                            EpochEnd, BatchBegin, BatchEnd, StoppingHandler,
+                            CheckpointHandler, EarlyStoppingHandler,
+                            LoggingHandler, MetricHandler, ValidationHandler)

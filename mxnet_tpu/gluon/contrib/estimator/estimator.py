@@ -1,0 +1,96 @@
+"""Estimator: Keras-like fit loop (reference: python/mxnet/gluon/contrib/
+estimator/estimator.py — Estimator.fit with event handlers dispatched at
+train/epoch/batch boundaries).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .... import autograd, metric as metric_mod
+from ....base import MXNetError
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Trains a Gluon net over a DataLoader with pluggable handlers."""
+
+    def __init__(self, net, loss, train_metrics=None, trainer: Optional[Trainer] = None,
+                 context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics if train_metrics is not None else \
+            [metric_mod.Accuracy()]
+        if not isinstance(self.train_metrics, (list, tuple)):
+            self.train_metrics = [self.train_metrics]
+        self.train_metrics = list(self.train_metrics)
+        self.train_loss_metric = metric_mod.Loss("train_loss")
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.01})
+
+    def _dispatch(self, handlers, event, *args, **kwargs):
+        stop = False
+        for h in handlers:
+            r = getattr(h, event)(self, *args, **kwargs)
+            stop = stop or bool(r)
+        return stop
+
+    def evaluate(self, val_data, val_metrics=None):
+        """One pass over val_data updating val_metrics."""
+        metrics = val_metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            x, y = batch[0], batch[1]
+            pred = self.net(x)
+            for m in metrics:
+                if "loss" in m.name.lower():
+                    m.update(None, self.loss(pred, y))
+                else:
+                    m.update(y, pred)
+        return metrics
+
+    def fit(self, train_data, val_data=None, epochs: Optional[int] = None,
+            event_handlers=None, batches: Optional[int] = None):
+        if epochs is None and batches is None:
+            raise MXNetError("fit requires epochs or batches")
+        stopper = StoppingHandler(max_epoch=epochs, max_batch=batches)
+        handlers = [stopper,
+                    MetricHandler([self.train_loss_metric] +
+                                  self.train_metrics)]
+        if event_handlers:
+            handlers.extend(event_handlers)
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + self.train_metrics))
+
+        tb = [h for h in handlers if isinstance(h, TrainBegin)]
+        te = [h for h in handlers if isinstance(h, TrainEnd)]
+        eb = [h for h in handlers if isinstance(h, EpochBegin)]
+        ee = [h for h in handlers if isinstance(h, EpochEnd)]
+        bb = [h for h in handlers if isinstance(h, BatchBegin)]
+        be = [h for h in handlers if isinstance(h, BatchEnd)]
+
+        self._dispatch(tb, "train_begin")
+        while not stopper.stop_training:
+            self._dispatch(eb, "epoch_begin")
+            for batch in train_data:
+                x, y = batch[0], batch[1]
+                self._dispatch(bb, "batch_begin")
+                with autograd.record():
+                    pred = self.net(x)
+                    loss = self.loss(pred, y)
+                loss.backward()
+                bs = x.shape[0]
+                self.trainer.step(bs)
+                if self._dispatch(be, "batch_end", pred=pred, label=y,
+                                  loss=loss):
+                    break
+            if self._dispatch(ee, "epoch_end"):
+                break
+        self._dispatch(te, "train_end")
+        return self

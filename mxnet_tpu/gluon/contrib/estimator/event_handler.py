@@ -1,0 +1,221 @@
+"""Estimator event handlers (reference: python/mxnet/gluon/contrib/
+estimator/event_handler.py — CheckpointHandler, EarlyStoppingHandler,
+LoggingHandler, etc. hooked at train/epoch/batch boundaries).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+import numpy as onp
+
+__all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class EventHandler:
+    pass
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch/max_batch (reference StoppingHandler)."""
+
+    def __init__(self, max_epoch: Optional[int] = None,
+                 max_batch: Optional[int] = None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Resets/updates train metrics (reference MetricHandler)."""
+
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, pred=None, label=None, loss=None,
+                  **kwargs):
+        for m in self.metrics:
+            if "loss" in m.name.lower():
+                m.update(None, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Runs validation every ``epoch_period`` epochs (reference
+    ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period: int = 1):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Logs metrics per epoch (and optionally per N batches)."""
+
+    def __init__(self, log_interval: str = "epoch", metrics=None,
+                 logger=None):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.logger = logger or logging.getLogger("mxnet_tpu.estimator")
+        self.batch_index = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training end; total time %.1fs",
+                         time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = ", ".join(f"{m.name}={m.get()[1]:.4f}" for m in self.metrics)
+        self.logger.info("Epoch done (%.1fs) %s",
+                         time.time() - self.epoch_start, msg)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = ", ".join(f"{m.name}={m.get()[1]:.4f}"
+                            for m in self.metrics)
+            self.logger.info("Batch %d %s", self.batch_index, msg)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Saves model (and best-model) checkpoints (reference
+    CheckpointHandler: model_dir/model_prefix, monitor + mode)."""
+
+    def __init__(self, model_dir: str, model_prefix: str = "model",
+                 monitor=None, mode: str = "min", save_best: bool = False,
+                 epoch_period: int = 1):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.current_epoch = 0
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min/max")
+        self.mode = mode
+        self.best = float("inf") if mode == "min" else -float("inf")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period:
+            return
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(
+            f"{prefix}-epoch{self.current_epoch}.params")
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            better = val < self.best if self.mode == "min" else val > self.best
+            if better:
+                self.best = val
+                estimator.net.save_parameters(f"{prefix}-best.params")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stops when the monitored metric stops improving (reference
+    EarlyStoppingHandler: monitor/min_delta/patience/mode)."""
+
+    def __init__(self, monitor, min_delta: float = 0.0, patience: int = 0,
+                 mode: str = "min"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min/max")
+        self.mode = mode
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self.wait = 0
+        self.stop_training = False
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        _, val = self.monitor.get()
+        if onp.isnan(val):
+            return self.stop_training
+        improved = (val < self.best - self.min_delta) if self.mode == "min" \
+            else (val > self.best + self.min_delta)
+        if improved:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+        return self.stop_training

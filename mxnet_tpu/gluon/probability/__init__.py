@@ -1,0 +1,12 @@
+"""gluon.probability (reference: python/mxnet/gluon/probability/).
+
+Distributions, a KL registry, transformations, and StochasticBlock.
+Sampling uses the framework RNG stream (functional JAX keys under the
+hood); log_prob/entropy/kl are pure ops XLA fuses into surrounding
+computation.
+"""
+from .distributions import *  # noqa: F401,F403
+from .block import StochasticBlock  # noqa: F401
+from . import distributions, block
+
+__all__ = list(distributions.__all__) + ["StochasticBlock"]

@@ -1,0 +1,39 @@
+"""StochasticBlock (reference: python/mxnet/gluon/probability/block/
+stochastic_block.py): a HybridBlock that can collect auxiliary losses
+(e.g. KL terms in a VAE) from inside forward.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock"]
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock with ``add_loss`` collection. Decorate forward with
+    ``StochasticBlock.collectLoss`` to expose ``(out, losses)``."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(forward_fn):
+        @functools.wraps(forward_fn)
+        def wrapped(self, *args, **kwargs):
+            self._losscache = []
+            out = forward_fn(self, *args, **kwargs)
+            self._losses = list(self._losscache)
+            self._losscache = []
+            return out
+        return wrapped
+
+    @property
+    def losses(self):
+        return self._losses

@@ -1,0 +1,627 @@
+"""Distribution zoo (reference: python/mxnet/gluon/probability/distributions/
+— ~20 distribution classes with sample/log_prob/entropy + a KL registry).
+
+Every density/entropy is a pure jnp computation flowing through the op
+invoke funnel (differentiable on the tape, fusable by XLA); sampling draws
+from the framework's stateless key chain (ndarray/random.py next_key), so
+``mx.random.seed`` reproduces sample paths.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ndarray.random import next_key
+from ...ops.registry import invoke_raw
+
+__all__ = ["Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace",
+           "Cauchy", "HalfCauchy", "Gumbel", "Uniform", "Exponential",
+           "Gamma", "Beta", "Chi2", "StudentT", "Weibull", "Pareto",
+           "Bernoulli", "Geometric", "Poisson", "Categorical",
+           "OneHotCategorical", "Dirichlet", "MultivariateNormal",
+           "kl_divergence", "register_kl"]
+
+_EULER = 0.5772156649015329
+
+
+def _data(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x, jnp.float32)
+
+
+def _op(name, fn, inputs):
+    return invoke_raw(name, fn, [x if isinstance(x, NDArray)
+                                 else NDArray(jnp.asarray(x, jnp.float32))
+                                 for x in inputs])
+
+
+class Distribution:
+    """Base distribution (reference distribution.py Distribution)."""
+
+    has_grad = True
+    support = None
+
+    def __init__(self, **params):
+        # keep the caller's NDArray objects: their tape identity is what
+        # lets gradients flow back to distribution parameters
+        self._nd_params = {
+            k: v if isinstance(v, NDArray)
+            else NDArray(jnp.asarray(v, jnp.float32))
+            for k, v in params.items()}
+        self._params = {k: v._data for k, v in self._nd_params.items()}
+        for k, v in self._nd_params.items():
+            setattr(self, k, v)
+
+    def _p(self, name):
+        return self._params[name]
+
+    def _sample_shape(self, size):
+        base = jnp.broadcast_shapes(*[p.shape for p in
+                                      self._params.values()]) \
+            if self._params else ()
+        if size is None:
+            return base
+        if isinstance(size, int):
+            size = (size,)
+        return tuple(size) + base
+
+    # -- interface --------------------------------------------------------
+    def sample(self, size=None) -> NDArray:
+        key = next_key()
+        shape = self._sample_shape(size)
+        fn = lambda *ps: self._sample_impl(key, shape, *ps)
+        return _op(f"{type(self).__name__}_sample", fn,
+                   list(self._nd_params.values()))
+
+    def sample_n(self, size=None):
+        return self.sample(size)
+
+    def log_prob(self, value) -> NDArray:
+        fn = lambda v, *ps: self._log_prob_impl(v, *ps)
+        return _op(f"{type(self).__name__}_log_prob", fn,
+                   [value] + list(self._nd_params.values()))
+
+    def prob(self, value) -> NDArray:
+        lp = self.log_prob(value)
+        return _op("exp", jnp.exp, [lp])
+
+    def entropy(self) -> NDArray:
+        fn = lambda *ps: self._entropy_impl(*ps)
+        return _op(f"{type(self).__name__}_entropy", fn,
+                   list(self._nd_params.values()))
+
+    @property
+    def mean(self) -> NDArray:
+        return NDArray(self._mean_impl(*self._params.values()))
+
+    @property
+    def variance(self) -> NDArray:
+        return NDArray(self._variance_impl(*self._params.values()))
+
+    # -- per-distribution hooks ------------------------------------------
+    def _sample_impl(self, key, shape, *params):
+        raise NotImplementedError
+
+    def _log_prob_impl(self, value, *params):
+        raise NotImplementedError
+
+    def _entropy_impl(self, *params):
+        raise MXNetError(f"{type(self).__name__} has no closed-form entropy")
+
+    def _mean_impl(self, *params):
+        raise NotImplementedError
+
+    def _variance_impl(self, *params):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def _sample_impl(self, key, shape, loc, scale):
+        return loc + scale * jax.random.normal(key, shape)
+
+    def _log_prob_impl(self, v, loc, scale):
+        z = (v - loc) / scale
+        return -0.5 * z * z - jnp.log(scale) - 0.5 * math.log(2 * math.pi)
+
+    def _entropy_impl(self, loc, scale):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale) \
+            + jnp.zeros_like(loc)
+
+    def _mean_impl(self, loc, scale):
+        return jnp.broadcast_to(loc, jnp.broadcast_shapes(loc.shape,
+                                                          scale.shape))
+
+    def _variance_impl(self, loc, scale):
+        return jnp.broadcast_to(scale * scale,
+                                jnp.broadcast_shapes(loc.shape, scale.shape))
+
+
+class LogNormal(Normal):
+    def _sample_impl(self, key, shape, loc, scale):
+        return jnp.exp(super()._sample_impl(key, shape, loc, scale))
+
+    def _log_prob_impl(self, v, loc, scale):
+        return super()._log_prob_impl(jnp.log(v), loc, scale) - jnp.log(v)
+
+    def _mean_impl(self, loc, scale):
+        return jnp.exp(loc + scale * scale / 2)
+
+    def _variance_impl(self, loc, scale):
+        s2 = scale * scale
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * loc + s2)
+
+    def _entropy_impl(self, loc, scale):
+        return loc + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+
+
+class HalfNormal(Distribution):
+    def __init__(self, scale=1.0):
+        super().__init__(scale=scale)
+
+    def _sample_impl(self, key, shape, scale):
+        return jnp.abs(scale * jax.random.normal(key, shape))
+
+    def _log_prob_impl(self, v, scale):
+        z = v / scale
+        return math.log(2.) - 0.5 * z * z - jnp.log(scale) \
+            - 0.5 * math.log(2 * math.pi)
+
+    def _mean_impl(self, scale):
+        return scale * math.sqrt(2 / math.pi)
+
+    def _variance_impl(self, scale):
+        return scale * scale * (1 - 2 / math.pi)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def _sample_impl(self, key, shape, loc, scale):
+        return loc + scale * jax.random.laplace(key, shape)
+
+    def _log_prob_impl(self, v, loc, scale):
+        return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+    def _entropy_impl(self, loc, scale):
+        return 1 + jnp.log(2 * scale) + jnp.zeros_like(loc)
+
+    def _mean_impl(self, loc, scale):
+        return jnp.broadcast_to(loc, jnp.broadcast_shapes(loc.shape,
+                                                          scale.shape))
+
+    def _variance_impl(self, loc, scale):
+        return 2 * scale * scale + jnp.zeros_like(loc)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def _sample_impl(self, key, shape, loc, scale):
+        return loc + scale * jax.random.cauchy(key, shape)
+
+    def _log_prob_impl(self, v, loc, scale):
+        z = (v - loc) / scale
+        return -jnp.log1p(z * z) - jnp.log(math.pi * 1.0) - jnp.log(scale)
+
+    def _entropy_impl(self, loc, scale):
+        return jnp.log(4 * math.pi * scale) + jnp.zeros_like(loc)
+
+
+class HalfCauchy(Distribution):
+    def __init__(self, scale=1.0):
+        super().__init__(scale=scale)
+
+    def _sample_impl(self, key, shape, scale):
+        return jnp.abs(scale * jax.random.cauchy(key, shape))
+
+    def _log_prob_impl(self, v, scale):
+        z = v / scale
+        return math.log(2 / math.pi) - jnp.log1p(z * z) - jnp.log(scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def _sample_impl(self, key, shape, loc, scale):
+        return loc + scale * jax.random.gumbel(key, shape)
+
+    def _log_prob_impl(self, v, loc, scale):
+        z = (v - loc) / scale
+        return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+    def _entropy_impl(self, loc, scale):
+        return jnp.log(scale) + 1 + _EULER + jnp.zeros_like(loc)
+
+    def _mean_impl(self, loc, scale):
+        return loc + scale * _EULER
+
+    def _variance_impl(self, loc, scale):
+        return (math.pi ** 2 / 6) * scale * scale + jnp.zeros_like(loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low=0.0, high=1.0):
+        super().__init__(low=low, high=high)
+
+    def _sample_impl(self, key, shape, low, high):
+        return jax.random.uniform(key, shape, minval=0., maxval=1.) \
+            * (high - low) + low
+
+    def _log_prob_impl(self, v, low, high):
+        inside = (v >= low) & (v <= high)
+        return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+    def _entropy_impl(self, low, high):
+        return jnp.log(high - low)
+
+    def _mean_impl(self, low, high):
+        return (low + high) / 2
+
+    def _variance_impl(self, low, high):
+        return (high - low) ** 2 / 12
+
+
+class Exponential(Distribution):
+    def __init__(self, scale=1.0):
+        super().__init__(scale=scale)
+
+    def _sample_impl(self, key, shape, scale):
+        return scale * jax.random.exponential(key, shape)
+
+    def _log_prob_impl(self, v, scale):
+        return -v / scale - jnp.log(scale)
+
+    def _entropy_impl(self, scale):
+        return 1 + jnp.log(scale)
+
+    def _mean_impl(self, scale):
+        return scale
+
+    def _variance_impl(self, scale):
+        return scale * scale
+
+
+class Gamma(Distribution):
+    def __init__(self, shape=1.0, scale=1.0):
+        super().__init__(alpha=shape, scale=scale)
+
+    def _sample_impl(self, key, shape, alpha, scale):
+        return scale * jax.random.gamma(key, alpha, shape)
+
+    def _log_prob_impl(self, v, alpha, scale):
+        return (alpha - 1) * jnp.log(v) - v / scale \
+            - lax.lgamma(alpha) - alpha * jnp.log(scale)
+
+    def _mean_impl(self, alpha, scale):
+        return alpha * scale
+
+    def _variance_impl(self, alpha, scale):
+        return alpha * scale * scale
+
+
+class Beta(Distribution):
+    def __init__(self, alpha=1.0, beta=1.0):
+        super().__init__(alpha=alpha, beta=beta)
+
+    def _sample_impl(self, key, shape, alpha, beta):
+        return jax.random.beta(key, alpha, beta, shape)
+
+    def _log_prob_impl(self, v, alpha, beta):
+        lbeta = lax.lgamma(alpha) + lax.lgamma(beta) - lax.lgamma(alpha + beta)
+        return (alpha - 1) * jnp.log(v) + (beta - 1) * jnp.log1p(-v) - lbeta
+
+    def _mean_impl(self, alpha, beta):
+        return alpha / (alpha + beta)
+
+    def _variance_impl(self, alpha, beta):
+        t = alpha + beta
+        return alpha * beta / (t * t * (t + 1))
+
+
+class Chi2(Gamma):
+    def __init__(self, df):
+        Distribution.__init__(self, alpha=_data(df) / 2,
+                              scale=jnp.full_like(_data(df), 2.0))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        super().__init__(df=df, loc=loc, scale=scale)
+
+    def _sample_impl(self, key, shape, df, loc, scale):
+        return loc + scale * jax.random.t(key, df, shape)
+
+    def _log_prob_impl(self, v, df, loc, scale):
+        z = (v - loc) / scale
+        return lax.lgamma((df + 1) / 2) - lax.lgamma(df / 2) \
+            - 0.5 * jnp.log(df * math.pi) - jnp.log(scale) \
+            - (df + 1) / 2 * jnp.log1p(z * z / df)
+
+
+class Weibull(Distribution):
+    def __init__(self, concentration, scale=1.0):
+        super().__init__(k=concentration, scale=scale)
+
+    def _sample_impl(self, key, shape, k, scale):
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        return scale * (-jnp.log(u)) ** (1 / k)
+
+    def _log_prob_impl(self, v, k, scale):
+        z = v / scale
+        return jnp.log(k / scale) + (k - 1) * jnp.log(z) - z ** k
+
+    def _mean_impl(self, k, scale):
+        return scale * jnp.exp(lax.lgamma(1 + 1 / k))
+
+
+class Pareto(Distribution):
+    def __init__(self, alpha, scale=1.0):
+        super().__init__(alpha=alpha, scale=scale)
+
+    def _sample_impl(self, key, shape, alpha, scale):
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        return scale * u ** (-1 / alpha)
+
+    def _log_prob_impl(self, v, alpha, scale):
+        valid = v >= scale
+        lp = jnp.log(alpha) + alpha * jnp.log(scale) - (alpha + 1) * jnp.log(v)
+        return jnp.where(valid, lp, -jnp.inf)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Bernoulli takes exactly one of prob/logit")
+        if prob is None:
+            prob = jax.nn.sigmoid(_data(logit))
+        super().__init__(prob=prob)
+
+    def _sample_impl(self, key, shape, prob):
+        return jax.random.bernoulli(key, prob, shape).astype(jnp.float32)
+
+    def _log_prob_impl(self, v, prob):
+        eps = 1e-7
+        p = jnp.clip(prob, eps, 1 - eps)
+        return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+    def _entropy_impl(self, prob):
+        eps = 1e-7
+        p = jnp.clip(prob, eps, 1 - eps)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    def _mean_impl(self, prob):
+        return prob
+
+    def _variance_impl(self, prob):
+        return prob * (1 - prob)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k ∈ {0,1,...}."""
+
+    def __init__(self, prob):
+        super().__init__(prob=prob)
+
+    def _sample_impl(self, key, shape, prob):
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-prob))
+
+    def _log_prob_impl(self, v, prob):
+        return v * jnp.log1p(-prob) + jnp.log(prob)
+
+    def _mean_impl(self, prob):
+        return (1 - prob) / prob
+
+    def _variance_impl(self, prob):
+        return (1 - prob) / (prob * prob)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        super().__init__(rate=rate)
+
+    def _sample_impl(self, key, shape, rate):
+        return jax.random.poisson(key, rate, shape).astype(jnp.float32)
+
+    def _log_prob_impl(self, v, rate):
+        return v * jnp.log(rate) - rate - lax.lgamma(v + 1)
+
+    def _mean_impl(self, rate):
+        return rate
+
+    def _variance_impl(self, rate):
+        return rate
+
+
+class Categorical(Distribution):
+    """Integer-class distribution over the last axis of prob/logit."""
+
+    def __init__(self, prob=None, logit=None, num_events=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Categorical takes exactly one of prob/logit")
+        logit = jnp.log(jnp.clip(_data(prob), 1e-30)) if logit is None \
+            else _data(logit)
+        super().__init__(logit=logit)
+        self.num_events = num_events or logit.shape[-1]
+
+    def _sample_shape(self, size):
+        base = self._p("logit").shape[:-1]
+        if size is None:
+            return base
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return size + base
+
+    def _sample_impl(self, key, shape, logit):
+        return jax.random.categorical(key, logit, axis=-1,
+                                      shape=shape).astype(jnp.float32)
+
+    def _log_prob_impl(self, v, logit):
+        logp = jax.nn.log_softmax(logit, axis=-1)
+        idx = v.astype(jnp.int32)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(logp, v.shape + (logp.shape[-1],)),
+            idx[..., None], axis=-1)[..., 0]
+
+    def _entropy_impl(self, logit):
+        logp = jax.nn.log_softmax(logit, axis=-1)
+        return -(jnp.exp(logp) * logp).sum(-1)
+
+    @property
+    def prob(self):
+        return NDArray(jax.nn.softmax(self._p("logit"), axis=-1))
+
+
+class OneHotCategorical(Categorical):
+    def _sample_impl(self, key, shape, logit):
+        idx = jax.random.categorical(key, logit, axis=-1, shape=shape)
+        return jax.nn.one_hot(idx, logit.shape[-1])
+
+    def _sample_shape(self, size):
+        return super()._sample_shape(size)
+
+    def _log_prob_impl(self, v, logit):
+        logp = jax.nn.log_softmax(logit, axis=-1)
+        return (v * logp).sum(-1)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, alpha):
+        super().__init__(alpha=alpha)
+
+    def _sample_shape(self, size):
+        base = self._p("alpha").shape
+        if size is None:
+            return base
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return size + base
+
+    def _sample_impl(self, key, shape, alpha):
+        g = jax.random.gamma(key, jnp.broadcast_to(alpha, shape))
+        return g / g.sum(-1, keepdims=True)
+
+    def _log_prob_impl(self, v, alpha):
+        lnorm = lax.lgamma(alpha).sum(-1) - lax.lgamma(alpha.sum(-1))
+        return ((alpha - 1) * jnp.log(v)).sum(-1) - lnorm
+
+    def _mean_impl(self, alpha):
+        return alpha / alpha.sum(-1, keepdims=True)
+
+
+class MultivariateNormal(Distribution):
+    """MVN parameterized by loc and covariance (or scale_tril)."""
+
+    def __init__(self, loc, cov=None, scale_tril=None):
+        if (cov is None) == (scale_tril is None):
+            raise MXNetError("MultivariateNormal takes one of cov/scale_tril")
+        tril = jnp.linalg.cholesky(_data(cov)) if scale_tril is None \
+            else _data(scale_tril)
+        super().__init__(loc=loc, scale_tril=tril)
+
+    def _sample_shape(self, size):
+        base = jnp.broadcast_shapes(self._p("loc").shape,
+                                    self._p("scale_tril").shape[:-1])
+        if size is None:
+            return base
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return size + base
+
+    def _sample_impl(self, key, shape, loc, tril):
+        eps = jax.random.normal(key, shape)
+        return loc + jnp.einsum("...ij,...j->...i", tril, eps)
+
+    def _log_prob_impl(self, v, loc, tril):
+        d = v.shape[-1]
+        diff = v - loc
+        sol = jax.scipy.linalg.solve_triangular(tril, diff[..., None],
+                                                lower=True)[..., 0]
+        logdet = jnp.log(jnp.abs(jnp.diagonal(tril, axis1=-2,
+                                              axis2=-1))).sum(-1)
+        return -0.5 * (sol * sol).sum(-1) - logdet \
+            - 0.5 * d * math.log(2 * math.pi)
+
+    def _mean_impl(self, loc, tril):
+        return loc
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference probability/distributions/divergence.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> NDArray:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (tp, tq), f in _KL_REGISTRY.items():
+            if isinstance(p, tp) and isinstance(q, tq):
+                fn = f
+                break
+    if fn is None:
+        raise MXNetError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        vr = (ps / qs) ** 2
+        return 0.5 * (vr + ((pl - ql) / qs) ** 2 - 1 - jnp.log(vr))
+    return _op("kl_normal", fn, [p.loc, p.scale, q.loc, q.scale])
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qp):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return pp * (jnp.log(pp) - jnp.log(qp)) + \
+            (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+    return _op("kl_bernoulli", fn, [p.prob, q.prob])
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def fn(pl, ql):
+        plog = jax.nn.log_softmax(pl, -1)
+        qlog = jax.nn.log_softmax(ql, -1)
+        return (jnp.exp(plog) * (plog - qlog)).sum(-1)
+    return _op("kl_categorical", fn, [p.logit, q.logit])
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def fn(ps, qs):
+        r = qs / ps
+        return jnp.log(r) + 1 / r - 1
+    return _op("kl_exponential", fn, [p.scale, q.scale])
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def fn(pl, ph, ql, qh):
+        inside = (ql <= pl) & (qh >= ph)
+        return jnp.where(inside, jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+    return _op("kl_uniform", fn, [p.low, p.high, q.low, q.high])

@@ -1,0 +1,3 @@
+"""Image processing API (reference: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from .image import __all__  # noqa: F401

@@ -1,0 +1,330 @@
+"""Image ops + augmenters (reference: python/mxnet/image/image.py — imdecode/
+imresize/crops/jitter augmenters + CreateAugmenter, backed by OpenCV in the
+reference).
+
+TPU-native notes: decode uses PIL when present (OpenCV is not in this
+environment) with a raw-array fallback; resize lowers to ``jax.image.resize``
+(an XLA program — runs on TPU for on-device preprocessing); augmenters are
+numpy/NDArray transforms applied CPU-side in the data pipeline.
+"""
+from __future__ import annotations
+
+import io as _io
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["imdecode", "imresize", "imresize_np", "imdecode_or_raw",
+           "resize_short", "fixed_crop", "center_crop", "random_crop",
+           "color_normalize", "random_size_crop", "Augmenter",
+           "SequentialAug", "ResizeAug", "ForceResizeAug", "CastAug",
+           "HorizontalFlipAug", "RandomCropAug", "CenterCropAug",
+           "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "RandomGrayAug", "CreateAugmenter"]
+
+
+def _as_np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
+
+
+def imdecode(buf, flag: int = 1, to_rgb: bool = True) -> NDArray:
+    """Decode an encoded image buffer to HWC uint8 (reference imdecode)."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("imdecode requires PIL in this environment") from e
+    im = Image.open(_io.BytesIO(bytes(buf)))
+    if flag == 0:
+        im = im.convert("L")
+        arr = onp.asarray(im)[..., None]
+    else:
+        im = im.convert("RGB")
+        arr = onp.asarray(im)
+        if not to_rgb:
+            arr = arr[..., ::-1]
+    return nd_array(arr)
+
+
+def imdecode_or_raw(payload: bytes, data_shape) -> onp.ndarray:
+    """Decode via PIL, else interpret payload as a raw CHW/HWC uint8/float32
+    array of ``data_shape`` (the framework's synthetic-record escape used by
+    tests and im2rec-less pipelines)."""
+    try:
+        from PIL import Image
+        im = Image.open(_io.BytesIO(payload)).convert("RGB")
+        return onp.asarray(im)
+    except Exception:
+        c, h, w = data_shape
+        n = c * h * w
+        if len(payload) == n:  # uint8 CHW
+            return onp.frombuffer(payload, onp.uint8).reshape(
+                c, h, w).transpose(1, 2, 0).astype("float32")
+        if len(payload) == 4 * n:  # float32 CHW
+            return onp.frombuffer(payload, onp.float32).reshape(
+                c, h, w).transpose(1, 2, 0)
+        raise MXNetError(
+            f"cannot decode record payload of {len(payload)} bytes")
+
+
+def imresize_np(src: onp.ndarray, w: int, h: int,
+                interp: int = 1) -> onp.ndarray:
+    method = "nearest" if interp == 0 else "linear"
+    out = jax.image.resize(jnp.asarray(src, jnp.float32),
+                           (h, w, src.shape[2]), method=method)
+    return onp.asarray(out)
+
+
+def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+    """Resize HWC image (reference imresize; lowers to jax.image.resize)."""
+    return nd_array(imresize_np(_as_np(src).astype("float32"), w, h, interp))
+
+
+def resize_short(src, size: int, interp: int = 2) -> NDArray:
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0: int, y0: int, w: int, h: int, size=None,
+               interp: int = 2) -> NDArray:
+    img = _as_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        return imresize(img, size[0], size[1], interp)
+    return nd_array(img)
+
+
+def center_crop(src, size, interp: int = 2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    ow, oh = size
+    x0 = max(0, (w - ow) // 2)
+    y0 = max(0, (h - oh) // 2)
+    out = fixed_crop(img, x0, y0, min(ow, w), min(oh, h), size, interp)
+    return out, (x0, y0, ow, oh)
+
+
+def random_crop(src, size, interp: int = 2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    ow, oh = min(size[0], w), min(size[1], h)
+    x0 = pyrandom.randint(0, w - ow)
+    y0 = pyrandom.randint(0, h - oh)
+    out = fixed_crop(img, x0, y0, ow, oh, size, interp)
+    return out, (x0, y0, ow, oh)
+
+
+def random_size_crop(src, size, area, ratio, interp: int = 2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(*area) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        ar = onp.exp(pyrandom.uniform(*log_ratio))
+        ow = int(round(onp.sqrt(target_area * ar)))
+        oh = int(round(onp.sqrt(target_area / ar)))
+        if ow <= w and oh <= h:
+            x0 = pyrandom.randint(0, w - ow)
+            y0 = pyrandom.randint(0, h - oh)
+            return fixed_crop(img, x0, y0, ow, oh, size, interp), \
+                (x0, y0, ow, oh)
+    return center_crop(img, size, interp)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    img = _as_np(src).astype("float32") - _as_np(mean)
+    if std is not None:
+        img = img / _as_np(std)
+    return nd_array(img)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference image.py Augmenter hierarchy)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src: NDArray) -> NDArray:
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts: List[Augmenter]):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size: int, interp: int = 2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp: int = 2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ: str = "float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd_array(_as_np(src).astype(self.typ))
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p: float = 0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return nd_array(_as_np(src)[:, ::-1].copy())
+        return src if isinstance(src, NDArray) else nd_array(src)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp: int = 2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp: int = 2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean, self.std = onp.asarray(mean, "float32"), \
+            onp.asarray(std, "float32") if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness: float):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return nd_array(_as_np(src).astype("float32") * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _COEF = onp.array([0.299, 0.587, 0.114], "float32")
+
+    def __init__(self, contrast: float):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _as_np(src).astype("float32")
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray_mean = (img * self._COEF).sum(-1).mean()
+        return nd_array(img * alpha + gray_mean * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _COEF = ContrastJitterAug._COEF
+
+    def __init__(self, saturation: float):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _as_np(src).astype("float32")
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (img * self._COEF).sum(-1, keepdims=True)
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class RandomGrayAug(Augmenter):
+    _COEF = ContrastJitterAug._COEF
+
+    def __init__(self, p: float = 0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        img = _as_np(src).astype("float32")
+        if pyrandom.random() < self.p:
+            gray = (img * self._COEF).sum(-1, keepdims=True)
+            img = onp.broadcast_to(gray, img.shape).copy()
+        return nd_array(img)
+
+
+def CreateAugmenter(data_shape, resize: int = 0, rand_crop: bool = False,
+                    rand_resize: bool = False, rand_mirror: bool = False,
+                    mean=None, std=None, brightness: float = 0,
+                    contrast: float = 0, saturation: float = 0,
+                    rand_gray: float = 0, inter_method: int = 2
+                    ) -> List[Augmenter]:
+    """Build the standard augmenter list (reference CreateAugmenter)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomCropAug(crop_size, inter_method))  # simplified
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if rand_gray:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53], "float32")
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375], "float32")
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist

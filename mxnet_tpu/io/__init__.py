@@ -1,0 +1,17 @@
+"""Legacy data-iterator API (reference: python/mxnet/io/ + src/io/).
+
+``DataIter`` subclasses yield ``DataBatch`` objects with ``provide_data``/
+``provide_label`` descriptors — the pre-Gluon input pipeline the reference
+keeps for compatibility (io.py DataIter/NDArrayIter/CSVIter and the C++
+MXDataIter iterators registered via MXNET_REGISTER_IO_ITER).
+
+TPU-native notes: ``ImageRecordIter`` reads dmlc RecordIO through the
+native C++ prefetcher thread (src/native/recordio.cc — the role of the
+reference's iter_image_recordio_2.cc decode/prefetch pipeline), decoding
+and augmenting in Python via mx.image.
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,  # noqa: F401
+                 ResizeIter, PrefetchingIter, ImageRecordIter, MXDataIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MXDataIter"]

@@ -1,0 +1,386 @@
+"""DataIter implementations (reference: python/mxnet/io/io.py).
+
+Cites: DataBatch/DataDesc (io.py:81,36), DataIter (io.py:202), NDArrayIter
+(utils.py/io.py:683), CSVIter + ImageRecordIter (C++ iterators surfaced as
+MXDataIter, src/io/iter_csv.cc / iter_image_recordio_2.cc:887).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+from .. import recordio as rio
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MXDataIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Data descriptor (reference io.py:36); dtype/layout as attributes."""
+
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        self = super().__new__(cls, name, tuple(shape))
+        self.dtype = dtype
+        self.layout = layout
+        return self
+
+
+class DataBatch:
+    """One batch: data/label lists + pad/index bookkeeping (io.py:81)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference io.py:202): next/reset/iter protocol."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return []
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return []
+
+
+def _to_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays with pad/discard/roll_over last-batch
+    handling and optional shuffle (reference io.py:683 NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size: int = 1,
+                 shuffle: bool = False, last_batch_handle: str = "pad",
+                 data_name: str = "data", label_name: str = "softmax_label"):
+        super().__init__(batch_size)
+        self.data = self._canonize(data, data_name)
+        self.label = self._canonize(label, label_name) if label is not None \
+            else []
+        self.shuffle = shuffle
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError("last_batch_handle must be pad/discard/roll_over")
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.data[0][1].shape[0]
+        self._idx = onp.arange(self.num_data)
+        self.cursor = 0
+        self.reset()
+
+    @staticmethod
+    def _canonize(data, default_name):
+        if data is None:
+            return []
+        if isinstance(data, (onp.ndarray, NDArray)):
+            return [(default_name, _to_numpy(data))]
+        if isinstance(data, dict):
+            return [(k, _to_numpy(v)) for k, v in sorted(data.items())]
+        if isinstance(data, (list, tuple)):
+            return [(f"{default_name}_{i}" if i else default_name,
+                     _to_numpy(v)) for i, v in enumerate(data)]
+        raise MXNetError(f"unsupported data type {type(data)}")
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:],
+                         dtype=str(a.dtype)) for n, a in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:],
+                         dtype=str(a.dtype)) for n, a in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self._idx)
+        self.cursor = 0
+
+    def next(self) -> DataBatch:
+        if self.cursor >= self.num_data:
+            raise StopIteration
+        end = self.cursor + self.batch_size
+        pad = 0
+        if end > self.num_data:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            pad = end - self.num_data
+            if self.last_batch_handle == "roll_over":
+                idx = onp.concatenate([self._idx[self.cursor:],
+                                       self._idx[:pad]])
+            else:  # pad: repeat from the front
+                idx = onp.concatenate([self._idx[self.cursor:],
+                                       self._idx[:pad]])
+        else:
+            idx = self._idx[self.cursor:end]
+        self.cursor = end
+        data = [nd_array(a[idx]) for _, a in self.data]
+        label = [nd_array(a[idx]) for _, a in self.label]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference src/io/iter_csv.cc surfaced via MXDataIter):
+    row-major float CSV; ``data_shape`` reshapes each row."""
+
+    def __init__(self, data_csv: str, data_shape, batch_size: int,
+                 label_csv: Optional[str] = None, label_shape=(1,),
+                 round_batch: bool = True):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype="float32", ndmin=2)
+        self._data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype="float32",
+                                ndmin=2).reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(
+            self._data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ResizeIter(DataIter):
+    """Truncates/extends an iterator to ``size`` batches (io.py ResizeIter)."""
+
+    def __init__(self, data_iter: DataIter, size: int,
+                 reset_internal: bool = True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch wrapper (reference io.py PrefetchingIter /
+    C++ iter_prefetcher.h): overlaps batch production with consumption."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        it = iters[0] if isinstance(iters, (list, tuple)) else iters
+        super().__init__(it.batch_size)
+        self.iter = it
+        self._queue = collections.deque()
+        self._sem = threading.Semaphore(0)
+        self._space = threading.Semaphore(prefetch_depth)
+        self._lock = threading.Lock()
+        self._done = False
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        self._done = False
+
+        def loop():
+            while True:
+                self._space.acquire()
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    with self._lock:
+                        self._queue.append(None)
+                    self._sem.release()
+                    return
+                with self._lock:
+                    self._queue.append(batch)
+                self._sem.release()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def reset(self):
+        if self._thread is not None:
+            # drain current producer
+            while self._thread.is_alive():
+                self._space.release()
+                self._thread.join(timeout=0.01)
+        self._queue.clear()
+        self._sem = threading.Semaphore(0)
+        self._space = threading.Semaphore(2)
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        self._sem.acquire()
+        with self._lock:
+            batch = self._queue.popleft()
+        self._space.release()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+
+class ImageRecordIter(DataIter):
+    """Image RecordIO iterator (reference ImageRecordIter,
+    src/io/iter_image_recordio_2.cc:887): records are IRHeader-packed
+    encoded images; reading is done by the native C++ prefetcher thread,
+    decode + augment + batch in Python (mx.image)."""
+
+    def __init__(self, path_imgrec: str, data_shape, batch_size: int,
+                 label_width: int = 1, shuffle: bool = False,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 mean_r: float = 0., mean_g: float = 0., mean_b: float = 0.,
+                 std_r: float = 1., std_g: float = 1., std_b: float = 1.,
+                 preprocess_threads: int = 4, prefetch_buffer: int = 64,
+                 round_batch: bool = True, **kwargs):
+        super().__init__(batch_size)
+        self.path_imgrec = path_imgrec
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = onp.array([mean_r, mean_g, mean_b], "float32")
+        self.std = onp.array([std_r, std_g, std_b], "float32")
+        self.prefetch_buffer = prefetch_buffer
+        self.round_batch = round_batch
+        self._reader = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size, self.label_width)
+                         if self.label_width > 1 else (self.batch_size,))]
+
+    def reset(self):
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except Exception:
+                pass
+        from .. import _native
+        if _native.available():
+            self._reader = _native.NativePrefetchReader(
+                self.path_imgrec, capacity=self.prefetch_buffer)
+            self._read = self._reader.read
+        else:
+            self._reader = rio.MXRecordIO(self.path_imgrec, "r")
+            self._read = self._reader.read
+
+    def _decode_one(self, rec: bytes):
+        from .. import image as img_mod
+        header, payload = rio.unpack(rec)
+        c, h, w = self.data_shape
+        img = img_mod.imdecode_or_raw(payload, self.data_shape)
+        arr = img.astype("float32")  # HWC
+        if arr.shape[0] != h or arr.shape[1] != w:
+            arr = img_mod.imresize_np(arr, w, h)
+        if self.rand_mirror and onp.random.rand() < 0.5:
+            arr = arr[:, ::-1]
+        arr = (arr - self.mean) / self.std
+        label = header.label
+        if isinstance(label, onp.ndarray):
+            lab = label[:self.label_width]
+        else:
+            lab = onp.array([label], "float32")[:self.label_width]
+        return arr.transpose(2, 0, 1), lab  # CHW
+
+    def next(self) -> DataBatch:
+        datas, labels = [], []
+        while len(datas) < self.batch_size:
+            rec = self._read()
+            if rec is None:
+                break
+            d, l = self._decode_one(rec)
+            datas.append(d)
+            labels.append(l)
+        if not datas:
+            raise StopIteration
+        pad = self.batch_size - len(datas)
+        if pad and not self.round_batch:
+            raise StopIteration
+        while len(datas) < self.batch_size:  # pad by repeating
+            datas.append(datas[-1])
+            labels.append(labels[-1])
+        data = nd_array(onp.stack(datas))
+        lab = onp.stack(labels)
+        if self.label_width == 1:
+            lab = lab[:, 0]
+        return DataBatch([data], [nd_array(lab)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+# reference exposes C++ iterators through MXDataIter; our native-backed
+# iterators are constructed directly, so the alias points at the closest one
+MXDataIter = ImageRecordIter

@@ -187,3 +187,176 @@ def cond(pred, then_func, else_func, inputs=None):
     res = invoke_raw("_cond", fn, [pred] + ins, n_outputs=n_o)
     res = list(res) if isinstance(res, tuple) else [res]
     return res if out_is_list else res[0]
+
+
+# ---------------------------------------------------------------------------
+# Bounding-box / detection ops
+# Reference analog: src/operator/contrib/bounding_box.cc (box_iou, box_nms)
+# and src/operator/contrib/roi_align.cc. TPU-native: fixed-shape vectorized
+# jnp programs — NMS is a masked greedy scan (static trip count compiles to
+# one XLA program; the reference's CUDA kernel sorted + suppressed in-place).
+# ---------------------------------------------------------------------------
+
+__all__ += ["box_iou", "box_nms", "ROIAlign"]
+
+
+def _corner_iou(a, b):
+    """IoU between (..., N, 4) and (..., M, 4) corner boxes → (..., N, M)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.clip(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * \
+        jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * \
+        jnp.clip(b[..., 3] - b[..., 1], 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+def _to_corner(x, fmt):
+    if fmt == "corner":
+        return x
+    # center: (cx, cy, w, h) -> (x1, y1, x2, y2)
+    half = x[..., 2:] / 2
+    return jnp.concatenate([x[..., :2] - half, x[..., :2] + half], -1)
+
+
+def _to_center(x):
+    # corner (x1, y1, x2, y2) -> (cx, cy, w, h)
+    wh = x[..., 2:] - x[..., :2]
+    return jnp.concatenate([x[..., :2] + wh / 2, wh], -1)
+
+
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference _contrib_box_iou, bounding_box.cc)."""
+    def fn(a, b):
+        return _corner_iou(_to_corner(a, format), _to_corner(b, format))
+    return invoke_raw("box_iou", fn, [lhs, rhs])
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference _contrib_box_nms,
+    bounding_box.cc): rows are [id, score, x1, y1, x2, y2, ...]; suppressed
+    rows have all entries set to -1. Batch-aware on (B, N, K) or (N, K)."""
+    def fn(x):
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        b, n, k = x.shape
+        scores = x[..., score_index]
+        ids = x[..., id_index] if id_index >= 0 else jnp.zeros_like(scores)
+        boxes = _to_corner(
+            lax.dynamic_slice_in_dim(x, coord_start, 4, axis=2), in_format)
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=1)
+        if topk > 0:
+            keep_rank = jnp.arange(n) < topk
+        else:
+            keep_rank = jnp.ones((n,), bool)
+        sboxes = jnp.take_along_axis(boxes, order[..., None], 1)
+        svalid = jnp.take_along_axis(valid, order, 1) & keep_rank[None, :]
+        sids = jnp.take_along_axis(ids, order, 1)
+        iou = _corner_iou(sboxes, sboxes)          # (b, n, n)
+        same_cls = (sids[..., :, None] == sids[..., None, :]) | force_suppress
+
+        def body(i, keep):
+            sup = (iou[:, i] > overlap_thresh) & same_cls[:, i] & \
+                keep[:, i][:, None] & svalid[:, i][:, None] & \
+                (jnp.arange(n) > i)[None, :]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, n, body, jnp.ones((b, n), bool))
+        keep = keep & svalid
+        sx = jnp.take_along_axis(x, order[..., None], 1)
+        if in_format != out_format:
+            coords = lax.dynamic_slice_in_dim(sx, coord_start, 4, axis=2)
+            coords = _to_corner(coords, in_format) if out_format == "corner" \
+                else _to_center(coords)
+            sx = lax.dynamic_update_slice_in_dim(sx, coords, coord_start,
+                                                 axis=2)
+        out = jnp.where(keep[..., None], sx, -jnp.ones_like(sx))
+        return out[0] if squeeze else out
+
+    return invoke_raw("box_nms", fn, [data])
+
+
+def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=2,
+             position_sensitive=False):
+    """ROI Align with bilinear sampling (reference roi_align.cc; Mask R-CNN
+    semantics: no coordinate rounding, out-of-image samples contribute
+    zero, negative batch index → all-zero output for that ROI).
+
+    data (N, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords. Plain: out (R, C, PH, PW). ``position_sensitive``: channels
+    are grouped per output bin (C must be divisible by PH*PW) and out is
+    (R, C/(PH*PW), PH, PW) — PS-ROIAlign.
+
+    ``sample_ratio <= 0``: the reference samples ceil(roi/pooled) points
+    per bin *per ROI* (dynamic); XLA needs a static grid, so this build
+    uses the feature-map upper bound ceil(H/PH) × ceil(W/PW) — at least as
+    dense as the reference everywhere.
+    """
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+
+    def fn(x, r):
+        n, c, h, w = x.shape
+        if sample_ratio > 0:
+            sry = srx = int(sample_ratio)
+        else:
+            sry = max(1, -(-h // ph))
+            srx = max(1, -(-w // pw))
+        if position_sensitive and c % (ph * pw):
+            raise MXNetError(f"position_sensitive needs channels ({c}) "
+                             f"divisible by PH*PW ({ph * pw})")
+
+        def one_roi(roi):
+            bi = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = [roi[i + 1] * spatial_scale for i in range(4)]
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            bin_w, bin_h = rw / pw, rh / ph
+            gy = (y1 + (jnp.arange(ph)[:, None] +
+                        (jnp.arange(sry)[None, :] + 0.5) / sry) * bin_h
+                  ).reshape(-1)                    # (ph*sry,)
+            gx = (x1 + (jnp.arange(pw)[:, None] +
+                        (jnp.arange(srx)[None, :] + 0.5) / srx) * bin_w
+                  ).reshape(-1)                    # (pw*srx,)
+            img = x[jnp.clip(bi, 0, n - 1)]        # (c, h, w)
+
+            # reference bilinear_interpolate: points past [-1, size] are 0
+            in_y = (gy >= -1.0) & (gy <= h)
+            in_x = (gx >= -1.0) & (gx <= w)
+            cy = jnp.clip(gy, 0, h - 1)
+            cx = jnp.clip(gx, 0, w - 1)
+            y0 = jnp.floor(cy)
+            x0 = jnp.floor(cx)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            wy = cy - y0
+            wx = cx - x0
+            r0 = img[:, y0i]                       # (c, ph*sry, w)
+            r1 = img[:, y1i]
+            top = r0[:, :, x0i] * (1 - wx) + r0[:, :, x1i] * wx
+            bot = r1[:, :, x0i] * (1 - wx) + r1[:, :, x1i] * wx
+            val = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+            val = val * (in_y[:, None] & in_x[None, :])[None]
+            val = jnp.where(bi >= 0, val, 0.0)     # padded ROI → zeros
+            val = val.reshape(c, ph, sry, pw, srx).mean((2, 4))
+            if position_sensitive:
+                cg = c // (ph * pw)
+                # channel block (i,j) feeds output bin (i,j)
+                val = val.reshape(ph, pw, cg, ph, pw)
+                ii = jnp.arange(ph)[:, None]
+                jj = jnp.arange(pw)[None, :]
+                val = val[ii, jj, :, ii, jj]       # (ph, pw, cg)
+                val = jnp.moveaxis(val, -1, 0)
+            return val
+
+        return jax.vmap(one_roi)(r)
+
+    return invoke_raw("ROIAlign", fn, [data, rois])

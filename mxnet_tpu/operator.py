@@ -1,0 +1,186 @@
+"""Custom operators in Python (reference: python/mxnet/operator.py +
+src/operator/custom/custom-inl.h).
+
+Reference mechanics: ``CustomOp`` (forward/backward mutating out buffers via
+``assign``), ``CustomOpProp`` (shape/type inference + operator factory),
+``mx.operator.register``; the C++ side runs Python callbacks on a dedicated
+worker pool so they never block engine threads (custom-inl.h:52,103).
+
+TPU-native redesign: the host escape is ``jax.pure_callback`` — the same op
+works eagerly AND inside a jit/hybridized trace (XLA calls back to host),
+which is the role the reference's callback thread pool played. Autograd
+rides the tape with a custom vjp that invokes ``backward`` through the same
+escape. The fwd/bwd contract is stateless: ``backward`` receives in_data and
+out_data again rather than instance state (instances are not shared between
+traced executions).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, jx_dtype
+from .ndarray.ndarray import NDArray
+from .ops.registry import invoke_raw
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "Custom", "get_all_registered"]
+
+_CUSTOM_OPS: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for custom operator implementations (reference
+    operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError(
+            f"{type(self).__name__} does not implement backward")
+
+    def assign(self, dst: NDArray, req: str, src):
+        """Write ``src`` into ``dst`` honoring grad_req semantics."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Describes a custom op: arity, shapes, types, and the operator
+    factory (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs: Dict[str, str] = {}
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Class decorator registering a CustomOpProp under ``reg_name``
+    (reference mx.operator.register). The op is then invocable as
+    ``mx.nd.Custom(*inputs, op_type=reg_name, **kwargs)``."""
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_OPS[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_CUSTOM_OPS)
+
+
+def _run_forward(prop, op, out_shapes, out_dtypes, is_train, *np_inputs):
+    ins = [NDArray(jnp.asarray(a)) for a in np_inputs]
+    outs = [NDArray(jnp.zeros(s, d)) for s, d in zip(out_shapes, out_dtypes)]
+    op.forward(is_train=is_train, req=["write"] * len(outs),
+               in_data=ins, out_data=outs, aux=[])
+    return tuple(onp.asarray(o._data, dtype=d)
+                 for o, d in zip(outs, out_dtypes))
+
+
+def _run_backward(prop, op, in_shapes, in_dtypes, n_in, n_out, *np_args):
+    np_grads = np_args[:n_out]
+    np_ins = np_args[n_out:n_out + n_in]
+    np_outs = np_args[n_out + n_in:]
+    ograds = [NDArray(jnp.asarray(a)) for a in np_grads]
+    ins = [NDArray(jnp.asarray(a)) for a in np_ins]
+    outs = [NDArray(jnp.asarray(a)) for a in np_outs]
+    igrads = [NDArray(jnp.zeros(s, d)) for s, d in zip(in_shapes, in_dtypes)]
+    op.backward(req=["write"] * n_in, out_grad=ograds, in_data=ins,
+                out_data=outs, in_grad=igrads, aux=[])
+    return tuple(onp.asarray(g._data, dtype=d)
+                 for g, d in zip(igrads, in_dtypes))
+
+
+def _make_custom_fn(prop, op, in_shapes, in_dtypes, out_shapes, out_dtypes,
+                    is_train):
+    """Pure jax function (pure_callback escape) with custom vjp."""
+    out_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                       for s, d in zip(out_shapes, out_dtypes))
+    in_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                      for s, d in zip(in_shapes, in_dtypes))
+
+    @jax.custom_vjp
+    def custom_fn(*xs):
+        return jax.pure_callback(
+            functools.partial(_run_forward, prop, op, out_shapes, out_dtypes,
+                              is_train), out_struct, *xs)
+
+    def fwd(*xs):
+        ys = custom_fn(*xs)
+        return ys, (xs, ys)
+
+    def bwd(res, gs):
+        xs, ys = res
+        gs = gs if isinstance(gs, tuple) else (gs,)
+        return jax.pure_callback(
+            functools.partial(_run_backward, prop, op, in_shapes, in_dtypes,
+                              len(xs), len(gs)), in_struct, *gs, *xs, *ys)
+
+    custom_fn.defvjp(fwd, bwd)
+    return custom_fn
+
+
+def Custom(*data, op_type: str, **kwargs):
+    """Invoke a registered custom op on NDArrays (reference mx.nd.Custom)."""
+    if op_type not in _CUSTOM_OPS:
+        raise MXNetError(f"custom op {op_type!r} is not registered")
+    from . import _tape
+    cls = _CUSTOM_OPS[op_type]
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    try:
+        prop = cls(**str_kwargs)  # reference passes attrs as strings
+    except TypeError:
+        prop = cls()
+    prop.kwargs = str_kwargs
+
+    in_shapes = [d.shape for d in data]
+    in_dtypes = [onp.dtype(d.dtype) for d in data]
+    _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+    it, ot, _ = prop.infer_type(list(in_dtypes))
+    out_dtypes = [onp.dtype(t) for t in ot]
+    op = prop.create_operator(None, in_shapes, in_dtypes)
+    is_train = _tape.is_recording()
+
+    fn = _make_custom_fn(prop, op, in_shapes, in_dtypes, out_shapes,
+                         out_dtypes, is_train)
+    n_out = len(out_shapes)
+    if n_out == 1:
+        return invoke_raw(f"Custom[{op_type}]",
+                          lambda *xs: fn(*xs)[0], list(data))
+    return invoke_raw(f"Custom[{op_type}]", fn, list(data), n_outputs=n_out)
+
+
+# expose mx.nd.Custom like the reference's generated wrapper
+from . import ndarray as _nd_mod  # noqa: E402
+_nd_mod.Custom = Custom

@@ -17,9 +17,24 @@ from .. import _tape, engine
 from ..base import MXNetError
 
 __all__ = ["Op", "register", "get_op", "invoke", "invoke_raw", "list_ops",
-           "set_np_ndarray_cls"]
+           "set_np_ndarray_cls", "add_invoke_wrapper", "remove_invoke_wrapper"]
 
 _OP_REGISTRY: Dict[str, "Op"] = {}
+
+# Cross-cutting hooks on the imperative invoke funnel (profiler timing, AMP
+# dtype casting). Each wrapper is fn(op_name, kernel) -> kernel'. The analog
+# of the reference's engine-level profiler hooks (threaded_engine.h:85) and
+# AMP op patching (contrib/amp/amp.py:282).
+_INVOKE_WRAPPERS: List = []
+
+
+def add_invoke_wrapper(wrapper):
+    _INVOKE_WRAPPERS.append(wrapper)
+
+
+def remove_invoke_wrapper(wrapper):
+    if wrapper in _INVOKE_WRAPPERS:
+        _INVOKE_WRAPPERS.remove(wrapper)
 
 # The mx.np ndarray class, registered by mxnet_tpu.numpy at import. When any
 # input to an op is an mx.np array, outputs are mx.np arrays — the analog of
@@ -93,6 +108,8 @@ def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
         cls = NDArray
         if _NP_CLS is not None and any(isinstance(x, _NP_CLS) for x in inputs):
             cls = _NP_CLS
+    for _w in _INVOKE_WRAPPERS:
+        fn = _w(name, fn)
     in_datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
     should_record = _tape.is_recording() if record is None else record
 

@@ -1,0 +1,194 @@
+"""Profiler: per-op event recording + Chrome-trace dump + XProf bridge.
+
+Reference analog: src/profiler/ (Profiler singleton with mode bitmask,
+per-device stat queues, Chrome tracing JSON via DumpProfile — profiler.h:251,
+:299) and python/mxnet/profiler.py (set_config/set_state/dump/dumps).
+
+TPU-native split: XLA owns device-side timing, so device kernels are
+profiled with the JAX/XProf tracer (``tensorboard_dir`` option → TensorBoard
+'Profile' tab). What this module records natively is the *host-side* op
+stream — every imperative invoke, with dispatch wall time — dumped in Chrome
+tracing format (chrome://tracing / Perfetto), plus aggregate tables like the
+reference's ``dumps(); aggregate_stats=True``.
+
+Async caveat (same as the reference's "dispatch vs run" distinction): under
+the default async engine an event's duration is host dispatch time; run with
+MXNET_ENGINE_TYPE=NaiveEngine (every op synchronous) for true per-op wall
+time on small workloads.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "scope", "Profiler"]
+
+
+class Profiler:
+    """Process-global profiler (reference Profiler singleton)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.filename = "profile.json"
+        self.aggregate_stats = False
+        self.tensorboard_dir: Optional[str] = None
+        self.running = False
+        self.paused = False
+        self._events = []
+        self._ev_lock = threading.Lock()
+        self._scope = ""
+        self._hook_installed = False
+        self._tb_active = False
+
+    @classmethod
+    def get(cls) -> "Profiler":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = Profiler()
+        return cls._instance
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, name: str, t_start: float, t_end: float,
+               cat: str = "operator"):
+        if not self.running or self.paused:
+            return
+        with self._ev_lock:
+            self._events.append({
+                "name": (self._scope + name) if self._scope else name,
+                "cat": cat, "ph": "X",
+                "ts": t_start * 1e6, "dur": (t_end - t_start) * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+            })
+
+    def _invoke_wrapper(self, name, fn):
+        prof = self
+
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                prof.record(name, t0, time.perf_counter())
+        return wrapped
+
+    def _install_hook(self):
+        if not self._hook_installed:
+            _registry.add_invoke_wrapper(self._invoke_wrapper)
+            self._hook_installed = True
+
+    # -- state -------------------------------------------------------------
+
+    def set_config(self, **kwargs):
+        known = {"filename", "aggregate_stats", "tensorboard_dir",
+                 # reference mode flags, accepted for parity (host stream
+                 # records all imperative ops; XLA owns device timing):
+                 "profile_all", "profile_symbolic", "profile_imperative",
+                 "profile_memory", "profile_api", "continuous_dump"}
+        for k, v in kwargs.items():
+            if k not in known:
+                raise MXNetError(f"unknown profiler option {k!r}")
+            if k in ("filename", "aggregate_stats", "tensorboard_dir"):
+                setattr(self, k, v)
+
+    def set_state(self, state: str):
+        if state not in ("run", "stop"):
+            raise MXNetError("profiler state must be 'run' or 'stop'")
+        if state == "run":
+            self._install_hook()
+            self.running = True
+            if self.tensorboard_dir and not self._tb_active:
+                import jax
+                jax.profiler.start_trace(self.tensorboard_dir)
+                self._tb_active = True
+        else:
+            self.running = False
+            if self._tb_active:
+                import jax
+                jax.profiler.stop_trace()
+                self._tb_active = False
+
+    def dump(self, finished: bool = True):
+        """Write accumulated events as Chrome tracing JSON."""
+        with self._ev_lock:
+            events = list(self._events)
+            if finished:
+                self._events.clear()
+        with open(self.filename, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+    def dumps(self, reset: bool = False) -> str:
+        """Aggregate per-op table (reference aggregate_stats output)."""
+        with self._ev_lock:
+            events = list(self._events)
+            if reset:
+                self._events.clear()
+        agg = {}
+        for e in events:
+            st = agg.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
+            st[0] += 1
+            st[1] += e["dur"]
+            st[2] = min(st[2], e["dur"])
+            st[3] = max(st[3], e["dur"])
+        lines = [f"{'Name':<40s}{'Calls':>8s}{'Total(us)':>14s}"
+                 f"{'Min(us)':>12s}{'Max(us)':>12s}{'Avg(us)':>12s}"]
+        for name in sorted(agg, key=lambda n: -agg[n][1]):
+            c, tot, mn, mx = agg[name]
+            lines.append(f"{name:<40s}{c:>8d}{tot:>14.1f}{mn:>12.1f}"
+                         f"{mx:>12.1f}{tot / c:>12.1f}")
+        return "\n".join(lines)
+
+
+def set_config(**kwargs):
+    Profiler.get().set_config(**kwargs)
+
+
+def set_state(state: str = "stop"):
+    Profiler.get().set_state(state)
+
+
+def state() -> str:
+    return "run" if Profiler.get().running else "stop"
+
+
+def dump(finished: bool = True):
+    Profiler.get().dump(finished)
+
+
+def dumps(reset: bool = False) -> str:
+    return Profiler.get().dumps(reset)
+
+
+def pause():
+    Profiler.get().paused = True
+
+
+def resume():
+    Profiler.get().paused = False
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Prefix recorded op names (reference __profiler_scope__ attr,
+    c_api_ndarray.cc:104); also emits a JAX trace annotation so the scope
+    shows up in XProf device traces."""
+    prof = Profiler.get()
+    old = prof._scope
+    prof._scope = old + name.rstrip(":") + ":"
+    try:
+        import jax
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        prof._scope = old

@@ -1,0 +1,67 @@
+"""Runtime feature introspection (reference: python/mxnet/runtime.py backed
+by src/libinfo.cc — ``mx.runtime.feature_list()`` / ``Features``).
+
+Features here describe the TPU build: which backends/subsystems are live in
+this process (XLA platform, Pallas, the native C++ host runtime, …).
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    import jax
+    from . import _native
+    backend = jax.default_backend()
+    feats = {
+        "TPU": backend == "tpu",
+        "CUDA": False,            # by design: this build targets XLA/TPU
+        "CUDNN": False,
+        "NCCL": False,            # collectives ride XLA/ICI instead
+        "XLA": True,
+        "PALLAS": True,
+        "BLAS_OPEN": True,        # XLA's CPU backend carries its own BLAS
+        "MKLDNN": False,
+        "OPENCV": False,
+        "NATIVE_ENGINE": _native.available(),
+        "RECORDIO": True,
+        "DIST_KVSTORE": True,     # jax.distributed-backed
+        "F16C": True,             # bf16/fp16 casts via XLA
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": False,
+        "DEBUG": False,
+        "TVM_OP": False,
+    }
+    return feats
+
+
+class Features(collections.abc.Mapping):
+    """Mapping of feature name → Feature (reference runtime.py:52)."""
+
+    def __init__(self):
+        self._feats = {k: Feature(k, v) for k, v in _detect().items()}
+
+    def __getitem__(self, k):
+        return self._feats[k]
+
+    def __iter__(self):
+        return iter(self._feats)
+
+    def __len__(self):
+        return len(self._feats)
+
+    def is_enabled(self, name: str) -> bool:
+        return self._feats[name].enabled
+
+    def __repr__(self):
+        on = [k for k, f in self._feats.items() if f.enabled]
+        return f"[{', '.join('✔ ' + k for k in on)}]"
+
+
+def feature_list():
+    """List of Feature namedtuples (reference runtime.py:75)."""
+    return list(Features().values())

@@ -1,0 +1,111 @@
+"""mx.io + mx.image tests (reference: tests/python/unittest/test_io.py,
+test_image.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.io import (NDArrayIter, CSVIter, ResizeIter, PrefetchingIter,
+                          ImageRecordIter)
+
+
+def test_ndarrayiter_basic_and_pad():
+    x = onp.arange(50, dtype="float32").reshape(10, 5)
+    y = onp.arange(10, dtype="float32")
+    it = NDArrayIter(x, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 5)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+    it2 = NDArrayIter(x, y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    x = onp.arange(20, dtype="float32").reshape(20, 1)
+    it = NDArrayIter(x, None, batch_size=5, shuffle=True)
+    seen = onp.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_csviter(tmp_path):
+    data_csv = tmp_path / "d.csv"
+    onp.savetxt(data_csv, onp.arange(24).reshape(8, 3), delimiter=",")
+    label_csv = tmp_path / "l.csv"
+    onp.savetxt(label_csv, onp.arange(8), delimiter=",")
+    it = CSVIter(str(data_csv), (3,), 4, label_csv=str(label_csv))
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3)
+    assert b.label[0].shape == (4, 1)
+
+
+def test_resize_and_prefetch_iters():
+    x = onp.arange(40, dtype="float32").reshape(8, 5)
+    base = NDArrayIter(x, None, batch_size=4)
+    r = ResizeIter(base, size=5)  # wraps around
+    assert len(list(r)) == 5
+    base2 = NDArrayIter(x, None, batch_size=4)
+    p = PrefetchingIter(base2)
+    got = list(p)
+    assert len(got) == 2
+    onp.testing.assert_allclose(got[0].data[0].asnumpy(), x[:4])
+
+
+def test_image_record_iter(tmp_path):
+    # synthetic raw-CHW payload records (imdecode_or_raw escape)
+    path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = onp.random.RandomState(0)
+    imgs = []
+    for i in range(10):
+        img = rng.randint(0, 255, (3, 8, 8), dtype=onp.uint8)
+        imgs.append(img)
+        hdr = recordio.IRHeader(flag=0, label=float(i % 3), id=i, id2=0)
+        rec.write(recordio.pack(hdr, img.tobytes()))
+    rec.close()
+
+    it = ImageRecordIter(path, data_shape=(3, 8, 8), batch_size=4,
+                         round_batch=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy()[0],
+                                imgs[0].astype("float32"))
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                                [0., 1., 2., 0.])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_resize_crop_normalize():
+    from mxnet_tpu import image as img
+    rng = onp.random.RandomState(0)
+    src = rng.randint(0, 255, (20, 30, 3)).astype("float32")
+    out = img.imresize(src, 15, 10)
+    assert out.shape == (10, 15, 3)
+    short = img.resize_short(src, 10)
+    assert min(short.shape[:2]) == 10
+    c, _ = img.center_crop(src, (8, 8))
+    assert c.shape == (8, 8, 3)
+    rc, (x0, y0, w, h) = img.random_crop(src, (8, 8))
+    assert rc.shape == (8, 8, 3) and w == 8 and h == 8
+    norm = img.color_normalize(src, onp.array([128., 128., 128.]),
+                               onp.array([64., 64., 64.]))
+    onp.testing.assert_allclose(norm.asnumpy(),
+                                (src - 128.) / 64., rtol=1e-6)
+
+
+def test_augmenter_pipeline():
+    from mxnet_tpu import image as img
+    rng = onp.random.RandomState(1)
+    src = rng.randint(0, 255, (32, 32, 3)).astype("uint8")
+    augs = img.CreateAugmenter((3, 24, 24), rand_mirror=True, brightness=0.1,
+                               contrast=0.1, saturation=0.1,
+                               mean=True, std=True)
+    out = src
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert str(out.dtype) == "float32"

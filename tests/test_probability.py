@@ -1,0 +1,170 @@
+"""gluon.probability + estimator tests.
+
+Numerics oracle: scipy.stats log-pdfs (reference test style:
+tests/python/unittest/test_gluon_probability_v2.py compares vs scipy).
+"""
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import probability as mgp
+from mxnet_tpu.base import MXNetError
+
+
+def _lp(dist, v):
+    return dist.log_prob(mx.nd.array(onp.asarray(v, "float32"))).asnumpy()
+
+
+@pytest.mark.parametrize("case", [
+    ("Normal", lambda: mgp.Normal(1.0, 2.0),
+     lambda v: ss.norm.logpdf(v, 1.0, 2.0), onp.linspace(-3, 3, 7)),
+    ("LogNormal", lambda: mgp.LogNormal(0.5, 0.8),
+     lambda v: ss.lognorm.logpdf(v, 0.8, scale=onp.exp(0.5)),
+     onp.linspace(0.2, 4, 6)),
+    ("Laplace", lambda: mgp.Laplace(0.0, 1.5),
+     lambda v: ss.laplace.logpdf(v, 0, 1.5), onp.linspace(-2, 2, 5)),
+    ("Cauchy", lambda: mgp.Cauchy(0.5, 1.0),
+     lambda v: ss.cauchy.logpdf(v, 0.5, 1.0), onp.linspace(-2, 2, 5)),
+    ("Gumbel", lambda: mgp.Gumbel(0.0, 2.0),
+     lambda v: ss.gumbel_r.logpdf(v, 0, 2.0), onp.linspace(-2, 4, 5)),
+    ("Exponential", lambda: mgp.Exponential(2.0),
+     lambda v: ss.expon.logpdf(v, scale=2.0), onp.linspace(0.1, 5, 5)),
+    ("Gamma", lambda: mgp.Gamma(3.0, 2.0),
+     lambda v: ss.gamma.logpdf(v, 3.0, scale=2.0), onp.linspace(0.5, 8, 5)),
+    ("Beta", lambda: mgp.Beta(2.0, 3.0),
+     lambda v: ss.beta.logpdf(v, 2.0, 3.0), onp.linspace(0.1, 0.9, 5)),
+    ("Chi2", lambda: mgp.Chi2(4.0),
+     lambda v: ss.chi2.logpdf(v, 4.0), onp.linspace(0.5, 9, 5)),
+    ("StudentT", lambda: mgp.StudentT(5.0, 0.0, 1.0),
+     lambda v: ss.t.logpdf(v, 5.0), onp.linspace(-2, 2, 5)),
+    ("Weibull", lambda: mgp.Weibull(1.5, 2.0),
+     lambda v: ss.weibull_min.logpdf(v, 1.5, scale=2.0),
+     onp.linspace(0.3, 4, 5)),
+    ("Pareto", lambda: mgp.Pareto(3.0, 1.0),
+     lambda v: ss.pareto.logpdf(v, 3.0), onp.linspace(1.1, 4, 5)),
+    ("Poisson", lambda: mgp.Poisson(3.0),
+     lambda v: ss.poisson.logpmf(v, 3.0), onp.arange(0, 8.0)),
+    ("Geometric", lambda: mgp.Geometric(0.3),
+     lambda v: ss.geom.logpmf(v + 1, 0.3), onp.arange(0, 6.0)),
+    ("HalfNormal", lambda: mgp.HalfNormal(2.0),
+     lambda v: ss.halfnorm.logpdf(v, scale=2.0), onp.linspace(0.1, 4, 5)),
+    ("HalfCauchy", lambda: mgp.HalfCauchy(1.0),
+     lambda v: ss.halfcauchy.logpdf(v), onp.linspace(0.1, 4, 5)),
+    ("Uniform", lambda: mgp.Uniform(-1.0, 3.0),
+     lambda v: ss.uniform.logpdf(v, -1.0, 4.0), onp.linspace(-0.5, 2.5, 5)),
+], ids=lambda c: c[0] if isinstance(c, tuple) else str(c))
+def test_log_prob_vs_scipy(case):
+    _, mk, ref_fn, grid = case
+    d = mk()
+    onp.testing.assert_allclose(_lp(d, grid), ref_fn(grid),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_bernoulli_and_categorical():
+    b = mgp.Bernoulli(prob=0.3)
+    onp.testing.assert_allclose(
+        _lp(b, [0.0, 1.0]), ss.bernoulli.logpmf([0, 1], 0.3), rtol=1e-6)
+    logit = onp.log(onp.array([0.2, 0.3, 0.5], "float32"))
+    c = mgp.Categorical(logit=mx.nd.array(logit))
+    onp.testing.assert_allclose(
+        _lp(c, [0.0, 1.0, 2.0]), onp.log([0.2, 0.3, 0.5]), rtol=1e-5)
+    ent = c.entropy().asnumpy()
+    onp.testing.assert_allclose(ent, ss.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+
+
+def test_dirichlet_mvn():
+    alpha = onp.array([2.0, 3.0, 4.0], "float32")
+    d = mgp.Dirichlet(mx.nd.array(alpha))
+    v = onp.array([0.2, 0.3, 0.5], "float32")
+    onp.testing.assert_allclose(_lp(d, v), ss.dirichlet.logpdf(v, alpha),
+                                rtol=1e-5)
+    cov = onp.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+    mvn = mgp.MultivariateNormal(mx.nd.array(onp.zeros(2, "float32")),
+                                 cov=mx.nd.array(cov))
+    v2 = onp.array([0.5, -0.7], "float32")
+    onp.testing.assert_allclose(
+        _lp(mvn, v2), ss.multivariate_normal.logpdf(v2, onp.zeros(2), cov),
+        rtol=1e-5)
+
+
+def test_sampling_moments():
+    mx.random.seed(7)
+    n = mgp.Normal(2.0, 0.5)
+    s = n.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 2.0) < 0.02 and abs(s.std() - 0.5) < 0.02
+    g = mgp.Gamma(3.0, 2.0)
+    sg = g.sample((20000,)).asnumpy()
+    assert abs(sg.mean() - 6.0) < 0.15
+    c = mgp.Categorical(logit=mx.nd.array(onp.log([0.1, 0.9]).astype("float32")))
+    sc = c.sample((5000,)).asnumpy()
+    assert abs(sc.mean() - 0.9) < 0.05
+
+
+def test_kl_registry():
+    p, q = mgp.Normal(0.0, 1.0), mgp.Normal(1.0, 2.0)
+    kl = mgp.kl_divergence(p, q).asnumpy()
+    expected = onp.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    onp.testing.assert_allclose(kl, expected, rtol=1e-5)
+    with pytest.raises(MXNetError, match="no KL registered"):
+        mgp.kl_divergence(mgp.Normal(0, 1), mgp.Gamma(1.0, 1.0))
+
+
+def test_log_prob_differentiable():
+    loc = mx.nd.array(onp.array([0.5], "float32"))
+    loc.attach_grad()
+    with mx.autograd.record():
+        d_lp = mgp.Normal(loc, mx.nd.array(onp.array([1.0], "float32")))
+        lp = d_lp.log_prob(mx.nd.array(onp.array([2.0], "float32"))).sum()
+    lp.backward()
+    onp.testing.assert_allclose(loc.grad.asnumpy(), [1.5], rtol=1e-5)
+
+
+def test_stochastic_block_collects_losses():
+    from mxnet_tpu.gluon.probability import StochasticBlock
+    from mxnet_tpu.gluon import nn
+
+    class VAELayer(StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4, in_units=4)
+
+        @StochasticBlock.collectLoss
+        def forward(self, x):
+            out = self.dense(x)
+            self.add_loss((out * out).mean())
+            return out
+
+    blk = VAELayer()
+    blk.initialize()
+    out = blk(mx.nd.ones((2, 4)))
+    assert out.shape == (2, 4)
+    assert len(blk.losses) == 1
+
+
+def test_estimator_fit_and_handlers(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   CheckpointHandler,
+                                                   EarlyStoppingHandler)
+    from mxnet_tpu.gluon import nn, data as gdata
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    rng = onp.random.RandomState(0)
+    x = rng.randn(64, 8).astype("float32")
+    w = rng.randn(8, 3).astype("float32")
+    y = x.dot(w).argmax(1).astype("int32")
+    ds = gdata.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    loader = gdata.DataLoader(ds, batch_size=16, shuffle=True)
+
+    net = nn.Dense(3, in_units=8)
+    net.initialize()
+    est = Estimator(net, SoftmaxCrossEntropyLoss(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.05}))
+    ckpt = CheckpointHandler(str(tmp_path), monitor=est.train_loss_metric,
+                             save_best=True)
+    early = EarlyStoppingHandler(monitor=est.train_loss_metric, patience=50)
+    est.fit(loader, epochs=5, event_handlers=[ckpt, early])
+    name, acc = est.train_metrics[0].get()
+    assert acc > 0.5, (name, acc)
+    import os
+    assert any(f.endswith(".params") for f in os.listdir(tmp_path))

@@ -1,0 +1,67 @@
+"""INT8 quantization tests (reference: tests/python/quantization/
+test_quantization.py — round-trip + quantized-net accuracy checks)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(16, 16).astype("float32") * 3)
+    qd, mn, mxr = q.quantize_v2(x)
+    assert str(qd.dtype) == "int8"
+    back = q.dequantize(qd, mn, mxr)
+    err = onp.abs(back.asnumpy() - x.asnumpy()).max()
+    scale = max(abs(float(mn.asnumpy()[0])), abs(float(mxr.asnumpy()[0]))) / 127
+    assert err <= scale * 0.51 + 1e-6  # within half a quantization step
+
+
+def test_quantize_with_calib_range():
+    x = mx.nd.array(onp.array([[-5.0, 0.0, 5.0, 100.0]], "float32"))
+    qd, mn, mxr = q.quantize_v2(x, min_calib_range=-5.0, max_calib_range=5.0)
+    # 100 saturates to 127
+    assert qd.asnumpy()[0, 3] == 127
+
+
+def test_quantized_dense_close_to_fp32():
+    rng = onp.random.RandomState(1)
+    dense = nn.Dense(8, in_units=16, use_bias=True)
+    dense.initialize()
+    x = mx.nd.array(rng.uniform(-1, 1, (4, 16)).astype("float32"))
+    ref = dense(x).asnumpy()
+    qd = q.QuantizedDense(dense, -1.0, 1.0)
+    out = qd(x).asnumpy()
+    # int8 symmetric: ~1% relative error budget for this scale
+    assert onp.abs(out - ref).max() < 0.05, onp.abs(out - ref).max()
+
+
+def test_quantize_net_swaps_and_stays_accurate():
+    rng = onp.random.RandomState(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(10, in_units=32))
+    net.initialize()
+    calib = [mx.nd.array(rng.uniform(-1, 1, (8, 16)).astype("float32"))
+             for _ in range(4)]
+    x = calib[0]
+    ref = net(x).asnumpy()
+    q.quantize_net(net, calib, calib_mode="naive")
+    swapped = [type(c).__name__ for c in net]
+    assert swapped == ["QuantizedDense", "QuantizedDense"], swapped
+    out = net(x).asnumpy()
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_quantized_conv():
+    rng = onp.random.RandomState(3)
+    conv = nn.Conv2D(4, kernel_size=3, padding=1, in_channels=3)
+    conv.initialize()
+    x = mx.nd.array(rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32"))
+    ref = conv(x).asnumpy()
+    qc = q.QuantizedConv(conv, -1.0, 1.0)
+    out = qc(x).asnumpy()
+    assert onp.abs(out - ref).max() < 0.1, onp.abs(out - ref).max()
