@@ -31,7 +31,7 @@ _OPTIONAL_MODULES = [
     ("numpy_extension", "npx"), ("symbol", None), ("symbol", "sym"),
     ("image", None), ("io", None), ("runtime", None), ("parallel", None),
     ("test_utils", None), ("amp", None), ("recordio", None),
-    ("operator", None),
+    ("operator", None), ("rtc", None), ("contrib", None),
 ]
 import importlib as _importlib
 

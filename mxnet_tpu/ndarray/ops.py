@@ -272,6 +272,73 @@ def linalg_syrk(A, transpose=False, alpha=1.0):
     return invoke_raw("linalg_syrk", fn, [_wrap(A)])
 
 
+@_export
+def linalg_inverse(A):
+    """Matrix inverse (reference la_op _linalg_inverse)."""
+    return invoke_raw("linalg_inverse", jnp.linalg.inv, [_wrap(A)])
+
+
+@_export
+def linalg_det(A):
+    return invoke_raw("linalg_det", jnp.linalg.det, [_wrap(A)])
+
+
+@_export
+def linalg_slogdet(A):
+    return invoke_raw("linalg_slogdet",
+                      lambda a: tuple(jnp.linalg.slogdet(a)), [_wrap(A)],
+                      n_outputs=2)
+
+
+@_export
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular solve (reference la_op _linalg_trsm)."""
+    def fn(a, b):
+        import jax.scipy.linalg as jsl
+        if rightside:
+            # solve X A = alpha B  ->  A^T X^T = alpha B^T
+            x = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                     jnp.swapaxes(alpha * b, -1, -2),
+                                     lower=not lower, trans=1 if transpose
+                                     else 0)
+            return jnp.swapaxes(x, -1, -2)
+        return jsl.solve_triangular(a, alpha * b, lower=lower,
+                                    trans=1 if transpose else 0)
+    return invoke_raw("linalg_trsm", fn, [_wrap(A), _wrap(B)])
+
+
+@_export
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matmul (reference la_op _linalg_trmm)."""
+    def fn(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+    return invoke_raw("linalg_trmm", fn, [_wrap(A), _wrap(B)])
+
+
+@_export
+def linalg_syevd(A):
+    """Symmetric eigendecomposition (reference la_op _linalg_syevd):
+    returns (U, L) with rows of U the eigenvectors (A = U^T diag(L) U)."""
+    def fn(a):
+        l, u = jnp.linalg.eigh(a)
+        return jnp.swapaxes(u, -1, -2), l
+    return invoke_raw("linalg_syevd", fn, [_wrap(A)], n_outputs=2)
+
+
+@_export
+def linalg_sumlogdiag(A):
+    """Sum of log of diagonal (reference la_op _linalg_sumlogdiag)."""
+    def fn(a):
+        return jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)).sum(-1)
+    return invoke_raw("linalg_sumlogdiag", fn, [_wrap(A)])
+
+
 # ---- shape / layout manipulation (reference: matrix_op*) ----
 @_export
 def reshape(data, shape, reverse=False):

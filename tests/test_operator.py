@@ -1,0 +1,268 @@
+"""Broad operator numerics (reference: tests/python/unittest/
+test_operator.py, 9.3k LoC — golden values vs NumPy + finite-difference
+gradient checks via check_numeric_gradient).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = onp.random.RandomState(42)
+
+
+def _a(shape, lo=-2.0, hi=2.0):
+    return RNG.uniform(lo, hi, shape).astype("float32")
+
+
+UNARY_CASES = [
+    ("relu", lambda x: nd.relu(x), lambda x: onp.maximum(x, 0), (-2, 2)),
+    ("sigmoid", lambda x: nd.sigmoid(x),
+     lambda x: 1 / (1 + onp.exp(-x)), (-3, 3)),
+    ("tanh", lambda x: nd.tanh(x), onp.tanh, (-2, 2)),
+    ("exp", lambda x: nd.exp(x), onp.exp, (-2, 2)),
+    ("log", lambda x: nd.log(x), onp.log, (0.1, 4)),
+    ("sqrt", lambda x: nd.sqrt(x), onp.sqrt, (0.1, 4)),
+    ("rsqrt", lambda x: nd.rsqrt(x), lambda x: 1 / onp.sqrt(x), (0.1, 4)),
+    ("abs", lambda x: nd.abs(x), onp.abs, (-2, 2)),
+    ("square", lambda x: nd.square(x), onp.square, (-2, 2)),
+    ("cbrt", lambda x: nd.cbrt(x), onp.cbrt, (-2, 2)),
+    ("sin", lambda x: nd.sin(x), onp.sin, (-3, 3)),
+    ("cos", lambda x: nd.cos(x), onp.cos, (-3, 3)),
+    ("arctan", lambda x: nd.arctan(x), onp.arctan, (-2, 2)),
+    ("erf", lambda x: nd.erf(x),
+     lambda x: __import__("scipy.special", fromlist=["erf"]).erf(x), (-2, 2)),
+    ("log1p", lambda x: nd.log1p(x), onp.log1p, (-0.5, 3)),
+    ("expm1", lambda x: nd.expm1(x), onp.expm1, (-2, 2)),
+    ("floor", lambda x: nd.floor(x), onp.floor, (-3, 3)),
+    ("ceil", lambda x: nd.ceil(x), onp.ceil, (-3, 3)),
+    ("sign", lambda x: nd.sign(x), onp.sign, (-2, 2)),
+    ("reciprocal", lambda x: nd.reciprocal(x), lambda x: 1 / x, (0.2, 3)),
+    ("gamma", lambda x: nd.gamma(x),
+     lambda x: __import__("scipy.special", fromlist=["gamma"]).gamma(x),
+     (0.5, 4)),
+    ("gammaln", lambda x: nd.gammaln(x),
+     lambda x: __import__("scipy.special", fromlist=["gammaln"]).gammaln(x),
+     (0.5, 4)),
+]
+
+
+@pytest.mark.parametrize("case", UNARY_CASES, ids=lambda c: c[0])
+def test_unary_forward(case):
+    name, fn, ref, (lo, hi) = case
+    x = _a((3, 7), lo, hi)
+    out = fn(nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(out, ref(x), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("case", [c for c in UNARY_CASES if c[0] in
+                                  ("sigmoid", "tanh", "exp", "log", "sqrt",
+                                   "square", "sin", "cos", "log1p")],
+                         ids=lambda c: c[0])
+def test_unary_numeric_gradient(case):
+    name, fn, ref, (lo, hi) = case
+    x = _a((4, 5), lo + 0.2, hi)
+    check_numeric_gradient(lambda a: fn(a).sum(), [nd.array(x)])
+
+
+BINARY_CASES = [
+    ("add", lambda a, b: a + b, onp.add),
+    ("sub", lambda a, b: a - b, onp.subtract),
+    ("mul", lambda a, b: a * b, onp.multiply),
+    ("div", lambda a, b: a / b, onp.divide),
+    ("pow", lambda a, b: nd.power(nd.abs(a) + 0.5, b),
+     lambda a, b: onp.power(onp.abs(a) + 0.5, b)),
+    ("maximum", nd.maximum, onp.maximum),
+    ("minimum", nd.minimum, onp.minimum),
+    ("hypot", nd.hypot, onp.hypot),
+]
+
+
+@pytest.mark.parametrize("case", BINARY_CASES, ids=lambda c: c[0])
+def test_binary_forward_broadcast(case):
+    name, fn, ref = case
+    a, b = _a((4, 1, 5)), _a((1, 3, 5), 0.5, 2.0)
+    out = fn(nd.array(a), nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(out, ref(a, b), rtol=2e-5, atol=2e-5)
+
+
+REDUCE_CASES = [
+    ("sum", lambda x, ax: nd.sum(x, axis=ax), onp.sum),
+    ("mean", lambda x, ax: nd.mean(x, axis=ax), onp.mean),
+    ("max", lambda x, ax: nd.max(x, axis=ax), onp.max),
+    ("min", lambda x, ax: nd.min(x, axis=ax), onp.min),
+    ("prod", lambda x, ax: nd.prod(x, axis=ax), onp.prod),
+]
+
+
+@pytest.mark.parametrize("case", REDUCE_CASES, ids=lambda c: c[0])
+@pytest.mark.parametrize("axis", [0, 1, (0, 2), None])
+def test_reductions(case, axis):
+    name, fn, ref = case
+    x = _a((3, 4, 5), 0.5, 1.5)
+    out = fn(nd.array(x), axis).asnumpy()
+    onp.testing.assert_allclose(out, ref(x, axis=axis), rtol=1e-5, atol=1e-5)
+
+
+def test_norm_ord():
+    x = _a((4, 6))
+    onp.testing.assert_allclose(nd.norm(nd.array(x)).asnumpy(),
+                                onp.linalg.norm(x), rtol=1e-5)
+    onp.testing.assert_allclose(
+        nd.norm(nd.array(x), ord=1, axis=1).asnumpy(),
+        onp.abs(x).sum(1), rtol=1e-5)
+
+
+def test_dot_and_batch_dot_grads():
+    a, b = _a((4, 6)), _a((6, 3))
+    onp.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                                a @ b, rtol=1e-5, atol=1e-5)
+    check_numeric_gradient(
+        lambda x, y: nd.dot(x, y).sum(), [nd.array(a), nd.array(b)])
+    ba, bb = _a((2, 4, 5)), _a((2, 5, 3))
+    onp.testing.assert_allclose(
+        nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
+        onp.einsum("bij,bjk->bik", ba, bb), rtol=1e-5, atol=1e-5)
+
+
+def test_indexing_family():
+    x = _a((5, 7))
+    xa = nd.array(x)
+    idx = nd.array(onp.array([0, 2, 4], "int32"))
+    onp.testing.assert_allclose(nd.take(xa, idx).asnumpy(), x[[0, 2, 4]])
+    oh = nd.one_hot(idx, 5).asnumpy()
+    assert oh.shape == (3, 5) and oh.sum() == 3
+    # MXNet gather_nd: indices are (index_dims, N) — output[n] =
+    # data[ind[0,n], ind[1,n]] (reference tensor/indexing_op.h semantics)
+    ind = nd.array(onp.array([[0, 1], [2, 3]], "int32"))
+    g = nd.gather_nd(xa, ind)
+    onp.testing.assert_allclose(g.asnumpy(), [x[0, 2], x[1, 3]])
+
+
+def test_ordering_family():
+    x = _a((3, 8))
+    xa = nd.array(x)
+    onp.testing.assert_allclose(nd.argmax(xa, axis=1).asnumpy(),
+                                x.argmax(1))
+    onp.testing.assert_allclose(nd.argmin(xa, axis=1).asnumpy(),
+                                x.argmin(1))
+    onp.testing.assert_allclose(nd.sort(xa, axis=1).asnumpy(),
+                                onp.sort(x, 1), rtol=1e-6)
+    onp.testing.assert_allclose(nd.argsort(xa, axis=1).asnumpy(),
+                                onp.argsort(x, 1, kind="stable"))
+    tk = nd.topk(xa, k=3, axis=1, ret_typ="value").asnumpy()
+    onp.testing.assert_allclose(tk, -onp.sort(-x, 1)[:, :3], rtol=1e-6)
+
+
+def test_matrix_manip_family():
+    x = _a((2, 3, 4))
+    xa = nd.array(x)
+    onp.testing.assert_allclose(
+        nd.transpose(xa, axes=(2, 0, 1)).asnumpy(), x.transpose(2, 0, 1))
+    onp.testing.assert_allclose(
+        nd.reshape(xa, (6, 4)).asnumpy(), x.reshape(6, 4))
+    onp.testing.assert_allclose(nd.flip(xa, axis=1).asnumpy(),
+                                x[:, ::-1])
+    onp.testing.assert_allclose(nd.tile(xa, reps=(2, 1, 1)).asnumpy(),
+                                onp.tile(x, (2, 1, 1)))
+    onp.testing.assert_allclose(
+        nd.repeat(xa, repeats=2, axis=0).asnumpy(), onp.repeat(x, 2, 0))
+    onp.testing.assert_allclose(
+        nd.expand_dims(xa, axis=1).asnumpy(), x[:, None])
+    st = nd.stack(xa, xa, axis=0).asnumpy()
+    onp.testing.assert_allclose(st, onp.stack([x, x]))
+    cc = nd.concat(xa, xa, dim=2).asnumpy()
+    onp.testing.assert_allclose(cc, onp.concatenate([x, x], 2))
+    s = nd.slice(xa, begin=(0, 1, 0), end=(2, 3, 2)).asnumpy()
+    onp.testing.assert_allclose(s, x[0:2, 1:3, 0:2])
+    sa = nd.slice_axis(xa, axis=2, begin=1, end=3).asnumpy()
+    onp.testing.assert_allclose(sa, x[:, :, 1:3])
+    w = nd.where(nd.array((x > 0).astype("float32")), xa, -xa).asnumpy()
+    onp.testing.assert_allclose(w, onp.where(x > 0, x, -x))
+    cl = nd.clip(xa, -0.5, 0.5).asnumpy()
+    onp.testing.assert_allclose(cl, onp.clip(x, -0.5, 0.5))
+
+
+def test_softmax_family_and_grads():
+    x = _a((4, 10))
+    xa = nd.array(x)
+    ref = onp.exp(x) / onp.exp(x).sum(1, keepdims=True)
+    onp.testing.assert_allclose(nd.softmax(xa, axis=1).asnumpy(), ref,
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(nd.log_softmax(xa, axis=1).asnumpy(),
+                                onp.log(ref), rtol=1e-5, atol=1e-5)
+    check_numeric_gradient(lambda a: (nd.softmax(a, axis=1) ** 2).sum(),
+                           [nd.array(x)])
+
+
+def test_higher_order_grad_still_works():
+    # d2/dx2 of x^3 = 6x through create_graph
+    x = nd.array(onp.array([1.0, 2.0], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x ** 3).sum()
+        g1 = mx.autograd.grad(y, [x], create_graph=True)[0]
+        g1s = g1.sum()
+    g1s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0, 12.0], rtol=1e-5)
+
+
+def test_linalg_family():
+    a = _a((4, 4)) + 4 * onp.eye(4, dtype="float32")
+    aa = nd.array(a)
+    onp.testing.assert_allclose(nd.linalg_inverse(aa).asnumpy(),
+                                onp.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    spd = a @ a.T + onp.eye(4, dtype="float32")
+    onp.testing.assert_allclose(
+        nd.linalg_potrf(nd.array(spd)).asnumpy(),
+        onp.linalg.cholesky(spd), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        nd.linalg_gemm2(aa, aa).asnumpy(), a @ a, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_and_sequence():
+    w = _a((10, 4))
+    ids = onp.array([[1, 3], [5, 0]], "int32")
+    out = nd.Embedding(nd.array(ids), nd.array(w), input_dim=10,
+                       output_dim=4).asnumpy()
+    onp.testing.assert_allclose(out, w[ids])
+    x = _a((5, 2, 3))  # (T, B, C)
+    lens = onp.array([3, 5], "float32")
+    m = nd.SequenceMask(nd.array(x), nd.array(lens),
+                        use_sequence_length=True).asnumpy()
+    assert (m[3:, 0] == 0).all() and (m[:, 1] == x[:, 1]).all()
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    onp.testing.assert_allclose(last[0], x[2, 0], rtol=1e-6)
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    onp.testing.assert_allclose(rev[0, 0], x[2, 0], rtol=1e-6)
+
+
+def test_linalg_extended():
+    a = _a((4, 4)) + 4 * onp.eye(4, dtype="float32")
+    spd = a @ a.T + onp.eye(4, dtype="float32")
+    onp.testing.assert_allclose(nd.linalg_det(nd.array(a)).asnumpy(),
+                                onp.linalg.det(a), rtol=1e-3)
+    sign, logdet = nd.linalg_slogdet(nd.array(spd))
+    s_ref, l_ref = onp.linalg.slogdet(spd)
+    onp.testing.assert_allclose(sign.asnumpy(), s_ref, rtol=1e-5)
+    onp.testing.assert_allclose(logdet.asnumpy(), l_ref, rtol=1e-4)
+    # trsm: solve L X = B for lower-triangular L
+    L = onp.linalg.cholesky(spd).astype("float32")
+    B = _a((4, 3))
+    X = nd.linalg_trsm(nd.array(L), nd.array(B)).asnumpy()
+    onp.testing.assert_allclose(L @ X, B, rtol=1e-4, atol=1e-4)
+    # trmm
+    Y = nd.linalg_trmm(nd.array(L), nd.array(B)).asnumpy()
+    onp.testing.assert_allclose(Y, L @ B, rtol=1e-4, atol=1e-4)
+    # syevd
+    U, lam = nd.linalg_syevd(nd.array(spd))
+    U, lam = U.asnumpy(), lam.asnumpy()
+    onp.testing.assert_allclose(U.T @ onp.diag(lam) @ U, spd,
+                                rtol=1e-3, atol=1e-3)
+    # sumlogdiag
+    onp.testing.assert_allclose(
+        nd.linalg_sumlogdiag(nd.array(spd)).asnumpy(),
+        onp.log(onp.diag(spd)).sum(), rtol=1e-5)

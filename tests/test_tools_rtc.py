@@ -1,0 +1,126 @@
+"""tools/ (launch, im2rec) + mx.rtc + onnx gating tests.
+
+Reference analogs: tests/nightly dist launch rigs (`tools/launch.py -n N
+--launcher local`, SURVEY §4) and test_rtc.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_launch_local_multiprocess(tmp_path):
+    # 3 workers each write rank/size read from the DMLC_* env contract
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        f"open(r'{tmp_path}' + '/out' + os.environ['DMLC_WORKER_ID'], 'w')"
+        ".write(os.environ['DMLC_NUM_WORKER'])\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode()
+    for i in range(3):
+        assert (tmp_path / f"out{i}").read_text() == "3"
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    # tiny image tree with raw files (no PIL needed for packing)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / f"{i}.jpg").write_bytes(os.urandom(64))
+    prefix = str(tmp_path / "ds")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, str(tmp_path / "imgs"), "--no-shuffle"],
+        capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode()
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+    rec = mx.recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rec.keys) == 6
+    hdr, payload = mx.recordio.unpack(rec.read_idx(rec.keys[0]))
+    assert len(payload) == 64
+    labels = sorted({float(mx.recordio.unpack(rec.read_idx(k))[0].label)
+                     for k in rec.keys})
+    assert labels == [0.0, 1.0]
+
+
+def test_rtc_pallas_module():
+    src = """
+def scale_add(x, y, alpha=2.0):
+    return x * alpha + y
+"""
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("scale_add")
+    x = mx.nd.array(onp.ones((4,), "float32"))
+    y = mx.nd.array(onp.arange(4, dtype="float32"))
+    out = k.launch(x, y, alpha=3.0)
+    onp.testing.assert_allclose(out.asnumpy(), 3.0 + onp.arange(4))
+    with pytest.raises(MXNetError, match="not found"):
+        mod.get_kernel("nope")
+
+
+def test_rtc_pallas_kernel_real():
+    # an actual pallas_call kernel through the interpreter
+    src = """
+from jax.experimental import pallas as pl
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+def double(x):
+    return pl.pallas_call(
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True)(x)
+"""
+    mod = mx.rtc.PallasModule(src)
+    out = mod.get_kernel("double").launch(mx.nd.array(onp.ones((8, 128),
+                                                               "float32")))
+    onp.testing.assert_allclose(out.asnumpy(), 2 * onp.ones((8, 128)))
+
+
+def test_cuda_module_redirects():
+    with pytest.raises(MXNetError, match="PallasModule"):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_onnx_gated():
+    from mxnet_tpu.contrib import onnx as mxonnx
+    with pytest.raises(MXNetError, match="onnx"):
+        mxonnx.export_model(None, None)
+
+
+def test_launch_local_kills_siblings_on_failure(tmp_path):
+    # one worker exits 1 immediately; a sibling sleeps forever — launcher
+    # must terminate it and return nonzero instead of hanging
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['DMLC_WORKER_ID'] == '0':\n"
+        "    sys.exit(1)\n"
+        "time.sleep(600)\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, timeout=30)
+    assert r.returncode != 0
+
+
+def test_rtc_ignores_imported_callables():
+    mod = mx.rtc.PallasModule(
+        "from functools import partial\n"
+        "import math\n"
+        "def real_kernel(x):\n"
+        "    return x + 1\n")
+    assert sorted(mod._kernels) == ["real_kernel"]

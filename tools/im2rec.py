@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Build RecordIO datasets from image folders/lists (reference:
+tools/im2rec.py — list generation + multiprocess pack into .rec/.idx).
+
+Usage (same shape as the reference):
+    python tools/im2rec.py --list prefix image_root   # writes prefix.lst
+    python tools/im2rec.py prefix image_root          # writes prefix.rec/.idx
+List lines: "index\\tlabel\\trelative/path.jpg".
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix: str, root: str, shuffle: bool = True):
+    """Scan ``root``: each subdirectory is a class (reference list_image)."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(EXTS):
+                        rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                        entries.append((label, rel))
+    else:
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                if fn.lower().endswith(EXTS):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    entries.append((0, rel))
+    if shuffle:
+        random.shuffle(entries)
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, rel) in enumerate(entries):
+            f.write(f"{i}\t{label}\t{rel}\n")
+    return len(entries)
+
+
+def read_list(path_lst: str):
+    with open(path_lst) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) >= 3:
+                yield int(parts[0]), float(parts[1]), parts[2]
+
+
+def make_rec(prefix: str, root: str):
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(prefix + ".lst"):
+        with open(os.path.join(root, rel), "rb") as f:
+            payload = f.read()
+        hdr = recordio.IRHeader(flag=0, label=label, id=idx, id2=0)
+        rec.write_idx(idx, recordio.pack(hdr, payload))
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images", file=sys.stderr)
+    rec.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(description="image folder -> RecordIO")
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst only")
+    ap.add_argument("--no-shuffle", action="store_true")
+    args = ap.parse_args()
+    if args.list:
+        n = make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+        print(f"wrote {args.prefix}.lst ({n} images)")
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+        n = make_rec(args.prefix, args.root)
+        print(f"wrote {args.prefix}.rec/.idx ({n} records)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Multi-process / multi-host job launcher (reference: tools/launch.py —
+dmlc-tracker submitting N workers + servers + scheduler over
+local/ssh/mpi/sge/yarn).
+
+TPU-native redesign: there are no parameter servers — every process is an
+SPMD worker in one global mesh (`jax.distributed`). The launcher keeps the
+reference CLI (`-n` workers, `--launcher local|ssh`) and env-var contract
+(DMLC_NUM_WORKER / DMLC_WORKER_ID / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT,
+consumed by mxnet_tpu.parallel.dist.initialize), so reference launch
+scripts port unchanged:
+
+    python tools/launch.py -n 4 --launcher local python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def launch_local(n: int, cmd, port: int) -> int:
+    """Spawn n local worker processes sharing a coordinator (the analog of
+    the reference's `--launcher local` multi-process rig used by
+    tests/nightly/dist_sync_kvstore.py)."""
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(i),
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    # Poll all workers: if one dies with an error, kill the siblings (they
+    # may be blocked in a collective waiting for the dead rank forever).
+    import time
+    rc = 0
+    alive = list(procs)
+    while alive:
+        for p in list(alive):
+            r = p.poll()
+            if r is None:
+                continue
+            alive.remove(p)
+            if r != 0:
+                rc = rc or r
+                for q in alive:
+                    q.terminate()
+        time.sleep(0.05)
+    return rc
+
+
+def launch_ssh(n: int, cmd, hostfile: str, port: int) -> int:
+    """One worker per host line in ``hostfile`` (reference ssh launcher)."""
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if len(hosts) < n:
+        raise SystemExit(f"hostfile has {len(hosts)} hosts, need {n}")
+    coord = hosts[0]
+    procs = []
+    for i in range(n):
+        envs = " ".join([
+            f"DMLC_NUM_WORKER={n}", f"DMLC_WORKER_ID={i}",
+            "DMLC_ROLE=worker", f"DMLC_PS_ROOT_URI={coord}",
+            f"DMLC_PS_ROOT_PORT={port}",
+        ])
+        remote = f"cd {shlex.quote(os.getcwd())} && {envs} " + \
+            " ".join(shlex.quote(c) for c in cmd)
+        # -t allocates a PTY so killing the ssh client sends SIGHUP to the
+        # remote command instead of orphaning it on every host
+        procs.append(subprocess.Popen(["ssh", "-tt", "-o",
+                                       "StrictHostKeyChecking=no",
+                                       hosts[i], remote]))
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("-p", "--port", type=int, default=9091)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        rc = launch_local(args.num_workers, args.command, args.port)
+    else:
+        if not args.hostfile:
+            ap.error("--launcher ssh requires --hostfile")
+        rc = launch_ssh(args.num_workers, args.command, args.hostfile,
+                        args.port)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
