@@ -79,5 +79,62 @@ def main():
     }))
 
 
+def main_bert():
+    """Secondary benchmark (MXNET_BENCH_MODEL=bert): BERT-base MLM-style
+    training tokens/sec/chip — the BASELINE.md north-star language metric.
+    Flash attention (Pallas on TPU) backs every layer."""
+    from mxnet_tpu.gluon.model_zoo import bert
+    from __graft_entry__ import make_train_step
+
+    backend = jax.default_backend()
+    on_accel = backend != "cpu"
+    bs, seqlen = (32, 512) if on_accel else (2, 32)
+    warmup, steps = (3, 10) if on_accel else (1, 2)
+    log(f"bench[bert]: backend={backend} bs={bs} seq={seqlen}")
+
+    onp.random.seed(0)
+    net = bert.BERTClassifier(
+        bert.bert_base(max_length=seqlen) if on_accel
+        else bert.bert_small_test(), num_classes=2)
+    tokens = onp.random.randint(0, 1000, size=(1, seqlen)).astype("int32")
+    net.initialize()
+    import mxnet_tpu as mx
+    net(mx.nd.array(tokens))
+    params = [p for p in net.collect_params().values()
+              if p._data is not None]
+    train_step = make_train_step(net, params, lr=0.01)
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
+    mom = tuple(jnp.zeros_like(d) for d in pd)
+    x = jnp.asarray(onp.random.randint(0, 1000, size=(bs, seqlen))
+                    .astype("int32"))
+    y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        pd, mom, loss = step(pd, mom, x, y, key)
+    jax.block_until_ready(loss)
+    log(f"bench[bert]: warmup {time.perf_counter() - t0:.1f}s, "
+        f"loss={float(loss):.3f}")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        pd, mom, loss = step(pd, mom, x, y, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tok_s = bs * seqlen * steps / dt
+    print(json.dumps({
+        "metric": "bert_base_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # reference publishes no in-tree BERT number
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    import os
+    if os.environ.get("MXNET_BENCH_MODEL", "resnet50") == "bert":
+        main_bert()
+    else:
+        main()

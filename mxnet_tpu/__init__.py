@@ -32,6 +32,7 @@ _OPTIONAL_MODULES = [
     ("image", None), ("io", None), ("runtime", None), ("parallel", None),
     ("test_utils", None), ("amp", None), ("recordio", None),
     ("operator", None), ("rtc", None), ("contrib", None),
+    ("subgraph", None), ("checkpoint", None),
 ]
 import importlib as _importlib
 

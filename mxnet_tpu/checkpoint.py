@@ -1,0 +1,158 @@
+"""Checkpoint / resume (reference: NDArray serialization ndarray.h:399-411,
+Gluon save_parameters/load_parameters block.py:339,375, Trainer
+save_states/load_states trainer.py:477,506 — all single-file, rank-0
+writes; the reference has NO sharded/distributed checkpointing, SURVEY §5).
+
+TPU-native extension: orbax-backed checkpoints that save/restore the full
+training state (parameters + optimizer state + step + bias-correction
+counters) atomically, with a retention policy. Restore re-applies each
+parameter onto the live array's sharding (a sharded param stays sharded).
+Arrays are materialized on host during restore — for models too large for
+one host's memory, drive orbax's abstract-target restore directly. The
+reference-parity single-file paths (``nd.save``/``save_parameters``/
+``Trainer.save_states``) remain the simple route.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager"]
+
+
+def _state_tree(net=None, trainer=None, extra=None) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    if net is not None:
+        tree["params"] = {k: v._data._data for k, v in
+                          net.collect_params().items()
+                          if v._data is not None}
+    if trainer is not None:
+        states = {}
+        upd = getattr(trainer, "_updater", None)
+        if upd is not None:
+            for idx, st in upd.states.items():
+                states[str(idx)] = _flatten_state(st)
+        tree["optimizer"] = states
+        # bias-correction counters (reference get_states dump_optimizer=True
+        # keeps num_update/index counts so Adam-style steps resume exactly)
+        opt = getattr(trainer, "_optimizer", None)
+        if opt is not None:
+            tree["opt_counts"] = {
+                "num_update": onp.asarray(opt.num_update),
+                "index_keys": onp.asarray(
+                    sorted(opt._index_update_count), dtype=onp.int64),
+                "index_vals": onp.asarray(
+                    [opt._index_update_count[k]
+                     for k in sorted(opt._index_update_count)],
+                    dtype=onp.int64),
+            }
+    if extra:
+        tree["extra"] = {k: onp.asarray(v) for k, v in extra.items()}
+    return tree
+
+
+def _flatten_state(st):
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten(
+        st, is_leaf=lambda t: isinstance(t, NDArray))
+    return [l._data if isinstance(l, NDArray) else l for l in leaves]
+
+
+def _unflatten_into(st, leaves):
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten(
+        st, is_leaf=lambda t: isinstance(t, NDArray))
+    new = [NDArray(d) if isinstance(o, NDArray) else type(o)(d)
+           for o, d in zip(flat, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, new)
+
+
+def save_checkpoint(path: str, net=None, trainer=None, step: int = 0,
+                    extra: Optional[Dict] = None):
+    """Atomically save params (+ optimizer state, + user extras) to an
+    orbax checkpoint directory."""
+    import orbax.checkpoint as ocp
+    tree = _state_tree(net, trainer, extra)
+    tree["step"] = onp.asarray(step)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, net=None, trainer=None) -> Dict[str, Any]:
+    """Restore a checkpoint in place; returns the raw tree (incl. 'step'
+    and 'extra')."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    tree = ckptr.restore(os.path.abspath(path))
+    _apply_tree(tree, net, trainer)
+    return tree
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints with retention (orbax CheckpointManager):
+    ``save(step, net, trainer)`` / ``restore_latest(net, trainer)``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, net=None, trainer=None,
+             extra: Optional[Dict] = None):
+        import orbax.checkpoint as ocp
+        tree = _state_tree(net, trainer, extra)
+        tree["step"] = onp.asarray(step)
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, net=None, trainer=None) -> Dict[str, Any]:
+        import orbax.checkpoint as ocp
+        step = self._mgr.latest_step()
+        if step is None:
+            raise MXNetError(f"no checkpoints under {self._dir}")
+        tree = self._mgr.restore(step)
+        _apply_tree(tree, net, trainer)
+        return tree
+
+
+def _apply_tree(tree, net, trainer):
+    import jax
+    import jax.numpy as jnp
+    if net is not None and "params" in tree:
+        params = net.collect_params()
+        for k, p in params.items():
+            if k in tree["params"]:
+                arr = jnp.asarray(tree["params"][k])
+                cur = p._data
+                # preserve the live parameter's sharding: restoring must
+                # not silently replace a sharded array with a replicated one
+                if cur is not None and hasattr(cur._data, "sharding"):
+                    arr = jax.device_put(arr, cur._data.sharding)
+                p.set_data(NDArray(arr))
+    if trainer is not None and tree.get("optimizer"):
+        upd = getattr(trainer, "_updater", None)
+        if upd is not None:
+            for idx_s, leaves in tree["optimizer"].items():
+                idx = int(idx_s)
+                if idx in upd.states:
+                    upd.states[idx] = _unflatten_into(upd.states[idx],
+                                                      leaves)
+    if trainer is not None and "opt_counts" in tree:
+        opt = getattr(trainer, "_optimizer", None)
+        if opt is not None:
+            oc = tree["opt_counts"]
+            opt.num_update = int(oc["num_update"])
+            opt._index_update_count = {
+                int(k): int(v) for k, v in zip(oc["index_keys"],
+                                               oc["index_vals"])}

@@ -1,10 +1,10 @@
 """ONNX export/import (reference: python/mxnet/contrib/onnx/ — mx2onnx
 export_model + onnx2mx import_model).
 
-The ``onnx`` package is not available in this environment; the API surface
-is kept (reference parity) and raises a clear error at call time. When
-``onnx`` is importable, ``export_model`` walks a hybridized block's traced
-jaxpr and emits the ONNX graph for the ops it covers.
+The ``onnx`` package is not available in this environment and the
+serialization backend is NOT implemented yet — the API surface is kept for
+reference parity and raises a clear error at call time either way. Native
+deployment checkpoints are ``HybridBlock.export`` / ``SymbolBlock.imports``.
 """
 from __future__ import annotations
 

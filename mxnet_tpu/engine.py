@@ -88,7 +88,8 @@ def host():
             if _host_engine is None:
                 from . import _native
                 if _native.available():
-                    _host_engine = _native.NativeEngine()
+                    n = int(get_env("MXNET_CPU_WORKER_NTHREADS", "0"))
+                    _host_engine = _native.NativeEngine(num_threads=n)
     return _host_engine
 
 

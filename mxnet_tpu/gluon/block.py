@@ -373,6 +373,12 @@ class HybridBlock(Block):
             return tuple(o._data if isinstance(o, NDArray) else o
                          for o in out_leaves) + tuple(state_leaves)
 
+        if self._backend is not None:
+            # reference BuildSubgraph/SubgraphProperty analog: transform the
+            # traced callable before XLA compiles it (subgraph.py)
+            from .. import subgraph as _subgraph
+            fn = _subgraph.get_backend(self._backend).transform(
+                fn, static_argnums=(2, 3, 4, 5))
         self._cached_fn = jax.jit(fn, static_argnums=(2, 3, 4, 5))
 
     def _call_cached_op(self, *args, **kwargs):

@@ -1,0 +1,108 @@
+"""Subgraph/partitioning backends for ``optimize_for`` (reference:
+src/operator/subgraph/ — SubgraphProperty registry + BuildSubgraph pass,
+build_subgraph.cc:726, surfaced as HybridBlock.optimize_for(backend=...),
+python block.py:1141).
+
+TPU-native redesign: a hybridized block is ONE traced XLA computation, so a
+"backend" is a transformation of that traced callable rather than an
+nnvm-graph partition — XLA then compiles the transformed program (its
+fusion pass is the analog of the reference's MKLDNN/TensorRT subgraph
+fusion, and it runs always). Built-in backends:
+
+- ``remat``    — jax.checkpoint over the whole forward: recompute instead
+                 of storing activations (HBM relief for big models).
+- ``bf16``     — graph-level ReducePrecision (reference
+                 src/nnvm/low_precision_pass.cc analog): float32 traced
+                 inputs/params cast to bfloat16, outputs restored to f32.
+
+Register custom backends with ``register_backend`` (the analog of
+``SubgraphBackendRegistry``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["SubgraphBackend", "register_backend", "get_backend",
+           "list_backends"]
+
+
+class SubgraphBackend:
+    """Transforms the traced forward callable of a hybridized block.
+
+    ``transform(fn, static_argnums)`` receives the function jax.jit will
+    compile (array args are leaves/params; ``static_argnums`` index
+    non-array metadata) and returns a replacement with the SAME signature.
+    """
+
+    name = "base"
+
+    def transform(self, fn: Callable, static_argnums=()) -> Callable:
+        return fn
+
+
+_BACKENDS: Dict[str, SubgraphBackend] = {}
+
+
+def register_backend(name: str):
+    """Decorator: register a SubgraphBackend class or instance."""
+    def deco(obj):
+        inst = obj() if isinstance(obj, type) else obj
+        inst.name = name
+        _BACKENDS[name] = inst
+        return obj
+    return deco
+
+
+def get_backend(name: str) -> SubgraphBackend:
+    if name not in _BACKENDS:
+        raise MXNetError(f"subgraph backend {name!r} is not registered; "
+                         f"available: {sorted(_BACKENDS)}")
+    return _BACKENDS[name]
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+@register_backend("default")
+class _DefaultBackend(SubgraphBackend):
+    """No-op: XLA's always-on fusion is the default 'partitioner'."""
+
+
+@register_backend("remat")
+class _RematBackend(SubgraphBackend):
+    def transform(self, fn, static_argnums=()):
+        return jax.checkpoint(fn, static_argnums=tuple(static_argnums))
+
+
+@register_backend("bf16")
+class _BF16Backend(SubgraphBackend):
+    """Whole-graph bf16 (ReducePrecision analog): f32 array inputs are
+    cast down on entry and outputs cast back up on exit."""
+
+    def transform(self, fn, static_argnums=()):
+        static = set(static_argnums)
+
+        def cast_down(x):
+            if hasattr(x, "dtype") and x.dtype == jnp.float32:
+                return x.astype(jnp.bfloat16)
+            return x
+
+        def cast_up(x):
+            if hasattr(x, "dtype") and x.dtype == jnp.bfloat16:
+                return x.astype(jnp.float32)
+            return x
+
+        def wrapped(*args):
+            cast_args = tuple(
+                a if i in static else jax.tree_util.tree_map(cast_down, a)
+                for i, a in enumerate(args))
+            out = fn(*cast_args)
+            return jax.tree_util.tree_map(cast_up, out)
+
+        return wrapped

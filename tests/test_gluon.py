@@ -429,3 +429,48 @@ def test_hybridize_kwargs_and_static_flags():
         loss = m(x, double=True, bias=b).sum()
     loss.backward()
     assert float(b.grad.asnumpy().sum()) == 6.0
+
+
+def test_optimize_for_backends():
+    """Subgraph backends (reference optimize_for/SubgraphProperty):
+    remat + bf16 transforms of the hybridized computation."""
+    import mxnet_tpu.subgraph as sg
+    assert "remat" in sg.list_backends() and "bf16" in sg.list_backends()
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 8).astype("float32"))
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+        net.initialize()
+        return net
+
+    mx.random.seed(3)
+    base = build()
+    ref = base(x).asnumpy()
+
+    mx.random.seed(3)
+    net_r = build()
+    out_r = net_r.optimize_for(x, backend="remat")
+    onp.testing.assert_allclose(out_r.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    # grads flow through the remat'd program
+    with mx.autograd.record():
+        loss = (net_r(x) ** 2).sum()
+    loss.backward()
+    g = [p.grad() for p in net_r.collect_params().values()]
+    assert any(float(onp.abs(a.asnumpy()).sum()) > 0 for a in g)
+
+    mx.random.seed(3)
+    net_b = build()
+    out_b = net_b.optimize_for(x, backend="bf16")
+    assert str(out_b.dtype) == "float32"
+    onp.testing.assert_allclose(out_b.asnumpy(), ref, rtol=0.05, atol=0.05)
+    assert not onp.array_equal(out_b.asnumpy(), ref)  # really ran in bf16
+
+    from mxnet_tpu.base import MXNetError as _E
+    try:
+        build().optimize_for(x, backend="nope")
+        assert False, "expected error"
+    except _E as e:
+        assert "not registered" in str(e)

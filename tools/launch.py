@@ -45,23 +45,7 @@ def launch_local(n: int, cmd, port: int) -> int:
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
-    # Poll all workers: if one dies with an error, kill the siblings (they
-    # may be blocked in a collective waiting for the dead rank forever).
-    import time
-    rc = 0
-    alive = list(procs)
-    while alive:
-        for p in list(alive):
-            r = p.poll()
-            if r is None:
-                continue
-            alive.remove(p)
-            if r != 0:
-                rc = rc or r
-                for q in alive:
-                    q.terminate()
-        time.sleep(0.05)
-    return rc
+    return _wait_all(procs)
 
 
 def launch_ssh(n: int, cmd, hostfile: str, port: int) -> int:
@@ -93,9 +77,26 @@ def launch_ssh(n: int, cmd, hostfile: str, port: int) -> int:
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
+    return _wait_all(procs)
+
+
+def _wait_all(procs) -> int:
+    """Wait on all workers; when one fails, terminate the siblings (they
+    may be blocked in a collective waiting for the dead rank forever)."""
+    import time
     rc = 0
-    for p in procs:
-        rc = p.wait() or rc
+    alive = list(procs)
+    while alive:
+        for p in list(alive):
+            r = p.poll()
+            if r is None:
+                continue
+            alive.remove(p)
+            if r != 0:
+                rc = rc or r
+                for q in alive:
+                    q.terminate()
+        time.sleep(0.05)
     return rc
 
 
