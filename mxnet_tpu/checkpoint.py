@@ -143,11 +143,24 @@ def _apply_tree(tree, net, trainer):
     if trainer is not None and tree.get("optimizer"):
         upd = getattr(trainer, "_updater", None)
         if upd is not None:
+            t_params = list(getattr(trainer, "_params", []))
             for idx_s, leaves in tree["optimizer"].items():
                 idx = int(idx_s)
-                if idx in upd.states:
-                    upd.states[idx] = _unflatten_into(upd.states[idx],
-                                                      leaves)
+                if idx not in upd.states:
+                    # natural resume flow: load before the first step().
+                    # Allocate the typed state so the saved moments are
+                    # applied — dropping them while num_update advances
+                    # would silently run Adam with zero moments at t=N.
+                    if idx < len(t_params) and \
+                            t_params[idx]._data is not None:
+                        upd.states[idx] = \
+                            upd.optimizer.create_state_multi_precision(
+                                idx, t_params[idx].data())
+                    else:
+                        raise MXNetError(
+                            f"cannot restore optimizer state {idx}: "
+                            "trainer has no initialized parameter there")
+                upd.states[idx] = _unflatten_into(upd.states[idx], leaves)
     if trainer is not None and "opt_counts" in tree:
         opt = getattr(trainer, "_optimizer", None)
         if opt is not None:

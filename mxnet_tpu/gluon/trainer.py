@@ -122,6 +122,9 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        # batch all live params into ONE fused updater call (the
+        # reference's multi-tensor update, optimizer_op.cc multi_sgd_*)
+        idxs, grads, datas = [], [], []
         for i, p in enumerate(self._params):
             if self._update_on_kvstore:
                 # store ran the optimizer during push; pull fresh weights
@@ -138,8 +141,20 @@ class Trainer:
                 # reference trainer.py skips stale params entirely rather
                 # than re-applying the old gradient
                 continue
-            self._updater(i, p.grad(), data)
-            data.fresh_grad = False
+            idxs.append(i)
+            grads.append(p.grad())
+            datas.append(data)
+        if not idxs:
+            return
+        if len(idxs) == len(self._params):  # _params already excludes null
+            self._updater(idxs, grads, datas)   # fused: one XLA dispatch
+        else:
+            # stale/partial subset: per-param path — a fused program keyed
+            # on this exact subset would recompile per distinct subset
+            for i, g, d in zip(idxs, grads, datas):
+                self._updater(i, g, d)
+        for d in datas:
+            d.fresh_grad = False
 
     # ---------------- persistence (reference trainer.py:477,506) -----------
     def save_states(self, fname: str):

@@ -15,7 +15,9 @@ from .base import MXNetError
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
            "Perplexity", "F1", "MCC", "PearsonCorrelation", "Loss",
-           "Torch", "Caffe", "CustomMetric", "np", "create", "PCC"]
+           "Torch", "Caffe", "CustomMetric", "np", "create", "PCC",
+           "Fbeta", "BinaryAccuracy", "MeanPairwiseDistance",
+           "MeanCosineSimilarity"]
 
 _registry = {}
 
@@ -252,12 +254,15 @@ class F1(EvalMetric):
             self._fn += float(((pred == 0) & (label == 1)).sum())
             self.num_inst += len(label)
 
+    beta = 1.0  # F-beta with beta=1 is F1; Fbeta overrides
+
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
         prec = self._tp / max(self._tp + self._fp, 1e-12)
         rec = self._tp / max(self._tp + self._fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        b2 = self.beta * self.beta
+        f1 = (1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12)
         return self.name, f1
 
 
@@ -358,6 +363,73 @@ class CustomMetric(EvalMetric):
             else:
                 self.sum_metric += v
                 self.num_inst += 1
+
+
+@_register("fbeta")
+class Fbeta(F1):
+    """F-beta score (reference metric.Fbeta): shares F1's counting; beta
+    weighs recall (beta=1 reduces to F1)."""
+
+    def __init__(self, name="fbeta", beta=1.0, average="macro", **kwargs):
+        super().__init__(name=name, average=average, **kwargs)
+        self.beta = beta
+
+
+@_register("binary_accuracy")
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of probabilities vs binary labels at a threshold
+    (reference metric.BinaryAccuracy)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).flatten()
+            pred = (_to_numpy(pred).flatten() > self.threshold)
+            self.sum_metric += float((pred == (label > 0.5)).sum())
+            self.num_inst += len(label)
+
+
+@_register("mean_pairwise_distance", "mpd")
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between label and pred rows (reference
+    metric.MeanPairwiseDistance)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label)
+            pred = _to_numpy(pred)
+            d = (onp.abs(pred - label) ** self.p).sum(
+                axis=tuple(range(1, label.ndim))) ** (1.0 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += d.shape[0]
+
+
+@_register("mean_cosine_similarity", "cos_sim")
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (reference
+    metric.MeanCosineSimilarity)."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label)
+            pred = _to_numpy(pred)
+            num = (label * pred).sum(-1)
+            den = onp.linalg.norm(label, axis=-1) * \
+                onp.linalg.norm(pred, axis=-1)
+            sim = num / onp.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
 
 
 def np(numpy_feval, name="custom", allow_extra_outputs=False):

@@ -360,3 +360,186 @@ def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=2,
         return jax.vmap(one_roi)(r)
 
     return invoke_raw("ROIAlign", fn, [data, rois])
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox ops
+# Reference analog: src/operator/contrib/multibox_prior.cc / multibox_target.cc
+# / multibox_detection.cc (anchor generation, gt matching with variance-
+# encoded regression targets, decode+NMS). Encoding uses the standard SSD
+# variances (0.1, 0.1, 0.2, 0.2).
+# ---------------------------------------------------------------------------
+
+__all__ += ["MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection"]
+
+_SSD_VAR = (0.1, 0.1, 0.2, 0.2)
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes for one feature map (reference multibox_prior.cc).
+    data (B, C, H, W) → (1, H*W*num_anchors, 4) corner boxes in [0,1];
+    num_anchors = len(sizes) + len(ratios) - 1."""
+    sizes = tuple(float(s) for s in (sizes if isinstance(sizes, (list, tuple))
+                                     else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if isinstance(ratios,
+                                                           (list, tuple))
+                                      else (ratios,)))
+
+    def fn(x):
+        h, w = x.shape[2], x.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h) + offsets[0]) * step_y
+        cx = (jnp.arange(w) + offsets[1]) * step_x
+        # anchor shapes: all sizes at ratio 1, then ratios[1:] at sizes[0]
+        ws, hs = [], []
+        for s in sizes:
+            ws.append(s * jnp.sqrt(ratios[0]))
+            hs.append(s / jnp.sqrt(ratios[0]))
+        for r in ratios[1:]:
+            ws.append(sizes[0] * jnp.sqrt(r))
+            hs.append(sizes[0] / jnp.sqrt(r))
+        aw = jnp.asarray(ws)                      # (A,)
+        ah = jnp.asarray(hs)
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+        cxg = cxg[..., None]
+        cyg = cyg[..., None]
+        x1 = cxg - aw / 2
+        y1 = cyg - ah / 2
+        x2 = cxg + aw / 2
+        y2 = cyg + ah / 2
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # (H, W, A, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes.reshape(1, -1, 4)
+
+    return invoke_raw("MultiBoxPrior", fn, [data])
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=_SSD_VAR):
+    """Assign gt to anchors (reference multibox_target.cc).
+    anchor (1, N, 4); label (B, M, 5) rows [cls, x1, y1, x2, y2] (cls<0 =
+    padding); cls_pred (B, num_cls+1, N) (used for hard negative mining).
+    Returns (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N))
+    where cls_target is 0 for background, gt_cls+1 for matched."""
+    v = jnp.asarray(variances)
+
+    def fn(anc, lab, cp):
+        anc = anc[0]                              # (N, 4)
+        n = anc.shape[0]
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+
+        def one(lb, cp_b):
+            valid = lb[:, 0] >= 0                 # (M,)
+            gt = lb[:, 1:5]
+            iou = _corner_iou(anc, gt)            # (N, M)
+            iou = jnp.where(valid[None, :], iou, -1.0)
+            best_gt = jnp.argmax(iou, axis=1)     # per anchor
+            best_iou = jnp.max(iou, axis=1)
+            matched = best_iou >= overlap_threshold
+            # force-match: each VALID gt's best anchor. Padding rows must
+            # not participate: their argmax lands on some real anchor and
+            # a duplicate-index scatter would clobber a valid gt's forced
+            # match — route them to index n and drop.
+            best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+            safe_anchor = jnp.where(valid, best_anchor, n)
+            forced = jnp.zeros((n,), bool).at[safe_anchor].set(
+                True, mode="drop")
+            forced_gt = jnp.zeros((n,), jnp.int32).at[safe_anchor].set(
+                jnp.arange(lb.shape[0], dtype=jnp.int32), mode="drop")
+            gt_idx = jnp.where(forced, forced_gt, best_gt)
+            matched = matched | forced
+
+            g = gt[gt_idx]                        # (N, 4)
+            gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+            gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+            gcx = (g[:, 0] + g[:, 2]) / 2
+            gcy = (g[:, 1] + g[:, 3]) / 2
+            tx = (gcx - acx) / aw / v[0]
+            ty = (gcy - acy) / ah / v[1]
+            tw = jnp.log(gw / aw) / v[2]
+            th = jnp.log(gh / ah) / v[3]
+            bt = jnp.stack([tx, ty, tw, th], 1)   # (N, 4)
+            bt = jnp.where(matched[:, None], bt, 0.0)
+            mask = jnp.where(matched[:, None], 1.0,
+                             0.0) * jnp.ones((1, 4))
+            cls_t = jnp.where(matched, lb[gt_idx, 0] + 1.0, 0.0)
+            if negative_mining_ratio > 0:
+                # hard negatives: most-confused background anchors first;
+                # near-misses (IoU >= negative_mining_thresh) are excluded
+                # from the candidate pool (reference multibox_target.cc)
+                bg_prob = jax.nn.softmax(cp_b, axis=0)[0]  # (N,)
+                candidate = (~matched) & \
+                    (best_iou < negative_mining_thresh)
+                neg_score = jnp.where(candidate, bg_prob, jnp.inf)
+                n_pos = jnp.maximum(matched.sum(), 1)
+                n_neg = jnp.maximum(
+                    (negative_mining_ratio * n_pos).astype(jnp.int32),
+                    jnp.int32(minimum_negative_samples))
+                n_neg = jnp.minimum(n_neg, candidate.sum())
+                order = jnp.argsort(neg_score)    # most-confused first
+                rank = jnp.zeros((n,), jnp.int32).at[order].set(
+                    jnp.arange(n, dtype=jnp.int32))
+                keep_neg = candidate & (rank < n_neg)
+                cls_t = jnp.where(matched | keep_neg, cls_t,
+                                  jnp.float32(ignore_label))
+            return bt.reshape(-1), mask.reshape(-1), cls_t
+
+        bt, mask, ct = jax.vmap(one)(lab, cp)
+        return bt, mask, ct
+
+    return invoke_raw("MultiBoxTarget", fn, [anchor, label, cls_pred],
+                      n_outputs=3)
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5,
+                      force_suppress=False, variances=_SSD_VAR,
+                      nms_topk=-1):
+    """Decode predictions + per-class NMS (reference multibox_detection.cc).
+    cls_prob (B, num_cls+1, N); loc_pred (B, N*4); anchor (1, N, 4) →
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], suppressed rows -1;
+    cls_id excludes background (0-based after removing background_id)."""
+    v = jnp.asarray(variances)
+
+    def fn(cp, lp, anc):
+        b = cp.shape[0]
+        anc = anc[0]
+        n = anc.shape[0]
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        loc = lp.reshape(b, n, 4)
+        cx = loc[..., 0] * v[0] * aw + acx
+        cy = loc[..., 1] * v[1] * ah + acy
+        w = jnp.exp(loc[..., 2] * v[2]) * aw
+        h = jnp.exp(loc[..., 3] * v[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          -1)                     # (B, N, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (reference picks argmax)
+        scores_all = jnp.moveaxis(cp, 1, 2)       # (B, N, C+1)
+        fg = jnp.concatenate([scores_all[..., :background_id],
+                              scores_all[..., background_id + 1:]], -1)
+        cls_id = jnp.argmax(fg, axis=-1).astype(jnp.float32)
+        score = jnp.max(fg, axis=-1)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[..., None],
+             jnp.where(keep, score, -1.0)[..., None], boxes], -1)
+        return rows
+
+    raw = invoke_raw("MultiBoxDetection_decode", fn,
+                     [cls_prob, loc_pred, anchor])
+    return box_nms(raw, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)

@@ -158,10 +158,60 @@ class Optimizer:
             self._jit_update = jax.jit(stepfn, donate_argnums=(7,))
         return self._jit_update
 
+    def _jitted_multi(self):
+        """Multi-tensor fused step (reference multi_sgd_mom_update,
+        src/operator/optimizer_op.cc): ALL parameter updates compile into
+        ONE XLA program — one dispatch per optimizer step instead of one
+        per parameter."""
+        if getattr(self, "_jit_multi", None) is None:
+            rule = self._rule()
+            has_clip = self.clip_gradient is not None
+
+            def stepfn(ws, gs, lrs, wds, ts, rescale, clip, states):
+                new_ws, new_ss = [], []
+                for w, g, lr, wd, t, st in zip(ws, gs, lrs, wds, ts,
+                                               states):
+                    g = g * rescale
+                    if has_clip:
+                        g = jnp.clip(g, -clip, clip)
+                    nw, ns = rule(w, g, lr, wd, t, st)
+                    new_ws.append(nw)
+                    new_ss.append(ns)
+                return tuple(new_ws), tuple(new_ss)
+
+            self._jit_multi = jax.jit(stepfn, donate_argnums=(7,))
+        return self._jit_multi
+
+    def _update_multi(self, indices, weights, grads, states):
+        """Fused path for plain (non-multi-precision) states."""
+        ts = [self._update_count(i) for i in indices]
+        lrs = [self._get_lr(i) for i in indices]
+        wds = [self._get_wd(i) for i in indices]
+        clip = self.clip_gradient if self.clip_gradient is not None else 0.0
+        raw_states = tuple(tuple(s._data for s in st) for st in states)
+        new_ws, new_ss = self._jitted_multi()(
+            tuple(w._data for w in weights),
+            tuple(g._data for g in grads),
+            lrs, wds, ts, self.rescale_grad, clip, raw_states)
+        for w, nw in zip(weights, new_ws):
+            w._data = nw
+        for st, ns in zip(states, new_ss):
+            for s, n in zip(st, ns):
+                s._data = n
+
     def update(self, index, weight, grad, state):
         """Single-param update (reference Optimizer.update). Lists are the
-        reference's multi-tensor form."""
+        reference's multi-tensor form, fused into one XLA program."""
         if isinstance(index, (list, tuple)):
+            plain = all(
+                not (isinstance(s, tuple) and len(s) == 2 and
+                     isinstance(s[0], tuple) and isinstance(s[1], NDArray) and
+                     w._data.dtype in (jnp.float16, jnp.bfloat16))
+                for s, w in zip(state, weight))
+            if plain and len(index) > 1:
+                self._update_multi(list(index), list(weight), list(grad),
+                                   list(state))
+                return
             for i, w, g, s in zip(index, weight, grad, state):
                 self._update_one(i, w, g, s)
         else:
@@ -677,11 +727,17 @@ class Updater:
         indices = index if isinstance(index, (list, tuple)) else [index]
         grads = grad if isinstance(grad, (list, tuple)) else [grad]
         weights = weight if isinstance(weight, (list, tuple)) else [weight]
-        for i, g, w in zip(indices, grads, weights):
+        for i, w in zip(indices, weights):
             if i not in self.states:
                 self.states[i] = \
                     self.optimizer.create_state_multi_precision(i, w)
-            self.optimizer._update_one(i, w, g, self.states[i])
+        if len(indices) > 1:
+            # multi-tensor fused update: one XLA dispatch for all params
+            self.optimizer.update(list(indices), list(weights), list(grads),
+                                  [self.states[i] for i in indices])
+        else:
+            self.optimizer._update_one(indices[0], weights[0], grads[0],
+                                       self.states[indices[0]])
 
     def get_states(self, dump_optimizer=False):
         """Reference optimizer/updater.py: pickles (states, optimizer) when

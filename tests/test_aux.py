@@ -299,3 +299,83 @@ def test_box_nms_out_format():
     out = contrib.box_nms(mx.nd.array(center), in_format="center",
                           out_format="corner").asnumpy()
     onp.testing.assert_allclose(out[0, 2:], [0, 0, 10, 10], rtol=1e-5)
+
+
+def test_multibox_prior():
+    from mxnet_tpu.ndarray import contrib
+    data = mx.nd.ones((1, 8, 4, 4))
+    anchors = contrib.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                    ratios=(1.0, 2.0))
+    # num_anchors = 2 + 2 - 1 = 3 per position
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at (0,0): center (0.125, 0.125), size 0.5
+    onp.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                       0.125 + 0.25, 0.125 + 0.25],
+                                rtol=1e-5)
+    # width/height of ratio-2 anchor: w = 0.5*sqrt(2), h = 0.5/sqrt(2)
+    w = a[2, 2] - a[2, 0]
+    h = a[2, 3] - a[2, 1]
+    onp.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+
+
+def test_multibox_target_and_detection_roundtrip():
+    from mxnet_tpu.ndarray import contrib
+    # 4 hand-built anchors; one gt box aligned with anchor 1
+    anchors = onp.array([[0.0, 0.0, 0.3, 0.3],
+                         [0.3, 0.3, 0.7, 0.7],
+                         [0.6, 0.6, 1.0, 1.0],
+                         [0.0, 0.6, 0.4, 1.0]], "float32")[None]
+    gt = onp.array([[[1.0, 0.32, 0.28, 0.72, 0.68]]], "float32")  # cls 1
+    cls_pred = onp.zeros((1, 3, 4), "float32")
+    bt, mask, ct = contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(gt), mx.nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert ct[1] == 2.0          # gt cls 1 -> target 2 (0 is background)
+    assert ct[0] == 0.0 and ct[2] == 0.0
+    mask = mask.asnumpy().reshape(4, 4)
+    assert mask[1].sum() == 4 and mask[0].sum() == 0
+
+    # decode: feed perfect loc targets back -> recovered gt box
+    bt = bt.asnumpy().reshape(1, -1)
+    cls_prob = onp.zeros((1, 3, 4), "float32")
+    cls_prob[0, 1, 1] = 0.9      # class 0 (fg) on anchor 1
+    out = contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(bt), mx.nd.array(anchors),
+        threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 1
+    onp.testing.assert_allclose(kept[0, 2:], gt[0, 0, 1:], atol=1e-5)
+
+
+def test_multibox_target_padded_labels_keep_forced_match():
+    from mxnet_tpu.ndarray import contrib
+    # low-IoU gt (only force-match applies) + a padding row whose argmax
+    # would collide with the real gt's best anchor
+    anchors = onp.array([[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9]], "float32")[None]
+    labels = onp.array([[[1.0, 0.0, 0.0, 0.2, 0.2],
+                         [-1.0, 0.0, 0.0, 0.0, 0.0]]], "float32")
+    cls_pred = onp.zeros((1, 3, 2), "float32")
+    bt, mask, ct = contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0, ct      # forced match survives the padding row
+    assert mask.asnumpy().reshape(2, 4)[0].sum() == 4
+
+
+def test_multibox_target_negative_mining_thresh():
+    from mxnet_tpu.ndarray import contrib
+    anchors = onp.array([[0.0, 0.0, 0.4, 0.4],     # matched (forced)
+                         [0.02, 0.02, 0.42, 0.42],  # near-miss IoU>0.4
+                         [0.6, 0.6, 0.9, 0.9]], "float32")[None]
+    labels = onp.array([[[0.0, 0.0, 0.0, 0.4, 0.4]]], "float32")
+    cls_pred = onp.zeros((1, 2, 3), "float32")
+    _, _, ct = contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_pred),
+        overlap_threshold=0.9, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.4)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0          # positive
+    assert ct[1] == -1.0         # near-miss: excluded from negatives
+    assert ct[2] == 0.0          # true negative kept

@@ -246,3 +246,39 @@ def test_checkpoint_manager_retention(tmp_path):
     assert mgr.latest_step() == 3
     tree = mgr.restore_latest(net)
     assert int(tree["step"]) == 3
+
+
+def test_checkpoint_restore_into_fresh_trainer(tmp_path):
+    # natural resume: load BEFORE any step — optimizer moments must be
+    # allocated and applied, not silently dropped
+    from mxnet_tpu import checkpoint as ckpt
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(16, 4).astype("float32"))
+    y = mx.nd.array(rng.randn(16, 1).astype("float32"))
+
+    mx.random.seed(21)
+    net, tr = nn.Dense(1, in_units=4), None
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(16)
+    ckpt.save_checkpoint(str(tmp_path / "ck"), net, tr, step=3)
+
+    mx.random.seed(77)
+    net2 = nn.Dense(1, in_units=4)
+    net2.initialize()
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+    ckpt.load_checkpoint(str(tmp_path / "ck"), net2, tr2)  # no prior step
+    assert tr2._updater.states, "optimizer states must be restored"
+    for n_, t_ in ((net, tr), (net2, tr2)):
+        with mx.autograd.record():
+            l = ((n_(x) - y) ** 2).mean()
+        l.backward()
+        t_.step(16)
+    onp.testing.assert_allclose(net2.weight.data().asnumpy(),
+                                net.weight.data().asnumpy(), rtol=1e-5)
