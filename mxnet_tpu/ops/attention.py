@@ -6,11 +6,12 @@ context: none in the reference") — attention lived in gluon-nlp as unfused
 batch_dot+softmax. This module is the TPU-idiomatic superset the build plan
 (SURVEY.md §7 stage 10) calls for:
 
-- ``flash_attention``: O(S) memory online-softmax attention. On TPU the
-  forward is a Pallas kernel (grid over (batch*heads, q-blocks, k-blocks),
-  f32 accumulators in VMEM scratch, MXU-shaped 128x128 tiles); elsewhere a
-  blockwise ``lax.scan`` XLA implementation with identical math. Backward is
-  recompute-based (flash-attention-style: no S×S residuals are saved).
+- ``flash_attention``: O(S) memory online-softmax attention. On TPU both
+  the forward AND the backward are Pallas kernels (FlashAttention-2 style:
+  the forward saves a per-row log-sum-exp residual; the backward's dq and
+  dk/dv kernels reconstruct softmax blocks from it — no S×S residual is
+  ever materialized). Elsewhere a blockwise ``lax.scan`` XLA implementation
+  with identical math and a recompute-based backward.
 - ``ring_attention``: context parallelism over a mesh axis. Each device
   holds a sequence shard of Q/K/V; K/V blocks rotate around the ring via
   ``lax.ppermute`` (ICI neighbor exchange) while online-softmax accumulators
@@ -121,7 +122,7 @@ def _attention_xla(q, k, v, causal: bool, sm_scale: float,
 # Pallas TPU forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                   sm_scale, causal, block_q, block_k, nk, seq_q, seq_k):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
@@ -174,19 +175,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
         # the shared convention across every path in this module
         out = jnp.where(m_s[:, :1] > _NEG_INF / 2, out, 0.0)
         o_ref[0] = out.astype(o_ref.dtype)
+        # log-sum-exp per row: the residual the backward kernels need
+        # (p = exp(s - lse) reconstructs softmax without the S×S matrix)
+        lse = jnp.where(m_s[:, :1] > _NEG_INF / 2,
+                        m_s[:, :1] + jnp.log(l), _NEG_INF)
+        # 8-lane replication: narrowest layout the TPU tiling rules allow
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
-                      block_q: int = 512, block_k: int = 512,
-                      interpret: bool = False):
-    # 512x512 blocks measured 2.2x faster than 128x128 on one TPU chip
-    # (8x12x2048x64 causal: 4.5ms vs 13ms; XLA blockwise scan: 9.7ms)
-    """Pallas flash attention forward. Pads seq to block multiples and
-    head_dim to the 128-lane tile (zero-padded dims cancel in QK^T and are
-    sliced off the output)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+def _pad_for_blocks(q, k, v, block_q, block_k):
+    """Shared fwd/bwd tiling preamble: clamp block sizes, pad seq dims to
+    block multiples and head_dim to the 128-lane tile, fold (B, H) →
+    batch-of-heads. The backward's exp(s - lse) recompute is only correct
+    when it uses EXACTLY these conventions — keep this the single source."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, max(sq, 8))
@@ -202,12 +203,30 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
     qp = pad3(q, sqp, dp).reshape(b * h, sqp, dp)
     kp = pad3(k, skp, dp).reshape(b * h, skp, dp)
     vp = pad3(v, skp, dp).reshape(b * h, skp, dp)
-    nq, nk = sqp // block_q, skp // block_k
+    return (qp, kp, vp, pad3, block_q, block_k, dp, sqp, skp,
+            sqp // block_q, skp // block_k)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
+                      block_q: int = 512, block_k: int = 512,
+                      interpret: bool = False):
+    # 512x512 blocks measured 2.2x faster than 128x128 on one TPU chip
+    # (8x12x2048x64 causal: 4.5ms vs 13ms; XLA blockwise scan: 9.7ms)
+    """Pallas flash attention forward → (out, lse). Padding/tiling via
+    _pad_for_blocks; zero-padded head dims cancel in QK^T and are sliced
+    off the output."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    (qp, kp, vp, _, block_q, block_k, dp, sqp, skp, nq, nk) = \
+        _pad_for_blocks(q, k, v, block_q, block_k)
 
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_k=block_k, nk=nk, seq_q=sq, seq_k=sk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -215,9 +234,14 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sqp, 8), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -227,25 +251,210 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
-    return out.reshape(b, h, sqp, dp)[:, :, :sq, :d]
+    return (out.reshape(b, h, sqp, dp)[:, :, :sq, :d],
+            lse[:, :, 0].reshape(b, h, sqp)[:, :, :sq])
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU backward kernels (FlashAttention-2 style: recompute p from the
+# saved per-row log-sum-exp; no S×S residual is ever materialized)
+# ---------------------------------------------------------------------------
+
+def _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k):
+    k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1)
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0)
+    valid = (k_pos < seq_k) & (q_pos < seq_q)
+    if causal:
+        valid = valid & (k_pos <= q_pos + (seq_k - seq_q))
+    return valid
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal,
+                          block_q, block_k, nq, seq_q, seq_k):
+    from jax.experimental import pallas as pl
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    run = True
+    if causal:  # this k block only touches q rows at/after the diagonal
+        run = ki * block_k <= qi * block_q + block_q - 1 + (seq_k - seq_q)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # (bq, d)
+        k = k_ref[0].astype(jnp.float32)            # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)          # (bq, d)
+        lse = lse_ref[0][:, :1]                     # (bq, 1)
+        delta = delta_ref[0][:, :1]                 # (bq, 1)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        valid = _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        dv_s[...] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_s[...] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_s, *, sm_scale, causal, block_q,
+                         block_k, nk, seq_q, seq_k):
+    from jax.experimental import pallas as pl
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1 + (seq_k - seq_q)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        valid = _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_s[...] += lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
+                      block_q: int = 512, block_k: int = 512,
+                      interpret: bool = False):
+    """Pallas flash attention backward: dq via a (q-parallel, k-inner)
+    kernel, dk/dv via a (k-parallel, q-inner) kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    (qp, kp, vp, pad3, block_q, block_k, dp, sqp, skp, nq, nk) = \
+        _pad_for_blocks(q, k, v, block_q, block_k)
+    dop = pad3(do.astype(q.dtype), sqp, dp).reshape(b * h, sqp, dp)
+    # delta_i = rowsum(dO_i * O_i) (cheap; XLA fuses into the pad)
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    dl = jnp.pad(delta.reshape(b * h, sq), ((0, 0), (0, sqp - sq)))
+    lsep = jnp.pad(lse.reshape(b * h, sq), ((0, 0), (0, sqp - sq)))
+    # 8-lane replication (TPU block tiling minimum for a row vector)
+    dl = jnp.broadcast_to(dl[..., None], dl.shape + (8,))
+    lsep = jnp.broadcast_to(lsep[..., None], lsep.shape + (8,))
+
+    q_spec = pl.BlockSpec((1, block_q, dp), lambda bh, a, c: (bh, a, 0))
+    row_spec = pl.BlockSpec((1, block_q, 8), lambda bh, a, c: (bh, a, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          nk=nk, seq_q=sq, seq_k=sk),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            q_spec, row_spec, row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dl)
+
+    k_spec = pl.BlockSpec((1, block_k, dp), lambda bh, ki, qi: (bh, ki, 0))
+    qrow = pl.BlockSpec((1, block_q, dp), lambda bh, ki, qi: (bh, qi, 0))
+    rrow = pl.BlockSpec((1, block_q, 8), lambda bh, ki, qi: (bh, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          nq=nq, seq_q=sq, seq_k=sk),
+        grid=(b * h, nk, nq),
+        in_specs=[qrow, k_spec, k_spec, qrow, rrow, rrow],
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, skp, dp), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
+                        pltpu.VMEM((block_k, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dl)
+
+    return (dq.reshape(b, h, sqp, dp)[:, :, :sq, :d],
+            dk.reshape(b, h, skp, dp)[:, :, :sk, :d],
+            dv.reshape(b, h, skp, dp)[:, :, :sk, :d])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_tpu(q, k, v, causal, sm_scale, interpret):
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                             interpret=interpret)[0]
+
+
+def _flash_tpu_fwd(q, k, v, causal, sm_scale, interpret):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                               interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_tpu_bwd(causal, sm_scale, interpret, res, g):
+    q, k, v, o, lse = res
+    return _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
+                             interpret=interpret)
+
+
+_flash_tpu.defvjp(_flash_tpu_fwd, _flash_tpu_bwd)
 
 
 # ---------------------------------------------------------------------------
 # Public flash_attention with recompute backward
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, sm_scale, use_pallas):
-    if use_pallas:
-        return _flash_fwd_pallas(q, k, v, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, sm_scale):
+    """XLA (non-Pallas) flash path: blockwise scan forward, recompute
+    backward. The TPU default goes through _flash_tpu instead."""
     return _attention_xla(q, k, v, causal, sm_scale)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
-    return _flash(q, k, v, causal, sm_scale, use_pallas), (q, k, v)
+def _flash_fwd(q, k, v, causal, sm_scale):
+    return _flash(q, k, v, causal, sm_scale), (q, k, v)
 
 
-def _flash_bwd(causal, sm_scale, use_pallas, res, g):
+def _flash_bwd(causal, sm_scale, res, g):
     q, k, v = res
     # Flash-style backward: recompute attention blockwise (no S×S residual).
     _, vjp = jax.vjp(
@@ -284,10 +493,11 @@ def flash_attention(q, k, v, causal: bool = False,
                     valid_length=None):
     """Fused memory-efficient attention on (B, H, S, D) tensors.
 
-    On TPU the forward runs as a Pallas kernel; everywhere else (and for
-    the backward pass) a blockwise lax.scan implementation with identical
-    online-softmax math is used. ``valid_length`` (B,) masks padded keys;
-    that path always uses the blockwise implementation (still O(S·block)
+    On TPU forward and backward run as Pallas kernels (_flash_tpu:
+    FlashAttention-2 dq/dkv kernels off the saved log-sum-exp); elsewhere
+    a blockwise lax.scan implementation with identical online-softmax math
+    and a recompute-based backward. ``valid_length`` (B,) masks padded
+    keys; that path uses the blockwise implementation (still O(S·block)
     memory, never an S×S score matrix).
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
@@ -299,7 +509,11 @@ def flash_attention(q, k, v, causal: bool = False,
         return _flash_vl(q, k, v, vl, causal, float(sm_scale))
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    return _flash(q, k, v, causal, float(sm_scale), bool(use_pallas))
+    if use_pallas:
+        # full-Pallas path: flash forward AND FlashAttention-2-style
+        # backward kernels (dq + dkv) off the saved log-sum-exp
+        return _flash_tpu(q, k, v, causal, float(sm_scale), False)
+    return _flash(q, k, v, causal, float(sm_scale))
 
 
 # ---------------------------------------------------------------------------
