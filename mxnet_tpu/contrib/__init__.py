@@ -3,3 +3,4 @@ tensorboard). AMP lives at mxnet_tpu.amp; re-exported here for parity."""
 from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import tensorboard  # noqa: F401

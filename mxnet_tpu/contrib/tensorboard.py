@@ -1,0 +1,45 @@
+"""TensorBoard logging bridge (reference: python/mxnet/contrib/
+tensorboard.py — LogMetricsCallback writing EvalMetric values through a
+SummaryWriter).
+
+Works with any writer exposing ``add_scalar(tag, value, step)`` (e.g.
+``torch.utils.tensorboard.SummaryWriter``, tensorboardX, or jax's
+TensorBoard profile dir via mx.profiler tensorboard_dir for device traces).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Per-batch/epoch callback pushing metric values to a summary writer
+    (reference tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir: str = None, prefix: str = None,
+                 summary_writer=None):
+        self.prefix = prefix
+        self.step = 0
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError as e:
+            raise MXNetError(
+                "no SummaryWriter available; pass summary_writer= or "
+                "install a tensorboard writer") from e
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """Accepts an object with ``eval_metric`` (reference
+        BatchEndParam) or an EvalMetric directly."""
+        metric = getattr(param, "eval_metric", param)
+        if metric is None:
+            return
+        self.step += 1
+        for name, value in metric.get_name_value():
+            if self.prefix:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

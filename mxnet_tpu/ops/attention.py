@@ -138,7 +138,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     diag_off = seq_k - seq_q
     run = True
     if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1 + diag_off
+        run = _causal_block_skip(qi, ki, block_q, block_k, seq_q, seq_k)
 
     @pl.when(run)
     def _compute():
@@ -260,6 +260,13 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
 # saved per-row log-sum-exp; no S×S residual is ever materialized)
 # ---------------------------------------------------------------------------
 
+def _causal_block_skip(qi, ki, block_q, block_k, seq_q, seq_k):
+    """True iff block (qi, ki) holds ANY valid causal entry — the shared
+    skip predicate for the forward and both backward kernels (a divergence
+    here would desynchronize forward and backward masking)."""
+    return ki * block_k <= qi * block_q + block_q - 1 + (seq_k - seq_q)
+
+
 def _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k):
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 1)
@@ -285,7 +292,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:  # this k block only touches q rows at/after the diagonal
-        run = ki * block_k <= qi * block_q + block_q - 1 + (seq_k - seq_q)
+        run = _causal_block_skip(qi, ki, block_q, block_k, seq_q, seq_k)
 
     @pl.when(run)
     def _compute():
@@ -326,7 +333,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1 + (seq_k - seq_q)
+        run = _causal_block_skip(qi, ki, block_q, block_k, seq_q, seq_k)
 
     @pl.when(run)
     def _compute():

@@ -209,3 +209,28 @@ def test_flash_attention_pallas_backward_cross_length():
     for a, b in zip(g, gr):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
                                     rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_pallas_backward_multiblock(causal):
+    # Small explicit blocks force a 3x4 grid: exercises cross-block
+    # accumulator init/+=/finalize and the causal block-skip predicate in
+    # both backward kernels (not reachable with default 512 blocks on CI
+    # sizes).
+    q, k, v = _rand_qkv(b=1, h=2, s=48, d=8, seed=5)
+    k = k[:, :, :64] if k.shape[2] >= 64 else k
+    sm = 8 ** -0.5
+
+    o, lse = A._flash_fwd_pallas(q, k, v, causal, sm, block_q=16,
+                                 block_k=16, interpret=True)
+    rng = onp.random.RandomState(9)
+    do = jnp.asarray(rng.randn(*o.shape).astype("float32"))
+    dq, dk, dv = A._flash_bwd_pallas(q, k, v, o, lse, do, causal, sm,
+                                     block_q=16, block_k=16, interpret=True)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: A.attention_reference(q_, k_, v_, causal=causal,
+                                                 sm_scale=sm), q, k, v)
+    rq, rk, rv = vjp(do)
+    for a, b in zip((dq, dk, dv), (rq, rk, rv)):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
