@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""INT8 post-training quantization (reference:
+example/quantization/imagenet_gen_qsym.py workflow): train briefly in f32,
+calibrate, swap in int8 MXU kernels, compare accuracy.
+
+Run: python examples/quantize_inference.py
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def main():
+    rng = onp.random.RandomState(0)
+    w = rng.randn(16, 4).astype("float32")
+    x_all = rng.uniform(-1, 1, (512, 16)).astype("float32")
+    y_all = x_all.dot(w).argmax(1).astype("int32")
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(4, in_units=32))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for i in range(0, 512, 32):
+        xb = mx.nd.array(x_all[i:i + 32])
+        yb = mx.nd.array(y_all[i:i + 32])
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+        loss.backward()
+        trainer.step(32)
+
+    def accuracy(model):
+        pred = model(mx.nd.array(x_all)).asnumpy().argmax(1)
+        return (pred == y_all).mean()
+
+    fp32_acc = accuracy(net)
+    calib = [mx.nd.array(x_all[i:i + 32]) for i in range(0, 128, 32)]
+    q.quantize_net(net, calib, calib_mode="naive")
+    int8_acc = accuracy(net)
+    print(f"fp32 accuracy:  {fp32_acc:.4f}")
+    print(f"int8 accuracy:  {int8_acc:.4f} "
+          f"(layers now: {[type(b).__name__ for b in net]})")
+    assert int8_acc > fp32_acc - 0.02
+
+
+if __name__ == "__main__":
+    main()

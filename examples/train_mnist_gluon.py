@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Gluon MNIST training (reference: example/gluon/mnist/mnist.py).
+
+Run: python examples/train_mnist_gluon.py [--epochs 3] [--hybridize]
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import argparse
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--hybridize", action="store_true")
+    args = ap.parse_args()
+
+    train_ds = MNIST(train=True).transform_first(transforms.ToTensor())
+    val_ds = MNIST(train=False).transform_first(transforms.ToTensor())
+    train = gluon.data.DataLoader(train_ds, args.batch_size, shuffle=True)
+    val = gluon.data.DataLoader(val_ds, args.batch_size)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize()
+    if args.hybridize:
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr}, kvstore="device")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in train:
+            x = x.reshape((x.shape[0], -1))
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+        _, train_acc = metric.get()
+
+        metric.reset()
+        for x, y in val:
+            metric.update(y, net(x.reshape((x.shape[0], -1))))
+        _, val_acc = metric.get()
+        print(f"epoch {epoch}: train_acc={train_acc:.4f} "
+              f"val_acc={val_acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

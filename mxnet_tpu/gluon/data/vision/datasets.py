@@ -22,11 +22,16 @@ __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageFolderDataset", "ImageRecordDataset"]
 
 
-def _synthetic_mnist(num: int, seed: int, num_classes: int = 10):
+def _synthetic_mnist(num: int, seed: int, num_classes: int = 10,
+                     template_seed: int = None):
     """Deterministic learnable stand-in: each class is a blurred template
-    plus noise."""
+    plus noise. The templates come from ``template_seed`` so train and
+    test splits share them (a model trained on one generalizes to the
+    other); only labels/noise vary with ``seed``."""
+    t_rng = onp.random.RandomState(
+        template_seed if template_seed is not None else seed)
+    templates = t_rng.rand(num_classes, 28, 28).astype("float32")
     rng = onp.random.RandomState(seed)
-    templates = rng.rand(num_classes, 28, 28).astype("float32")
     labels = rng.randint(0, num_classes, size=num).astype("int32")
     noise = rng.rand(num, 28, 28).astype("float32") * 0.5
     images = templates[labels] + noise
@@ -61,7 +66,8 @@ class MNIST(Dataset):
         else:
             n = 8000 if self._train else 2000
             self._data, self._label = _synthetic_mnist(
-                n, self._base_seed + (0 if self._train else 1))
+                n, self._base_seed + (0 if self._train else 1),
+                template_seed=self._base_seed)
 
     @staticmethod
     def _read_idx(img_path, lbl_path):
@@ -125,10 +131,13 @@ class CIFAR10(Dataset):
             self._data = onp.concatenate(datas)
             self._label = onp.concatenate(labels)
         else:
+            # templates from a split-independent seed: train and test must
+            # share class structure for the data to be learnable
+            t_rng = onp.random.RandomState(123 + self._num_classes)
+            templates = t_rng.rand(self._num_classes, 32, 32, 3) \
+                .astype("float32")
             rng = onp.random.RandomState(123 if self._train else 321)
             n = 4000 if self._train else 1000
-            templates = rng.rand(self._num_classes, 32, 32, 3) \
-                .astype("float32")
             self._label = rng.randint(0, self._num_classes, n).astype("int32")
             imgs = templates[self._label] + \
                 rng.rand(n, 32, 32, 3).astype("float32") * 0.5

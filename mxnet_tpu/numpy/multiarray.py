@@ -591,7 +591,6 @@ floor_divide = _binary("floor_divide", jnp.floor_divide)
 mod = _binary("mod", jnp.mod)
 remainder = mod
 fmod = _binary("fmod", jnp.fmod)
-divmod_ = None  # not in mx.np
 power = _binary("power", jnp.power)
 float_power = _binary("float_power", jnp.float_power)
 arctan2 = _binary("arctan2", jnp.arctan2)
@@ -1290,3 +1289,69 @@ def size(a, axis=None):
 
 def may_apply_along(a):  # pragma: no cover — placeholder
     raise NotImplementedError
+
+
+# ---- window functions + remaining numpy API surface (reference
+# src/operator/numpy/np_window_op.cc et al.) ----
+
+def hanning(M, dtype=None, ctx=None):
+    return ndarray(jnp.hanning(M).astype(_adt(dtype)),
+                   ctx=ctx)
+
+
+def hamming(M, dtype=None, ctx=None):
+    return ndarray(jnp.hamming(M).astype(_adt(dtype)),
+                   ctx=ctx)
+
+
+def blackman(M, dtype=None, ctx=None):
+    return ndarray(jnp.blackman(M).astype(_adt(dtype)),
+                   ctx=ctx)
+
+
+def kaiser(M, beta, dtype=None, ctx=None):
+    return ndarray(jnp.kaiser(M, beta).astype(_adt(dtype)),
+                   ctx=ctx)
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, axis=0,
+              ctx=None):
+    return ndarray(jnp.geomspace(start, stop, num, endpoint=endpoint,
+                                 dtype=_adt(dtype), axis=axis), ctx=ctx)
+
+
+def unwrap(p, discont=None, axis=-1, period=6.283185307179586):
+    return _invoke("unwrap",
+                   lambda x: jnp.unwrap(x, discont=discont, axis=axis,
+                                        period=period), [asarray(p)])
+
+
+def row_stack(tup):
+    return _invoke("row_stack", lambda *xs: jnp.vstack(xs),
+                   [asarray(x) for x in tup])
+
+
+def divmod(x1, x2):  # noqa: A001 - numpy API name
+    return _invoke("divmod", lambda a, b: (a // b, a % b),
+                   [asarray(x1), asarray(x2)], n_outputs=2)
+
+
+def signbit(x):
+    return _invoke("signbit", jnp.signbit, [asarray(x)])
+
+
+def frexp(x):
+    return _invoke("frexp", jnp.frexp, [asarray(x)], n_outputs=2)
+
+
+def spacing(x):
+    def fn(a):
+        # numpy.spacing: ULP step AWAY from zero (negative for a < 0);
+        # spacing(0) is the smallest subnormal, which XLA's flush-to-zero
+        # arithmetic would lose — special-case it as a constant
+        toward = jnp.where(a >= 0, jnp.full_like(a, jnp.inf),
+                           jnp.full_like(a, -jnp.inf))
+        step = jnp.nextafter(a, toward) - a
+        return jnp.where(a == 0, jnp.finfo(a.dtype).smallest_subnormal,
+                         step)
+    return _invoke("spacing", fn, [asarray(x)])

@@ -1,0 +1,49 @@
+"""Examples must stay runnable (reference CI runs example/ scripts)."""
+import os
+import runpy
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name, argv=("x",)):
+    old = sys.argv
+    sys.argv = list(argv)
+    try:
+        runpy.run_path(os.path.join(REPO, "examples", name),
+                       run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_example_quantize():
+    _run("quantize_inference.py")
+
+
+def test_example_ring_attention():
+    # subprocess: the 8-virtual-device mesh needs XLA_FLAGS set before jax
+    # initializes, which is impossible in this already-initialized process
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "import runpy, sys; sys.argv=['x'];"
+         f"runpy.run_path(r'{os.path.join(REPO, 'examples', 'long_context_ring_attention.py')}',"
+         "run_name='__main__')"],
+        env=env, capture_output=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"ring attention over 8 devices" in r.stdout, r.stdout
+
+
+def test_example_mnist_one_epoch():
+    _run("train_mnist_gluon.py", ("x", "--epochs", "1"))
+
+
+def test_example_bert():
+    _run("train_bert_classifier.py")
