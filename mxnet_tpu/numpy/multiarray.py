@@ -1326,9 +1326,7 @@ def unwrap(p, discont=None, axis=-1, period=6.283185307179586):
                                         period=period), [asarray(p)])
 
 
-def row_stack(tup):
-    return _invoke("row_stack", lambda *xs: jnp.vstack(xs),
-                   [asarray(x) for x in tup])
+row_stack = vstack  # numpy defines row_stack as a vstack alias
 
 
 def divmod(x1, x2):  # noqa: A001 - numpy API name
@@ -1347,8 +1345,11 @@ def frexp(x):
 def spacing(x):
     def fn(a):
         # numpy.spacing: ULP step AWAY from zero (negative for a < 0);
-        # spacing(0) is the smallest subnormal, which XLA's flush-to-zero
-        # arithmetic would lose — special-case it as a constant
+        # integer inputs promote to float like numpy; spacing(0) is the
+        # smallest subnormal, which XLA's flush-to-zero arithmetic would
+        # lose — special-case it as a constant
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            a = a.astype(jnp.float32)  # framework default float width
         toward = jnp.where(a >= 0, jnp.full_like(a, jnp.inf),
                            jnp.full_like(a, -jnp.inf))
         step = jnp.nextafter(a, toward) - a

@@ -1,0 +1,113 @@
+"""Error-handling, sparse, and fft semantics (reference:
+tests/python/unittest/test_exc_handling.py, test_sparse_ndarray.py,
+test_numpy_op.py fft sections)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# error semantics (reference test_exc_handling.py: async failures surface
+# as Python exceptions, engine stays usable)
+# ---------------------------------------------------------------------------
+
+def test_backward_on_unrecorded_raises():
+    x = mx.nd.ones((2,))
+    with pytest.raises(MXNetError):
+        x.backward()
+
+
+def test_grad_of_non_attached_input():
+    x = mx.nd.ones((2,))
+    y = mx.nd.ones((2,))
+    y.attach_grad()
+    with mx.autograd.record():
+        z = (x * y).sum()
+    z.backward()
+    assert y.grad is not None
+    assert x.grad is None  # never attached: no gradient buffer
+
+
+def test_shape_mismatch_is_python_exception():
+    with pytest.raises(Exception):
+        mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 5))).wait_to_read()
+    # framework still healthy afterwards
+    out = mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((3, 2)))
+    onp.testing.assert_allclose(out.asnumpy(), 3 * onp.ones((2, 2)))
+
+
+def test_invalid_context_raises():
+    with pytest.raises(MXNetError):
+        mx.tpu(99)
+
+
+def test_unknown_optimizer_raises():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    with pytest.raises(MXNetError):
+        mx.gluon.Trainer(net.collect_params(), "definitely_not_an_optimizer")
+
+
+# ---------------------------------------------------------------------------
+# sparse (reference test_sparse_ndarray.py)
+# ---------------------------------------------------------------------------
+
+def test_row_sparse_roundtrip_and_retain():
+    from mxnet_tpu.ndarray import sparse
+    dense = onp.zeros((6, 3), "float32")
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rs = sparse.row_sparse_array(
+        (onp.array([[1., 1., 1.], [2., 2., 2.]], "float32"),
+         onp.array([1, 4], "int64")), shape=(6, 3))
+    assert rs.stype == "row_sparse"
+    onp.testing.assert_allclose(rs.asdense().asnumpy(), dense)
+    kept = rs.retain(mx.nd.array(onp.array([4], "int64")))
+    d2 = kept.asdense().asnumpy()
+    assert d2[1].sum() == 0 and d2[4].sum() == 6
+
+
+def test_csr_roundtrip_and_dot():
+    from mxnet_tpu.ndarray import sparse
+    dense = onp.array([[0, 1, 0], [2, 0, 3]], "float32")
+    csr = sparse.csr_matrix(
+        (onp.array([1., 2., 3.], "float32"),
+         onp.array([1, 0, 2], "int64"),
+         onp.array([0, 1, 3], "int64")), shape=(2, 3))
+    assert csr.stype == "csr"
+    onp.testing.assert_allclose(csr.asdense().asnumpy(), dense)
+    rhs = onp.array([[1.], [2.], [3.]], "float32")
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), dense @ rhs)
+
+
+def test_tostype_conversions():
+    from mxnet_tpu.ndarray import sparse
+    x = mx.nd.array(onp.array([[0, 1], [0, 0], [2, 0]], "float32"))
+    rs = x.tostype("row_sparse") if hasattr(x, "tostype") \
+        else sparse.row_sparse_array(x)
+    onp.testing.assert_allclose(rs.asdense().asnumpy(), x.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# fft (reference numpy fft ops)
+# ---------------------------------------------------------------------------
+
+def test_fft_roundtrip_and_freqs():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(16).astype("float32")
+    X = mx.np.fft.fft(mx.np.array(x))
+    onp.testing.assert_allclose(X.asnumpy(), onp.fft.fft(x),
+                                rtol=1e-4, atol=1e-4)
+    back = mx.np.fft.ifft(X)
+    onp.testing.assert_allclose(back.asnumpy().real, x, rtol=1e-4,
+                                atol=1e-4)
+    onp.testing.assert_allclose(
+        mx.np.fft.rfftfreq(8, d=0.5).asnumpy(), onp.fft.rfftfreq(8, 0.5))
+    x2 = rng.randn(4, 8).astype("float32")
+    onp.testing.assert_allclose(
+        mx.np.fft.fft2(mx.np.array(x2)).asnumpy(), onp.fft.fft2(x2),
+        rtol=1e-3, atol=1e-3)
