@@ -29,9 +29,12 @@ _DEVTYPE_NAMES = {_DEVTYPE_CPU: "cpu", _DEVTYPE_TPU: "tpu",
 
 
 def _accelerator_devices():
-    """All non-CPU jax devices, else CPU devices (CPU-only test rigs)."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs if devs else jax.devices()
+    """Non-CPU jax devices addressable by THIS process, else local CPU
+    devices (CPU-only test rigs). Local, not global: under jax.distributed a
+    Context can only place data on this worker's own chips — the reference's
+    ctx is likewise per-process (each worker addresses its own GPUs)."""
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return devs if devs else jax.local_devices()
 
 
 class Context:
@@ -67,11 +70,11 @@ class Context:
     @property
     def jax_device(self) -> jax.Device:
         if self.device_type in ("cpu", "cpu_pinned"):
-            cpus = [d for d in jax.devices() if d.platform == "cpu"]
+            cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
             if not cpus:
                 # On a TPU-only runtime host staging still works via numpy;
                 # map cpu ctx onto device 0 as the reference maps pinned mem.
-                cpus = jax.devices()
+                cpus = jax.local_devices()
             return cpus[min(self.device_id, len(cpus) - 1)]
         devs = _accelerator_devices()
         if self.device_id >= len(devs):
@@ -139,7 +142,7 @@ def device(dev_type: str, device_id: int = 0) -> Context:
 
 
 def num_tpus() -> int:
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return len(devs)
 
 

@@ -50,17 +50,21 @@ class Engine:
 
     def wait_for_all(self):
         """Block until all pending async work completes; raises deferred
-        errors (reference Engine::WaitForAll)."""
-        try:
-            jax.effects_barrier()
-        except Exception:
-            pass
-        # Sync all live devices; PjRt surfaces async errors here.
-        for d in jax.devices():
-            try:
-                d.synchronize_all_activity()  # pjrt device sync if available
-            except AttributeError:
+        errors (reference Engine::WaitForAll; rethrow contract
+        threaded_engine.cc:422-436). Deferred computation errors MUST
+        propagate from here — only the absence of the barrier API itself is
+        tolerated, never an error it reports."""
+        barrier = getattr(jax, "effects_barrier", None)
+        if barrier is not None:
+            barrier()
+        # Sync all locally-addressable devices; PjRt surfaces async errors
+        # here (remote workers sync their own — reference WaitForAll is
+        # per-process too).
+        for d in jax.local_devices():
+            sync = getattr(d, "synchronize_all_activity", None)
+            if sync is None:
                 break
+            sync()
 
     def set_bulk_size(self, size: int) -> int:
         """Reference ThreadedEngine::set_bulk_size (threaded_engine.h:414).

@@ -249,6 +249,11 @@ class Block:
         for child in self._children.values():
             child.hybridize(active, **kwargs)
 
+    def _iter_blocks(self):
+        yield self
+        for c in self._children.values():
+            yield from c._iter_blocks()
+
     def summary(self, *inputs):
         lines = [f"{type(self).__name__}:"]
         for k, p in self.collect_params().items():
@@ -305,12 +310,27 @@ class HybridBlock(Block):
     def _ensure_shapes(self, args, kwargs=None):
         """Trigger deferred param init by one throwaway eager forward
         (the reference's deferred-compute trace performs shape inference;
-        our layers infer shapes inline in forward)."""
+        our layers infer shapes inline in forward).
+
+        Hybridization is deactivated for the throwaway pass: child cached
+        ops draw a per-call RNG key, which would advance the seeded global
+        chain between deferred inits and break the "same seed ⇒ same
+        weights" invariant between eager and hybrid execution (reference
+        guarantees init values are independent of hybridize())."""
         incomplete = any(p._data is None
                          for p in self.collect_params().values())
-        if incomplete:
+        if not incomplete:
+            return
+        hybrids = [b for b in self._iter_blocks()
+                   if isinstance(b, HybridBlock) and b._active]
+        for b in hybrids:
+            b._active = False
+        try:
             with autograd.pause():
                 self.forward(*args, **(kwargs or {}))
+        finally:
+            for b in hybrids:
+                b._active = True
 
     def _build_cache(self, args, kwargs=None):
         self._ensure_shapes(args, kwargs)

@@ -73,12 +73,23 @@ class KVStoreTPU(KVStoreBase):
             o._data = merged._data
         return out
 
+    def _merge(self, values: List[NDArray]) -> NDArray:
+        """Reduce per-device replica values to one array. KVStoreDist
+        overrides this with a cross-process collective."""
+        return _reduce_sum(values)
+
+    def _compressed(self, key, values: List[NDArray]) -> List[NDArray]:
+        """Wire-compression applied before merge (reference compresses on
+        push, kvstore_dist.h). Returns NEW arrays; callers must keep the
+        originals for result writeback."""
+        if self._compression is None:
+            return values
+        return [self._compression.compress_decompress(v, (str(key), i))
+                for i, v in enumerate(values)]
+
     def pushpull(self, key, value, out=None, priority=0):
         values = _as_list(value)
-        if self._compression is not None:
-            values = [self._compression.compress_decompress(v)
-                      for v in values]
-        merged = _reduce_sum(values)
+        merged = self._merge(self._compressed(key, values))
         if self._updater is not None:
             skey = str(key)
             if skey not in self._store:
@@ -88,6 +99,8 @@ class KVStoreTPU(KVStoreBase):
         else:
             result = merged
         if out is None:
+            # write back into the caller's arrays (NOT the compressed
+            # copies _compressed returned)
             for v in values:
                 v._data = result._data
             return value
@@ -111,7 +124,7 @@ class KVStoreTPU(KVStoreBase):
             for k, v in zip(keys, value):
                 grouped.setdefault(str(k), []).extend(_as_list(v))
         for k, vals in grouped.items():
-            merged = _reduce_sum(vals)
+            merged = self._merge(self._compressed(k, vals))
             if self._updater is not None:
                 if k not in self._store:
                     self._store[k] = NDArray(merged._data)
@@ -208,13 +221,105 @@ def _int_or_str(k: str):
 
 class KVStoreDist(KVStoreTPU):
     """Multi-host store (reference kvstore_dist.h over ps-lite). TPU-native:
-    rides the jax.distributed runtime — every worker holds a shard of the
-    global mesh and pushpull lowers to DCN-spanning allreduce. Requires
-    jax.distributed.initialize() (see parallel/dist.py launch helper)."""
+    rides the jax.distributed runtime — every worker contributes its local
+    gradient as one shard of a global array over a one-device-per-process
+    mesh, and a jitted SPMD sum issues the DCN-spanning allreduce (the
+    successor of ps::KVWorker::ZPush/ZPull, reference kvstore_dist.h:44-157,
+    and the server-side merge kvstore_dist_server.h:330-359). Requires
+    jax.distributed.initialize() (see parallel/dist.py launch helper).
+
+    Sync vs async (reference kvstore_dist_server.h:164-206): in sync mode
+    every pushpull blocks until the merged value is materialized — all
+    workers advance in lockstep. In async mode the collective is *dispatched*
+    but not waited on (JAX async dispatch), so a worker continues into its
+    next step while the reduction is in flight; ordering per key is still
+    preserved by XLA's program order, which is strictly stronger than
+    ps-lite async (no unbounded staleness).
+    """
 
     def __init__(self, name: str = "dist_sync"):
         super().__init__(name)
         self._async = "async" in name
+        self._mesh = None
+        self._sum_fn = None
+
+    # -------- cross-process collective machinery --------
+    def _worker_mesh(self):
+        """One device per process, ordered by process index — the 'worker'
+        axis every cross-host reduction runs over."""
+        if self._mesh is None:
+            import numpy as onp
+            from jax.sharding import Mesh
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[i] for i in sorted(per_proc)]
+            self._mesh = Mesh(onp.array(devs), ("worker",))
+        return self._mesh
+
+    def _cross_process_sum(self, x: jax.Array) -> jax.Array:
+        """Sum one same-shaped array per worker across ALL processes.
+
+        Each process donates its local value as the shard at index
+        process_index of a (num_workers, *shape) global array; a jitted sum
+        over the worker axis makes XLA emit the cross-host all-reduce.
+        All workers must call this in the same per-key order (the reference's
+        sync contract; kvstore.h:129-141 engine-ordering analog)."""
+        nproc = jax.process_count()
+        if nproc == 1:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._worker_mesh()
+        local_dev = mesh.devices.flat[jax.process_index()]
+        xl = jax.device_put(x, local_dev)[None]
+        gshape = (nproc,) + tuple(x.shape)
+        garr = jax.make_array_from_single_device_arrays(
+            gshape, NamedSharding(mesh, PartitionSpec("worker")), [xl])
+        if self._sum_fn is None:
+            self._sum_fn = jax.jit(
+                lambda a: jnp.sum(a, axis=0),
+                out_shardings=NamedSharding(mesh, PartitionSpec()))
+        out = self._sum_fn(garr)
+        if not self._async:
+            out.block_until_ready()
+        return out.addressable_data(0)
+
+    # -------- overridden reduction point --------
+    def _merge(self, values: List[NDArray]) -> NDArray:
+        """Local replica reduce, then the worker-axis allreduce; push and
+        pushpull (and their compression hook) are inherited unchanged."""
+        local = _reduce_sum(values)
+        return NDArray(self._cross_process_sum(local._data))
+
+    def broadcast(self, key, value, out, priority=0):
+        """Rank 0's value wins (reference: server holds init value; workers
+        pull it). Implemented as a worker-axis sum where non-root workers
+        contribute zeros."""
+        value = _as_list(value)
+        local = _reduce_sum(value) if len(value) > 1 else value[0]
+        data = local._data
+        if jax.process_count() > 1:
+            if jax.process_index() != 0:
+                data = jnp.zeros_like(data)
+            data = self._cross_process_sum(data)
+        self._store[str(key)] = NDArray(data)
+        for o in _as_list(out):
+            o._data = data
+        return out
+
+    def init(self, key, value):
+        keys = _as_list(key) if isinstance(key, (list, tuple)) else [key]
+        values = _as_list(value)
+        for k, v in zip(keys, values):
+            self.broadcast(k, v, out=[v])
+
+    def barrier(self):
+        """Cross-host barrier (reference ps::Postoffice barrier)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+        else:
+            super().barrier()
 
 
 # name → class resolution (reference factory kvstore.cc:41-79)

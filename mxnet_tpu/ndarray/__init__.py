@@ -8,6 +8,8 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       linspace, eye, concatenate, waitall, from_jax, moveaxis)
 from .ops import *  # noqa: F401,F403
 from .nn_ops import *  # noqa: F401,F403
+from .vision_ops import (BilinearSampler, GridGenerator, SpatialTransformer,
+                         Correlation)
 from . import ops as op
 from . import random
 from . import sparse

@@ -27,6 +27,19 @@ from .ndarray import NDArray
 
 __all__ = ["foreach", "while_loop", "cond"]
 
+# grid-sampling / detection family lives in vision_ops; the reference
+# exposes these under mx.nd.contrib.* (contrib/deformable_convolution.cc,
+# deformable_psroi_pooling.cc, proposal.cc, count_sketch.cc,
+# sync_batch_norm.cc)
+from .vision_ops import (DeformableConvolution, DeformablePSROIPooling,  # noqa: E402,F401
+                         Proposal, MultiProposal, count_sketch,
+                         SyncBatchNorm, BilinearSampler, GridGenerator,
+                         SpatialTransformer, Correlation)
+__all__ += ["DeformableConvolution", "DeformablePSROIPooling", "Proposal",
+            "MultiProposal", "count_sketch", "SyncBatchNorm",
+            "BilinearSampler", "GridGenerator", "SpatialTransformer",
+            "Correlation"]
+
 
 def _as_list(x) -> Tuple[List, bool]:
     if isinstance(x, (list, tuple)):

@@ -584,7 +584,7 @@ class NDArray:
 
 
 def _accel_index(dev) -> int:
-    accels = [d for d in jax.devices() if d.platform != "cpu"]
+    accels = [d for d in jax.local_devices() if d.platform != "cpu"]
     for i, d in enumerate(accels):
         if d == dev:
             return i

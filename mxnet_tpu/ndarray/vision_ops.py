@@ -1,0 +1,519 @@
+"""Grid-sampling / detection / correlation operator family.
+
+Reference analogs (all CUDA/C++ there, pure-XLA here):
+- BilinearSampler        src/operator/bilinear_sampler.cc
+- GridGenerator          src/operator/grid_generator.cc
+- SpatialTransformer     src/operator/spatial_transformer.cc
+- DeformableConvolution  src/operator/contrib/deformable_convolution.cc
+  (offset-channel layout per deformable_im2col.h:239-243: for deformable
+  group g and kernel tap k=(i*kw+j), channel 2k is the ROW offset map and
+  2k+1 the COLUMN offset map)
+- DeformablePSROIPooling src/operator/contrib/deformable_psroi_pooling.cc
+- Proposal               src/operator/contrib/proposal.cc
+- Correlation            src/operator/correlation-inl.h:98-116
+- CountSketch            src/operator/contrib/count_sketch.cc
+- SyncBatchNorm          src/operator/contrib/sync_batch_norm.cc
+
+TPU-native design: ONE shared differentiable bilinear-grid kernel
+(`_grid_sample`) backs the sampler family — each op is a coordinate
+transform plus that kernel, and XLA fuses the gathers. All kernel taps /
+displacement loops are static Python loops over small constant ranges, so
+everything stays a single fused XLA computation (no dynamic shapes).
+SyncBatchNorm is the degenerate case: one mesh-sharded logical batch is
+already globally normalized, with an optional `axis_name` for explicit
+shard_map code.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import invoke_raw
+from .ndarray import NDArray
+
+__all__ = ["BilinearSampler", "GridGenerator", "SpatialTransformer",
+           "DeformableConvolution", "DeformablePSROIPooling", "Proposal",
+           "MultiProposal", "Correlation", "count_sketch", "SyncBatchNorm"]
+
+
+def _wrap(x):
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+# ---------------------------------------------------------------------------
+# shared bilinear-grid kernel
+# ---------------------------------------------------------------------------
+
+def _grid_sample(data: jax.Array, ys: jax.Array, xs: jax.Array) -> jax.Array:
+    """Sample ``data`` (B, C, H, W) at fractional pixel coords ``ys``/``xs``
+    (B, *S), zero-padded outside the image (reference bilinear_sampler.cc /
+    deformable_im2col.h boundary semantics). Returns (B, C, *S).
+
+    Differentiable wrt data AND coords; the 4 corner gathers vectorize to
+    XLA gathers that fuse with the weighting arithmetic.
+    """
+    B, C, H, W = data.shape
+    sshape = ys.shape[1:]
+    ys = ys.reshape(B, -1)
+    xs = xs.reshape(B, -1)
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+
+    def corner(yi, xi, wy, wx):
+        valid = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        # (B, C, N) gather of per-batch pixel lists
+        flat = data.reshape(B, C, H * W)
+        idx = yc * W + xc                              # (B, N)
+        vals = jnp.take_along_axis(flat, idx[:, None, :], axis=2)
+        w = (wy * wx * valid.astype(data.dtype))[:, None, :]
+        return vals * w
+
+    out = (corner(y0, x0, wy0, wx0) + corner(y0, x0 + 1, wy0, wx1)
+           + corner(y0 + 1, x0, wy1, wx0) + corner(y0 + 1, x0 + 1, wy1, wx1))
+    return out.reshape((B, C) + sshape)
+
+
+# ---------------------------------------------------------------------------
+# sampler family
+# ---------------------------------------------------------------------------
+
+def BilinearSampler(data, grid, **_ignored):
+    """``out[b,c,i,j] = G(data[b,c], grid[b,1,i,j], grid[b,0,i,j])`` with
+    grid in [-1, 1] (reference bilinear_sampler.cc: -1 ↦ pixel 0,
+    +1 ↦ pixel H-1/W-1; outside ↦ 0)."""
+    data, grid = _wrap(data), _wrap(grid)
+
+    def fn(d, g):
+        H, W = d.shape[2], d.shape[3]
+        xs = (g[:, 0] + 1.0) * (W - 1) / 2.0
+        ys = (g[:, 1] + 1.0) * (H - 1) / 2.0
+        return _grid_sample(d, ys, xs)
+
+    return invoke_raw("BilinearSampler", fn, [data, grid])
+
+
+def GridGenerator(data, transform_type: str = "affine",
+                  target_shape: Optional[Sequence[int]] = None, **_ignored):
+    """Generate a sampling grid (B, 2, H, W) with channel 0 = x, 1 = y in
+    [-1, 1] (reference grid_generator.cc). 'affine': data (B, 6) row-major
+    2x3 matrices applied to the regular target grid. 'warp': data (B,2,H,W)
+    optical flow in pixels added to the regular grid then normalized."""
+    data = _wrap(data)
+    if transform_type == "affine":
+        if target_shape is None:
+            raise MXNetError("GridGenerator(affine) needs target_shape")
+        H, W = int(target_shape[0]), int(target_shape[1])
+
+        def fn(theta):
+            B = theta.shape[0]
+            ys, xs = jnp.meshgrid(jnp.linspace(-1.0, 1.0, H),
+                                  jnp.linspace(-1.0, 1.0, W), indexing="ij")
+            ones = jnp.ones_like(xs)
+            src = jnp.stack([xs, ys, ones], 0).reshape(3, -1)  # (3, H*W)
+            m = theta.reshape(B, 2, 3)
+            out = jnp.einsum("bij,jn->bin", m, src)            # (B, 2, H*W)
+            return out.reshape(B, 2, H, W)
+
+        return invoke_raw("GridGenerator", fn, [data])
+
+    if transform_type == "warp":
+        def fn(flow):
+            B, _, H, W = flow.shape
+            ys, xs = jnp.meshgrid(jnp.arange(H, dtype=flow.dtype),
+                                  jnp.arange(W, dtype=flow.dtype),
+                                  indexing="ij")
+            x = (xs[None] + flow[:, 0]) * 2.0 / max(W - 1, 1) - 1.0
+            y = (ys[None] + flow[:, 1]) * 2.0 / max(H - 1, 1) - 1.0
+            return jnp.stack([x, y], 1)
+
+        return invoke_raw("GridGenerator", fn, [data])
+    raise MXNetError(f"unknown transform_type {transform_type!r}")
+
+
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type: str = "affine",
+                       sampler_type: str = "bilinear", **_ignored):
+    """Affine spatial transformer network op (reference
+    spatial_transformer.cc): grid-generate from ``loc`` then bilinear-sample
+    ``data``."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear")
+    grid = GridGenerator(loc, "affine", target_shape)
+    return BilinearSampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# deformable family
+# ---------------------------------------------------------------------------
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=None,
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=None, num_group: int = 1,
+                          num_deformable_group: int = 1, no_bias=False,
+                          **_ignored):
+    """DCNv1 (reference contrib/deformable_convolution.cc): each kernel tap
+    samples the input at a learned fractional offset. Implemented as K
+    bilinear grid-samples (one per tap, static loop) building the
+    deformable im2col tensor, then one einsum onto the MXU."""
+    data, offset, weight = _wrap(data), _wrap(offset), _wrap(weight)
+    kh, kw = (int(kernel[0]), int(kernel[1])) if kernel is not None \
+        else (int(weight.shape[2]), int(weight.shape[3]))
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    dg = int(num_deformable_group)
+
+    def fn(x, off, w, *maybe_b):
+        B, C, H, W = x.shape
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        base_y = jnp.arange(Ho) * sh - ph
+        base_x = jnp.arange(Wo) * sw - pw
+        gy, gx = jnp.meshgrid(base_y.astype(x.dtype),
+                              base_x.astype(x.dtype), indexing="ij")
+        cols = []  # K entries of (B, C, Ho, Wo)
+        cpg = C // dg  # data channels per deformable group
+        for k in range(kh * kw):
+            i, j = divmod(k, kw)
+            per_g = []
+            for g in range(dg):
+                oy = off[:, (g * kh * kw + k) * 2]        # (B, Ho, Wo)
+                ox = off[:, (g * kh * kw + k) * 2 + 1]
+                ys = gy[None] + i * dh + oy
+                xs = gx[None] + j * dw + ox
+                per_g.append(_grid_sample(
+                    x[:, g * cpg:(g + 1) * cpg], ys, xs))
+            cols.append(jnp.concatenate(per_g, axis=1) if dg > 1
+                        else per_g[0])
+        col = jnp.stack(cols, axis=2)                     # (B, C, K, Ho, Wo)
+        O = w.shape[0]
+        cg = C // num_group
+        og = O // num_group
+        col = col.reshape(B, num_group, cg, kh * kw, Ho, Wo)
+        wg = w.reshape(num_group, og, cg, kh * kw)
+        out = jnp.einsum("bgckn,gock->bgon",
+                         col.reshape(B, num_group, cg, kh * kw, Ho * Wo), wg)
+        out = out.reshape(B, O, Ho, Wo)
+        if maybe_b:
+            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [data, offset, weight]
+    if not no_bias and bias is not None:
+        args.append(_wrap(bias))
+    return invoke_raw("DeformableConvolution", fn, args)
+
+
+def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
+                           output_dim=None, group_size=1, pooled_size=7,
+                           part_size=0, sample_per_part=1, trans_std=0.0,
+                           no_trans=False, **_ignored):
+    """Deformable position-sensitive ROI pooling (reference
+    contrib/deformable_psroi_pooling.cc). data channels =
+    output_dim * group_size^2; each pooled bin (ph, pw) averages
+    sample_per_part^2 bilinear samples from its position-sensitive channel
+    group, displaced by the learned normalized offsets in ``trans``."""
+    data, rois = _wrap(data), _wrap(rois)
+    P = int(pooled_size)
+    G = int(group_size)
+    part = int(part_size) if part_size else P
+    spp = int(sample_per_part)
+    out_dim = int(output_dim) if output_dim else data.shape[1] // (G * G)
+
+    def fn(x, r, *maybe_t):
+        B, C, H, W = x.shape
+        R = r.shape[0]
+        batch_idx = r[:, 0].astype(jnp.int32)
+        # rois scaled to feature coords; +pixel rounding per reference
+        x1 = jnp.round(r[:, 1]) * spatial_scale - 0.5
+        y1 = jnp.round(r[:, 2]) * spatial_scale - 0.5
+        x2 = (jnp.round(r[:, 3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(r[:, 4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P                                     # (R,)
+        bin_h = rh / P
+        sub_w = bin_w / spp
+        sub_h = bin_h / spp
+
+        ph = jnp.arange(P)
+        pw = jnp.arange(P)
+        gph, gpw = jnp.meshgrid(ph, pw, indexing="ij")     # (P, P)
+
+        if maybe_t and not no_trans:
+            t = maybe_t[0]                                 # (R, 2*cls, part, part)
+            cls = t.shape[1] // 2
+            pidx_h = jnp.clip((gph * part) // P, 0, part - 1)
+            pidx_w = jnp.clip((gpw * part) // P, 0, part - 1)
+            # class-0 offsets; reference layout is x at channel 2*cls,
+            # y at 2*cls+1 (deformable_psroi_pooling.cu:110-118) — NOT the
+            # row-first order the deformable-conv offsets use
+            dx = t[:, 0, pidx_h, pidx_w] * trans_std       # (R, P, P)
+            dy = t[:, 1, pidx_h, pidx_w] * trans_std
+        else:
+            dy = jnp.zeros((R, P, P), x.dtype)
+            dx = jnp.zeros((R, P, P), x.dtype)
+
+        # sample grid per bin: (R, P, P, spp, spp)
+        s = (jnp.arange(spp, dtype=x.dtype) + 0.5)
+        ys = (y1[:, None, None] + gph[None] * bin_h[:, None, None]
+              + dy * rh[:, None, None])[..., None, None] \
+            + s[None, None, None, :, None] * sub_h[:, None, None, None, None]
+        xs = (x1[:, None, None] + gpw[None] * bin_w[:, None, None]
+              + dx * rw[:, None, None])[..., None, None] \
+            + s[None, None, None, None, :] * sub_w[:, None, None, None, None]
+
+        # gather each roi's source image: (R, C, H, W)
+        src = x[batch_idx]
+        samp = _grid_sample(src, ys, xs)   # (R, C, P, P, spp, spp)
+        samp = samp.mean(axis=(-2, -1))    # (R, C, P, P)
+        # position-sensitive channel select: channel block depends on bin
+        samp = samp.reshape(R, out_dim, G, G, P, P)
+        gh = jnp.clip((gph * G) // P, 0, G - 1)            # (P, P)
+        gw = jnp.clip((gpw * G) // P, 0, G - 1)
+        out = samp[:, :, gh, gw, gph, gpw]                 # (R, out_dim, P, P)
+        return out
+
+    args = [data, rois]
+    if trans is not None and not no_trans:
+        args.append(_wrap(trans))
+    return invoke_raw("DeformablePSROIPooling", fn, args)
+
+
+# ---------------------------------------------------------------------------
+# proposal (RPN)
+# ---------------------------------------------------------------------------
+
+def _make_anchors(base_size, scales, ratios):
+    """Anchor windows centered on a base_size cell (reference
+    contrib/proposal.cc GenerateAnchors semantics)."""
+    import numpy as onp
+    px = (base_size - 1) * 0.5
+    anchors = []
+    for r in ratios:
+        size = base_size * base_size / r
+        ws = onp.round(onp.sqrt(size))
+        hs = onp.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            anchors.append([px - 0.5 * (w - 1), px - 0.5 * (h - 1),
+                            px + 0.5 * (w - 1), px + 0.5 * (h - 1)])
+    return onp.array(anchors, dtype="float32")
+
+
+def Proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False,
+             **_ignored):
+    """RPN proposal op (reference contrib/proposal.cc): decode anchor
+    deltas, clip to image, drop small boxes, take pre-NMS top-K by score,
+    greedy-NMS, pad to post-NMS count. Output (B*post_nms, 5):
+    [batch_idx, x1, y1, x2, y2] (+ scores when output_score)."""
+    from .contrib import box_nms
+    cls_prob, bbox_pred, im_info = \
+        _wrap(cls_prob), _wrap(bbox_pred), _wrap(im_info)
+    anchors_base = _make_anchors(feature_stride, scales, ratios)
+    A = anchors_base.shape[0]
+    pre_n = int(rpn_pre_nms_top_n)
+    post_n = int(rpn_post_nms_top_n)
+
+    def fn(cp, bp, info):
+        B, _, H, W = cp.shape
+        shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+        shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+        sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+        shifts = jnp.stack([sx, sy, sx, sy], -1).reshape(-1, 1, 4)
+        anc = (jnp.asarray(anchors_base)[None] + shifts).reshape(-1, 4)
+        N = anc.shape[0]                                  # H*W*A
+
+        # deltas (B, 4A, H, W) -> (B, N, 4) matching anchor order (h,w,a)
+        d = bp.reshape(B, A, 4, H, W).transpose(0, 3, 4, 1, 2).reshape(B, N, 4)
+        scores = cp[:, A:].reshape(B, A, H, W) \
+            .transpose(0, 2, 3, 1).reshape(B, N)          # fg scores
+
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + 0.5 * (aw - 1)
+        acy = anc[:, 1] + 0.5 * (ah - 1)
+        cx = d[..., 0] * aw + acx
+        cy = d[..., 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[..., 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[..., 3], -10, 10)) * ah
+        x1 = cx - 0.5 * (w - 1)
+        y1 = cy - 0.5 * (h - 1)
+        x2 = cx + 0.5 * (w - 1)
+        y2 = cy + 0.5 * (h - 1)
+        # clip to image (im_info rows: [height, width, scale])
+        imh = info[:, 0][:, None]
+        imw = info[:, 1][:, None]
+        x1 = jnp.clip(x1, 0, imw - 1.0)
+        y1 = jnp.clip(y1, 0, imh - 1.0)
+        x2 = jnp.clip(x2, 0, imw - 1.0)
+        y2 = jnp.clip(y2, 0, imh - 1.0)
+        # min-size filter (scaled by im scale)
+        min_sz = rpn_min_size * info[:, 2][:, None]
+        keep = ((x2 - x1 + 1.0) >= min_sz) & ((y2 - y1 + 1.0) >= min_sz)
+        scores_f = jnp.where(keep, scores, -1.0)
+
+        k = min(pre_n, N)
+        top_scores, top_idx = lax.top_k(scores_f, k)
+        def take(v):
+            return jnp.take_along_axis(v, top_idx, axis=1)
+        rows = jnp.stack([jnp.zeros_like(top_scores), top_scores,
+                          take(x1), take(y1), take(x2), take(y2)], -1)
+        return rows                                       # (B, k, 6)
+
+    rows = invoke_raw("Proposal_decode", fn, [cls_prob, bbox_pred, im_info])
+    kept = box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
+                   topk=post_n, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=True)
+
+    def pick(kr):
+        B = kr.shape[0]
+        if kr.shape[1] < post_n:   # fewer anchors than post-NMS count
+            kr = jnp.pad(kr, ((0, 0), (0, post_n - kr.shape[1]), (0, 0)),
+                         constant_values=-1.0)
+        out = kr[:, :post_n, :]                           # (B, post_n, 6)
+        # suppressed rows come back as -1 markers from box_nms; emit them as
+        # all-zero padding rows (fixed output shape, reference pads too)
+        valid = (out[..., 0] >= 0)[..., None]
+        out = jnp.where(valid, out, jnp.zeros_like(out))
+        bidx = jnp.broadcast_to(
+            jnp.arange(B, dtype=kr.dtype)[:, None], out.shape[:2])
+        boxes = jnp.concatenate([bidx[..., None], out[..., 2:6]], -1)
+        score = out[..., 1:2]
+        boxes = boxes.reshape(B * post_n, 5)
+        score = score.reshape(B * post_n, 1)
+        return (jnp.concatenate([boxes, score], -1) if output_score
+                else boxes)
+
+    return invoke_raw("Proposal_pick", pick, [kept])
+
+
+def MultiProposal(*args, **kwargs):
+    """Batch variant — identical here (Proposal already handles B > 1;
+    reference contrib/multi_proposal.cc)."""
+    return Proposal(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# correlation (FlowNet)
+# ---------------------------------------------------------------------------
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, **_ignored):
+    """Patch cross-correlation between two feature maps (reference
+    correlation-inl.h:98-116). Output channels = ((2*max_displacement /
+    stride2) + 1)^2, each the kernel-window correlation at one displacement
+    — a static displacement loop of shifted elementwise products that XLA
+    fuses; no explicit im2col buffer."""
+    data1, data2 = _wrap(data1), _wrap(data2)
+    K = int(kernel_size)
+    md = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    pad = int(pad_size)
+    kr = (K - 1) // 2
+    border = md + kr
+    ngr = md // s2                       # neighborhood grid radius
+    ngw = 2 * ngr + 1
+
+    def fn(a, b):
+        B, C, H, W = a.shape
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        Hp, Wp = H + 2 * pad, W + 2 * pad
+        Ho = int(jnp.ceil((Hp - border * 2) / s1))
+        Wo = int(jnp.ceil((Wp - border * 2) / s1))
+        ys = border + jnp.arange(Ho) * s1
+        xs = border + jnp.arange(Wo) * s1
+        sumelems = K * K * C
+
+        def reduce_window(oy, ox):
+            # kernel-window reduction of a⋆b at displacement (oy, ox):
+            # (B, Ho, Wo) after channel sum
+            acc = 0.0
+            for ky in range(-kr, K - kr):
+                for kx in range(-kr, K - kr):
+                    a_w = ap[:, :, (ys + ky)[:, None], (xs + kx)[None, :]]
+                    b_w = bp[:, :, (ys + oy + ky)[:, None],
+                             (xs + ox + kx)[None, :]]
+                    acc = acc + (a_w * b_w if is_multiply
+                                 else jnp.abs(a_w - b_w))
+            return acc.sum(axis=1) / sumelems
+
+        outs = [reduce_window(dy * s2, dx * s2)
+                for dy in range(-ngr, ngr + 1)
+                for dx in range(-ngr, ngr + 1)]
+        return jnp.stack(outs, axis=1)    # (B, ngw*ngw, Ho, Wo)
+
+    return invoke_raw("Correlation", fn, [data1, data2])
+
+
+# ---------------------------------------------------------------------------
+# count sketch
+# ---------------------------------------------------------------------------
+
+def count_sketch(data, h, s, out_dim: int, **_ignored):
+    """Count-sketch projection (reference contrib/count_sketch.cc, used by
+    MCB pooling): out[b, h[i]] += s[i] * data[b, i]. One XLA scatter-add;
+    autodiff gives the transpose gather for free."""
+    data, h, s = _wrap(data), _wrap(h), _wrap(s)
+    out_dim = int(out_dim)
+
+    def fn(x, hh, ss):
+        B = x.shape[0]
+        idx = hh.reshape(-1).astype(jnp.int32)
+        sign = ss.reshape(-1).astype(x.dtype)
+        out = jnp.zeros((B, out_dim), x.dtype)
+        return out.at[:, idx].add(x * sign[None, :])
+
+    return invoke_raw("count_sketch", fn, [data, h, s])
+
+
+# ---------------------------------------------------------------------------
+# sync batch norm
+# ---------------------------------------------------------------------------
+
+def SyncBatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                  momentum=0.9, fix_gamma=True, use_global_stats=False,
+                  ndev=1, key=None, axis_name=None, **_ignored):
+    """Cross-device BatchNorm (reference contrib/sync_batch_norm.cc, which
+    all-reduces batch mean/var over GPUs via a barrier rendezvous).
+
+    TPU-native: a mesh-sharded batch is ONE logical array, so plain
+    BatchNorm statistics are already global — XLA inserts the psum when the
+    batch axis is sharded. That makes this the default path (ndev/key are
+    accepted for API parity). Inside explicit shard_map/pmap code pass
+    ``axis_name`` to psum the per-shard moments."""
+    if axis_name is None:
+        from .nn_ops import BatchNorm
+        return BatchNorm(data, gamma, beta, moving_mean, moving_var,
+                         eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                         use_global_stats=use_global_stats)
+
+    data = _wrap(data)
+    gamma, beta = _wrap(gamma), _wrap(beta)
+
+    def fn(x, g, b):
+        axes = (0,) + tuple(range(2, x.ndim))
+        mean = jax.lax.pmean(jnp.mean(x, axis=axes), axis_name)
+        var = jax.lax.pmean(jnp.mean(x * x, axis=axes), axis_name) \
+            - mean * mean
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        xn = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+        gg = jnp.ones_like(g) if fix_gamma else g
+        return xn * gg.reshape(shape) + b.reshape(shape)
+
+    return invoke_raw("SyncBatchNorm", fn, [data, gamma, beta])

@@ -10,4 +10,5 @@ from .mesh import (DeviceMesh, make_mesh, current_mesh, data_parallel_mesh,
                    shard_batch, replicate, shard_params)
 from .compression import GradientCompression
 from . import mesh, compression, dist, collectives
-from .collectives import allreduce, allgather, reduce_scatter, broadcast_axis
+from .collectives import (allreduce, allgather, reduce_scatter,
+                          broadcast_axis, ppermute)

@@ -30,8 +30,24 @@ def _get_mesh(mesh):
 
 
 def _shard_map(fn, mesh: DeviceMesh, in_spec, out_spec):
-    return jax.shard_map(fn, mesh=mesh.mesh, in_specs=in_spec,
-                         out_specs=out_spec)
+    # check_vma off: e.g. a tiled all_gather's output IS replicated over the
+    # axis but the varying-axis inference can't prove it; numerics are
+    # asserted in tests/test_parallel.py instead.
+    try:
+        return jax.shard_map(fn, mesh=mesh.mesh, in_specs=in_spec,
+                             out_specs=out_spec, check_vma=False)
+    except TypeError:  # older jax without check_vma
+        return jax.shard_map(fn, mesh=mesh.mesh, in_specs=in_spec,
+                             out_specs=out_spec)
+
+
+def _on_mesh(x: NDArray, mesh: DeviceMesh, spec) -> jax.Array:
+    """Place the operand on the mesh with the collective's input layout.
+    Imperative callers usually hold single-device arrays (the reference's
+    kvstore accepted plain NDArrays the same way); already-matching sharded
+    arrays pass through without a copy."""
+    from jax.sharding import NamedSharding
+    return jax.device_put(x._data, NamedSharding(mesh.mesh, spec))
 
 
 def allreduce(x: NDArray, axis: str = "dp",
@@ -48,7 +64,7 @@ def allreduce(x: NDArray, axis: str = "dp",
             return jax.lax.pmax(v, axis)
         raise MXNetError(f"unknown reduce op {op}")
     spec = _batch_spec(x, axis)
-    out = _shard_map(f, mesh, (spec,), spec)(x._data)
+    out = _shard_map(f, mesh, (spec,), spec)(_on_mesh(x, mesh, spec))
     return NDArray(out)
 
 
@@ -59,7 +75,7 @@ def allgather(x: NDArray, axis: str = "dp",
     def f(v):
         return jax.lax.all_gather(v, axis, tiled=tiled)
     spec = _batch_spec(x, axis)
-    out = _shard_map(f, mesh, (spec,), P())(x._data)
+    out = _shard_map(f, mesh, (spec,), P())(_on_mesh(x, mesh, spec))
     return NDArray(out)
 
 
@@ -69,7 +85,8 @@ def reduce_scatter(x: NDArray, axis: str = "dp",
 
     def f(v):
         return jax.lax.psum_scatter(v, axis, tiled=True)
-    out = _shard_map(f, mesh, (P(),), _batch_spec_ndim(x.ndim, axis))(x._data)
+    out = _shard_map(f, mesh, (P(),),
+                     _batch_spec_ndim(x.ndim, axis))(_on_mesh(x, mesh, P()))
     return NDArray(out)
 
 
@@ -80,12 +97,13 @@ def broadcast_axis(x: NDArray, axis: str = "dp",
     n = mesh.shape[axis]
 
     def f(v):
+        # psum of the src-masked value: every shard receives src's block
+        # (ppermute can't fan out one source to many destinations)
         idx = jax.lax.axis_index(axis)
-        perm = [(src, i) for i in range(n)]
-        got = jax.lax.ppermute(v, axis, perm)
-        return jnp.where(idx == src, v, got)
+        masked = jnp.where(idx == src, v, jnp.zeros_like(v))
+        return jax.lax.psum(masked, axis)
     spec = _batch_spec(x, axis)
-    out = _shard_map(f, mesh, (spec,), spec)(x._data)
+    out = _shard_map(f, mesh, (spec,), spec)(_on_mesh(x, mesh, spec))
     return NDArray(out)
 
 
@@ -96,7 +114,7 @@ def ppermute(x: NDArray, perm, axis: str = "dp",
     def f(v):
         return jax.lax.ppermute(v, axis, perm)
     spec = _batch_spec(x, axis)
-    out = _shard_map(f, mesh, (spec,), spec)(x._data)
+    out = _shard_map(f, mesh, (spec,), spec)(_on_mesh(x, mesh, spec))
     return NDArray(out)
 
 

@@ -9,7 +9,7 @@ that compression is off by default, matching the reference's opt-in design.
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Hashable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +29,7 @@ class GradientCompression:
             raise MXNetError(f"unsupported compression type {type!r}")
         self.type = type
         self.threshold = float(threshold)
-        self._residuals: Dict[int, jax.Array] = {}
+        self._residuals: Dict[Hashable, jax.Array] = {}
         self._fn = jax.jit(self._make_fn())
 
     def _make_fn(self):
@@ -49,10 +49,18 @@ class GradientCompression:
             return q, g - q  # (compressed value, new error residual)
         return fn
 
-    def compress_decompress(self, grad: NDArray) -> NDArray:
+    def compress_decompress(self, grad: NDArray,
+                            key: Optional[Hashable] = None) -> NDArray:
         """Round-trip compress (what the wire would carry) with error
-        feedback accumulation, keyed per gradient buffer."""
-        key = id(grad)
+        feedback accumulation.
+
+        Residuals are keyed by the caller-supplied ``key`` — the kvstore
+        parameter key plus replica index (reference keeps one residual per
+        kvstore key per device, gradient_compression.h:38-121). Keying by
+        buffer identity is unsound: ids are reused after GC, and the buffer
+        changes every step."""
+        if key is None:
+            key = id(grad)  # legacy fallback for direct callers
         res = self._residuals.get(key)
         if res is None or res.shape != grad._data.shape:
             res = jnp.zeros_like(grad._data)

@@ -1,0 +1,83 @@
+"""Worker body for the 2-process dist kvstore test (launched by
+tools/launch.py --launcher local; the analog of reference
+tests/nightly/dist_sync_kvstore.py run under
+tests/nightly/test_distributed_training-gpu.sh:25-39).
+
+Each rank joins the jax.distributed job via DMLC_* env vars, exercises
+KVStoreDist (broadcast-on-init, cross-process pushpull reduction,
+update-on-store SGD convergence to identical weights), and writes its
+observations as JSON for the parent test to compare.
+"""
+import json
+import os
+import sys
+
+# one CPU device per process; must be configured before first backend
+# initialization. jax may already be imported (sitecustomize), so flip the
+# platform through jax.config as well (same pattern as tests/conftest.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count"))
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+
+def main(outdir):
+    dist.initialize()
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.type == "dist_sync"
+    assert kv.num_workers == 2 and kv.rank == rank
+    results = {"rank": rank}
+
+    # init broadcasts rank0's value (reference: server holds init value)
+    w = nd.array(onp.full((4,), 10.0 if rank == 0 else -99.0, dtype="float32"))
+    kv.init("w", w)
+    results["init_bcast"] = w.asnumpy().tolist()
+
+    # pushpull sums across processes: rank0 sends 1s, rank1 sends 2s -> 3s
+    g = nd.array(onp.full((4,), float(rank + 1), dtype="float32"))
+    kv.pushpull("g", g)
+    results["pushpull_sum"] = g.asnumpy().tolist()
+
+    # update-on-store training: ranks contribute different grads each step;
+    # both must converge to identical weights (the dist_sync_kvstore.py
+    # invariant)
+    from mxnet_tpu import optimizer as opt
+    kv2 = mx.kvstore.create("dist_sync")
+    kv2.set_optimizer(opt.SGD(learning_rate=0.1))
+    w2 = nd.array(onp.zeros((3,), dtype="float32"))
+    kv2.init(0, w2)
+    rng = onp.random.RandomState(100 + rank)
+    for _ in range(5):
+        grad = nd.array(rng.uniform(-1, 1, size=(3,)).astype("float32"))
+        kv2.push(0, grad)
+        out = nd.zeros((3,))
+        kv2.pull(0, out=out)
+    results["trained_w"] = out.asnumpy().tolist()
+
+    # async store: dispatch-without-block mode still reduces correctly
+    kva = mx.kvstore.create("dist_async")
+    a = nd.array(onp.full((2,), float(rank + 1), dtype="float32"))
+    kva.pushpull("a", a)
+    results["async_sum"] = a.asnumpy().tolist()
+
+    kv.barrier()
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+    print(f"worker {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

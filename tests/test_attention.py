@@ -155,6 +155,7 @@ def test_bert_forward_and_mlm():
     assert scores.shape == (2, 12, 128)
 
 
+@pytest.mark.slow
 def test_bert_classifier_train_step():
     from mxnet_tpu.gluon.model_zoo import bert
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss

@@ -23,6 +23,7 @@ def test_example_quantize():
     _run("quantize_inference.py")
 
 
+@pytest.mark.slow
 def test_example_ring_attention():
     # subprocess: the 8-virtual-device mesh needs XLA_FLAGS set before jax
     # initializes, which is impossible in this already-initialized process
@@ -45,5 +46,6 @@ def test_example_mnist_one_epoch():
     _run("train_mnist_gluon.py", ("x", "--epochs", "1"))
 
 
+@pytest.mark.slow
 def test_example_bert():
     _run("train_bert_classifier.py")

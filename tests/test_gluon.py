@@ -474,3 +474,32 @@ def test_optimize_for_backends():
         assert False, "expected error"
     except _E as e:
         assert "not registered" in str(e)
+
+
+def test_cold_hybridize_same_seed_same_weights():
+    """Deferred init under a cold hybridized first call must draw the same
+    RNG sequence as eager execution (regression: child cached-ops consumed
+    per-call keys between inits, so `1.weight` diverged; the reference
+    guarantees init is independent of hybridize())."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn as gnn
+
+    def build():
+        mx.random.seed(7)
+        net = gnn.HybridSequential()
+        net.add(gnn.Dense(16, activation="relu"), gnn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    x = mx.nd.array(onp.random.RandomState(1).randn(4, 8).astype("float32"))
+    n1 = build()
+    o1 = n1(x).asnumpy()
+    n2 = build()
+    n2.hybridize()
+    o2 = n2(x).asnumpy()
+    onp.testing.assert_allclose(o1, o2, atol=1e-6)
+    for k in n1.collect_params():
+        onp.testing.assert_allclose(
+            n1.collect_params()[k].data().asnumpy(),
+            n2.collect_params()[k].data().asnumpy(),
+            err_msg=f"param {k} diverged under cold hybridize")

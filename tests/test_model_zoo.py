@@ -9,6 +9,7 @@ from mxnet_tpu.gluon.model_zoo import vision
 from mxnet_tpu.gluon.model_zoo.vision import get_model
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name,size", [
     ("resnet18_v1", 112), ("resnet18_v2", 112), ("resnet34_v1", 112),
     ("resnet50_v1", 112), ("resnet50_v2", 112),
@@ -27,6 +28,7 @@ def test_model_forward(name, size):
     assert y.shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_inception_v3():
     net = get_model("inceptionv3", classes=10)
     net.initialize()
@@ -45,6 +47,7 @@ def test_resnet18_hybrid_matches_eager():
     onp.testing.assert_allclose(y_eager, y_hyb, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_resnet_train_step():
     """One SGD step through hybridized resnet18 converges the loss."""
     from mxnet_tpu import autograd

@@ -115,6 +115,7 @@ def test_rnn_layer_hybridize_consistency():
     onp.testing.assert_allclose(y_eager, y_hyb, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_rnn_training_converges():
     """Tiny sequence-sum regression learns (LSTM LM baseline smoke,
     BASELINE config 4)."""

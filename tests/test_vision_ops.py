@@ -1,0 +1,350 @@
+"""Grid-sampling / deformable / proposal / correlation op tests.
+
+Methodology per SURVEY §4: numpy golden forward + finite-difference
+gradients (reference tests/python/unittest/test_operator.py
+test_bilinear_sampler / test_spatial_transformer / test_correlation /
+test_deformable_convolution analogs).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _np_bilinear_sample(data, ys, xs):
+    """Zero-padded bilinear sampling golden, (B,C,H,W) at pixel coords."""
+    B, C, H, W = data.shape
+    out = onp.zeros((B, C) + ys.shape[1:], dtype=data.dtype)
+    for b in range(B):
+        for idx in onp.ndindex(ys.shape[1:]):
+            y, x = ys[(b,) + idx], xs[(b,) + idx]
+            y0, x0 = int(onp.floor(y)), int(onp.floor(x))
+            for (yy, xx, w) in ((y0, x0, (1 - (y - y0)) * (1 - (x - x0))),
+                                (y0, x0 + 1, (1 - (y - y0)) * (x - x0)),
+                                (y0 + 1, x0, (y - y0) * (1 - (x - x0))),
+                                (y0 + 1, x0 + 1, (y - y0) * (x - x0))):
+                if 0 <= yy < H and 0 <= xx < W:
+                    out[(b, slice(None)) + idx] += w * data[b, :, yy, xx]
+    return out
+
+
+def test_bilinear_sampler_golden():
+    rng = onp.random.RandomState(0)
+    data = rng.randn(2, 3, 5, 6).astype("float32")
+    grid = rng.uniform(-1.2, 1.2, size=(2, 2, 4, 4)).astype("float32")
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    xs = (grid[:, 0] + 1) * (6 - 1) / 2.0
+    ys = (grid[:, 1] + 1) * (5 - 1) / 2.0
+    golden = _np_bilinear_sample(data, ys, xs)
+    onp.testing.assert_allclose(out, golden, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_identity_grid():
+    rng = onp.random.RandomState(1)
+    data = rng.randn(1, 2, 4, 4).astype("float32")
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, 4), onp.linspace(-1, 1, 4),
+                          indexing="ij")
+    grid = onp.stack([xs, ys], 0)[None].astype("float32")
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    onp.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_sampler_grad():
+    rng = onp.random.RandomState(2)
+    data = rng.randn(1, 2, 5, 5).astype("float32")
+    grid = rng.uniform(-0.9, 0.9, size=(1, 2, 3, 3)).astype("float32")
+    check_numeric_gradient(
+        lambda d, g: nd.BilinearSampler(d, g), [data, grid],
+        rtol=2e-2, atol=2e-2)
+
+
+def test_grid_generator_affine_identity():
+    theta = onp.array([[1, 0, 0, 0, 1, 0]], dtype="float32")
+    grid = nd.GridGenerator(nd.array(theta), "affine",
+                            target_shape=(3, 5)).asnumpy()
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, 3), onp.linspace(-1, 1, 5),
+                          indexing="ij")
+    onp.testing.assert_allclose(grid[0, 0], xs, rtol=1e-6, atol=1e-6)
+    onp.testing.assert_allclose(grid[0, 1], ys, rtol=1e-6, atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = onp.zeros((1, 2, 3, 4), dtype="float32")
+    grid = nd.GridGenerator(nd.array(flow), "warp").asnumpy()
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, 3), onp.linspace(-1, 1, 4),
+                          indexing="ij")
+    onp.testing.assert_allclose(grid[0, 0], xs, rtol=1e-6, atol=1e-6)
+    onp.testing.assert_allclose(grid[0, 1], ys, rtol=1e-6, atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rng = onp.random.RandomState(3)
+    data = rng.randn(2, 3, 6, 6).astype("float32")
+    theta = onp.tile(onp.array([[1, 0, 0, 0, 1, 0]], "float32"), (2, 1))
+    out = nd.SpatialTransformer(nd.array(data), nd.array(theta),
+                                target_shape=(6, 6)).asnumpy()
+    onp.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_scale_and_grad():
+    rng = onp.random.RandomState(4)
+    data = rng.randn(1, 1, 8, 8).astype("float32")
+    theta = onp.array([[0.5, 0, 0.1, 0, 0.5, -0.1]], dtype="float32")
+    out = nd.SpatialTransformer(nd.array(data), nd.array(theta),
+                                target_shape=(4, 4))
+    assert out.shape == (1, 1, 4, 4)
+    check_numeric_gradient(
+        lambda d, t: nd.SpatialTransformer(d, t, target_shape=(4, 4)),
+        [data, theta], rtol=2e-2, atol=2e-2)
+
+
+def _np_deform_conv(data, offset, weight, stride, pad, dilate, dg):
+    B, C, H, W = data.shape
+    O, Cg, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = onp.zeros((B, O, Ho, Wo), "float32")
+    cpg = C // dg
+    for b in range(B):
+        for ho in range(Ho):
+            for wo in range(Wo):
+                col = onp.zeros((C, kh * kw), "float32")
+                for k in range(kh * kw):
+                    i, j = divmod(k, kw)
+                    for g in range(dg):
+                        oy = offset[b, (g * kh * kw + k) * 2, ho, wo]
+                        ox = offset[b, (g * kh * kw + k) * 2 + 1, ho, wo]
+                        y = ho * sh - ph + i * dh + oy
+                        x = wo * sw - pw + j * dw + ox
+                        sl = data[b:b + 1, g * cpg:(g + 1) * cpg]
+                        col[g * cpg:(g + 1) * cpg, k] = _np_bilinear_sample(
+                            sl, onp.array([[[y]]]), onp.array([[[x]]])
+                        )[0, :, 0, 0]
+                out[b, :, ho, wo] = (weight.reshape(O, -1)
+                                     @ col.reshape(-1))
+    return out
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = onp.random.RandomState(5)
+    data = rng.randn(2, 4, 7, 7).astype("float32")
+    weight = rng.randn(6, 4, 3, 3).astype("float32")
+    offset = onp.zeros((2, 2 * 9, 5, 5), dtype="float32")
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(3, 3), num_filter=6).asnumpy()
+    ref = nd.Convolution(nd.array(data), nd.array(weight),
+                         kernel=(3, 3), num_filter=6,
+                         no_bias=True).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_golden_and_grad():
+    rng = onp.random.RandomState(6)
+    data = rng.randn(1, 2, 5, 5).astype("float32")
+    weight = rng.randn(3, 2, 3, 3).astype("float32")
+    offset = (rng.randn(1, 18, 3, 3) * 0.5).astype("float32")
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(3, 3), num_filter=3).asnumpy()
+    golden = _np_deform_conv(data, offset, weight, (1, 1), (0, 0), (1, 1), 1)
+    onp.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)
+    check_numeric_gradient(
+        lambda d, o, w: nd.contrib.DeformableConvolution(
+            d, o, w, kernel=(3, 3), num_filter=3),
+        [data, offset, weight], rtol=3e-2, atol=3e-2)
+
+
+def test_deformable_conv_groups():
+    rng = onp.random.RandomState(7)
+    data = rng.randn(1, 4, 6, 6).astype("float32")
+    weight = rng.randn(4, 2, 3, 3).astype("float32")   # num_group=2
+    offset = onp.zeros((1, 2 * 2 * 9, 4, 4), "float32")  # dg=2
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(3, 3), num_filter=4, num_group=2,
+        num_deformable_group=2).asnumpy()
+    ref = nd.Convolution(nd.array(data), nd.array(weight), kernel=(3, 3),
+                         num_filter=4, num_group=2, no_bias=True).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_psroi_pooling_uniform():
+    """On a channelwise-constant map every pooled bin returns that
+    channel-group's constant, regardless of trans offsets."""
+    P, G, out_dim = 3, 3, 2
+    C = out_dim * G * G
+    data = onp.zeros((1, C, 9, 9), "float32")
+    for c in range(C):
+        data[0, c] = c
+    rois = onp.array([[0, 1, 1, 7, 7]], dtype="float32")
+    trans = (onp.random.RandomState(8).randn(1, 2, P, P) * 0.1) \
+        .astype("float32")
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans),
+        spatial_scale=1.0, output_dim=out_dim, group_size=G, pooled_size=P,
+        trans_std=0.1).asnumpy()
+    assert out.shape == (1, out_dim, P, P)
+    for d in range(out_dim):
+        for ph in range(P):
+            for pw in range(P):
+                gh = min((ph * G) // P, G - 1)
+                gw = min((pw * G) // P, G - 1)
+                expect = d * G * G + gh * G + gw
+                onp.testing.assert_allclose(out[0, d, ph, pw], expect,
+                                            rtol=1e-5)
+
+
+def test_proposal_shapes_and_ordering():
+    rng = onp.random.RandomState(9)
+    B, A, H, W = 1, 6, 4, 4  # scales x ratios = 2*3
+    cls_prob = rng.uniform(0, 1, size=(B, 2 * A, H, W)).astype("float32")
+    bbox_pred = (rng.randn(B, 4 * A, H, W) * 0.1).astype("float32")
+    im_info = onp.array([[64, 64, 1.0]], dtype="float32")
+    out = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, threshold=0.7,
+        rpn_min_size=4, scales=(4, 8), ratios=(0.5, 1, 2),
+        feature_stride=16).asnumpy()
+    assert out.shape == (10, 5)
+    # boxes are clipped to the image (suppressed slots are zero padding)
+    assert (out[:, 1:] >= -1e-4).all()
+    assert (out[:, [1, 3]] <= 64).all() and (out[:, [2, 4]] <= 64).all()
+    ws = out[:, 3] - out[:, 1]
+    hs = out[:, 4] - out[:, 2]
+    valid = ws > 0
+    assert valid.any()
+    assert (ws[valid] + 1 >= 4 - 1e-4).all() and \
+        (hs[valid] + 1 >= 4 - 1e-4).all()
+    out2 = nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, scales=(4, 8),
+        ratios=(0.5, 1, 2)).asnumpy()
+    assert out2.shape == (10, 5)
+
+
+def _np_correlation(a, b, K, md, s1, s2, pad, multiply):
+    B, C, H, W = a.shape
+    kr = (K - 1) // 2
+    border = md + kr
+    ap = onp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    bp = onp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    Ho = int(onp.ceil((Hp - border * 2) / s1))
+    Wo = int(onp.ceil((Wp - border * 2) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    out = onp.zeros((B, ngw * ngw, Ho, Wo), "float32")
+    sumelems = K * K * C
+    for bi in range(B):
+        for ci, (dy, dx) in enumerate(
+                (dy, dx) for dy in range(-ngr, ngr + 1)
+                for dx in range(-ngr, ngr + 1)):
+            for ho in range(Ho):
+                for wo in range(Wo):
+                    y1 = border + ho * s1
+                    x1 = border + wo * s1
+                    y2, x2 = y1 + dy * s2, x1 + dx * s2
+                    acc = 0.0
+                    for ky in range(-kr, K - kr):
+                        for kx in range(-kr, K - kr):
+                            pa = ap[bi, :, y1 + ky, x1 + kx]
+                            pb = bp[bi, :, y2 + ky, x2 + kx]
+                            acc += (pa * pb).sum() if multiply else \
+                                onp.abs(pa - pb).sum()
+                    out[bi, ci, ho, wo] = acc / sumelems
+    return out
+
+
+@pytest.mark.parametrize("multiply", [True, False])
+def test_correlation_golden(multiply):
+    rng = onp.random.RandomState(10)
+    a = rng.randn(1, 3, 6, 6).astype("float32")
+    b = rng.randn(1, 3, 6, 6).astype("float32")
+    out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1, is_multiply=multiply).asnumpy()
+    golden = _np_correlation(a, b, 1, 1, 1, 1, 1, multiply)
+    onp.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_grad():
+    rng = onp.random.RandomState(11)
+    a = rng.randn(1, 2, 5, 5).astype("float32")
+    b = rng.randn(1, 2, 5, 5).astype("float32")
+    check_numeric_gradient(
+        lambda x, y: nd.Correlation(x, y, kernel_size=1, max_displacement=1,
+                                    pad_size=1),
+        [a, b], rtol=2e-2, atol=2e-2)
+
+
+def test_count_sketch_golden_and_grad():
+    rng = onp.random.RandomState(12)
+    B, D, O = 3, 10, 6
+    data = rng.randn(B, D).astype("float32")
+    h = rng.randint(0, O, size=(D,)).astype("float32")
+    s = rng.choice([-1.0, 1.0], size=(D,)).astype("float32")
+    out = nd.contrib.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                                  out_dim=O).asnumpy()
+    golden = onp.zeros((B, O), "float32")
+    for i in range(D):
+        golden[:, int(h[i])] += s[i] * data[:, i]
+    onp.testing.assert_allclose(out, golden, rtol=1e-5, atol=1e-6)
+    check_numeric_gradient(
+        lambda d: nd.contrib.count_sketch(d, nd.array(h), nd.array(s),
+                                          out_dim=O),
+        [data], rtol=2e-2, atol=2e-2)
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    rng = onp.random.RandomState(13)
+    x = rng.randn(4, 3, 5, 5).astype("float32")
+    gamma = onp.ones(3, "float32")
+    beta = onp.zeros(3, "float32")
+    mm = onp.zeros(3, "float32")
+    mv = onp.ones(3, "float32")
+    out = nd.contrib.SyncBatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mm),
+        nd.array(mv), fix_gamma=False).asnumpy()
+    ref = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mm),
+        nd.array(mv), eps=1e-3).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sync_batch_norm_axis_name_psum():
+    """Explicit shard_map path: per-shard moments psum'ed over the axis
+    equal whole-batch normalization."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as onp2
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    rng = onp.random.RandomState(14)
+    x = rng.randn(8, 3, 4, 4).astype("float32")
+    gamma = onp.ones(3, "float32")
+    beta = onp.zeros(3, "float32")
+    mesh = Mesh(onp2.array(jax.devices()[:4]), ("dp",))
+
+    from mxnet_tpu.ndarray.vision_ops import SyncBatchNorm as SBN
+
+    def per_shard(xs):
+        out = SBN(mx.nd.from_jax(xs), nd.array(gamma), nd.array(beta),
+                  nd.array(onp.zeros(3, "float32")),
+                  nd.array(onp.ones(3, "float32")),
+                  fix_gamma=False, axis_name="dp")
+        return out._data
+
+    f = jax.jit(jax.shard_map(per_shard, mesh=mesh,
+                              in_specs=P("dp"), out_specs=P("dp")))
+    got = onp.asarray(f(jnp.asarray(x)))
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    ref = (x - mean) / onp.sqrt(var + 1e-3)
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
