@@ -283,19 +283,48 @@ class HybridBlock(Block):
         self._backend = None
         self._partition_if_dynamic = True
         self._last_input_avals = None
+        self._bucket_axis = None
+        self._bucket_sizes = None
+        self._jit_lru = OrderedDict()
+        self._traced_fn = None
+        self._bucket_shape_cache: Dict[Any, Any] = {}
 
     def hybridize(self, active: bool = True, backend=None, clear=True,
                   static_alloc: bool = False, static_shape: bool = False,
-                  partition_if_dynamic: bool = True, **kwargs):
+                  partition_if_dynamic: bool = True, bucket_axis=None,
+                  bucket_sizes=None, **kwargs):
         """Reference block.py:1216. static_alloc/static_shape are accepted
-        for parity; XLA's buffer assignment subsumes them."""
+        for parity; XLA's buffer assignment subsumes them.
+
+        Retrace policy (reference dynamic CachedOp, cached_op.cc:696, and
+        SURVEY §7 "dynamic shapes" hard part): ``bucket_axis`` opts into
+        pad-to-bucket dispatch — traced inputs are zero-padded along that
+        axis up to the next bucket size (``bucket_sizes`` ascending list, or
+        next power of two when None) so variable-length workloads compile
+        once per bucket instead of once per length; outputs are sliced back.
+        Only valid when rows along the axis are independent (the contract of
+        the reference's BucketingModule — masking stays the model's job; do
+        not use with cross-row ops like BatchNorm over that axis).
+        ``MXNET_CACHEDOP_BUCKET_AXIS`` sets a process default.
+        ``MXNET_CACHEDOP_CACHE_SIZE`` (default 0 = unbounded) caps the
+        number of live compiled signatures per block, LRU-evicted.
+        """
+        import os
         self._active = active
         self._backend = backend
+        if bucket_axis is None:
+            env_ax = os.environ.get("MXNET_CACHEDOP_BUCKET_AXIS", "")
+            bucket_axis = int(env_ax) if env_ax else None
+        self._bucket_axis = bucket_axis
+        self._bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
         if clear:
             self._cached_fn = None
             self._cached_out_info = {}
+            self._jit_lru.clear()
+            self._traced_fn = None
+            self._bucket_shape_cache = {}
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 
@@ -399,7 +428,129 @@ class HybridBlock(Block):
             from .. import subgraph as _subgraph
             fn = _subgraph.get_backend(self._backend).transform(
                 fn, static_argnums=(2, 3, 4, 5))
+        self._traced_fn = fn
         self._cached_fn = jax.jit(fn, static_argnums=(2, 3, 4, 5))
+
+    # -------- retrace policy --------
+    @staticmethod
+    def _cache_cap() -> int:
+        import os
+        try:
+            return int(os.environ.get("MXNET_CACHEDOP_CACHE_SIZE", "0"))
+        except ValueError:
+            return 0
+
+    def _jit_for(self, shape_key):
+        """LRU of jit wrappers keyed by input shapes/dtypes. Evicting a
+        wrapper frees its compiled executable — the bound analog of the
+        reference's per-bucket CachedOp binds."""
+        cap = self._cache_cap()
+        if cap <= 0:
+            return self._cached_fn
+        ent = self._jit_lru.get(shape_key)
+        if ent is None:
+            ent = jax.jit(self._traced_fn, static_argnums=(2, 3, 4, 5))
+            self._jit_lru[shape_key] = ent
+            while len(self._jit_lru) > cap:
+                self._jit_lru.popitem(last=False)
+        else:
+            self._jit_lru.move_to_end(shape_key)
+        return ent
+
+    def _bucket_of(self, n: int) -> int:
+        if self._bucket_sizes:
+            for b in self._bucket_sizes:
+                if b >= n:
+                    return b
+            return n  # beyond the ladder: compile per exact length
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    def _bucket_pad(self, traced):
+        """Zero-pad traced leaves along self._bucket_axis to the bucket size
+        (tape-recorded, so gradients flow back through the pad)."""
+        ax = self._bucket_axis
+        lengths = {int(l._data.shape[ax]) if isinstance(l, NDArray)
+                   else int(l.shape[ax])
+                   for l in traced
+                   if getattr(l, "ndim", 0) > ax}
+        if len(lengths) != 1:
+            raise MXNetError(
+                f"bucket_axis={ax} requires all traced inputs to share one "
+                f"length along that axis, got {sorted(lengths)}")
+        (orig,) = lengths
+        tgt = self._bucket_of(orig)
+        if tgt == orig:
+            return traced, (ax, orig, tgt)
+        padded = []
+        for l in traced:
+            if getattr(l, "ndim", 0) > ax:
+                widths = [(0, 0)] * l.ndim
+                widths[ax] = (0, tgt - orig)
+
+                def _pad(d, _w=tuple(widths)):
+                    import jax.numpy as jnp
+                    return jnp.pad(d, _w)
+                if isinstance(l, NDArray):
+                    l = invoke_raw("bucket_pad", _pad, [l])
+                else:
+                    l = _pad(l)
+            padded.append(l)
+        return padded, (ax, orig, tgt)
+
+    def _bucket_true_shapes(self, sig, orig_traced, rng_key, arg_treedef,
+                            train, static_spec, nd_mask):
+        """Abstract-trace (jax.eval_shape — no compile) the forward at the
+        ORIGINAL length to learn each output's true shape. Exact unpad rule:
+        slice any output axis whose padded dim differs from the true dim —
+        an output that coincidentally has bucket-size many classes is left
+        alone, and padding that lands on a transposed axis is still cut."""
+        key = (sig, tuple(
+            tuple((l._data if isinstance(l, NDArray) else l).shape)
+            for l in orig_traced))
+        if key in self._bucket_shape_cache:
+            return self._bucket_shape_cache[key]
+        sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+
+        def part(k, leaves, pd):
+            return self._traced_fn(k, leaves, arg_treedef, train,
+                                   static_spec, nd_mask, *pd)
+        try:
+            out = jax.eval_shape(
+                part, sds(rng_key),
+                tuple(sds(l._data if isinstance(l, NDArray) else l)
+                      for l in orig_traced),
+                [sds(p._data._data) for p in self._cached_params])
+            shapes = tuple(tuple(o.shape) for o in out)
+        except Exception:
+            shapes = None  # fall back to the axis-dim heuristic
+        self._bucket_shape_cache[key] = shapes
+        return shapes
+
+    def _bucket_unpad(self, outs, restore, true_shapes=None):
+        ax, orig, tgt = restore
+        if tgt == orig:
+            return outs
+        sliced = []
+        for i, o in enumerate(outs):
+            d = o._data if isinstance(o, NDArray) else o
+            if true_shapes is not None and i < len(true_shapes):
+                ts = true_shapes[i]
+                if tuple(d.shape) != ts:
+                    def _slc(x, _ts=ts):
+                        return x[tuple(slice(0, s) for s in _ts)]
+                    o = invoke_raw(
+                        "bucket_slice", _slc,
+                        [o if isinstance(o, NDArray) else NDArray(o)])
+            elif getattr(d, "ndim", 0) > ax and d.shape[ax] == tgt:
+                def _slc(x, _ax=ax, _n=orig):
+                    return jax.lax.slice_in_dim(x, 0, _n, axis=_ax)
+                o = invoke_raw("bucket_slice", _slc,
+                               [o if isinstance(o, NDArray) else NDArray(o)])
+            sliced.append(o)
+        return sliced
 
     def _call_cached_op(self, *args, **kwargs):
         """Reference block.py:1095 → CachedOp::Forward. One tape node per
@@ -419,11 +570,19 @@ class HybridBlock(Block):
         static_spec = tuple(
             _TRACED if isinstance(l, (NDArray, onp.ndarray, jax.Array))
             else l for l in all_leaves)
+        restore = None
+        orig_traced = traced
+        if self._bucket_axis is not None and traced:
+            traced, restore = self._bucket_pad(traced)
         nd_mask = tuple(isinstance(l, NDArray) for l in traced)
         rng_key = next_key()
         train = _tape.is_training()
 
-        fn = self._cached_fn
+        shape_key = (train, arg_treedef, static_spec, nd_mask, tuple(
+            (tuple((l._data if isinstance(l, NDArray) else l).shape),
+             str((l._data if isinstance(l, NDArray) else l).dtype))
+            for l in traced))
+        fn = self._jit_for(shape_key)
 
         def op_fn(*leaves_and_params, _fn=fn, _treedef=arg_treedef,
                   _key=rng_key, _n_args=len(traced), _train=train,
@@ -449,6 +608,12 @@ class HybridBlock(Block):
         result = result if isinstance(result, tuple) else (result,)
         outs = result[:info["n_out"]]
         states = result[info["n_out"]:]
+        if restore is not None and restore[1] != restore[2]:
+            true_shapes = self._bucket_true_shapes(
+                sig, orig_traced, rng_key, arg_treedef, train, static_spec,
+                nd_mask)
+            outs = tuple(self._bucket_unpad(list(outs), restore,
+                                            true_shapes))
         with autograd.pause():
             for i, s in zip(info["state_idx"], states):
                 # REBIND (not mutate) so an enclosing hybridized parent's

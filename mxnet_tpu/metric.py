@@ -297,7 +297,7 @@ class MCC(EvalMetric):
         return self.name, (tp * tn - fp * fn) / den if den else 0.0
 
 
-@_register("pearsonr", "pcc")
+@_register("pearsonr")
 class PearsonCorrelation(EvalMetric):
     def __init__(self, name="pearsonr", **kwargs):
         super().__init__(name, **kwargs)
@@ -321,7 +321,56 @@ class PearsonCorrelation(EvalMetric):
         return self.name, float(onp.corrcoef(l, p)[0, 1])
 
 
-PCC = PearsonCorrelation
+@_register("pcc")
+class PCC(EvalMetric):
+    """Multiclass Matthews correlation from a K x K confusion matrix
+    (reference metric.PCC, gluon/metric.py:1586): a discrete solution to
+    the Pearson correlation, reducing to MCC for K=2. The matrix grows as
+    new class indices appear."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self.k = 2
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.num_inst = 0
+        self.lcm = onp.zeros((self.k, self.k), dtype="float64")
+
+    def _grow(self, inc):
+        self.lcm = onp.pad(self.lcm, ((0, inc), (0, inc)), "constant")
+        self.k += inc
+
+    def _calc_mcc(self, cmat):
+        n = cmat.sum()
+        x = cmat.sum(axis=1)
+        y = cmat.sum(axis=0)
+        cov_xx = float((x * (n - x)).sum())
+        cov_yy = float((y * (n - y)).sum())
+        if cov_xx == 0 or cov_yy == 0:
+            return float("nan")
+        i = cmat.diagonal()
+        cov_xy = float((i * n - x * y).sum())
+        return cov_xy / (cov_xx * cov_yy) ** 0.5
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype("int64").flatten()
+            pred = _to_numpy(pred)
+            if pred.ndim > 1 and pred.shape != tuple(label.shape):
+                pred = onp.argmax(pred, axis=1)
+            pred = pred.astype("int64").flatten()
+            n = int(max(pred.max(), label.max()))
+            if n >= self.k:
+                self._grow(n + 1 - self.k)
+            bcm = onp.zeros((self.k, self.k), dtype="float64")
+            onp.add.at(bcm, (pred, label), 1)
+            self.lcm += bcm
+        self.num_inst += 1
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self._calc_mcc(self.lcm)
 
 
 @_register("loss")

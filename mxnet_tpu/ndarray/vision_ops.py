@@ -376,18 +376,27 @@ def Proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         return rows                                       # (B, k, 6)
 
     rows = invoke_raw("Proposal_decode", fn, [cls_prob, bbox_pred, im_info])
+    # NMS over the ENTIRE pre-NMS pool (topk=-1): survivors beyond rank
+    # post_n must backfill suppressed slots, as the reference does
+    # (proposal.cc keeps the top post_nms_top_n SURVIVORS of the 6000-box
+    # pool, not the survivors among the top 300)
     kept = box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
-                   topk=post_n, coord_start=2, score_index=1, id_index=0,
+                   topk=-1, coord_start=2, score_index=1, id_index=0,
                    force_suppress=True)
 
     def pick(kr):
         B = kr.shape[0]
+        # box_nms output is score-sorted with suppressed rows all -1;
+        # stable-compact survivors to the front (preserving score order)
+        survd = kr[..., 0] >= 0
+        order = jnp.argsort(jnp.where(survd, 0, 1), axis=1, stable=True)
+        kr = jnp.take_along_axis(kr, order[..., None], 1)
         if kr.shape[1] < post_n:   # fewer anchors than post-NMS count
             kr = jnp.pad(kr, ((0, 0), (0, post_n - kr.shape[1]), (0, 0)),
                          constant_values=-1.0)
         out = kr[:, :post_n, :]                           # (B, post_n, 6)
-        # suppressed rows come back as -1 markers from box_nms; emit them as
-        # all-zero padding rows (fixed output shape, reference pads too)
+        # remaining invalid slots are -1 markers; emit them as all-zero
+        # padding rows (fixed output shape, reference pads too)
         valid = (out[..., 0] >= 0)[..., None]
         out = jnp.where(valid, out, jnp.zeros_like(out))
         bidx = jnp.broadcast_to(
@@ -503,17 +512,40 @@ def SyncBatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                          eps=eps, momentum=momentum, fix_gamma=fix_gamma,
                          use_global_stats=use_global_stats)
 
+    from .. import _tape
+    from .nn_ops import _tape_paused
     data = _wrap(data)
     gamma, beta = _wrap(gamma), _wrap(beta)
+    mm, mv = _wrap(moving_mean), _wrap(moving_var)
+    training = _tape.is_training() and not use_global_stats
+    shape_of = lambda x: (1, -1) + (1,) * (x.ndim - 2)  # noqa: E731
+
+    if not training:
+        # inference: normalize by running stats (no cross-device moment
+        # exchange needed — reference sync BN only syncs training moments)
+        def infer(x, g, b, m, v):
+            sh = shape_of(x)
+            gg = jnp.ones_like(g) if fix_gamma else g
+            xn = (x - m.reshape(sh)) * lax.rsqrt(v.reshape(sh) + eps)
+            return xn * gg.reshape(sh) + b.reshape(sh)
+        return invoke_raw("SyncBatchNorm", infer, [data, gamma, beta, mm, mv])
 
     def fn(x, g, b):
         axes = (0,) + tuple(range(2, x.ndim))
         mean = jax.lax.pmean(jnp.mean(x, axis=axes), axis_name)
         var = jax.lax.pmean(jnp.mean(x * x, axis=axes), axis_name) \
             - mean * mean
-        shape = (1, -1) + (1,) * (x.ndim - 2)
-        xn = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+        sh = shape_of(x)
+        xn = (x - mean.reshape(sh)) * lax.rsqrt(var.reshape(sh) + eps)
         gg = jnp.ones_like(g) if fix_gamma else g
-        return xn * gg.reshape(shape) + b.reshape(shape)
+        return xn * gg.reshape(sh) + b.reshape(sh), mean, var
 
-    return invoke_raw("SyncBatchNorm", fn, [data, gamma, beta])
+    out, bm, bv = invoke_raw("SyncBatchNorm", fn, [data, gamma, beta],
+                             n_outputs=3)
+    # running-stats update with the synced moments (reference
+    # sync_batch_norm.cc momentum update), outside the recorded graph —
+    # same contract as nn_ops.BatchNorm
+    with _tape_paused():
+        mm._data = momentum * mm._data + (1 - momentum) * bm._data
+        mv._data = momentum * mv._data + (1 - momentum) * bv._data
+    return out

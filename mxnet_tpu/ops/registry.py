@@ -93,6 +93,35 @@ def list_ops() -> List[str]:
     return sorted(_OP_REGISTRY)
 
 
+try:
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # future jax relayout: annotate unconditionally
+    def _trace_state_clean():
+        return False
+
+
+def _named_scope_kernel(name: str, fn: Callable) -> Callable:
+    """Run the kernel under ``jax.named_scope(op_name)`` so the op name lands
+    in the HLO metadata name stack: XProf device traces then attribute fused
+    kernels back to framework op names even inside a single jitted CachedOp
+    computation (reference __profiler_scope__ + ProfileOperator,
+    src/profiler/profiler.h:251-299, c_api_ndarray.cc:104).
+
+    Only applied while a trace is being built (hybridize/_build_cache, jit,
+    vjp) — the metadata is meaningless on the eager hot path, so eager
+    dispatch pays one thread-local check instead of a context manager."""
+    if _trace_state_clean():
+        return fn
+    safe = name.replace(" ", "_")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        import jax
+        with jax.named_scope(safe):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
 def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
                n_outputs: int = 1, record: Optional[bool] = None,
                out_cls=None):
@@ -108,6 +137,7 @@ def invoke_raw(name: str, fn: Callable, inputs: Sequence[Any],
         cls = NDArray
         if _NP_CLS is not None and any(isinstance(x, _NP_CLS) for x in inputs):
             cls = _NP_CLS
+    fn = _named_scope_kernel(name, fn)
     for _w in _INVOKE_WRAPPERS:
         fn = _w(name, fn)
     in_datas = [x._data if isinstance(x, NDArray) else x for x in inputs]

@@ -76,9 +76,15 @@ class Profiler:
         prof = self
 
         def wrapped(*args, **kwargs):
+            if not prof.running or prof.paused:
+                return fn(*args, **kwargs)
+            import jax
             t0 = time.perf_counter()
             try:
-                return fn(*args, **kwargs)
+                # host-side XProf event per framework op; device kernels are
+                # attributed via the named_scope in the invoke funnel
+                with jax.profiler.TraceAnnotation(name):
+                    return fn(*args, **kwargs)
             finally:
                 prof.record(name, t0, time.perf_counter())
         return wrapped

@@ -38,6 +38,39 @@ def test_profiler_chrome_trace(tmp_path):
     assert any("mul" in n or "add" in n or "sum" in n for n in names), names
 
 
+def test_per_op_device_attribution_name_stack():
+    """Framework op names must flow into the XLA name stack (via
+    jax.named_scope in the invoke funnel) so XProf device traces attribute
+    kernels inside a jitted CachedOp back to framework ops — the analog of
+    the reference's __profiler_scope__/ProfileOperator device annotation
+    (src/profiler/profiler.h:251-299)."""
+    import jax
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    def f(x):
+        a = NDArray(x)
+        b = mx.nd.add(a, a)
+        return mx.nd.sigmoid(b)._data
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((2, 2)))
+    stacks = [str(e.source_info.name_stack) for e in jaxpr.eqns]
+    assert any("add" in s for s in stacks), stacks
+    assert any("sigmoid" in s for s in stacks), stacks
+    # a Gluon block traced inside jit funnels per-op through invoke_raw the
+    # same way, so a cached computation carries per-op scopes for every layer
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=3, activation="relu")
+    net.initialize()
+
+    def g(xj):
+        return net(NDArray(xj))._data
+
+    stacks = [str(e.source_info.name_stack)
+              for e in jax.make_jaxpr(g)(jnp.ones((2, 3))).eqns]
+    assert any("fully_connected" in s for s in stacks), stacks
+    assert any("activation" in s for s in stacks), stacks
+
+
 def test_profiler_scope_and_pause(tmp_path):
     fname = str(tmp_path / "trace2.json")
     mx.profiler.set_config(filename=fname)

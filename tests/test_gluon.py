@@ -240,6 +240,32 @@ def test_metrics():
     assert topk.get()[1] == 1.0
 
 
+def test_pcc_metric():
+    """PCC = multiclass MCC over a confusion matrix (reference
+    gluon/metric.py:1586). For binary inputs it must equal MCC, and its
+    confusion matrix must grow when higher class indices appear."""
+    from mxnet_tpu import metric
+    labels = nd.array([0] * 1001 + [1] * 10001)
+    preds = nd.array([[0.3, 0.7]] * 1000 + [[0.7, 0.3]] * 2
+                     + [[0.3, 0.7]] * 10000)
+    pcc = metric.PCC()
+    pcc.update([labels], [preds])
+    mcc = metric.MCC()
+    mcc.update([labels], [preds])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+    # growing: feed 4-class predictions into the same metric
+    pcc.update([nd.array([3, 2, 1, 0])],
+               [nd.array([3, 2, 1, 0])])
+    assert pcc.k == 4
+    # perfect extra batch only raises correlation
+    assert pcc.get()[1] > mcc.get()[1]
+    # registry + np() helper
+    assert isinstance(metric.create("pcc"), metric.PCC)
+    m = metric.np(lambda l, p: float((l == p).sum()) / l.size, name="frac")
+    m.update([nd.array([1, 1])], [nd.array([1, 0])])
+    assert m.get()[1] == 0.5
+
+
 def test_dropout_layer_modes():
     drop = nn.Dropout(0.5)
     x = nd.ones((100,))
@@ -429,6 +455,98 @@ def test_hybridize_kwargs_and_static_flags():
         loss = m(x, double=True, bias=b).sum()
     loss.backward()
     assert float(b.grad.asnumpy().sum()) == 6.0
+
+
+def test_cachedop_shape_bucketing():
+    """Retrace policy (reference dynamic CachedOp, cached_op.cc:696):
+    bucket_axis pads variable lengths to the next bucket so two bucketable
+    lengths share ONE compiled entry; outputs slice back to the true length
+    and gradients flow through the pad."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize(bucket_axis=0)
+    eager = nn.Dense(4, in_units=3)
+    eager.initialize()
+    eager.weight.set_data(net.weight.data())
+    eager.bias.set_data(net.bias.data())
+
+    x5 = mx.nd.array(onp.random.randn(5, 3).astype("float32"))
+    x7 = mx.nd.array(onp.random.randn(7, 3).astype("float32"))
+    y5 = net(x5)
+    y7 = net(x7)
+    assert y5.shape == (5, 4) and y7.shape == (7, 4)
+    onp.testing.assert_allclose(y5.asnumpy(), eager(x5).asnumpy(),
+                                rtol=2e-6, atol=2e-6)
+    onp.testing.assert_allclose(y7.asnumpy(), eager(x7).asnumpy(),
+                                rtol=2e-6, atol=2e-6)
+    # both lengths pad to bucket 8 -> a single compiled signature
+    assert net._cached_fn._cache_size() == 1
+    # a non-bucketable length compiles a second entry
+    net(mx.nd.ones((9, 3)))
+    assert net._cached_fn._cache_size() == 2
+
+    # gradients flow back through the pad/slice pair
+    x5.attach_grad()
+    with autograd.record():
+        loss = net(x5).sum()
+    loss.backward()
+    eager_x = mx.nd.array(x5.asnumpy())
+    eager_x.attach_grad()
+    with autograd.record():
+        loss2 = eager(eager_x).sum()
+    loss2.backward()
+    onp.testing.assert_allclose(x5.grad.asnumpy(), eager_x.grad.asnumpy(),
+                                rtol=2e-6, atol=2e-6)
+
+
+def test_bucket_unpad_exact_shapes_not_coincidence():
+    """Unpadding uses true output shapes from an abstract trace at the
+    original length: an output whose dim coincidentally equals the bucket
+    size (64 classes vs bucket 64) must NOT be sliced, while an output that
+    really carries the padded length is."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import HybridBlock
+
+    class M(HybridBlock):
+        def forward(self, x):
+            logits = mx.nd.dot(x[:, 0:1], mx.nd.ones((1, 64)))  # (B, 64)
+            seq = x * 2                                         # (B, L)
+            return logits, seq
+
+    m = M()
+    m.initialize()
+    m.hybridize(bucket_axis=1)
+    x = mx.nd.array(onp.arange(2 * 48, dtype="float32").reshape(2, 48))
+    logits, seq = m(x)
+    assert logits.shape == (2, 64), logits.shape   # untouched coincidence
+    assert seq.shape == (2, 48), seq.shape          # padded length sliced
+    onp.testing.assert_allclose(seq.asnumpy(), x.asnumpy() * 2)
+    onp.testing.assert_allclose(
+        logits.asnumpy(), onp.tile(x.asnumpy()[:, 0:1], (1, 64)))
+
+
+def test_cachedop_explicit_bucket_sizes_and_lru(monkeypatch):
+    """bucket_sizes pins the bucket ladder; MXNET_CACHEDOP_CACHE_SIZE caps
+    live compiled signatures with LRU eviction."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    monkeypatch.setenv("MXNET_CACHEDOP_CACHE_SIZE", "1")
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.hybridize(bucket_axis=0, bucket_sizes=[4, 16])
+    net(mx.nd.ones((3, 3)))   # -> bucket 4
+    net(mx.nd.ones((4, 3)))   # -> bucket 4, same entry
+    assert len(net._jit_lru) == 1
+    net(mx.nd.ones((10, 3)))  # -> bucket 16, evicts bucket-4 entry
+    assert len(net._jit_lru) == 1
+    out = net(mx.nd.ones((5, 3)))  # recompiles bucket 4 after eviction
+    assert out.shape == (5, 2)
+    assert len(net._jit_lru) == 1
 
 
 def test_optimize_for_backends():

@@ -228,6 +228,31 @@ def test_proposal_shapes_and_ordering():
     assert out2.shape == (10, 5)
 
 
+def test_proposal_backfills_survivors_from_pre_nms_pool():
+    """NMS must run over the whole pre-NMS pool so survivors ranked beyond
+    post_nms_top_n backfill suppressed slots (reference proposal.cc keeps
+    the top post_n SURVIVORS of the pool, not survivors among the top
+    post_n). With many overlapping top anchors plus distinct lower-scored
+    ones, all post_n slots should hold real (nonzero-width) boxes."""
+    rng = onp.random.RandomState(3)
+    B, A, H, W = 1, 6, 8, 8
+    # strongly peaked scores so the top anchors heavily overlap at one cell
+    cls_prob = rng.uniform(0.4, 0.6, size=(B, 2 * A, H, W)).astype("float32")
+    cls_prob[0, A:, 4, 4] = 0.99  # all 6 anchors at one location dominate
+    bbox_pred = onp.zeros((B, 4 * A, H, W), dtype="float32")
+    im_info = onp.array([[128, 128, 1.0]], dtype="float32")
+    post_n = 8
+    out = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=200, rpn_post_nms_top_n=post_n, threshold=0.5,
+        rpn_min_size=1, scales=(4, 8), ratios=(0.5, 1, 2),
+        feature_stride=8).asnumpy()
+    assert out.shape == (post_n, 5)
+    widths = out[:, 3] - out[:, 1]
+    # every slot backfilled with a real proposal from the pool
+    assert (widths > 0).all(), out
+
+
 def _np_correlation(a, b, K, md, s1, s2, pad, multiply):
     B, C, H, W = a.shape
     kr = (K - 1) // 2
@@ -317,6 +342,59 @@ def test_sync_batch_norm_matches_batch_norm():
     onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_sync_batch_norm_axis_name_updates_moving_stats():
+    """Training under axis_name must update moving_mean/moving_var with the
+    momentum rule, and inference (training flag off) must normalize by those
+    running stats (reference contrib/sync_batch_norm.cc)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as onp2
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    from mxnet_tpu.ndarray.vision_ops import SyncBatchNorm as SBN
+    from mxnet_tpu import _tape
+    rng = onp.random.RandomState(7)
+    x = rng.randn(4, 3, 2, 2).astype("float32") * 2 + 1.5
+    gamma = onp.ones(3, "float32")
+    beta = onp.zeros(3, "float32")
+    mesh = Mesh(onp2.array(jax.devices()[:2]), ("dp",))
+
+    def per_shard(xs):
+        m = nd.array(onp.zeros(3, "float32"))
+        v = nd.array(onp.ones(3, "float32"))
+        out = SBN(mx.nd.from_jax(xs), nd.array(gamma), nd.array(beta),
+                  m, v, fix_gamma=False, momentum=0.9,
+                  axis_name="dp")._data
+        # the op REBINDS m._data/v._data to the updated stats during the
+        # trace (the protocol HybridBlock's state capture detects); a raw
+        # jax caller returns them as outputs
+        return out, m._data, v._data
+
+    prev = _tape.set_training(True)
+    try:
+        out, new_mm, new_mv = jax.jit(jax.shard_map(
+            per_shard, mesh=mesh, in_specs=P("dp"),
+            out_specs=(P("dp"), P(), P())))(jnp.asarray(x))
+    finally:
+        _tape.set_training(prev)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    # stats advanced one momentum step toward the GLOBAL batch moments
+    onp.testing.assert_allclose(onp.asarray(new_mm), 0.1 * bm,
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(new_mv), 0.9 + 0.1 * bv,
+                                rtol=1e-3, atol=1e-4)
+    # inference path (training flag off): normalize by running stats
+    mm2 = nd.array(onp.asarray(new_mm))
+    mv2 = nd.array(onp.asarray(new_mv))
+    y = SBN(nd.array(x), nd.array(gamma), nd.array(beta), mm2, mv2,
+            fix_gamma=False, axis_name="dp", eps=1e-3).asnumpy()
+    ref = (x - onp.asarray(new_mm)[None, :, None, None]) / onp.sqrt(
+        onp.asarray(new_mv)[None, :, None, None] + 1e-3)
+    onp.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
 def test_sync_batch_norm_axis_name_psum():
     """Explicit shard_map path: per-shard moments psum'ed over the axis
     equal whole-batch normalization."""
@@ -343,7 +421,14 @@ def test_sync_batch_norm_axis_name_psum():
 
     f = jax.jit(jax.shard_map(per_shard, mesh=mesh,
                               in_specs=P("dp"), out_specs=P("dp")))
-    got = onp.asarray(f(jnp.asarray(x)))
+    # batch-moment normalization is the TRAINING path (inference uses the
+    # moving averages, reference sync_batch_norm.cc)
+    from mxnet_tpu import _tape
+    prev = _tape.set_training(True)
+    try:
+        got = onp.asarray(f(jnp.asarray(x)))
+    finally:
+        _tape.set_training(prev)
     mean = x.mean(axis=(0, 2, 3), keepdims=True)
     var = x.var(axis=(0, 2, 3), keepdims=True)
     ref = (x - mean) / onp.sqrt(var + 1e-3)
