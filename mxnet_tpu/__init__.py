@@ -32,7 +32,8 @@ _OPTIONAL_MODULES = [
     ("image", None), ("io", None), ("runtime", None), ("parallel", None),
     ("test_utils", None), ("amp", None), ("recordio", None),
     ("operator", None), ("rtc", None), ("contrib", None),
-    ("subgraph", None), ("checkpoint", None),
+    ("subgraph", None), ("checkpoint", None), ("library", None),
+    ("inspector", None),
 ]
 import importlib as _importlib
 

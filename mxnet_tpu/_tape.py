@@ -125,11 +125,23 @@ def _collect_graph(heads) -> Tuple[List[TapeNode], Dict[int, TapeNode]]:
     return order, seen
 
 
+def _ct_sum(a, b):
+    """Sum two cotangents. Raw jax arrays and NDArray-typed cotangents
+    (create_graph handles, row_sparse embedding grads) can meet on a shared
+    input; a mixed pair densifies the NDArray side."""
+    a_nd, b_nd = hasattr(a, "_data"), hasattr(b, "_data")
+    if a_nd and not b_nd:
+        return a._data + b
+    if b_nd and not a_nd:
+        return a + b._data
+    return a + b
+
+
 def _accumulate(store: Dict[Tuple[int, int], Any], key, val):
     if val is None:
         return
     if key in store:
-        store[key] = store[key] + val
+        store[key] = _ct_sum(store[key], val)
     else:
         store[key] = val
 
@@ -243,7 +255,7 @@ def _scatter_input_cts(node, in_cts, ct, leaf_grads, var_ids):
 
 def _accumulate_by_id(store: Dict[int, Any], key: int, val):
     if key in store:
-        store[key] = store[key] + val
+        store[key] = _ct_sum(store[key], val)
     else:
         store[key] = val
 
@@ -253,6 +265,27 @@ def _write_leaf_grad(x, g):
     calls, 'null' drops (reference grad_req handling, imperative.cc:490)."""
     req = getattr(x, "_grad_req", "write")
     if req == "null" or x._grad is None:
+        return
+    from .ndarray.sparse import RowSparseNDArray  # lazy: import cycle
+    if isinstance(g, RowSparseNDArray) and req == "write":
+        # keep the row_sparse structure on the leaf (reference grad_stype
+        # row_sparse, FInferStorageType): the optimizer's lazy path reads
+        # (indices, values); any dense consumer reads the dense mirror
+        x._grad = g
+        x._fresh_grad = True
+        return
+    if isinstance(x._grad, RowSparseNDArray):
+        # dense gradient arriving on a leaf whose previous grad was sparse
+        # (e.g. tied weights summed to dense this step): REPLACE the handle —
+        # writing _data in place would leave the old (indices, values) aux
+        # stale and the lazy optimizer would re-apply last step's rows
+        from .ndarray.ndarray import NDArray
+        gdata = g._data if hasattr(g, "_data") else g
+        base = x._grad._data if req == "add" else None
+        gdata = jnp.asarray(gdata, x._grad._data.dtype) \
+            .reshape(x._grad._data.shape)
+        x._grad = NDArray(gdata if base is None else base + gdata)
+        x._fresh_grad = True
         return
     gdata = g._data if hasattr(g, "_data") else g
     gdata = jnp.asarray(gdata, x._grad._data.dtype)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 __all__ = [
     "MXNetError",
     "get_env",
+    "data_dir",
     "np_dtype",
     "jx_dtype",
     "dtype_name",
@@ -27,6 +28,13 @@ __all__ = [
 
 class MXNetError(RuntimeError):
     """Default error type for the framework (reference: include/mxnet/base.h)."""
+
+
+def data_dir() -> str:
+    """Data/model cache root, MXNET_HOME-overridable (reference
+    python/mxnet/base.py data_dir, env_var.md MXNET_HOME)."""
+    return os.path.expanduser(os.environ.get(
+        "MXNET_HOME", os.path.join("~", ".mxnet")))
 
 
 def get_env(name: str, default: Any = None, dtype: type = str) -> Any:

@@ -311,6 +311,8 @@ class HybridBlock(Block):
         """
         import os
         self._active = active
+        if backend is None:
+            backend = os.environ.get("MXNET_SUBGRAPH_BACKEND") or None
         self._backend = backend
         if bucket_axis is None:
             env_ax = os.environ.get("MXNET_CACHEDOP_BUCKET_AXIS", "")

@@ -14,7 +14,7 @@ from typing import Optional
 
 import numpy as onp
 
-from ....base import MXNetError
+from ....base import MXNetError, data_dir
 from ....ndarray.ndarray import NDArray
 from ..dataset import ArrayDataset, Dataset
 
@@ -44,9 +44,12 @@ class MNIST(Dataset):
     when present, else generates the synthetic stand-in."""
 
     _base_seed = 42
+    _subdir = "mnist"
 
-    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+    def __init__(self, root=None, train=True,
                  transform=None):
+        if root is None:  # MXNET_HOME-relative default (env_var.md)
+            root = os.path.join(data_dir(), "datasets", self._subdir)
         self._root = os.path.expanduser(root)
         self._train = train
         self._transform = transform
@@ -97,8 +100,9 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     _base_seed = 77
+    _subdir = "fashion-mnist"
 
-    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+    def __init__(self, root=None, train=True,
                  transform=None):
         super().__init__(root, train, transform)
 
@@ -108,9 +112,12 @@ class CIFAR10(Dataset):
     from root, else synthesizes 32x32x3 learnable data."""
 
     _num_classes = 10
+    _subdir = "cifar10"
 
-    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+    def __init__(self, root=None, train=True,
                  transform=None):
+        if root is None:  # MXNET_HOME-relative default (env_var.md)
+            root = os.path.join(data_dir(), "datasets", self._subdir)
         self._root = os.path.expanduser(root)
         self._train = train
         self._transform = transform
@@ -156,8 +163,9 @@ class CIFAR10(Dataset):
 
 class CIFAR100(CIFAR10):
     _num_classes = 100
+    _subdir = "cifar100"
 
-    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+    def __init__(self, root=None, train=True,
                  transform=None, fine_label=True):
         super().__init__(root, train, transform)
 

@@ -1,0 +1,29 @@
+"""Pretrained-weight store (reference gluon/model_zoo/model_store.py).
+
+Weights resolve in order: an existing local file under ``root`` (default
+``$MXNET_HOME/models``), then the repo at ``MXNET_GLUON_REPO`` via
+``gluon.utils.download`` — which in this zero-egress build serves ``file://``
+mirrors and existing paths only (utils.py download). Point
+``MXNET_GLUON_REPO`` at a local mirror (``file:///data/mirror/``) to use
+pretrained weights offline.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import data_dir
+from ..utils import download, _get_repo_url
+
+__all__ = ["get_model_file"]
+
+
+def get_model_file(name: str, root: str | None = None) -> str:
+    """Return a local path to ``<name>.params``, fetching from the repo
+    mirror if absent (reference model_store.get_model_file)."""
+    root = os.path.expanduser(root or os.path.join(data_dir(), "models"))
+    path = os.path.join(root, f"{name}.params")
+    if os.path.exists(path):
+        return path
+    os.makedirs(root, exist_ok=True)
+    url = f"{_get_repo_url()}gluon/models/{name}.params"
+    return download(url, path=path)

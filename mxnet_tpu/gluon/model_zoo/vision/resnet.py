@@ -245,13 +245,19 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
             f"invalid resnet depth {num_layers}; options {sorted(resnet_spec)}")
     if version not in (1, 2):
         raise MXNetError("resnet version must be 1 or 2")
-    if pretrained:
-        raise MXNetError("pretrained weights are not bundled; use "
-                         "load_parameters with a local file")
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        # weights resolve through $MXNET_HOME/models then the
+        # MXNET_GLUON_REPO mirror (model_store.py; zero-egress builds need
+        # a file:// mirror or a pre-populated cache)
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file(f"resnet{num_layers}_v{version}", root=root),
+            ctx=ctx)
+    return net
 
 
 def resnet18_v1(**kwargs):

@@ -134,17 +134,27 @@ class Dropout(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Embedding lookup (reference gluon Embedding). ``sparse_grad=True``
+    gives the weight a row_sparse gradient: backward produces only the
+    touched rows and lazy optimizers (SGD/Adam/AdaGrad) update only those
+    rows — the O(rows) path for large vocabularies. Requires the eager
+    (non-hybridized) path; inside a jit trace gradients are dense."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = Parameter("weight", shape=(input_dim, output_dim),
-                                dtype=dtype, init=weight_initializer)
+        self._sparse_grad = sparse_grad
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         return F.Embedding(x, self.weight.data(), input_dim=self._input_dim,
-                           output_dim=self._output_dim)
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
 
 
 class BatchNorm(HybridBlock):

@@ -76,11 +76,19 @@ class Trainer:
                 self._kvstore.set_gradient_compression(
                     self._compression_params)
             if self._update_on_kvstore is None:
-                # single-worker: updating locally is cheaper; dist sync
-                # stores traditionally update on store
-                self._update_on_kvstore = \
-                    self._kvstore.num_workers > 1 and \
-                    "dist" in getattr(self._kvstore, "type", "")
+                import os
+                env = os.environ.get("MXNET_UPDATE_ON_KVSTORE")
+                if env is not None:
+                    # reference trainer.py honors this override in its
+                    # decision matrix (env_var.md MXNET_UPDATE_ON_KVSTORE)
+                    self._update_on_kvstore = \
+                        env.lower() not in ("0", "false", "no", "")
+                else:
+                    # single-worker: updating locally is cheaper; dist sync
+                    # stores traditionally update on store
+                    self._update_on_kvstore = \
+                        self._kvstore.num_workers > 1 and \
+                        "dist" in getattr(self._kvstore, "type", "")
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
             # seed store with current weights

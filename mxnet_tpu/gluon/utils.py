@@ -61,6 +61,20 @@ def clip_global_norm(arrays, max_norm: float, check_isfinite: bool = True):
     return total
 
 
+_APACHE_REPO_URL = "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
+
+
+def _get_repo_url():
+    """Base URL for model-zoo/dataset artifacts, overridable with
+    MXNET_GLUON_REPO (reference gluon/utils.py _get_repo_url). Zero-egress
+    builds point it at a local mirror directory via file://."""
+    import os
+    url = os.environ.get("MXNET_GLUON_REPO", _APACHE_REPO_URL)
+    if not url.endswith("/"):
+        url += "/"
+    return url
+
+
 def download(url, path=None, overwrite=False, sha1_hash=None,
              retries=5, verify_ssl=True):
     """Reference gluon.utils.download. This build runs zero-egress; only

@@ -1,0 +1,161 @@
+"""Tensor inspector: value dumping, checkers, checksums, NaN guard.
+
+Reference analog: ``src/common/tensor_inspector.h`` (TensorInspector with
+interactive_print/check_value/dump_to_file and the CheckerType zoo) — the
+debugging utility the reference compiles into every build. TPU-native
+additions: checks run as one jitted reduction on device (no host transfer
+until a failure is found), and an env-gated invoke-funnel guard
+(``MXNET_INSPECT_NAN=1``) validates every imperative op's outputs, naming
+the producing op — the eager analog of jax's debug_nans.
+"""
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Callable, List, Tuple, Union
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+__all__ = ["TensorInspector", "CheckerType", "install_nan_guard",
+           "remove_nan_guard"]
+
+
+class CheckerType:
+    """Value checkers (reference tensor_inspector.h:71 CheckerType)."""
+    NegativeChecker = "negative"
+    PositiveChecker = "positive"
+    ZeroChecker = "zero"
+    NaNChecker = "nan"
+    InfChecker = "inf"
+    NegativeInfChecker = "neg_inf"
+    PositiveInfChecker = "pos_inf"
+    FiniteChecker = "finite"
+    AbnormalChecker = "abnormal"   # nan or inf
+
+
+_CHECKS = {
+    CheckerType.NegativeChecker: lambda d: d < 0,
+    CheckerType.PositiveChecker: lambda d: d > 0,
+    CheckerType.ZeroChecker: lambda d: d == 0,
+    CheckerType.NaNChecker: lambda d: jnp.isnan(d),
+    CheckerType.InfChecker: lambda d: jnp.isinf(d),
+    CheckerType.NegativeInfChecker: lambda d: jnp.isneginf(d),
+    CheckerType.PositiveInfChecker: lambda d: jnp.isposinf(d),
+    CheckerType.FiniteChecker: lambda d: ~jnp.isfinite(d),
+    CheckerType.AbnormalChecker: lambda d: ~jnp.isfinite(d),
+}
+
+
+def _raw(t):
+    return t._data if hasattr(t, "_data") else jnp.asarray(t)
+
+
+class TensorInspector:
+    """Inspect one tensor (reference TensorInspector)."""
+
+    def __init__(self, tensor, tag: str = ""):
+        self._t = _raw(tensor)
+        self._tag = tag
+
+    # -- printing ----------------------------------------------------------
+    def to_string(self) -> str:
+        arr = onp.asarray(self._t)
+        head = (f"Tensor{f' <{self._tag}>' if self._tag else ''} "
+                f"shape={tuple(arr.shape)} dtype={arr.dtype}")
+        return head + "\n" + onp.array2string(arr, threshold=200)
+
+    def interactive_print(self, tag: str = ""):
+        """Non-interactive environments get the plain dump (the reference
+        prompts on a terminal; under a driver we just print)."""
+        if tag:
+            self._tag = tag
+        print(self.to_string())
+
+    # -- value checking ----------------------------------------------------
+    def check_value(self, checker: Union[str, Callable],
+                    interactive: bool = False,
+                    tag: str = "") -> List[Tuple[int, ...]]:
+        """Return coordinates of violating values. The ANY-violation test is
+        one jitted device reduction; coordinates are computed on host only
+        when a violation exists (keeps the common clean path transfer-free).
+        """
+        fn = _CHECKS.get(checker, checker)
+        if not callable(fn):
+            raise MXNetError(f"unknown checker {checker!r}")
+        mask = fn(self._t)
+        if not bool(jnp.any(mask)):
+            return []
+        coords = [tuple(int(i) for i in idx)
+                  for idx in zip(*onp.nonzero(onp.asarray(mask)))]
+        if interactive or tag:
+            print(f"check_value <{tag or self._tag}>: "
+                  f"{len(coords)} violations, first at {coords[0]}")
+        return coords
+
+    # -- checksums / dumping ----------------------------------------------
+    def checksum(self) -> int:
+        """CRC32 of the raw bytes (reference dump checksum usage)."""
+        return zlib.crc32(onp.ascontiguousarray(onp.asarray(self._t)))
+
+    def dump_to_file(self, tag: str, directory: str = ".") -> str:
+        """Write .npy named <tag>_<n>.npy (reference dump_to_file naming
+        with a per-tag visit counter)."""
+        count = _dump_counters.get(tag, 0) + 1
+        _dump_counters[tag] = count
+        path = os.path.join(directory, f"{tag}_{count}.npy")
+        onp.save(path, onp.asarray(self._t))
+        return path
+
+
+_dump_counters: dict = {}
+
+# ---------------------------------------------------------------------------
+# Invoke-funnel NaN guard
+# ---------------------------------------------------------------------------
+
+_guard_installed = False
+
+
+def _nan_guard_wrapper(name, fn):
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, o in enumerate(outs):
+            d = _raw(o)
+            if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating):
+                import jax
+                if isinstance(d, jax.core.Tracer):
+                    continue  # inside a trace: values unknown
+                if not bool(jnp.all(jnp.isfinite(d))):
+                    raise MXNetError(
+                        f"MXNET_INSPECT_NAN: op {name!r} produced a "
+                        f"non-finite value in output {i}")
+        return out
+    return wrapped
+
+
+def install_nan_guard():
+    """Check every imperative op's outputs for NaN/Inf, raising with the op
+    name (reference check_value NaNChecker wired through the invoke funnel;
+    enabled at import when MXNET_INSPECT_NAN=1). Synchronizes per op —
+    debugging tool, not a production mode."""
+    global _guard_installed
+    if not _guard_installed:
+        _registry.add_invoke_wrapper(_nan_guard_wrapper)
+        _guard_installed = True
+
+
+def remove_nan_guard():
+    global _guard_installed
+    if _guard_installed:
+        _registry.remove_invoke_wrapper(_nan_guard_wrapper)
+        _guard_installed = False
+
+
+if os.environ.get("MXNET_INSPECT_NAN", "0") == "1":
+    install_nan_guard()

@@ -43,6 +43,23 @@ def _reduce_sum(values: List[NDArray]) -> NDArray:
     return NDArray(acc)
 
 
+def _write_out(o: NDArray, result: NDArray) -> None:
+    """Write a merged result into a caller's array. If the caller handle is
+    row_sparse and the merged data is not its own (multi-replica or
+    cross-process reduce changed the row set), refresh the aux arrays to the
+    all-rows form so (indices, values) never go stale against the dense
+    mirror — correctness first; the O(rows) lazy path is preserved on the
+    common single-replica round-trip where the data object is unchanged."""
+    from ..ndarray import sparse as nd_sparse
+    if isinstance(o, nd_sparse.RowSparseNDArray) \
+            and o._data is not result._data:
+        import jax.numpy as _jnp
+        o._aux = {"indices": NDArray(_jnp.arange(result._data.shape[0],
+                                                 dtype=_jnp.int32)),
+                  "values": NDArray(result._data)}
+    o._data = result._data
+
+
 @KVStoreBase.register
 class KVStoreTPU(KVStoreBase):
     """The 'tpu' backend (reference north star: kvstore='tpu').
@@ -89,6 +106,15 @@ class KVStoreTPU(KVStoreBase):
 
     def pushpull(self, key, value, out=None, priority=0):
         values = _as_list(value)
+        if (len(values) == 1 and self._updater is None
+                and self._compression is None and self.num_workers == 1
+                and (out is None or out is value
+                     or _as_list(out) == values)):
+            # single replica, no store-side transform: the reduce is the
+            # identity. Skip it WITHOUT touching v._data so a lazy
+            # row_sparse gradient's dense mirror is never materialized
+            # (the O(rows) Embedding path).
+            return value if out is None else out
         merged = self._merge(self._compressed(key, values))
         if self._updater is not None:
             skey = str(key)
@@ -102,10 +128,10 @@ class KVStoreTPU(KVStoreBase):
             # write back into the caller's arrays (NOT the compressed
             # copies _compressed returned)
             for v in values:
-                v._data = result._data
+                _write_out(v, result)
             return value
         for o in _as_list(out):
-            o._data = result._data
+            _write_out(o, result)
         return out
 
     # ---------------- legacy API (reference kvstore.h) ----------------
@@ -137,11 +163,11 @@ class KVStoreTPU(KVStoreBase):
         outs = _as_list(out)
         if len(keys) == 1:
             for o in outs:
-                o._data = self._store[str(keys[0])]._data
+                _write_out(o, self._store[str(keys[0])])
         else:
             for k, o in zip(keys, outs):
                 for oo in _as_list(o):
-                    oo._data = self._store[str(k)]._data
+                    _write_out(oo, self._store[str(k)])
         return out
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):

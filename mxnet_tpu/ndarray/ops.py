@@ -503,11 +503,68 @@ def take(a, indices, axis=0, mode="clip"):
 @_export
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
               sparse_grad=False):
-    """Reference Embedding op (src/operator/tensor/indexing_op.cc)."""
+    """Reference Embedding op (src/operator/tensor/indexing_op.cc).
+
+    ``sparse_grad=True`` (reference indexing_op.cc SparseEmbedding +
+    FInferStorageType row_sparse grad): on the eager recording path the
+    weight gradient is produced as a RowSparseNDArray whose values are
+    segment-summed cotangent rows over the UNIQUE token ids — O(rows
+    touched) gradient math instead of a dense scatter over the whole
+    vocabulary, feeding the optimizer's lazy row update. Inside a jit trace
+    (hybridized) gradients are dense by construction and the standard path
+    is used."""
     data, weight = _wrap(data), _wrap(weight)
+    if sparse_grad:
+        from .. import _tape
+        if _tape.is_recording() and not isinstance(data._data,
+                                                   jax.core.Tracer):
+            return _embedding_sparse_grad(data, weight)
     return invoke_raw("embedding",
                       lambda idx, w: jnp.take(w, idx.astype(jnp.int32), axis=0),
                       [data, weight])
+
+
+def _embedding_sparse_grad(data, weight):
+    """Record an embedding lookup whose weight cotangent is row_sparse.
+
+    The unique-id set and inverse map are computed on host at forward time
+    (token ids are host-produced by the data pipeline, so this sync is
+    effectively free); backward is then a pure XLA segment_sum over the
+    looked-up rows."""
+    from .. import _tape
+    from .sparse import _make_row_sparse_lazy
+
+    ids_host = onp.asarray(data._data).astype("int32").reshape(-1)
+    uids, inv = onp.unique(ids_host, return_inverse=True)
+    uids_j = jnp.asarray(uids, jnp.int32)
+    inv_j = jnp.asarray(inv.astype("int32"))
+    n_u = int(uids.shape[0])
+    vocab, dim = weight._data.shape
+    out_shape = tuple(data.shape) + (dim,)
+
+    def fwd(idx, w):
+        return jnp.take(w, idx.astype(jnp.int32), axis=0)
+
+    out_data = jnp.take(weight._data, jnp.asarray(ids_host),
+                        axis=0).reshape(out_shape)
+
+    def vjp_fn(ct):
+        ctd = ct._data if isinstance(ct, NDArray) else ct
+        vals = ctd.reshape(-1, dim)
+        summed = jax.ops.segment_sum(vals, inv_j, num_segments=n_u)
+        # LAZY dense mirror: the O(vocab) scatter runs only if a dense
+        # consumer reads it; the sparse path (lazy optimizer, kvstore
+        # identity round-trip) stays O(rows) end-to-end
+        thunk = (lambda s=summed: jnp.zeros((vocab, dim), s.dtype)
+                 .at[uids_j].add(s))
+        return (None, _make_row_sparse_lazy(thunk, uids_j, summed))
+
+    node = _tape.TapeNode(
+        "embedding_sparse", [data, weight], fwd, vjp_fn,
+        [jax.ShapeDtypeStruct(out_data.shape, out_data.dtype)])
+    out = NDArray(out_data)
+    out._tape_entry = (node, 0)
+    return out
 
 
 @_export
@@ -931,7 +988,7 @@ def Dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False):
 @_export
 def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
-    return embedding(data, weight)
+    return embedding(data, weight, sparse_grad=sparse_grad)
 
 
 @_export

@@ -92,6 +92,49 @@ def _make_row_sparse(dense_data, indices, values) -> RowSparseNDArray:
     return out
 
 
+class LazyRowSparseNDArray(RowSparseNDArray):
+    """Row-sparse array whose dense mirror is materialized ON FIRST DENSE
+    ACCESS instead of eagerly. Sparse-aware consumers (lazy optimizer
+    update, kvstore sparse round-trip) read only (indices, values), so an
+    Embedding sparse gradient costs O(rows) memory traffic end-to-end; the
+    O(vocab) scatter happens only if something actually needs the dense
+    form (reference row_sparse arrays are likewise never densified on the
+    sparse path, src/operator/optimizer_op.cc sparse kernels)."""
+
+    __slots__ = ("_dense_thunk",)
+
+    # the subclass property shadows the NDArray `_data` slot; the slot
+    # descriptor on NDArray is still the storage
+    @property
+    def _data(self):
+        d = NDArray._data.__get__(self)
+        if d is None:
+            thunk = self._dense_thunk
+            if thunk is not None:
+                d = thunk()
+                NDArray._data.__set__(self, d)
+                self._dense_thunk = None
+        return d
+
+    @_data.setter
+    def _data(self, value):
+        NDArray._data.__set__(self, value)
+        self._dense_thunk = None
+
+    @property
+    def is_materialized(self) -> bool:
+        return NDArray._data.__get__(self) is not None
+
+
+def _make_row_sparse_lazy(dense_thunk, indices, values):
+    out = LazyRowSparseNDArray.__new__(LazyRowSparseNDArray)
+    out._dense_thunk = None
+    out._init_empty()
+    out._aux = {"indices": NDArray(indices), "values": NDArray(values)}
+    out._dense_thunk = dense_thunk
+    return out
+
+
 def _make_csr(dense_data, data, indices, indptr) -> CSRNDArray:
     out = CSRNDArray.__new__(CSRNDArray)
     out._init_empty()

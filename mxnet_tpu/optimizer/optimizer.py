@@ -158,6 +158,64 @@ class Optimizer:
             self._jit_update = jax.jit(stepfn, donate_argnums=(7,))
         return self._jit_update
 
+    def _jitted_sparse(self):
+        """Lazy row_sparse update (reference optimizer_op.cc sparse
+        sgd/adam kernels + optimizer.py lazy_update): the rule runs only on
+        the rows named by the gradient's indices — gather rows of weight and
+        state, apply the elementwise rule, scatter back. FLOPs and state
+        traffic are O(rows touched), not O(vocab).
+
+        MXNET_SPARSE_DONATE=1 additionally donates the weight buffer so the
+        scatter is in-place in HBM (off by default: the weight buffer may be
+        aliased by other live NDArray handles)."""
+        if getattr(self, "_jit_sparse", None) is None:
+            import os
+            rule = self._rule()
+            has_clip = self.clip_gradient is not None
+
+            def stepfn(w, ids, vals, lr, wd, t, rescale, clip, states):
+                g = vals * rescale
+                if has_clip:
+                    g = jnp.clip(g, -clip, clip)
+                w_rows = jnp.take(w, ids, axis=0)
+                s_rows = tuple(jnp.take(s, ids, axis=0) for s in states)
+                new_rows, new_s_rows = rule(w_rows, g, lr, wd, t, s_rows)
+                new_w = w.at[ids].set(new_rows)
+                new_states = tuple(s.at[ids].set(ns)
+                                   for s, ns in zip(states, new_s_rows))
+                return new_w, new_states
+
+            donate = (0, 8) if os.environ.get(
+                "MXNET_SPARSE_DONATE", "0") == "1" else (8,)
+            self._jit_sparse = jax.jit(stepfn, donate_argnums=donate)
+        return self._jit_sparse
+
+    def _update_one_sparse(self, index, weight, grad, state, t, lr, wd):
+        ids = grad._aux["indices"]._data.astype(jnp.int32)
+        vals = grad._aux["values"]._data
+        # pad the row count to the next power of two so variable
+        # unique-token counts share compiled programs instead of retracing
+        # per distinct count. Pad ids with vocab (out of bounds): XLA drops
+        # OOB scatter rows and clips OOB gather rows, so padding rows are
+        # read-and-discarded no-ops with zero-valued gradients.
+        n = int(ids.shape[0])
+        vocab = int(weight._data.shape[0])
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        if bucket > n:
+            ids = jnp.pad(ids, (0, bucket - n), constant_values=vocab)
+            vals = jnp.pad(vals, ((0, bucket - n),) + ((0, 0),) *
+                           (vals.ndim - 1))
+        clip = self.clip_gradient if self.clip_gradient is not None else 0.0
+        raw_state = tuple(s._data for s in state)
+        new_w, new_state = self._jitted_sparse()(
+            weight._data, ids, vals, lr, wd, t, self.rescale_grad, clip,
+            raw_state)
+        weight._data = new_w
+        for s, ns in zip(state, new_state):
+            s._data = ns
+
     def _jitted_multi(self):
         """Multi-tensor fused step (reference multi_sgd_mom_update,
         src/operator/optimizer_op.cc): ALL parameter updates compile into
@@ -202,12 +260,14 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         """Single-param update (reference Optimizer.update). Lists are the
         reference's multi-tensor form, fused into one XLA program."""
+        from ..ndarray.sparse import RowSparseNDArray
         if isinstance(index, (list, tuple)):
             plain = all(
                 not (isinstance(s, tuple) and len(s) == 2 and
                      isinstance(s[0], tuple) and isinstance(s[1], NDArray) and
                      w._data.dtype in (jnp.float16, jnp.bfloat16))
-                for s, w in zip(state, weight))
+                for s, w in zip(state, weight)) and not any(
+                isinstance(g, RowSparseNDArray) for g in grad)
             if plain and len(index) > 1:
                 self._update_multi(list(index), list(weight), list(grad),
                                    list(state))
@@ -228,6 +288,18 @@ class Optimizer:
                 isinstance(state[0], tuple) and isinstance(state[1], NDArray) \
                 and weight._data.dtype in (jnp.float16, jnp.bfloat16):
             state, master = state
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and master is None \
+                and getattr(self, "lazy_update", False) \
+                and grad._aux["indices"]._data.shape[0] \
+                < weight._data.shape[0]:
+            # lazy row update: touch only the rows named by the gradient
+            # (reference lazy_update semantics — wd/momentum decay also
+            # apply only to touched rows). An all-rows sparse grad (e.g.
+            # post-allreduce writeback) takes the dense rule below: a full
+            # gather+scatter would only add overhead.
+            self._update_one_sparse(index, weight, grad, state, t, lr, wd)
+            return
         fn = self._jitted()
         raw_state = tuple(s._data for s in state)
         clip = self.clip_gradient if self.clip_gradient is not None else 0.0
@@ -259,10 +331,13 @@ class SGD(Optimizer):
     """SGD with momentum/nesterov-free path (reference optimizer/sgd.py;
     kernels src/operator/optimizer_op.cc sgd_update/sgd_mom_update)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        # reference sgd.py lazy_update=True default: engages only when the
+        # gradient arrives row_sparse (Embedding sparse_grad)
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -379,9 +454,12 @@ class Adam(Optimizer):
     """Adam (reference optimizer/adam.py; kernel adam_update)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_update=False, **kwargs):
+                 epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        # reference adam.py lazy_update: row_sparse grads touch only their
+        # rows (bias correction still uses the global step t, as upstream)
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return self._zeros_state(weight, 2)
@@ -483,6 +561,7 @@ class AdaGrad(Optimizer):
     def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.epsilon = epsilon
+        self.lazy_update = True  # elementwise rule: safe on sparse rows
 
     def create_state(self, index, weight):
         return self._zeros_state(weight, 1)

@@ -1,0 +1,146 @@
+"""Out-of-tree op library loading (reference MXLoadLib + lib_api.h C ABI;
+example/extensions/lib_custom_op). Builds a real shared library with g++ at
+test time, loads it with mx.library.load, and runs its ops eagerly and
+inside a jit."""
+import os
+import subprocess
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+LIB_SRC = r"""
+#include <cstring>
+extern "C" {
+
+static const char* kNames[2] = {"lib_gelu_host", "lib_weighted_sum"};
+
+int mxt_lib_num_ops(void) { return 2; }
+
+const char* mxt_lib_op_name(int op) { return kNames[op]; }
+
+static long numel(const long* shape, int ndim) {
+  long n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+int mxt_lib_op_infer_shape(int op, const long* const* in_shapes,
+                           const int* in_ndims, int n_in,
+                           long* out_shape, int* out_ndim) {
+  (void)op; (void)n_in;
+  *out_ndim = in_ndims[0];
+  std::memcpy(out_shape, in_shapes[0], in_ndims[0] * sizeof(long));
+  return 0;
+}
+
+int mxt_lib_op_forward(int op, const float* const* ins,
+                       const long* const* in_shapes, const int* in_ndims,
+                       int n_in, float* out, const long* out_shape,
+                       int out_ndim) {
+  long n = numel(out_shape, out_ndim);
+  if (op == 0) {  // tanh-free "gelu": x * sigmoid(1.702 x)
+    for (long i = 0; i < n; ++i) {
+      float x = ins[0][i];
+      float s = 1.0f / (1.0f + __builtin_expf(-1.702f * x));
+      out[i] = x * s;
+    }
+    return 0;
+  }
+  if (op == 1) {  // 0.25*a + 0.75*b
+    if (n_in != 2 || numel(in_shapes[1], in_ndims[1]) != n) return 2;
+    for (long i = 0; i < n; ++i)
+      out[i] = 0.25f * ins[0][i] + 0.75f * ins[1][i];
+    return 0;
+  }
+  return 1;
+}
+
+}  // extern "C"
+"""
+
+
+@pytest.fixture(scope="module")
+def oplib(tmp_path_factory):
+    d = tmp_path_factory.mktemp("oplib")
+    src = d / "lib_ops.cc"
+    so = d / "libops.so"
+    src.write_text(LIB_SRC)
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(so)], check=True)
+    return str(so)
+
+
+def test_load_and_run_eager(oplib):
+    names = mx.library.load(oplib, verbose=False)
+    assert names == ["lib_gelu_host", "lib_weighted_sum"]
+    x = onp.linspace(-3, 3, 24, dtype="float32").reshape(4, 6)
+    out = mx.nd.lib_gelu_host(mx.nd.array(x)).asnumpy()
+    ref = x / (1.0 + onp.exp(-1.702 * x))
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    a = onp.ones((3, 3), "float32")
+    b = onp.full((3, 3), 2.0, "float32")
+    got = mx.nd.lib_weighted_sum(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.full((3, 3), 1.75))
+
+
+def test_library_op_inside_jit(oplib):
+    """pure_callback makes the host op usable inside a compiled
+    computation (the reference's async CustomOperator never blocking
+    engine workers, custom-inl.h:103)."""
+    mx.library.load(oplib, verbose=False)
+    from mxnet_tpu.ops.registry import get_op
+    op = get_op("lib_weighted_sum")
+
+    @jax.jit
+    def f(a, b):
+        return op.fn(a, b) + 1.0
+
+    got = onp.asarray(f(jnp.ones((2, 2)), jnp.full((2, 2), 2.0)))
+    onp.testing.assert_allclose(got, onp.full((2, 2), 2.75))
+
+
+def test_tensor_inspector():
+    """Reference src/common/tensor_inspector.h: checkers, checksum, dump."""
+    from mxnet_tpu.inspector import TensorInspector, CheckerType
+    x = mx.nd.array(onp.array([[1.0, -2.0], [onp.nan, 4.0]], "float32"))
+    ti = TensorInspector(x, tag="t")
+    assert ti.check_value(CheckerType.NaNChecker) == [(1, 0)]
+    assert ti.check_value(CheckerType.NegativeChecker) == [(0, 1)]
+    assert ti.check_value(CheckerType.FiniteChecker) == [(1, 0)]
+    clean = TensorInspector(mx.nd.ones((4, 4)))
+    assert clean.check_value(CheckerType.AbnormalChecker) == []
+    assert clean.checksum() == TensorInspector(mx.nd.ones((4, 4))).checksum()
+    assert "shape=(2, 2)" in ti.to_string()
+
+
+def test_nan_guard_names_offending_op(tmp_path):
+    from mxnet_tpu import inspector
+    inspector.install_nan_guard()
+    try:
+        with pytest.raises(MXNetError, match="log"):
+            mx.nd.log(mx.nd.array([-1.0])).wait_to_read()
+        # clean ops pass through
+        mx.nd.sqrt(mx.nd.array([4.0])).wait_to_read()
+    finally:
+        inspector.remove_nan_guard()
+    # dump_to_file round trip
+    from mxnet_tpu.inspector import TensorInspector
+    p = TensorInspector(mx.nd.ones((2,))).dump_to_file("w", str(tmp_path))
+    onp.testing.assert_allclose(onp.load(p), onp.ones(2))
+
+
+def test_load_rejects_non_library(oplib):
+    with pytest.raises(MXNetError):
+        mx.library.load("/usr/lib/x86_64-linux-gnu/libc.so.6",
+                        verbose=False)
+    # loading twice is idempotent
+    n1 = mx.library.load(oplib, verbose=False)
+    n2 = mx.library.load(oplib, verbose=False)
+    assert n1 == n2

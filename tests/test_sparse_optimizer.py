@@ -1,0 +1,259 @@
+"""Row-sparse gradient path: Embedding sparse_grad -> lazy optimizer update
+-> kvstore round-trip.
+
+Reference analog: sparse Embedding grad (src/operator/tensor/indexing_op.cc
+FInferStorageType row_sparse), lazy updates
+(python/mxnet/optimizer/{sgd,adam}.py lazy_update=True backed by
+src/operator/optimizer_op.cc sparse kernels), kvstore row_sparse push/pull
+(src/kvstore/kvstore_dist_server.h:52 kRowSparsePushPull).
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, gluon, optimizer as opt
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def _embed_backward(sparse_grad, ids, vocab=50, dim=4, seed=5):
+    onp.random.seed(seed)
+    w0 = onp.random.randn(vocab, dim).astype("float32")
+    emb = nn.Embedding(vocab, dim, sparse_grad=sparse_grad)
+    emb.initialize()
+    emb.weight.set_data(nd.array(w0))
+    x = nd.array(onp.array(ids, "int32"))
+    with autograd.record():
+        out = emb(x)
+        loss = (out * out).sum()
+    loss.backward()
+    return emb.weight.grad(), w0
+
+
+def test_embedding_sparse_grad_structure_and_values():
+    ids = [[3, 7, 3], [1, 7, 9]]
+    g_sparse, _ = _embed_backward(True, ids)
+    g_dense, _ = _embed_backward(False, ids)
+    assert isinstance(g_sparse, RowSparseNDArray)
+    assert sorted(g_sparse.indices.asnumpy().tolist()) == [1, 3, 7, 9]
+    # dense mirror of the sparse grad equals the dense-path grad
+    onp.testing.assert_allclose(g_sparse.asnumpy(), g_dense.asnumpy(),
+                                rtol=1e-6, atol=1e-6)
+    # values rows are the per-unique-id segment sums
+    dense = g_dense.asnumpy()
+    for i, uid in enumerate(g_sparse.indices.asnumpy()):
+        onp.testing.assert_allclose(g_sparse.data.asnumpy()[i], dense[uid],
+                                    rtol=1e-6, atol=1e-6)
+
+
+def test_sgd_lazy_update_touches_only_live_rows():
+    vocab, dim = 40, 3
+    rng = onp.random.RandomState(0)
+    w0 = rng.randn(vocab, dim).astype("float32")
+    rows = onp.array([4, 17], "int32")
+    vals = rng.randn(2, dim).astype("float32")
+    grad = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
+
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    assert sgd.lazy_update
+    w = nd.array(w0)
+    state = sgd.create_state(0, w)
+    m0 = onp.asarray(state[0].asnumpy())
+    sgd.update(0, w, grad, state)
+    w1 = w.asnumpy()
+    m1 = state[0].asnumpy()
+    untouched = onp.setdiff1d(onp.arange(vocab), rows)
+    # untouched rows bitwise identical in BOTH weight and momentum
+    onp.testing.assert_array_equal(w1[untouched], w0[untouched])
+    onp.testing.assert_array_equal(m1[untouched], m0[untouched])
+    # touched rows follow the momentum-SGD rule (wd applied lazily)
+    for r, v in zip(rows, vals):
+        g = v + 0.01 * w0[r]
+        m = 0.9 * 0.0 - 0.1 * g
+        onp.testing.assert_allclose(w1[r], w0[r] + m, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_lazy_update_touches_only_live_rows():
+    vocab, dim = 30, 5
+    rng = onp.random.RandomState(1)
+    w0 = rng.randn(vocab, dim).astype("float32")
+    rows = onp.array([0, 29], "int32")
+    vals = rng.randn(2, dim).astype("float32")
+    grad = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
+    adam = opt.Adam(learning_rate=0.01)
+    w = nd.array(w0)
+    state = adam.create_state(0, w)
+    adam.update(0, w, grad, state)
+    w1 = w.asnumpy()
+    untouched = onp.setdiff1d(onp.arange(vocab), rows)
+    onp.testing.assert_array_equal(w1[untouched], w0[untouched])
+    for s in state:
+        onp.testing.assert_array_equal(s.asnumpy()[untouched],
+                                       onp.zeros((len(untouched), dim)))
+    # touched rows match the dense Adam result on the same gradient
+    adam2 = opt.Adam(learning_rate=0.01, lazy_update=False)
+    w_d = nd.array(w0)
+    state_d = adam2.create_state(0, w_d)
+    adam2.update(0, w_d, nd.array(grad.asnumpy()), state_d)
+    onp.testing.assert_allclose(w1[rows], w_d.asnumpy()[rows],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_non_lazy_sparse_grad_uses_dense_semantics():
+    """lazy_update=False with a row_sparse grad must fall back to the dense
+    rule (wd decays EVERY row — reference standard update)."""
+    vocab, dim = 10, 2
+    w0 = onp.ones((vocab, dim), "float32")
+    rows = onp.array([2], "int32")
+    vals = onp.ones((1, dim), "float32")
+    grad = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
+    sgd = opt.SGD(learning_rate=0.1, wd=0.5, lazy_update=False)
+    w = nd.array(w0)
+    sgd.update(0, w, grad, sgd.create_state(0, w))
+    w1 = w.asnumpy()
+    # untouched rows still decayed by wd under dense semantics
+    onp.testing.assert_allclose(w1[0], w0[0] - 0.1 * (0.5 * w0[0]),
+                                rtol=1e-6)
+
+
+def test_trainer_embedding_sparse_end_to_end():
+    """Embedding-heavy step through Trainer + kvstore: loss decreases and
+    vocabulary rows never touched by any batch stay bitwise at init."""
+    vocab, dim = 100, 8
+    onp.random.seed(2)
+    net = nn.Sequential()
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    net.add(emb)
+    net.initialize()
+    w_init = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9},
+                            kvstore="tpu")
+    used = set()
+    losses = []
+    for step in range(5):
+        ids = onp.random.randint(0, 20, size=(8,))  # only rows 0..19
+        used.update(ids.tolist())
+        x = nd.array(ids.astype("int32"))
+        with autograd.record():
+            out = net(x)
+            loss = ((out - 1.0) ** 2).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+    w_now = emb.weight.data().asnumpy()
+    untouched = onp.setdiff1d(onp.arange(vocab),
+                              onp.array(sorted(used)))
+    assert len(untouched) >= 80
+    onp.testing.assert_array_equal(w_now[untouched], w_init[untouched])
+    touched = onp.array(sorted(used))
+    assert (w_now[touched] != w_init[touched]).any()
+
+
+def test_sparse_grad_lazy_mirror_not_materialized_in_train_step():
+    """The O(rows) claim end-to-end: a full backward + Trainer step must
+    never materialize the dense (vocab, dim) mirror of the embedding
+    gradient; it materializes only when a dense consumer reads it."""
+    from mxnet_tpu.ndarray.sparse import LazyRowSparseNDArray
+    vocab, dim = 1000, 4
+    net = nn.Sequential()
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    net.add(emb)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="tpu")
+    x = nd.array(onp.array([1, 2, 3], "int32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = emb.weight.data()._grad
+    assert isinstance(g, LazyRowSparseNDArray)
+    assert not g.is_materialized
+    trainer.step(1)
+    assert not g.is_materialized  # whole step stayed on (indices, values)
+    # dense read materializes on demand and agrees with the sparse parts
+    dense = g.asnumpy()
+    assert g.is_materialized
+    ids = g.indices.asnumpy()
+    onp.testing.assert_allclose(dense[ids], g.data.asnumpy(),
+                                rtol=1e-6, atol=1e-6)
+    untouched = onp.setdiff1d(onp.arange(vocab), ids)
+    assert (dense[untouched] == 0).all()
+
+
+def test_dense_grad_replaces_stale_sparse_leaf():
+    """Tied/shared-weight step: when the accumulated gradient for the
+    embedding weight arrives DENSE after a previous sparse step, the leaf's
+    old (indices, values) must not survive — the optimizer would re-apply
+    last step's rows."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    vocab, dim = 20, 2
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    w = emb.weight.data()
+    # step 1: sparse grad on rows [1, 2]
+    with autograd.record():
+        loss = (emb(nd.array(onp.array([1, 2], "int32")))).sum()
+    loss.backward()
+    assert isinstance(w._grad, RowSparseNDArray)
+    # step 2: the weight participates TWICE (sparse lookup + dense use) so
+    # cotangents accumulate to a dense gradient on different rows
+    with autograd.record():
+        out = emb(nd.array(onp.array([5, 6], "int32"))).sum() \
+            + (emb.weight.data() * 0.5).sum()
+        loss2 = out
+    loss2.backward()
+    g2 = w._grad
+    assert not isinstance(g2, RowSparseNDArray)  # replaced, aux gone
+    dense = g2.asnumpy()
+    onp.testing.assert_allclose(dense[5], [1.5, 1.5])
+    onp.testing.assert_allclose(dense[0], [0.5, 0.5])
+
+
+def test_sparse_update_bucketed_compiles():
+    """Variable unique-token counts share compiled programs: the row count
+    pads to the next power of two before the jitted sparse step."""
+    vocab, dim = 64, 2
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    w = nd.array(onp.zeros((vocab, dim), "float32"))
+    state = sgd.create_state(0, w)
+    for n in (3, 4, 5, 7):   # all bucket to 4 or 8
+        rows = onp.arange(n, dtype="int32")
+        vals = onp.ones((n, dim), "float32")
+        g = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
+        sgd.update(0, w, g, state)
+    assert sgd._jit_sparse._cache_size() == 2  # buckets {4, 8}
+    # padding rows are dropped: row `vocab-1` was never touched
+    assert w.asnumpy()[vocab - 1].tolist() == [0.0, 0.0]
+
+
+def test_all_rows_sparse_grad_falls_back_to_dense_rule():
+    vocab, dim = 8, 2
+    g = nd.sparse.row_sparse_array(
+        (onp.ones((vocab, dim), "float32"),
+         onp.arange(vocab, dtype="int32")), shape=(vocab, dim))
+    sgd = opt.SGD(learning_rate=0.1)
+    w = nd.array(onp.zeros((vocab, dim), "float32"))
+    sgd.update(0, w, g, sgd.create_state(0, w))
+    onp.testing.assert_allclose(w.asnumpy(), -0.1 * onp.ones((vocab, dim)),
+                                rtol=1e-6)
+
+
+def test_kvstore_row_sparse_pull_and_aux_consistency():
+    store = mx.kvstore.create("tpu")
+    vocab, dim = 12, 3
+    w = nd.array(onp.arange(vocab * dim, dtype="float32").reshape(vocab, dim))
+    store.init("emb", w)
+    out = nd.zeros((vocab, dim))
+    store.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 5]))
+    got = out.asnumpy()
+    expect = onp.zeros((vocab, dim), "float32")
+    expect[[1, 5]] = w.asnumpy()[[1, 5]]
+    onp.testing.assert_allclose(got, expect)
+    # pushpull with a single row_sparse grad keeps (indices, values) usable
+    grad = nd.sparse.row_sparse_array(
+        (onp.ones((2, dim), "float32"), onp.array([0, 3], "int32")),
+        shape=(vocab, dim))
+    store.pushpull("emb_g", grad)
+    assert isinstance(grad, RowSparseNDArray)
+    assert sorted(grad.indices.asnumpy().tolist()) == [0, 3]
