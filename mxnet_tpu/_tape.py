@@ -85,6 +85,20 @@ class TapeNode:
         self.out_is_tuple = out_is_tuple    # fn returned a tuple (vjp wants one)
 
 
+# Optional post-record hook on the concrete primal outputs — the tape-side
+# attachment point for the inspector's NaN guard: under record the kernel
+# runs inside jax.vjp tracing (invoke wrappers only see Tracers), while the
+# primal values surfacing here are concrete (reference check_value through
+# the engine's on-complete hook).
+_output_check: Optional[Callable] = None
+
+
+def set_output_check(fn: Optional[Callable]) -> Optional[Callable]:
+    global _output_check
+    old, _output_check = _output_check, fn
+    return old
+
+
 def record_op(name: str, fn: Callable, inputs: Sequence[Any],
               out_arrays: Sequence[Any]) -> None:
     """Attach a TapeNode to ``out_arrays``. ``out_arrays`` are the NDArray
@@ -92,6 +106,9 @@ def record_op(name: str, fn: Callable, inputs: Sequence[Any],
     Called by the op-invoke layer (ops/registry.py) when recording."""
     in_datas = [x._data for x in inputs]
     outs, vjp_fn = jax.vjp(fn, *in_datas)
+    if _output_check is not None:
+        _output_check(name, outs if isinstance(outs, (tuple, list))
+                      else (outs,))
     out_is_tuple = isinstance(outs, (tuple, list))
     if not out_is_tuple:
         outs = (outs,)
@@ -208,7 +225,10 @@ def backward(heads, head_grads=None, retain_graph=False, create_graph=False,
                         "cannot run backward: the graph has already been "
                         "freed. Call backward(retain_graph=True) to backward "
                         "through the graph a second time")
-                cts = [c if c is not None else _zeros_like_aval(a)
+                # unwrap NDArray-typed cotangents (row_sparse embedding
+                # grads) to raw jax arrays before entering the vjp closure
+                cts = [(c._data if hasattr(c, "_data") else c)
+                       if c is not None else _zeros_like_aval(a)
                        for c, a in zip(cts, node.out_avals)]
                 arg = tuple(cts) if node.out_is_tuple else cts[0]
                 in_cts = node.vjp_fn(arg)

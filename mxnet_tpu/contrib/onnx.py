@@ -1,41 +1,611 @@
 """ONNX export/import (reference: python/mxnet/contrib/onnx/ — mx2onnx
-export_model + onnx2mx import_model).
+``export_model`` + onnx2mx ``import_model``).
 
-The ``onnx`` package is not available in this environment and the
-serialization backend is NOT implemented yet — the API surface is kept for
-reference parity and raises a clear error at call time either way. Native
-deployment checkpoints are ``HybridBlock.export`` / ``SymbolBlock.imports``.
+The environment ships no ``onnx`` package, so the serializer writes the
+protobuf wire format directly (``onnx_proto.py``) with the spec's field
+numbers — output files are standard ONNX models (opset 13) loadable by
+onnxruntime. Coverage is the op surface of the Gluon layer zoo: Gemm/Conv/
+BatchNormalization/pooling/activations/elementwise/shape ops; exotic ops
+raise with the op name. Both directions round-trip through the ``mx.sym``
+DAG: export walks a Symbol (reference mx2onnx/_export_onnx.py walks the
+nnvm graph), import rebuilds a Symbol + params (reference
+onnx2mx/import_onnx.py GraphProto translation).
 """
 from __future__ import annotations
 
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
 from ..base import MXNetError
+from . import onnx_proto as P
 
-__all__ = ["export_model", "import_model"]
+__all__ = ["export_model", "import_model", "get_model_metadata"]
 
 
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return onnx
-    except ImportError as e:
-        raise MXNetError(
-            "the 'onnx' package is not installed in this environment; "
-            "mx.contrib.onnx keeps the reference API surface but needs "
-            "onnx to serialize models") from e
+# ---------------------------------------------------------------------------
+# message builders
+# ---------------------------------------------------------------------------
 
+def _attr(name: str, value) -> P.MessageWriter:
+    a = P.MessageWriter()
+    a.write_string(1, name)
+    if isinstance(value, bool):
+        a.write_int(3, int(value))
+        a.write_int(20, P.AttrType.INT)
+    elif isinstance(value, int):
+        a.write_int(3, value)
+        a.write_int(20, P.AttrType.INT)
+    elif isinstance(value, float):
+        a.write_float(2, value)
+        a.write_int(20, P.AttrType.FLOAT)
+    elif isinstance(value, str):
+        a.write_bytes(4, value.encode())
+        a.write_int(20, P.AttrType.STRING)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            a.write_packed_floats(7, value)
+            a.write_int(20, P.AttrType.FLOATS)
+        else:
+            a.write_packed_ints(8, [int(v) for v in value])
+            a.write_int(20, P.AttrType.INTS)
+    else:
+        raise MXNetError(f"unsupported ONNX attribute value {value!r}")
+    return a
+
+
+def _node(op_type: str, inputs: List[str], outputs: List[str],
+          name: str, attrs: Optional[Dict[str, Any]] = None) -> P.MessageWriter:
+    n = P.MessageWriter()
+    for i in inputs:
+        n.write_string(1, i)
+    for o in outputs:
+        n.write_string(2, o)
+    n.write_string(3, name)
+    n.write_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        n.write_message(5, _attr(k, v))
+    return n
+
+
+_NP2ONNX = {"float32": P.TensorDataType.FLOAT,
+            "float64": P.TensorDataType.DOUBLE,
+            "float16": P.TensorDataType.FLOAT16,
+            "int32": P.TensorDataType.INT32,
+            "int64": P.TensorDataType.INT64,
+            "uint8": P.TensorDataType.UINT8,
+            "int8": P.TensorDataType.INT8,
+            "bool": P.TensorDataType.BOOL}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def _tensor(name: str, arr: onp.ndarray) -> P.MessageWriter:
+    t = P.MessageWriter()
+    for d in arr.shape:
+        t.write_int(1, d)
+    dt = _NP2ONNX.get(str(arr.dtype))
+    if dt is None:  # bfloat16 and friends: store as float32
+        arr = arr.astype("float32")
+        dt = P.TensorDataType.FLOAT
+    t.write_int(2, dt)
+    t.write_string(8, name)
+    t.write_bytes(9, onp.ascontiguousarray(arr).tobytes())
+    return t
+
+
+def _value_info(name: str, shape, elem_type=P.TensorDataType.FLOAT
+                ) -> P.MessageWriter:
+    tt = P.MessageWriter()
+    tt.write_int(1, elem_type)
+    if shape is not None:
+        # shape omitted entirely when unknown: writing an empty
+        # TensorShapeProto would declare a rank-0 scalar and trip
+        # onnx shape inference on every non-scalar tensor
+        dims = P.MessageWriter()
+        for d in shape:
+            dim = P.MessageWriter()
+            dim.write_int(1, int(d))
+            dims.write_message(1, dim)
+        tt.write_message(2, dims)
+    ty = P.MessageWriter()
+    ty.write_message(1, tt)
+    vi = P.MessageWriter()
+    vi.write_string(1, name)
+    vi.write_message(2, ty)
+    return vi
+
+
+# ---------------------------------------------------------------------------
+# mx -> onnx op translation
+# ---------------------------------------------------------------------------
+# builder(node_name, attrs, in_names, out_name, extra) -> list of node
+# MessageWriters; consts created along the way append to
+# extra["initializers"].
+
+_MX2ONNX = {}
+
+
+def _mx2onnx(*opnames):
+    def deco(fn):
+        for n in opnames:
+            _MX2ONNX[n] = fn
+        return fn
+    return deco
+
+
+def _tup(attrs, key, default=None):
+    v = attrs.get(key, default)
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+@_mx2onnx("FullyConnected", "fully_connected")
+def _fc(name, attrs, ins, out, extra):
+    nodes = []
+    data = ins[0]
+    if attrs.get("flatten", True):
+        nodes.append(_node("Flatten", [data], [f"{name}_flat"],
+                           f"{name}_flatten", {"axis": 1}))
+        data = f"{name}_flat"
+    gemm_in = [data, ins[1]] + (ins[2:] if len(ins) > 2 else [])
+    nodes.append(_node("Gemm", gemm_in, [out], name,
+                       {"alpha": 1.0, "beta": 1.0, "transB": 1}))
+    return nodes
+
+
+@_mx2onnx("Convolution", "convolution")
+def _conv(name, attrs, ins, out, extra):
+    kernel = _tup(attrs, "kernel")
+    if kernel is None:
+        raise MXNetError(f"ONNX export: Convolution {name} needs 'kernel'")
+    k = len(kernel)
+    a = {"kernel_shape": kernel,
+         "strides": _tup(attrs, "stride") or (1,) * k,
+         "dilations": _tup(attrs, "dilate") or (1,) * k,
+         "pads": (_tup(attrs, "pad") or (0,) * k) * 2,
+         "group": int(attrs.get("num_group", 1))}
+    return [_node("Conv", ins, [out], name, a)]
+
+
+@_mx2onnx("BatchNorm", "batch_norm")
+def _bn(name, attrs, ins, out, extra):
+    a = {"epsilon": float(attrs.get("eps", 1e-5)),
+         "momentum": float(attrs.get("momentum", 0.9))}
+    return [_node("BatchNormalization", ins, [out], name, a)]
+
+
+@_mx2onnx("Activation")
+def _act(name, attrs, ins, out, extra):
+    act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+           "softrelu": "Softplus", "softsign": "Softsign"}
+    t = attrs.get("act_type", "relu")
+    if t not in act:
+        raise MXNetError(f"ONNX export: unsupported act_type {t!r}")
+    return [_node(act[t], ins, [out], name)]
+
+
+def _simple(op_type):
+    def fn(name, attrs, ins, out, extra):
+        return [_node(op_type, ins, [out], name)]
+    return fn
+
+
+for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                 ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                 ("sqrt", "Sqrt"), ("negative", "Neg"), ("abs", "Abs"),
+                 ("add", "Add"), ("broadcast_add", "Add"),
+                 ("sub", "Sub"), ("subtract", "Sub"),
+                 ("broadcast_sub", "Sub"),
+                 ("mul", "Mul"), ("multiply", "Mul"),
+                 ("broadcast_mul", "Mul"),
+                 ("div", "Div"), ("divide", "Div"),
+                 ("broadcast_div", "Div"),
+                 ("dot", "MatMul"), ("Flatten", "Flatten"),
+                 ("identity", "Identity")]:
+    _MX2ONNX[_mx] = _simple(_ox)
+
+
+@_mx2onnx("softmax", "log_softmax")
+def _softmax(name, attrs, ins, out, extra):
+    op = "LogSoftmax" if "log" in extra["mx_op"] else "Softmax"
+    return [_node(op, ins, [out], name,
+                  {"axis": int(attrs.get("axis", -1))})]
+
+
+@_mx2onnx("Pooling", "pooling", "global_pool")
+def _pool(name, attrs, ins, out, extra):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False) or extra["mx_op"] == "global_pool":
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError(f"ONNX export: global {ptype} pool unsupported")
+        return [_node(op, ins, [out], name)]
+    kernel = _tup(attrs, "kernel")
+    if kernel is None:
+        raise MXNetError(f"ONNX export: Pooling {name} needs 'kernel'")
+    k = len(kernel)
+    a = {"kernel_shape": kernel,
+         "strides": _tup(attrs, "stride") or (1,) * k,
+         "pads": (_tup(attrs, "pad") or (0,) * k) * 2}
+    op = {"max": "MaxPool", "avg": "AveragePool"}.get(ptype)
+    if op is None:
+        raise MXNetError(f"ONNX export: pool_type {ptype!r} unsupported")
+    if op == "AveragePool":
+        a["count_include_pad"] = int(attrs.get("count_include_pad", True))
+    return [_node(op, ins, [out], name, a)]
+
+
+@_mx2onnx("Reshape", "reshape")
+def _reshape(name, attrs, ins, out, extra):
+    shape = _tup(attrs, "shape")
+    sname = f"{name}_shape"
+    extra["initializers"].append(
+        _tensor(sname, onp.asarray(shape, "int64")))
+    return [_node("Reshape", [ins[0], sname], [out], name)]
+
+
+@_mx2onnx("transpose")
+def _transpose(name, attrs, ins, out, extra):
+    a = {}
+    if attrs.get("axes") is not None:
+        a["perm"] = _tup(attrs, "axes")
+    return [_node("Transpose", ins, [out], name, a)]
+
+
+@_mx2onnx("Concat", "concat", "concatenate")
+def _concat(name, attrs, ins, out, extra):
+    return [_node("Concat", ins, [out], name,
+                  {"axis": int(attrs.get("dim", attrs.get("axis", 1)))})]
+
+
+@_mx2onnx("Dropout", "dropout")
+def _dropout(name, attrs, ins, out, extra):
+    # inference graph: Identity (reference exporter emits Dropout, which
+    # inference consumers also treat as identity)
+    return [_node("Identity", ins, [out], name)]
+
+
+@_mx2onnx("add_scalar", "sub_scalar", "mul_scalar", "div_scalar")
+def _scalar_arith(name, attrs, ins, out, extra):
+    op = {"add": "Add", "sub": "Sub", "mul": "Mul",
+          "div": "Div"}[extra["mx_op"].split("_")[0]]
+    cname = f"{name}_const"
+    extra["initializers"].append(
+        _tensor(cname, onp.asarray(attrs["scalar"], "float32")))
+    return [_node(op, [ins[0], cname], [out], name)]
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
 
 def export_model(sym, params, in_shapes=None, in_types=None,
-                 onnx_file_path="model.onnx", **kwargs):
-    """Reference mx2onnx.export_model signature."""
-    _require_onnx()
-    raise MXNetError("ONNX serialization backend not implemented for the "
-                     "TPU build yet; use HybridBlock.export (native "
-                     "symbol.json + params checkpoint) for deployment")
+                 onnx_file_path="model.onnx", verbose=False,
+                 opset_version=P.ONNX_OPSET, **kwargs):
+    """Export a Symbol (+params dict name->NDArray) to an ONNX file
+    (reference mx2onnx.export_model). Returns the file path."""
+    from ..symbol.symbol import Symbol, StableHLOSymbol
+    if isinstance(sym, StableHLOSymbol):
+        raise MXNetError("ONNX export needs an op-level Symbol (mx.sym "
+                         "graph); StableHLO exports already ARE a portable "
+                         "compiler format")
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model expects a Symbol")
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+
+    graph = P.MessageWriter()
+    extra = {"initializers": []}
+    emitted: Dict[int, str] = {}
+    used_names: set = set()
+    input_vis = []
+    in_shapes = list(in_shapes or [])
+    var_idx = [0]
+
+    def unique(nm: str) -> str:
+        # ONNX graphs are SSA: every value name must be unique, while
+        # symbol-factory default names (f"{op}_{n_inputs}") collide freely
+        base, k = nm, 1
+        while nm in used_names:
+            nm = f"{base}_{k}"
+            k += 1
+        used_names.add(nm)
+        return nm
+
+    def visit(s) -> str:
+        if id(s) in emitted:
+            return emitted[id(s)]
+        if s._op is None:
+            nm = unique(s._name)
+            emitted[id(s)] = nm
+            if s._name in params:
+                extra["initializers"].append(
+                    _tensor(nm, onp.asarray(params[s._name].asnumpy())))
+            else:
+                shape = s._attrs.get("shape")
+                if shape is None and var_idx[0] < len(in_shapes):
+                    shape = in_shapes[var_idx[0]]
+                var_idx[0] += 1
+                input_vis.append(_value_info(nm, shape))
+            return nm
+        ins = [visit(i) for i in s._inputs]
+        builder = _MX2ONNX.get(s._op)
+        if builder is None:
+            raise MXNetError(
+                f"ONNX export: no translation for op {s._op!r} "
+                f"(node {s._name!r})")
+        out = unique(s._name)
+        extra["mx_op"] = s._op
+        attrs = {k: v for k, v in s._attrs.items() if v is not None}
+        # pass the uniquified name so helper nodes/consts a builder emits
+        # (f"{name}_flat", f"{name}_shape") inherit uniqueness
+        for nd_msg in builder(out, attrs, ins, out, extra):
+            graph.write_message(1, nd_msg)
+        emitted[id(s)] = out
+        return out
+
+    head = visit(sym)
+    graph.write_string(2, "mxnet_tpu")
+    for t in extra["initializers"]:
+        graph.write_message(5, t)
+    for vi in input_vis:
+        graph.write_message(11, vi)
+    graph.write_message(12, _value_info(head, None))
+
+    model = P.MessageWriter()
+    model.write_int(1, P.ONNX_IR_VERSION)
+    model.write_string(2, "mxnet_tpu")
+    model.write_string(3, "2.0")
+    opset = P.MessageWriter()
+    opset.write_string(1, "")
+    opset.write_int(2, opset_version)
+    model.write_message(8, opset)
+    model.write_message(7, graph)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.tobytes())
+    if verbose:
+        print(f"exported ONNX model to {onnx_file_path}")
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# import
+# ---------------------------------------------------------------------------
+
+def _get_str(fields, num, default=""):
+    for wire, val in fields.get(num, []):
+        return val.decode()
+    return default
+
+
+def _get_int(fields, num, default=0):
+    for wire, val in fields.get(num, []):
+        return val
+    return default
+
+
+def _parse_tensor(data: bytes) -> Tuple[str, onp.ndarray]:
+    f = P.parse_message(data)
+    dims = P.unpack_ints(f.get(1, []))
+    dt = _get_int(f, 2, P.TensorDataType.FLOAT)
+    name = _get_str(f, 8)
+    np_dt = _ONNX2NP.get(dt)
+    if np_dt is None:
+        raise MXNetError(f"ONNX import: unsupported tensor dtype {dt}")
+    raw = f.get(9)
+    if raw:
+        arr = onp.frombuffer(raw[0][1], dtype=np_dt).reshape(dims)
+    elif dt == P.TensorDataType.FLOAT and f.get(4):
+        import struct as _s
+        blob = f[4][0][1]
+        arr = onp.asarray(_s.unpack(f"<{len(blob) // 4}f", blob),
+                          "float32").reshape(dims)
+    elif dt == P.TensorDataType.INT64 and f.get(7):
+        arr = onp.asarray([P.signed64(v) for v in P.unpack_ints(f[7])],
+                          "int64").reshape(dims)
+    elif dt in (P.TensorDataType.INT32, P.TensorDataType.UINT8,
+                P.TensorDataType.INT8, P.TensorDataType.BOOL) and f.get(5):
+        # int32_data (field 5) also carries uint8/int8/bool per the spec
+        arr = onp.asarray([P.signed64(v) for v in P.unpack_ints(f[5])]
+                          ).astype(np_dt).reshape(dims)
+    elif int(onp.prod(dims)) == 0:
+        arr = onp.zeros(dims, np_dt)
+    else:
+        raise MXNetError(
+            f"ONNX import: tensor {name!r} uses an unsupported data "
+            f"encoding (dtype {dt}; raw_data/float_data/int64_data/"
+            f"int32_data are handled, external data is not)")
+    return name, arr
+
+
+def _parse_attrs(entries) -> Dict[str, Any]:
+    import struct as _s
+    out = {}
+    for wire, data in entries:
+        f = P.parse_message(data)
+        name = _get_str(f, 1)
+        atype = _get_int(f, 20, 0)
+        if atype == P.AttrType.INT or (atype == 0 and 3 in f):
+            out[name] = P.signed64(_get_int(f, 3))
+        elif atype == P.AttrType.FLOAT or (atype == 0 and 2 in f):
+            out[name] = _s.unpack("<f", f[2][0][1])[0]
+        elif atype == P.AttrType.STRING or (atype == 0 and 4 in f):
+            out[name] = f[4][0][1].decode()
+        elif atype == P.AttrType.INTS or (atype == 0 and 8 in f):
+            out[name] = tuple(P.signed64(v)
+                              for v in P.unpack_ints(f.get(8, [])))
+        elif atype == P.AttrType.FLOATS or (atype == 0 and 7 in f):
+            blob = f[7][0][1]
+            out[name] = tuple(_s.unpack(f"<{len(blob) // 4}f", blob))
+        elif atype == P.AttrType.TENSOR:
+            out[name] = _parse_tensor(f[5][0][1])[1]
+    return out
+
+
+def _onnx_pads(attrs, k):
+    pads = attrs.get("pads")
+    if pads is None:
+        return (0,) * k
+    begin, end = pads[:k], pads[k:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("ONNX import: asymmetric pads unsupported")
+    return tuple(begin)
 
 
 def import_model(model_file: str):
-    """Reference onnx2mx.import_model signature."""
-    _require_onnx()
-    raise MXNetError("ONNX import backend not implemented for the TPU "
-                     "build yet; use SymbolBlock.imports for native "
-                     "checkpoints")
+    """Parse an ONNX file into (sym, arg_params, aux_params) (reference
+    onnx2mx.import_model)."""
+    from ..symbol.symbol import Variable
+    from ..ndarray.ndarray import NDArray
+
+    with open(model_file, "rb") as f:
+        model = P.parse_message(f.read())
+    if 7 not in model:
+        raise MXNetError(f"{model_file!r} is not an ONNX ModelProto")
+    g = P.parse_message(model[7][0][1])
+
+    inits: Dict[str, onp.ndarray] = {}
+    for wire, t in g.get(5, []):
+        name, arr = _parse_tensor(t)
+        inits[name] = arr
+
+    sym_of: Dict[str, Any] = {}
+    const_of: Dict[str, onp.ndarray] = dict(inits)
+
+    for wire, vi in g.get(11, []):
+        f = P.parse_message(vi)
+        nm = _get_str(f, 1)
+        if nm not in inits:
+            sym_of[nm] = Variable(nm)
+
+    def sym_in(nm):
+        if nm not in sym_of:
+            sym_of[nm] = Variable(nm)
+        return sym_of[nm]
+
+    last_out = None
+    for wire, nd_bytes in g.get(1, []):
+        f = P.parse_message(nd_bytes)
+        ins = [v.decode() for w, v in f.get(1, [])]
+        outs = [v.decode() for w, v in f.get(2, [])]
+        name = _get_str(f, 3) or outs[0]
+        op = _get_str(f, 4)
+        attrs = _parse_attrs(f.get(5, []))
+        s = _import_node(op, name, ins, outs, attrs, sym_in, const_of)
+        sym_of[outs[0]] = s
+        last_out = outs[0]
+
+    out_names = [_get_str(P.parse_message(vi), 1)
+                 for w, vi in g.get(12, [])]
+    head = sym_of[out_names[0] if out_names and out_names[0] in sym_of
+                  else last_out]
+
+    used = set(head.list_arguments())
+    arg_params, aux_params = {}, {}
+    for nm, arr in inits.items():
+        if nm not in used:
+            continue  # consumed as a constant (e.g. Reshape shape input)
+        dest = aux_params if ("moving_" in nm or "running_" in nm) \
+            else arg_params
+        dest[nm] = NDArray(onp.ascontiguousarray(arr))
+    return head, arg_params, aux_params
+
+
+def _import_node(op, name, ins, outs, attrs, sym_in, consts):
+    from ..symbol.symbol import Symbol
+
+    def S(mx_op, inputs, a=None):
+        return Symbol(mx_op, name, [sym_in(i) for i in inputs], a or {})
+
+    simple = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+              "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Neg": "negative",
+              "Abs": "abs", "Add": "broadcast_add", "Sub": "broadcast_sub",
+              "Mul": "broadcast_mul", "Div": "broadcast_div",
+              "MatMul": "dot", "Flatten": "Flatten",
+              "Identity": "identity", "Softplus": "softrelu"}
+    if op in simple:
+        return S(simple[op], ins)
+    if op == "Gemm":
+        if attrs.get("transB", 0) != 1 or attrs.get("alpha", 1.0) != 1.0:
+            raise MXNetError("ONNX import: general Gemm unsupported; "
+                             "expected transB=1 alpha=1")
+        return S("FullyConnected", ins,
+                 {"no_bias": len(ins) < 3, "flatten": False})
+    if op == "Conv":
+        k = len(attrs["kernel_shape"])
+        return S("Convolution", ins, {
+            "kernel": tuple(attrs["kernel_shape"]),
+            "stride": tuple(attrs.get("strides", (1,) * k)),
+            "dilate": tuple(attrs.get("dilations", (1,) * k)),
+            "pad": _onnx_pads(attrs, k),
+            "num_group": int(attrs.get("group", 1)),
+            "no_bias": len(ins) < 3})
+    if op == "BatchNormalization":
+        return S("BatchNorm", ins, {
+            "eps": float(attrs.get("epsilon", 1e-5)),
+            "momentum": float(attrs.get("momentum", 0.9)),
+            "use_global_stats": True})
+    if op in ("MaxPool", "AveragePool"):
+        k = len(attrs["kernel_shape"])
+        a = {"kernel": tuple(attrs["kernel_shape"]),
+             "stride": tuple(attrs.get("strides", (1,) * k)),
+             "pad": _onnx_pads(attrs, k),
+             "pool_type": "max" if op == "MaxPool" else "avg"}
+        if op == "AveragePool":
+            a["count_include_pad"] = bool(
+                attrs.get("count_include_pad", 1))
+        return S("Pooling", ins, a)
+    if op in ("GlobalMaxPool", "GlobalAveragePool"):
+        return S("Pooling", ins, {
+            "pool_type": "max" if op == "GlobalMaxPool" else "avg",
+            "global_pool": True, "kernel": (1, 1)})
+    if op in ("Softmax", "LogSoftmax"):
+        return S("softmax" if op == "Softmax" else "log_softmax", ins,
+                 {"axis": int(attrs.get("axis", -1))})
+    if op == "Reshape":
+        shape = consts.get(ins[1])
+        if shape is None:
+            raise MXNetError("ONNX import: dynamic Reshape unsupported")
+        return S("reshape", ins[:1],
+                 {"shape": tuple(int(v) for v in shape)})
+    if op == "Transpose":
+        a = {}
+        if "perm" in attrs:
+            a["axes"] = tuple(attrs["perm"])
+        return S("transpose", ins, a)
+    if op == "Concat":
+        return S("concat", ins, {"dim": int(attrs.get("axis", 1))})
+    if op == "Dropout":
+        return S("identity", ins[:1])
+    raise MXNetError(f"ONNX import: unsupported op {op!r} (node {name!r})")
+
+
+def get_model_metadata(model_file: str):
+    """Reference onnx2mx.get_model_metadata: input/output names + shapes."""
+    with open(model_file, "rb") as f:
+        model = P.parse_message(f.read())
+    g = P.parse_message(model[7][0][1])
+
+    def vis(num):
+        out = []
+        for w, vi in g.get(num, []):
+            f = P.parse_message(vi)
+            nm = _get_str(f, 1)
+            shape = ()
+            if 2 in f:
+                ty = P.parse_message(f[2][0][1])
+                if 1 in ty:
+                    tt = P.parse_message(ty[1][0][1])
+                    if 2 in tt:
+                        sh = P.parse_message(tt[2][0][1])
+                        dims = []
+                        for w2, d in sh.get(1, []):
+                            df = P.parse_message(d)
+                            dims.append(_get_int(df, 1, 0))
+                        shape = tuple(dims)
+            out.append((nm, shape))
+        return out
+
+    return {"input_tensor_data": vis(11), "output_tensor_data": vis(12)}

@@ -1,0 +1,161 @@
+"""Minimal ONNX protobuf wire-format writer/reader (no deps).
+
+The environment ships no ``onnx``/``protobuf`` package, so serialization is
+implemented directly against the protobuf wire format (varint + tagged
+fields) using the stable field numbers of ``onnx.proto3``. The subset
+covers what export/import needs: ModelProto, GraphProto, NodeProto,
+AttributeProto, TensorProto, ValueInfoProto, TypeProto, TensorShapeProto,
+OperatorSetIdProto. Files written here are valid ONNX models loadable by
+the official ``onnx`` package / onnxruntime (field numbers and wire types
+follow the spec verbatim).
+
+Reference analog: python/mxnet/contrib/onnx/mx2onnx/_export_onnx.py builds
+the same messages through the onnx python API.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+__all__ = ["MessageWriter", "parse_message", "TensorDataType",
+           "AttrType", "ONNX_IR_VERSION", "ONNX_OPSET"]
+
+ONNX_IR_VERSION = 8
+ONNX_OPSET = 13
+
+
+class TensorDataType:
+    FLOAT = 1
+    UINT8 = 2
+    INT8 = 3
+    INT32 = 6
+    INT64 = 7
+    BOOL = 9
+    FLOAT16 = 10
+    DOUBLE = 11
+    BFLOAT16 = 16
+
+
+class AttrType:
+    FLOAT = 1
+    INT = 2
+    STRING = 3
+    TENSOR = 4
+    FLOATS = 6
+    INTS = 7
+    STRINGS = 8
+
+
+def _varint(value: int) -> bytes:
+    if value < 0:
+        value &= (1 << 64) - 1  # two's complement, 64-bit
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class MessageWriter:
+    """Builds one protobuf message; nested messages via sub-writers."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    # wire type 0
+    def write_int(self, field: int, value: int):
+        self._buf += _varint(field << 3 | 0)
+        self._buf += _varint(int(value))
+
+    # wire type 5 (float fields like AttributeProto.f)
+    def write_float(self, field: int, value: float):
+        self._buf += _varint(field << 3 | 5)
+        self._buf += struct.pack("<f", float(value))
+
+    # wire type 2
+    def write_bytes(self, field: int, data: bytes):
+        self._buf += _varint(field << 3 | 2)
+        self._buf += _varint(len(data))
+        self._buf += data
+
+    def write_string(self, field: int, s: str):
+        self.write_bytes(field, s.encode("utf-8"))
+
+    def write_message(self, field: int, msg: "MessageWriter"):
+        self.write_bytes(field, bytes(msg._buf))
+
+    def write_packed_ints(self, field: int, values):
+        payload = b"".join(_varint(int(v)) for v in values)
+        self.write_bytes(field, payload)
+
+    def write_packed_floats(self, field: int, values):
+        self.write_bytes(field, struct.pack(f"<{len(values)}f",
+                                            *[float(v) for v in values]))
+
+    def tobytes(self) -> bytes:
+        return bytes(self._buf)
+
+
+# ---------------------------------------------------------------------------
+# Generic reader
+# ---------------------------------------------------------------------------
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+
+
+def parse_message(data: bytes) -> Dict[int, List[Tuple[int, Any]]]:
+    """Parse one message into {field_number: [(wire_type, value), ...]}.
+    wire 0 -> int, wire 2 -> bytes (caller decides: submessage / string /
+    packed), wire 5 -> raw 4 bytes, wire 1 -> raw 8 bytes."""
+    fields: Dict[int, List[Tuple[int, Any]]] = {}
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(data, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(data, pos)
+            val = data[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = data[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = data[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, val))
+    return fields
+
+
+def unpack_ints(blob_or_entries) -> List[int]:
+    """Decode a packed-varint payload or repeated unpacked entries."""
+    out: List[int] = []
+    for wire, val in blob_or_entries:
+        if wire == 0:
+            out.append(val)
+        else:
+            pos = 0
+            while pos < len(val):
+                v, pos = _read_varint(val, pos)
+                out.append(v)
+    return out
+
+
+def signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v

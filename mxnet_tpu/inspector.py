@@ -139,21 +139,40 @@ def _nan_guard_wrapper(name, fn):
     return wrapped
 
 
+def _check_concrete_outputs(name, outs):
+    import jax
+    for i, d in enumerate(outs):
+        if isinstance(d, jax.core.Tracer):
+            continue
+        if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(d))):
+                raise MXNetError(
+                    f"MXNET_INSPECT_NAN: op {name!r} produced a "
+                    f"non-finite value in output {i}")
+
+
 def install_nan_guard():
     """Check every imperative op's outputs for NaN/Inf, raising with the op
     name (reference check_value NaNChecker wired through the invoke funnel;
-    enabled at import when MXNET_INSPECT_NAN=1). Synchronizes per op —
-    debugging tool, not a production mode."""
+    enabled at import when MXNET_INSPECT_NAN=1). Covers both plain eager
+    ops (invoke wrapper) and ops under autograd.record (tape hook on the
+    concrete vjp primals — inside record the kernel itself only sees
+    Tracers). Synchronizes per op — debugging tool, not a production
+    mode."""
     global _guard_installed
     if not _guard_installed:
+        from . import _tape
         _registry.add_invoke_wrapper(_nan_guard_wrapper)
+        _tape.set_output_check(_check_concrete_outputs)
         _guard_installed = True
 
 
 def remove_nan_guard():
     global _guard_installed
     if _guard_installed:
+        from . import _tape
         _registry.remove_invoke_wrapper(_nan_guard_wrapper)
+        _tape.set_output_check(None)
         _guard_installed = False
 
 

@@ -106,10 +106,12 @@ class KVStoreTPU(KVStoreBase):
 
     def pushpull(self, key, value, out=None, priority=0):
         values = _as_list(value)
+        outs_alias = out is None or out is value or (
+            len(_as_list(out)) == len(values)
+            and all(o is v for o, v in zip(_as_list(out), values)))
         if (len(values) == 1 and self._updater is None
                 and self._compression is None and self.num_workers == 1
-                and (out is None or out is value
-                     or _as_list(out) == values)):
+                and outs_alias):
             # single replica, no store-side transform: the reduce is the
             # identity. Skip it WITHOUT touching v._data so a lazy
             # row_sparse gradient's dense mirror is never materialized

@@ -39,8 +39,9 @@ def _eval_node(sym, feeds: Dict[str, NDArray], cache: Dict[int, NDArray]):
         return val
     ins = [_eval_node(i, feeds, cache) for i in sym._inputs]
     nd = _nd_namespace()
-    attrs = {k: v for k, v in sym._attrs.items()
-             if k not in ("shape", "dtype") and v is not None}
+    # None-valued attrs are "unset"; shape/dtype are real op attrs here
+    # (reshape/Cast) — Variable nodes never reach this branch
+    attrs = {k: v for k, v in sym._attrs.items() if v is not None}
     opname = sym._op
     if opname.endswith("_scalar"):
         base = opname[:-len("_scalar")]

@@ -121,13 +121,20 @@ def test_tensor_inspector():
 
 
 def test_nan_guard_names_offending_op(tmp_path):
-    from mxnet_tpu import inspector
+    from mxnet_tpu import inspector, autograd
     inspector.install_nan_guard()
     try:
         with pytest.raises(MXNetError, match="log"):
             mx.nd.log(mx.nd.array([-1.0])).wait_to_read()
         # clean ops pass through
         mx.nd.sqrt(mx.nd.array([4.0])).wait_to_read()
+        # under autograd.record the kernel runs inside jax.vjp tracing;
+        # the guard must still fire on the concrete primal outputs
+        a = mx.nd.array([0.5])
+        a.attach_grad()
+        with pytest.raises(MXNetError, match="log"):
+            with autograd.record():
+                mx.nd.log(a - 1.0)
     finally:
         inspector.remove_nan_guard()
     # dump_to_file round trip
