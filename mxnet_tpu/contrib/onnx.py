@@ -147,9 +147,10 @@ def _fc(name, attrs, ins, out, extra):
     nodes = []
     data = ins[0]
     if attrs.get("flatten", True):
-        nodes.append(_node("Flatten", [data], [f"{name}_flat"],
+        flat = extra["unique"](f"{name}_flat")
+        nodes.append(_node("Flatten", [data], [flat],
                            f"{name}_flatten", {"axis": 1}))
-        data = f"{name}_flat"
+        data = flat
     gemm_in = [data, ins[1]] + (ins[2:] if len(ins) > 2 else [])
     nodes.append(_node("Gemm", gemm_in, [out], name,
                        {"alpha": 1.0, "beta": 1.0, "transB": 1}))
@@ -241,7 +242,7 @@ def _pool(name, attrs, ins, out, extra):
 @_mx2onnx("Reshape", "reshape")
 def _reshape(name, attrs, ins, out, extra):
     shape = _tup(attrs, "shape")
-    sname = f"{name}_shape"
+    sname = extra["unique"](f"{name}_shape")
     extra["initializers"].append(
         _tensor(sname, onp.asarray(shape, "int64")))
     return [_node("Reshape", [ins[0], sname], [out], name)]
@@ -272,7 +273,7 @@ def _dropout(name, attrs, ins, out, extra):
 def _scalar_arith(name, attrs, ins, out, extra):
     op = {"add": "Add", "sub": "Sub", "mul": "Mul",
           "div": "Div"}[extra["mx_op"].split("_")[0]]
-    cname = f"{name}_const"
+    cname = extra["unique"](f"{name}_const")
     extra["initializers"].append(
         _tensor(cname, onp.asarray(attrs["scalar"], "float32")))
     return [_node(op, [ins[0], cname], [out], name)]
@@ -297,11 +298,12 @@ def export_model(sym, params, in_shapes=None, in_types=None,
     params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
 
     graph = P.MessageWriter()
-    extra = {"initializers": []}
+    extra: Dict[str, Any] = {"initializers": []}
     emitted: Dict[int, str] = {}
     used_names: set = set()
     input_vis = []
     in_shapes = list(in_shapes or [])
+    in_types = list(in_types or [])
     var_idx = [0]
 
     def unique(nm: str) -> str:
@@ -313,6 +315,8 @@ def export_model(sym, params, in_shapes=None, in_types=None,
             k += 1
         used_names.add(nm)
         return nm
+
+    extra["unique"] = unique  # builders reserve helper value names too
 
     def visit(s) -> str:
         if id(s) in emitted:
@@ -327,8 +331,12 @@ def export_model(sym, params, in_shapes=None, in_types=None,
                 shape = s._attrs.get("shape")
                 if shape is None and var_idx[0] < len(in_shapes):
                     shape = in_shapes[var_idx[0]]
+                elem = P.TensorDataType.FLOAT
+                if var_idx[0] < len(in_types) and in_types[var_idx[0]]:
+                    elem = _NP2ONNX.get(
+                        str(onp.dtype(in_types[var_idx[0]])), elem)
                 var_idx[0] += 1
-                input_vis.append(_value_info(nm, shape))
+                input_vis.append(_value_info(nm, shape, elem))
             return nm
         ins = [visit(i) for i in s._inputs]
         builder = _MX2ONNX.get(s._op)
@@ -528,9 +536,11 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
     if op in simple:
         return S(simple[op], ins)
     if op == "Gemm":
-        if attrs.get("transB", 0) != 1 or attrs.get("alpha", 1.0) != 1.0:
+        beta = attrs.get("beta", 1.0)
+        if attrs.get("transB", 0) != 1 or attrs.get("alpha", 1.0) != 1.0 \
+                or not (beta == 1.0 or (beta == 0.0 and len(ins) < 3)):
             raise MXNetError("ONNX import: general Gemm unsupported; "
-                             "expected transB=1 alpha=1")
+                             "expected transB=1 alpha=1 beta=1")
         return S("FullyConnected", ins,
                  {"no_bias": len(ins) < 3, "flatten": False})
     if op == "Conv":
@@ -554,8 +564,9 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
              "pad": _onnx_pads(attrs, k),
              "pool_type": "max" if op == "MaxPool" else "avg"}
         if op == "AveragePool":
+            # ONNX spec default EXCLUDES padding from the average
             a["count_include_pad"] = bool(
-                attrs.get("count_include_pad", 1))
+                attrs.get("count_include_pad", 0))
         return S("Pooling", ins, a)
     if op in ("GlobalMaxPool", "GlobalAveragePool"):
         return S("Pooling", ins, {

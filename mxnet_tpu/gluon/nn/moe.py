@@ -1,0 +1,56 @@
+"""Mixture-of-Experts Gluon layer.
+
+No reference analog (the reference has no MoE — SURVEY §2.3 lists expert
+parallelism as absent); TPU-native extension backed by ``ops/moe.py``
+(GShard/Switch-style capacity-bounded router + batched expert einsums, with
+an expert-parallel all-to-all path for mesh execution).
+"""
+from __future__ import annotations
+
+from ...ndarray.ndarray import NDArray
+from ...ops.registry import invoke_raw
+from ...ops import moe as moe_ops
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["MoE"]
+
+
+class MoE(HybridBlock):
+    """Sparse expert FFN: ``out, aux = moe(x)``.
+
+    x (..., units) is flattened to tokens; each token routes to ``top_k`` of
+    ``num_experts`` expert FFNs (units -> hidden -> units). ``aux`` is the
+    load-balance loss (≈1 when balanced) to add to the training objective.
+    For expert-parallel execution shard the expert dimension of
+    ``w1/w2`` over an 'ep' mesh axis and call ``ops.moe.moe_ffn`` with
+    ``axis_name`` inside shard_map (see __graft_entry__ dryrun)."""
+
+    def __init__(self, units, hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._e, self._k = num_experts, top_k
+        self._cf = capacity_factor
+        self.gate = Parameter("gate", shape=(units, num_experts),
+                              dtype=dtype)
+        self.w1 = Parameter("w1", shape=(num_experts, units, hidden),
+                            dtype=dtype)
+        self.w2 = Parameter("w2", shape=(num_experts, hidden, units),
+                            dtype=dtype)
+
+    def forward(self, x):
+        units = self.w1.shape[1]
+        shape = x.shape
+
+        def fn(xd, gw, w1, w2):
+            tokens = xd.reshape(-1, units)
+            out, aux = moe_ops.moe_ffn(tokens, gw, w1, w2, top_k=self._k,
+                                       capacity_factor=self._cf)
+            return out.reshape(shape), aux
+
+        out, aux = invoke_raw(
+            "moe_ffn", fn,
+            [x if isinstance(x, NDArray) else NDArray(x),
+             self.gate.data(), self.w1.data(), self.w2.data()],
+            n_outputs=2)
+        return out, aux

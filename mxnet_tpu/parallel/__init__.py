@@ -9,6 +9,7 @@ ICI/DCN collectives.
 from .mesh import (DeviceMesh, make_mesh, current_mesh, data_parallel_mesh,
                    shard_batch, replicate, shard_params)
 from .compression import GradientCompression
-from . import mesh, compression, dist, collectives
+from . import mesh, compression, dist, collectives, pipeline
 from .collectives import (allreduce, allgather, reduce_scatter,
                           broadcast_axis, ppermute)
+from .pipeline import pipeline_apply, run_pipeline

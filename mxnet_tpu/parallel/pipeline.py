@@ -1,0 +1,96 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a 'pp' mesh
+axis.
+
+No reference analog (SURVEY §2.3: pipeline parallelism absent upstream —
+the reference only had manual per-ctx layer placement with cross-device
+copies, model_parallel_lstm.md). TPU-native design: each device along the
+``pp`` axis owns ONE stage's weights; microbatches stream through the ring
+with ``lax.ppermute`` hops, so stage s computes microbatch m at tick
+t = s + m — the classic GPipe fill/drain schedule, expressed as a
+``lax.scan`` inside ``shard_map`` (differentiable end-to-end: reverse-mode
+through scan + ppermute gives the 1F1B-equivalent backward automatically).
+
+Uniform activation shape across stages is required (the transformer/MLP
+case); a stage is any ``fn(stage_params, x) -> y`` with y.shape == x.shape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "run_pipeline"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
+    """Run inside shard_map over ``axis_name``. ``stage_params`` are THIS
+    device's stage weights; ``microbatches`` (M, mb, ...) the full
+    replicated stream. Returns (M, mb, ...) outputs, replicated (last
+    stage's results psum-broadcast)."""
+    pp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m_count = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry_out, t):
+        carry, outputs = carry_out
+        # stage 0 ingests microbatch t (while it exists); later stages eat
+        # the ring carry from their predecessor
+        inp = jnp.where(idx == 0,
+                        microbatches[jnp.clip(t, 0, m_count - 1)], carry)
+        out = stage_fn(stage_params, inp)
+        # the last stage emits microbatch j = t - (pp-1) once the pipe fills
+        j = t - (pp - 1)
+        outputs = jnp.where((idx == pp - 1) & (j >= 0),
+                            outputs.at[jnp.clip(j, 0, m_count - 1)].set(out),
+                            outputs)
+        carry = lax.ppermute(out, axis_name, perm)
+        return (carry, outputs), None
+
+    def _varying(a):
+        # the ring carry differs per device; mark the initial zeros as
+        # pp-varying so scan's carry types line up (JAX VMA tracking)
+        try:
+            return lax.pcast(a, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return lax.pvary(a, (axis_name,))
+
+    init = (_varying(jnp.zeros(mb_shape, microbatches.dtype)),
+            _varying(jnp.zeros((m_count,) + mb_shape, microbatches.dtype)))
+    (carry, outputs), _ = lax.scan(tick, init,
+                                   jnp.arange(m_count + pp - 1))
+    # broadcast the last stage's buffer to every device so callers can use
+    # replicated out_specs
+    return lax.psum(jnp.where(idx == pp - 1, outputs,
+                              jnp.zeros_like(outputs)), axis_name)
+
+
+def run_pipeline(stage_fn, stacked_params, x, num_microbatches, mesh,
+                 axis_name="pp"):
+    """Convenience wrapper: shard ``stacked_params`` (leading dim = number
+    of stages) over ``axis_name`` of ``mesh``, split batch ``x`` into
+    ``num_microbatches``, run the pipeline, return (B, ...) outputs."""
+    from jax.sharding import PartitionSpec as P
+    pp = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise MXNetError(
+            f"batch {b} not divisible into {num_microbatches} microbatches")
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != pp:
+            raise MXNetError(
+                f"stacked_params leading dim {leaf.shape[0]} != pipeline "
+                f"size {pp} (one stage per '{axis_name}' device)")
+    micro = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+    def shard_fn(params_local, micro_all):
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        return pipeline_apply(stage_fn, params_local, micro_all, axis_name)
+
+    out = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis_name), P()), out_specs=P())(stacked_params, micro)
+    return out.reshape(b, *out.shape[2:])

@@ -142,7 +142,6 @@ def test_wire_format_is_valid_protobuf(tmp_path):
 
 def test_unsupported_op_raises_with_name(tmp_path):
     x = sym.Variable("x")
-    weird = sym.gamma(x, name="g1") if hasattr(mx.nd, "gamma") else None
     s = mx.symbol.Symbol("arctanh", "odd1", [x], {})
     with pytest.raises(MXNetError, match="arctanh"):
         mxonnx.export_model(s, {}, onnx_file_path=str(tmp_path / "x.onnx"))
