@@ -18,6 +18,7 @@ from typing import Any, Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 from jax import lax
 
 from .. import _tape, autograd
@@ -556,3 +557,290 @@ def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
     return box_nms(raw, overlap_thresh=nms_threshold, valid_thresh=threshold,
                    topk=nms_topk, coord_start=2, score_index=1, id_index=0,
                    force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# misc contrib ops (reference src/operator/contrib/: quadratic_op.cc,
+# gradient_multiplier_op.cc, allclose_op.cc, index_copy.cc, index_array.cc,
+# boolean_mask.cc, hawkes_ll.cc, dgl_graph.cc, krprod.cc)
+# ---------------------------------------------------------------------------
+
+__all__ += ["quadratic", "gradientmultiplier", "allclose", "index_copy",
+            "index_array", "boolean_mask", "arange_like", "getnnz",
+            "edge_id", "dgl_adjacency", "dgl_csr_neighbor_uniform_sample",
+            "hawkes_ll"]
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """f(x) = a x^2 + b x + c (reference contrib/quadratic_op.cc — the
+    tutorial op; kept for example parity)."""
+    return invoke_raw("quadratic",
+                      lambda x: a * x * x + b * x + c, _wrap([data]))
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by ``scalar`` on backward
+    (reference contrib/gradient_multiplier_op.cc — gradient-reversal trick
+    when scalar < 0)."""
+    import jax
+
+    @jax.custom_vjp
+    def _gm(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (ct * scalar,)
+
+    _gm.defvjp(fwd, bwd)
+    return invoke_raw("gradientmultiplier", _gm, _wrap([data]))
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """1.0 if all elements match within tolerance, else 0.0 (reference
+    contrib/allclose_op.cc returns a scalar 0/1 tensor)."""
+    return invoke_raw(
+        "allclose",
+        lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan).astype(jnp.float32),
+        _wrap([a, b]))
+
+
+def index_copy(old, index_vector, new_tensor):
+    """Copy rows of ``new_tensor`` into ``old`` at positions
+    ``index_vector`` (reference contrib/index_copy.cc; functional — returns
+    the updated tensor)."""
+    return invoke_raw(
+        "index_copy",
+        lambda o, i, n: o.at[i.astype(jnp.int32)].set(n),
+        _wrap([old, index_vector, new_tensor]))
+
+
+def index_array(data, axes=None):
+    """(d1..dn) -> (d1..dn, m) index mesh (reference
+    contrib/index_array.cc; see its describe block for semantics)."""
+    axes_t = tuple(axes) if axes is not None else None
+
+    def fn(x):
+        nd_ = x.ndim
+        sel = axes_t if axes_t is not None else tuple(range(nd_))
+        comps = []
+        for ax in sel:
+            ax = ax % nd_
+            shape = [1] * nd_
+            shape[ax] = x.shape[ax]
+            comp = jnp.arange(x.shape[ax], dtype=jnp.int64).reshape(shape)
+            comps.append(jnp.broadcast_to(comp, x.shape))
+        return jnp.stack(comps, axis=-1)
+
+    return invoke_raw("index_array", fn, _wrap([data]))
+
+
+def boolean_mask(data, index, axis=0):
+    """Select slices where ``index`` is nonzero (reference
+    contrib/boolean_mask.cc). Output shape is data-dependent, so this is an
+    EAGER-only op (the reference computes it with a host-synchronized
+    prefix-sum too); inside jit use ``jnp.where``-style masking."""
+    import jax
+    d, i = _datas(_wrap([data, index]))
+    if isinstance(d, jax.core.Tracer) or isinstance(i, jax.core.Tracer):
+        raise MXNetError("boolean_mask has a data-dependent output shape "
+                         "and cannot run inside jit; mask with where()")
+    keep = onp.nonzero(onp.asarray(i))[0]
+    from .ndarray import NDArray
+    return NDArray(jnp.take(d, jnp.asarray(keep, jnp.int32), axis=axis))
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """arange shaped like ``data`` (reference contrib arange_like)."""
+    def fn(x):
+        if axis is None:
+            n = x.size
+            out = start + step * jnp.floor(
+                jnp.arange(n * repeat) / repeat)[:n * repeat]
+            return out[:n].reshape(x.shape).astype(x.dtype)
+        n = x.shape[axis % x.ndim]
+        return (start + step * jnp.floor(
+            jnp.arange(n) / repeat)).astype(x.dtype)
+
+    return invoke_raw("arange_like", fn, _wrap([data]))
+
+
+# ---- graph (dgl) ops: CSR-backed, host-side like the reference's CPU
+# sampling kernels (src/operator/contrib/dgl_graph.cc) ----
+
+def getnnz(data, axis=None):
+    """Stored-value count of a CSR matrix (reference _contrib_getnnz)."""
+    from .sparse import CSRNDArray
+    from .ndarray import NDArray
+    if not isinstance(data, CSRNDArray):
+        raise MXNetError("getnnz expects a CSRNDArray")
+    if axis is None:
+        return NDArray(jnp.asarray(
+            int(data._aux["values"]._data.shape[0]), jnp.int32))
+    if axis in (1, -1):
+        indptr = data._aux["indptr"]._data
+        return NDArray((indptr[1:] - indptr[:-1]).astype(jnp.int32))
+    raise MXNetError("getnnz: axis must be None or 1")
+
+
+def edge_id(data, u, v):
+    """For each (u[i], v[i]) return the CSR stored value (edge id) or -1
+    when no such edge exists (reference _contrib_edge_id)."""
+    from .sparse import CSRNDArray
+    from .ndarray import NDArray
+    if not isinstance(data, CSRNDArray):
+        raise MXNetError("edge_id expects a CSRNDArray")
+    uu = onp.asarray((u._data if hasattr(u, "_data") else u)).astype("int64")
+    vv = onp.asarray((v._data if hasattr(v, "_data") else v)).astype("int64")
+    indptr = onp.asarray(data._aux["indptr"]._data)
+    indices = onp.asarray(data._aux["indices"]._data)
+    values = onp.asarray(data._aux["values"]._data)
+    out = onp.full(uu.shape, -1.0, "float32")
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = indptr[a], indptr[a + 1]
+        cols = indices[lo:hi]
+        hit = onp.nonzero(cols == b)[0]
+        if hit.size:
+            out[i] = values[lo + hit[0]]
+    return NDArray(jnp.asarray(out))
+
+
+def dgl_adjacency(data):
+    """CSR graph -> adjacency CSR whose stored values are all 1
+    (reference _contrib_dgl_adjacency: float32 data carrying ones)."""
+    from .sparse import CSRNDArray, _make_csr
+    if not isinstance(data, CSRNDArray):
+        raise MXNetError("dgl_adjacency expects a CSRNDArray")
+    ones = jnp.ones_like(data._aux["values"]._data, jnp.float32)
+    # rebuild the dense mirror from the STRUCTURE (indptr/indices), not the
+    # stored values: an explicitly-stored 0 edge value is still an edge
+    indptr = onp.asarray(data._aux["indptr"]._data)
+    indices = onp.asarray(data._aux["indices"]._data)
+    dense = onp.zeros(data._data.shape, "float32")
+    for u in range(indptr.shape[0] - 1):
+        dense[u, indices[indptr[u]:indptr[u + 1]]] = 1.0
+    return _make_csr(jnp.asarray(dense), ones,
+                     data._aux["indices"]._data,
+                     data._aux["indptr"]._data)
+
+
+def dgl_csr_neighbor_uniform_sample(csr, seeds, num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100, seed=None):
+    """Uniform neighbor sampling from a CSR graph (reference
+    _contrib_dgl_csr_neighbor_uniform_sample). Host-side like the
+    reference's CPU kernel. Returns (sampled_vertex_ids (padded with -1 to
+    max_num_vertices, last slot = count), sub-CSR with the sampled edges)."""
+    from .sparse import CSRNDArray, _make_csr
+    from .ndarray import NDArray
+    if not isinstance(csr, CSRNDArray):
+        raise MXNetError("neighbor sampling expects a CSRNDArray")
+    rng = onp.random.RandomState(seed)
+    indptr = onp.asarray(csr._aux["indptr"]._data)
+    indices = onp.asarray(csr._aux["indices"]._data)
+    values = onp.asarray(csr._aux["values"]._data)
+    n = indptr.shape[0] - 1
+    seed_ids = onp.asarray(seeds._data if hasattr(seeds, "_data")
+                           else seeds).astype("int64").reshape(-1)
+    # the last ids slot carries the count, so at most max-1 vertices fit —
+    # bound the seed set itself, not just hop-added vertices
+    visited = list(dict.fromkeys(seed_ids.tolist()))[:max_num_vertices - 1]
+    frontier = list(visited)
+    picked = {}  # (u, pos) -> True for chosen edges
+    for _ in range(num_hops):
+        nxt = []
+        for u in frontier:
+            lo, hi = int(indptr[u]), int(indptr[u + 1])
+            deg = hi - lo
+            if deg == 0:
+                continue
+            k = min(num_neighbor, deg)
+            for pos in rng.choice(deg, size=k, replace=False):
+                picked[(u, lo + int(pos))] = True
+                vtx = int(indices[lo + int(pos)])
+                if vtx not in visited and \
+                        len(visited) < max_num_vertices - 1:
+                    visited.append(vtx)
+                    nxt.append(vtx)
+        frontier = nxt
+    # sub-CSR over the ORIGINAL vertex numbering, keeping sampled edges
+    sub_indptr = [0]
+    sub_indices, sub_values = [], []
+    for u in range(n):
+        lo, hi = int(indptr[u]), int(indptr[u + 1])
+        for e in range(lo, hi):
+            if (u, e) in picked:
+                sub_indices.append(int(indices[e]))
+                sub_values.append(float(values[e]))
+        sub_indptr.append(len(sub_indices))
+    ids = onp.full((max_num_vertices,), -1, "int64")
+    ids[:len(visited)] = onp.asarray(visited, "int64")
+    ids[-1] = len(visited)  # reference convention: count rides the tail
+    dense = onp.zeros(csr._data.shape, "float32")
+    for u in range(n):
+        for j in range(sub_indptr[u], sub_indptr[u + 1]):
+            dense[u, sub_indices[j]] = sub_values[j]
+    sub = _make_csr(jnp.asarray(dense),
+                    jnp.asarray(onp.asarray(sub_values, "float32")),
+                    jnp.asarray(onp.asarray(sub_indices, "int32")),
+                    jnp.asarray(onp.asarray(sub_indptr, "int32")))
+    return NDArray(jnp.asarray(ids)), sub
+
+
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log likelihood of K independent univariate Hawkes processes with
+    exponential kernels (reference contrib/hawkes_ll.cc — see its describe
+    block for the intensity definition). Inputs: lda (N,K) background
+    rates, alpha (K,) branching ratios, beta (K,) decay rates, state (N,K)
+    prior memory s_k(0), lags/marks (N,T) left-aligned ragged sequences,
+    valid_length (N,), max_time (N,). Returns (log-likelihood (N,),
+    end-state s_k(T) (N,K)). One lax.scan over T — fully differentiable."""
+    from jax import lax as _lax
+
+    def fn(lda_, alpha_, beta_, state_, lags_, marks_, vl_, mt_):
+        n, k = lda_.shape
+        t_steps = lags_.shape[1]
+        marks_i = marks_.astype(jnp.int32)
+
+        def step(carry, inp):
+            s, t_cur, ll, idx, cnt = carry
+            lag, mark = inp                          # (N,), (N,)
+            valid = (idx < vl_).astype(lda_.dtype)   # (N,)
+            t_new = t_cur + lag
+            decay = jnp.exp(-beta_[None, :] * lag[:, None])
+            s_dec = s * decay
+            mark_oh = jax.nn.one_hot(mark, k, dtype=lda_.dtype)
+            lam = lda_ + alpha_[None, :] * beta_[None, :] * s_dec
+            lam_m = jnp.sum(lam * mark_oh, axis=1)
+            ll = ll + valid * jnp.log(jnp.maximum(lam_m, 1e-30))
+            s_new = s_dec + mark_oh * valid[:, None]
+            # only advance on valid points
+            s_out = jnp.where(valid[:, None] > 0, s_new, s)
+            t_out = jnp.where(valid > 0, t_new, t_cur)
+            cnt = cnt + mark_oh * valid[:, None]
+            return (s_out, t_out, ll, idx + 1, cnt), None
+
+        init = (state_, jnp.zeros((n,), lda_.dtype),
+                jnp.zeros((n,), lda_.dtype),
+                jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n, k), lda_.dtype))
+        (s_end, t_end, ll, _, cnt), _ = _lax.scan(
+            step, init, (lags_.T, marks_i.T), length=t_steps)
+        # s at the end of the observation window
+        s_at_T = s_end * jnp.exp(-beta_[None, :]
+                                 * (mt_[:, None] - t_end[:, None]))
+        # compensator over (0, max_time]:
+        #   ∫λ_k = λ_k T + α_k [Σ_i 1{y_i=k}(1 - e^{-β_k(T-t_i)})
+        #                       + s_k(0)(1 - e^{-β_k T})]
+        # and Σ_i e^{-β(T-t_i)} + s_0 e^{-β T} == s_at_T, so the bracket
+        # collapses to count_k + s_k(0) - s_k(T)
+        comp_bg = jnp.sum(lda_, axis=1) * mt_
+        comp_exc = jnp.sum(alpha_[None, :] * (cnt + state_ - s_at_T),
+                           axis=1)
+        return ll - comp_bg - comp_exc, s_at_T
+
+    return invoke_raw("hawkes_ll", fn,
+                      _wrap([lda, alpha, beta, state, lags, marks,
+                             valid_length, max_time]), n_outputs=2)

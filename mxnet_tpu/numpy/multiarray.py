@@ -1314,6 +1314,66 @@ def kaiser(M, beta, dtype=None, ctx=None):
                    ctx=ctx)
 
 
+def bartlett(M, dtype=None, ctx=None):
+    return ndarray(jnp.bartlett(M).astype(_adt(dtype)), ctx=ctx)
+
+
+def trim_zeros(filt, trim="fb"):
+    """Trim leading/trailing zeros (reference _npi_trim_zeros). Host-side
+    (output shape is data-dependent — same as the reference's CPU path)."""
+    arr = onp.trim_zeros(onp.asarray(filt._data if hasattr(filt, "_data")
+                                     else filt), trim)
+    return ndarray(jnp.asarray(arr))
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    """NumPy-parity apply_along_axis: vmap the 1-D function over every
+    other axis (compiled batching instead of the host loop)."""
+    a = arr._data if hasattr(arr, "_data") else jnp.asarray(arr)
+    axis = axis % a.ndim
+    moved = jnp.moveaxis(a, axis, -1)
+    lead_shape = moved.shape[:-1]
+    flat = moved.reshape(-1, moved.shape[-1])
+
+    def f1d(row):
+        out = func1d(ndarray(row), *args, **kwargs)
+        return out._data if hasattr(out, "_data") else jnp.asarray(out)
+
+    out = jax.vmap(f1d)(flat)
+    fo_shape = out.shape[1:]
+    out = out.reshape(lead_shape + fo_shape)
+    # NumPy inserts the func1d output dims AT `axis` (not at the end)
+    nl, nf = len(lead_shape), len(fo_shape)
+    out = jnp.moveaxis(out, tuple(range(nl, nl + nf)),
+                       tuple(range(axis, axis + nf)))
+    return ndarray(out)
+
+
+def polyval(p, x):
+    pd = p._data if hasattr(p, "_data") else jnp.asarray(p)
+    xd = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    return ndarray(jnp.polyval(pd, xd))
+
+
+def diag_indices_from(arr):
+    a = arr._data if hasattr(arr, "_data") else jnp.asarray(arr)
+    return tuple(ndarray(i) for i in jnp.diag_indices_from(a))
+
+
+def tril_indices(n, k=0, m=None):
+    return tuple(ndarray(i) for i in jnp.tril_indices(n, k, m))
+
+
+def fill_diagonal(a, val, wrap=False):
+    """In-place on the mx.np array handle (functional rebind underneath —
+    reference _npi_fill_diagonal writes in place)."""
+    d = a._data
+    a._data = jnp.fill_diagonal(d, jnp.asarray(
+        val._data if hasattr(val, "_data") else val), wrap=wrap,
+        inplace=False)
+    return None
+
+
 def geomspace(start, stop, num=50, endpoint=True, dtype=None, axis=0,
               ctx=None):
     return ndarray(jnp.geomspace(start, stop, num, endpoint=endpoint,

@@ -51,6 +51,7 @@ def moe_gating(x, gate_w, num_experts: int, top_k: int = 2,
     masked = probs
     # per-expert fill counters accumulate across the k rounds
     fill = jnp.zeros((e,), jnp.int32)
+    routed = jnp.zeros((n, e), x.dtype)  # PRE-capacity assignments
     for _ in range(top_k):
         idx = jnp.argmax(masked, axis=-1)                    # (N,)
         onehot = jax.nn.one_hot(idx, e, dtype=x.dtype)       # (N, E)
@@ -67,12 +68,16 @@ def moe_gating(x, gate_w, num_experts: int, top_k: int = 2,
         dispatch = dispatch + d
         combine = combine + d * gate_val[:, None, None]
         fill = fill + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        routed = routed + onehot
         masked = masked * (1.0 - onehot)                     # exclude chosen
 
     # load-balance auxiliary (fraction routed vs mean router prob):
     # balanced routing gives frac=k/E and mean_prob=1/E, so
-    # E * sum(frac * mean_prob) / k == 1 regardless of E or k
-    frac = jnp.mean(dispatch.sum(axis=2), axis=0)            # (E,)
+    # E * sum(frac * mean_prob) / k == 1 regardless of E or k. Fractions
+    # come from the PRE-capacity router assignments (Switch/GShard): if
+    # drops were counted instead, the penalty would plateau exactly when
+    # an expert overflows
+    frac = jnp.mean(routed, axis=0)                          # (E,)
     mean_prob = jnp.mean(probs, axis=0)                      # (E,)
     aux = e * jnp.sum(frac * mean_prob) / max(top_k, 1)
     return dispatch, combine, aux

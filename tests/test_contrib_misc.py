@@ -1,0 +1,233 @@
+"""Misc contrib ops (reference src/operator/contrib/): quadratic,
+gradient multiplier, allclose, index_copy/index_array, boolean_mask,
+arange_like, graph (dgl) CSR ops, hawkes_ll — plus the np gap-fill
+(bartlett/trim_zeros/apply_along_axis/polyval/tril_indices/
+fill_diagonal)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.base import MXNetError
+
+
+def test_quadratic_and_gradientmultiplier():
+    x = nd.array([1.0, 2.0, 3.0])
+    out = nd.contrib.quadratic(x, a=1.0, b=2.0, c=3.0)
+    onp.testing.assert_allclose(out.asnumpy(), [6.0, 11.0, 18.0])
+
+    g = nd.array([1.0, 2.0])
+    g.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(g, scalar=-0.5)  # grad reversal
+        loss = (y * nd.array([3.0, 4.0])).sum()
+    loss.backward()
+    onp.testing.assert_allclose(y.asnumpy(), g.asnumpy())  # identity fwd
+    onp.testing.assert_allclose(g.grad.asnumpy(), [-1.5, -2.0])
+
+
+def test_allclose_op():
+    a = nd.array([1.0, 2.0])
+    assert float(nd.contrib.allclose(a, nd.array([1.0, 2.0 + 1e-7]))
+                 .asnumpy()) == 1.0
+    assert float(nd.contrib.allclose(a, nd.array([1.0, 2.1])).asnumpy()) \
+        == 0.0
+
+
+def test_index_copy_and_index_array():
+    old = nd.zeros((4, 2))
+    out = nd.contrib.index_copy(old, nd.array([1, 3]),
+                                nd.array([[1.0, 1.0], [2.0, 2.0]]))
+    onp.testing.assert_allclose(out.asnumpy(),
+                                [[0, 0], [1, 1], [0, 0], [2, 2]])
+    idx = nd.contrib.index_array(nd.ones((3, 2))).asnumpy()
+    assert idx.shape == (3, 2, 2)
+    onp.testing.assert_array_equal(idx[2, 1], [2, 1])
+    idx2 = nd.contrib.index_array(nd.ones((3, 2, 2)), axes=(1, 0)).asnumpy()
+    onp.testing.assert_array_equal(idx2[1, 0, 1], [0, 1])
+
+
+def test_boolean_mask_and_arange_like():
+    data = nd.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    out = nd.contrib.boolean_mask(data, nd.array([1, 0, 1]))
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 2], [5, 6]])
+    al = nd.contrib.arange_like(nd.ones((2, 3))).asnumpy()
+    onp.testing.assert_allclose(al, [[0, 1, 2], [3, 4, 5]])
+    al2 = nd.contrib.arange_like(nd.ones((2, 3)), start=10, step=2,
+                                 axis=1).asnumpy()
+    onp.testing.assert_allclose(al2, [10, 12, 14])
+
+
+def _toy_graph():
+    # 4 vertices; edges with ids as data
+    dense = onp.array([[0, 1, 0, 2],
+                       [3, 0, 4, 0],
+                       [0, 5, 0, 0],
+                       [6, 0, 0, 0]], "float32")
+    return nd.sparse.csr_matrix(dense)
+
+
+def test_graph_ops():
+    g = _toy_graph()
+    assert int(nd.contrib.getnnz(g).asnumpy()) == 6
+    onp.testing.assert_array_equal(
+        nd.contrib.getnnz(g, axis=1).asnumpy(), [2, 2, 1, 1])
+    eid = nd.contrib.edge_id(g, nd.array([0, 1, 2]),
+                             nd.array([3, 0, 0])).asnumpy()
+    onp.testing.assert_allclose(eid, [2.0, 3.0, -1.0])
+    adj = nd.contrib.dgl_adjacency(g)
+    onp.testing.assert_allclose(adj.asnumpy(),
+                                (onp.asarray(g.asnumpy()) != 0)
+                                .astype("float32"))
+
+    ids, sub = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array([0]), num_hops=1, num_neighbor=1,
+        max_num_vertices=6, seed=0)
+    ids = ids.asnumpy()
+    count = int(ids[-1])
+    assert count >= 2 and int(ids[0]) == 0
+    # sampled edges are a subset of the original graph
+    sd = sub.asnumpy()
+    orig = g.asnumpy()
+    mask = sd != 0
+    onp.testing.assert_allclose(sd[mask], orig[mask])
+    assert mask.sum() == 1  # one neighbor sampled from one seed
+
+
+def _hawkes_ref(lda, alpha, beta, state, lags, marks, vl, mt):
+    """Direct per-sample loop over the closed-form exp-kernel Hawkes
+    log likelihood."""
+    n, k = lda.shape
+    ll = onp.zeros(n)
+    s_T = onp.zeros((n, k))
+    for i in range(n):
+        times = onp.cumsum(lags[i][:vl[i]])
+        ms = marks[i][:vl[i]]
+        acc = 0.0
+        for j in range(vl[i]):
+            t_j = times[j]
+            lam = lda[i].copy()
+            for kk in range(k):
+                mem = state[i, kk] * onp.exp(-beta[kk] * t_j)
+                prior = [t for t, m in zip(times[:j], ms[:j]) if m == kk]
+                mem += sum(onp.exp(-beta[kk] * (t_j - t)) for t in prior)
+                lam[kk] += alpha[kk] * beta[kk] * mem
+            acc += onp.log(lam[ms[j]])
+        comp = 0.0
+        for kk in range(k):
+            pts = [t for t, m in zip(times, ms) if m == kk]
+            comp += lda[i, kk] * mt[i]
+            comp += alpha[kk] * sum(1 - onp.exp(-beta[kk] * (mt[i] - t))
+                                    for t in pts)
+            comp += alpha[kk] * state[i, kk] * \
+                (1 - onp.exp(-beta[kk] * mt[i]))
+            s_T[i, kk] = state[i, kk] * onp.exp(-beta[kk] * mt[i]) + \
+                sum(onp.exp(-beta[kk] * (mt[i] - t)) for t in pts)
+        ll[i] = acc - comp
+    return ll, s_T
+
+
+def test_hawkes_ll_matches_direct_computation():
+    rng = onp.random.RandomState(0)
+    n, k, t = 3, 2, 5
+    lda = rng.uniform(0.5, 1.5, (n, k)).astype("float32")
+    alpha = rng.uniform(0.2, 0.6, (k,)).astype("float32")
+    beta = rng.uniform(0.5, 2.0, (k,)).astype("float32")
+    state = rng.uniform(0, 1, (n, k)).astype("float32")
+    lags = rng.uniform(0.1, 0.5, (n, t)).astype("float32")
+    marks = rng.randint(0, k, (n, t)).astype("int32")
+    vl = onp.array([5, 3, 4], "int32")
+    mt = onp.array([4.0, 3.0, 3.5], "float32")
+
+    ll, s_end = nd.contrib.hawkes_ll(
+        nd.array(lda), nd.array(alpha), nd.array(beta), nd.array(state),
+        nd.array(lags), nd.array(marks), nd.array(vl), nd.array(mt))
+    ref_ll, ref_s = _hawkes_ref(lda, alpha, beta, state, lags, marks, vl, mt)
+    onp.testing.assert_allclose(ll.asnumpy(), ref_ll, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(s_end.asnumpy(), ref_s, rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_hawkes_ll_differentiable():
+    n, k, t = 2, 2, 3
+    lda = nd.array(onp.full((n, k), 1.0, "float32"))
+    lda.attach_grad()
+    args = [nd.array(onp.full((k,), 0.5, "float32")),
+            nd.array(onp.full((k,), 1.0, "float32")),
+            nd.array(onp.zeros((n, k), "float32")),
+            nd.array(onp.full((n, t), 0.3, "float32")),
+            nd.array(onp.zeros((n, t), "int32")),
+            nd.array(onp.full((n,), t, "int32")),
+            nd.array(onp.full((n,), 2.0, "float32"))]
+    with autograd.record():
+        ll, _ = nd.contrib.hawkes_ll(lda, *args)
+        loss = ll.sum()
+    loss.backward()
+    g = lda.grad.asnumpy()
+    assert onp.isfinite(g).all() and (g != 0).any()
+
+
+def test_np_gap_fill_functions():
+    """bartlett/trim_zeros/apply_along_axis/polyval/tril_indices/
+    fill_diagonal/diag_indices_from (reference src/operator/numpy/
+    np_window_op.cc et al.)."""
+    onp.testing.assert_allclose(mx.np.bartlett(5).asnumpy(),
+                                onp.bartlett(5), rtol=1e-6)
+    onp.testing.assert_allclose(
+        mx.np.trim_zeros(mx.np.array([0, 0, 1, 2, 0])).asnumpy(), [1, 2])
+    x = mx.np.array(onp.arange(12.0).reshape(3, 4))
+    onp.testing.assert_allclose(
+        mx.np.apply_along_axis(lambda r: r.sum(), 1, x).asnumpy(),
+        onp.arange(12.0).reshape(3, 4).sum(1))
+    onp.testing.assert_allclose(
+        mx.np.polyval(mx.np.array([1.0, 0.0, -1.0]),
+                      mx.np.array([2.0, 3.0])).asnumpy(), [3.0, 8.0])
+    r, c = mx.np.tril_indices(3, k=-1)
+    onp.testing.assert_array_equal(r.asnumpy(), [1, 2, 2])
+    onp.testing.assert_array_equal(c.asnumpy(), [0, 0, 1])
+    a = mx.np.array(onp.zeros((3, 3), "float32"))
+    mx.np.fill_diagonal(a, 7.0)
+    onp.testing.assert_allclose(onp.diagonal(a.asnumpy()), [7, 7, 7])
+    rr, cc = mx.np.diag_indices_from(a)
+    onp.testing.assert_array_equal(rr.asnumpy(), [0, 1, 2])
+
+
+def test_review_fix_semantics():
+    """apply_along_axis multi-dim placement, arange_like repeat+axis,
+    adjacency with explicit zero edges, seed-bounded sampling."""
+    got = mx.np.apply_along_axis(
+        lambda r: mx.np.array(onp.zeros((4, 5), "float32")), 0,
+        mx.np.array(onp.ones((2, 3), "float32"))).shape
+    want = onp.apply_along_axis(lambda r: onp.zeros((4, 5)), 0,
+                                onp.ones((2, 3))).shape
+    assert got == want
+    onp.testing.assert_allclose(
+        nd.contrib.arange_like(nd.ones((2, 4)), repeat=2, axis=1).asnumpy(),
+        [0, 0, 1, 1])
+    # explicitly-stored zero edge is still an edge in the adjacency
+    g = nd.sparse.csr_matrix((onp.array([0.0, 7.0], "float32"),
+                              onp.array([1, 0], "int32"),
+                              onp.array([0, 1, 2], "int32")), shape=(2, 2))
+    onp.testing.assert_allclose(nd.contrib.dgl_adjacency(g).asnumpy(),
+                                [[0, 1], [1, 0]])
+    # oversized seed set is bounded, count slot intact
+    big = _toy_graph()
+    ids, _ = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        big, nd.array([0, 1, 2, 3]), num_hops=1, num_neighbor=1,
+        max_num_vertices=3, seed=0)
+    ids = ids.asnumpy()
+    assert int(ids[-1]) <= 2 and ids.shape == (3,)
+
+
+def test_moe_aux_counts_pre_drop_routing():
+    """Aux loss must keep penalizing imbalance past capacity saturation
+    (Switch/GShard pre-drop fractions)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.moe import moe_ffn
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(onp.abs(rng.randn(64, 8)).astype("float32"))
+    gate = jnp.zeros((8, 4), "float32").at[:, 0].set(5.0)
+    w1 = jnp.asarray(rng.randn(4, 8, 4).astype("float32"))
+    w2 = jnp.asarray(rng.randn(4, 4, 8).astype("float32"))
+    _, aux = moe_ffn(x, gate, w1, w2, top_k=1, capacity_factor=0.25)
+    assert float(aux) > 3.5  # ~E at full imbalance, undamped by drops
