@@ -74,6 +74,14 @@ def _declare(lib):
     lib.MXTPrefetchNext.argtypes = [H, ctypes.POINTER(ctypes.c_void_p),
                                     ctypes.POINTER(ctypes.c_size_t)]
     lib.MXTPrefetchDestroy.argtypes = [H]
+    lib.MXTBatchifyStack.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_int]
+    lib.MXTBatchifyImageNormalize.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int]
 
 
 def get_lib():
@@ -87,14 +95,17 @@ def get_lib():
         if get_env("MXNET_TPU_NO_NATIVE", "0") == "1":
             _load_failed = True
             return None
-        if not os.path.exists(_LIB_PATH) and not _build_lib():
+        # make is a fast no-op when the .so is current, and rebuilds it
+        # when headers/sources changed (stale-symbol protection); a failed
+        # build still falls through to an existing library
+        if not _build_lib() and not os.path.exists(_LIB_PATH):
             _load_failed = True
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
             _load_failed = True
     return _lib
 

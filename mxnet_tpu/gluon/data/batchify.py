@@ -1,22 +1,65 @@
-"""Batchify functions (reference: src/io/batchify.cc + gluon batchify)."""
+"""Batchify functions (reference: src/io/batchify.cc + gluon batchify).
+
+The native C++ backend (src/native/batchify.cc) collates uniform samples
+with GIL-free parallel memcpy — the analog of the reference's OMP-parallel
+StackBatchify — and fuses the image normalize+HWC→CHW transpose
+(reference iter_image_recordio_2.cc decode-side augmenters). Python
+fallbacks keep everything working without the library.
+"""
 from __future__ import annotations
+
+import ctypes
+import os
 
 import numpy as onp
 
+from ... import _native
 from ...ndarray.ndarray import NDArray
 
-__all__ = ["Stack", "Pad", "Group"]
+__all__ = ["Stack", "Pad", "Group", "ImageNormalize"]
 
 
 def _to_np(x):
     return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
 
 
+# below this many total bytes numpy's single memcpy loop wins over
+# spawning worker threads (measured ~3.5x for tiny batches)
+_NATIVE_STACK_MIN_BYTES = 1 << 20
+
+
+def _native_stack(arrs):
+    """Parallel native collation of uniform C-contiguous samples; None if
+    unavailable, non-uniform, object-typed, or too small to amortize the
+    thread spawn."""
+    lib = _native.get_lib()
+    if lib is None or len(arrs) < 2:
+        return None
+    first = arrs[0]
+    if first.dtype.hasobject:
+        return None  # raw-pointer memcpy would corrupt refcounts
+    if any(a.shape != first.shape or a.dtype != first.dtype for a in arrs):
+        return None
+    if first.nbytes * len(arrs) < _NATIVE_STACK_MIN_BYTES:
+        return None
+    arrs = [onp.ascontiguousarray(a) for a in arrs]
+    n = len(arrs)
+    out = onp.empty((n,) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+    rc = lib.MXTBatchifyStack(ptrs, n, first.nbytes,
+                              ctypes.c_void_p(out.ctypes.data),
+                              min(n, os.cpu_count() or 1, 16))
+    return out if rc == 0 else None
+
+
 class Stack:
-    """Stack samples along a new batch axis (reference StackBatchify)."""
+    """Stack samples along a new batch axis (reference StackBatchify;
+    native parallel copy when libmxt_native is built)."""
 
     def __call__(self, data):
-        return NDArray(onp.stack([_to_np(d) for d in data]))
+        arrs = [_to_np(d) for d in data]
+        native = _native_stack(arrs)
+        return NDArray(native if native is not None else onp.stack(arrs))
 
 
 class Pad:
@@ -50,3 +93,42 @@ class Group:
     def __call__(self, data):
         return tuple(fn([d[i] for d in data])
                      for i, fn in enumerate(self._fns))
+
+
+class ImageNormalize:
+    """Fused batchify for HWC uint8 images -> normalized NCHW float32
+    batch: out[n,c,h,w] = (img[n,h,w,c]/255 - mean[c]) / std[c]. The
+    native path runs one sample per C++ thread (the reference decodes +
+    normalizes on dmlc worker threads, iter_image_recordio_2.cc); the
+    Python fallback vectorizes with numpy."""
+
+    def __init__(self, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)):
+        self._mean = onp.asarray(mean, "float32")
+        self._std = onp.asarray(std, "float32")
+
+    def __call__(self, data):
+        arrs = [onp.ascontiguousarray(_to_np(d)) for d in data]
+        first = arrs[0]
+        if any(a.ndim != 3 or a.dtype != onp.uint8 for a in arrs):
+            raise ValueError("ImageNormalize expects HWC uint8 samples")
+        h, w, c = first.shape
+        if self._mean.shape[0] != c:
+            raise ValueError(f"mean/std have {self._mean.shape[0]} channels,"
+                             f" images have {c}")
+        n = len(arrs)
+        lib = _native.get_lib()
+        if lib is not None and n > 1 and \
+                all(a.shape == first.shape for a in arrs):
+            out = onp.empty((n, c, h, w), "float32")
+            ptrs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+            rc = lib.MXTBatchifyImageNormalize(
+                ptrs, n, h, w, c,
+                self._mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                min(n, os.cpu_count() or 1, 16))
+            if rc == 0:
+                return NDArray(out)
+        batch = onp.stack(arrs).astype("float32") / 255.0
+        batch = (batch - self._mean) / self._std
+        return NDArray(batch.transpose(0, 3, 1, 2))

@@ -28,9 +28,11 @@ __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 def default_batchify_fn(data):
     """Stack samples into a batch (reference dataloader.py
-    default_batchify_fn)."""
+    default_batchify_fn; native GIL-free parallel copy when built —
+    src/native/batchify.cc)."""
     if isinstance(data[0], NDArray):
-        return NDArray(onp.stack([d.asnumpy() for d in data]))
+        from .batchify import Stack
+        return Stack()(data)  # one native-or-numpy stack implementation
     if isinstance(data[0], (tuple, list)):
         return tuple(default_batchify_fn(list(d)) for d in zip(*data))
     arr = onp.asarray(data)

@@ -1,0 +1,88 @@
+// Native batchify: GIL-free parallel sample collation.
+//
+// Reference analog: src/io/batchify.cc (StackBatchify::Batchify runs an
+// OMP-parallel copy of N samples into one batch buffer) and the image
+// pipeline's normalize/transpose kernels (iter_image_recordio_2.cc) that
+// run on dmlc worker threads. Python's numpy stack holds the GIL per
+// element; these entry points take raw pointers so the Python side
+// releases the GIL once for the whole batch.
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "mxt_native.h"
+
+namespace {
+
+// Run fn(i) for i in [0, n) over up to n_threads workers.
+template <typename F>
+void ParallelFor(int n, int n_threads, F fn) {
+  if (n_threads <= 1 || n <= 1) {
+    for (int i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  int workers = std::min(n_threads, n);
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  std::atomic<int> next{0};
+  for (int w = 0; w < workers; ++w) {
+    pool.emplace_back([&] {
+      int i;
+      while ((i = next.fetch_add(1)) < n) fn(i);
+    });
+  }
+  for (auto &t : pool) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXTBatchifyStack(const void *const *srcs, int n, size_t sample_bytes,
+                     void *dst, int n_threads) {
+  if (!srcs || !dst || n < 0) {
+    MXTSetLastError("MXTBatchifyStack: bad arguments");
+    return -1;
+  }
+  char *out = static_cast<char *>(dst);
+  ParallelFor(n, n_threads, [&](int i) {
+    std::memcpy(out + static_cast<size_t>(i) * sample_bytes, srcs[i],
+                sample_bytes);
+  });
+  return 0;
+}
+
+// HWC uint8 images -> NCHW float32 batch with (x/255 - mean[c]) / std[c]:
+// the fused decode-side normalize+transpose of the reference image
+// pipeline (image/image.cc NormalizeAug + swap to CHW), one sample per
+// worker thread.
+int MXTBatchifyImageNormalize(const uint8_t *const *srcs, int n, int h,
+                              int w, int c, const float *mean,
+                              const float *stddev, float *dst,
+                              int n_threads) {
+  if (!srcs || !dst || n < 0 || c <= 0) {
+    MXTSetLastError("MXTBatchifyImageNormalize: bad arguments");
+    return -1;
+  }
+  const size_t plane = static_cast<size_t>(h) * w;
+  ParallelFor(n, n_threads, [&](int i) {
+    const uint8_t *src = srcs[i];
+    float *out = dst + static_cast<size_t>(i) * c * plane;
+    for (int ch = 0; ch < c; ++ch) {
+      const float m = mean ? mean[ch] : 0.0f;
+      const float s = stddev ? stddev[ch] : 1.0f;
+      const float inv = 1.0f / (255.0f * s);
+      float *op = out + ch * plane;
+      const uint8_t *ip = src + ch;
+      for (size_t p = 0; p < plane; ++p) {
+        op[p] = static_cast<float>(ip[p * c]) * inv - m / s;
+      }
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"

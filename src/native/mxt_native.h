@@ -71,6 +71,16 @@ int MXTRecordIOReaderSeek(MXTRecordIOHandle h, uint64_t pos);
 int MXTRecordIOReaderTell(MXTRecordIOHandle h, uint64_t *out);
 int MXTRecordIOReaderClose(MXTRecordIOHandle h);
 
+/* ---- batchify (src/io/batchify.cc analog) ---- */
+/* Parallel stack of n equal-size samples into dst (n * sample_bytes). */
+int MXTBatchifyStack(const void *const *srcs, int n, size_t sample_bytes,
+                     void *dst, int n_threads);
+/* HWC uint8 images -> NCHW float32 with (x/255 - mean[c]) / std[c]. */
+int MXTBatchifyImageNormalize(const uint8_t *const *srcs, int n, int h,
+                              int w, int c, const float *mean,
+                              const float *stddev, float *dst,
+                              int n_threads);
+
 /* ---- threaded prefetching reader ---- */
 int MXTPrefetchCreate(const char *path, int capacity, MXTPrefetchHandle *out);
 /* Blocking pop; at EOF returns 0 with *out_len == 0. The buffer is owned

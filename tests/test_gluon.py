@@ -549,6 +549,44 @@ def test_cachedop_explicit_bucket_sizes_and_lru(monkeypatch):
     assert len(net._jit_lru) == 1
 
 
+def test_threadsafe_cachedop_concurrent_inference():
+    """Reference thread-safe CachedOp (src/imperative/cached_op_threadsafe.cc,
+    example/multi_threaded_inference): concurrent forward calls on ONE
+    hybridized net from many threads must all produce the single-thread
+    result. jit dispatch is thread-safe by construction — this pins the
+    claim with a real multithreaded run."""
+    import threading
+    import queue
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    net.hybridize()
+    xs = [mx.nd.array(onp.random.RandomState(i).randn(4, 16)
+                      .astype("float32")) for i in range(8)]
+    expected = [net(x).asnumpy() for x in xs]  # also compiles once
+
+    errors: queue.Queue = queue.Queue()
+
+    def worker(idx):
+        try:
+            for _ in range(5):
+                out = net(xs[idx]).asnumpy()
+                onp.testing.assert_allclose(out, expected[idx],
+                                            rtol=1e-6, atol=1e-6)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.put((idx, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors.empty(), list(errors.queue)
+
+
 def test_optimize_for_backends():
     """Subgraph backends (reference optimize_for/SubgraphProperty):
     remat + bf16 transforms of the hybridized computation."""

@@ -201,3 +201,69 @@ def test_prefetcher(tmp_path):
     got = list(pf)
     pf.close()
     assert got == payloads
+
+
+def test_native_batchify_stack_matches_numpy():
+    """src/native/batchify.cc MXTBatchifyStack: GIL-free parallel collation
+    must be byte-identical to numpy stack (reference StackBatchify,
+    src/io/batchify.cc)."""
+    from mxnet_tpu import _native
+    from mxnet_tpu.gluon.data.batchify import Stack, _native_stack
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    rng = onp.random.RandomState(3)
+    # large batch (>1MB) rides the native parallel copy
+    arrs = [rng.randn(64, 512).astype("float32") for _ in range(16)]
+    assert _native_stack(arrs) is not None
+    onp.testing.assert_array_equal(Stack()(arrs).asnumpy(),
+                                   onp.stack(arrs))
+    # int dtype too
+    iarrs = [rng.randint(0, 9, (256, 512)).astype("int32")
+             for _ in range(16)]
+    onp.testing.assert_array_equal(Stack()(iarrs).asnumpy(),
+                                   onp.stack(iarrs))
+    # small batches skip the thread spawn (numpy memcpy wins there)
+    assert _native_stack([onp.zeros((4,), "float32")] * 8) is None
+    # non-uniform shapes and object dtype refuse the raw-memcpy path
+    assert _native_stack([onp.zeros((2,)), onp.zeros((3,))]) is None
+    objs = [onp.array([{"x": 1}, [2]], dtype=object)] * 4
+    assert _native_stack(objs) is None
+
+
+def test_native_image_normalize_fused():
+    """MXTBatchifyImageNormalize: HWC uint8 -> normalized NCHW float32,
+    fused (reference image pipeline normalize+transpose on worker
+    threads)."""
+    from mxnet_tpu import _native
+    from mxnet_tpu.gluon.data.batchify import ImageNormalize
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    rng = onp.random.RandomState(4)
+    imgs = [rng.randint(0, 255, (16, 20, 3)).astype("uint8")
+            for _ in range(6)]
+    norm = ImageNormalize(mean=(0.5, 0.4, 0.3), std=(0.2, 0.25, 0.3))
+    out = norm(imgs).asnumpy()
+    ref = (onp.stack(imgs).astype("float32") / 255.0
+           - onp.array([0.5, 0.4, 0.3], "float32")) \
+        / onp.array([0.2, 0.25, 0.3], "float32")
+    onp.testing.assert_allclose(out, ref.transpose(0, 3, 1, 2),
+                                rtol=1e-5, atol=1e-6)
+    # a non-uint8 sample anywhere in the batch must raise, not be
+    # reinterpreted byte-wise
+    with pytest.raises(ValueError, match="uint8"):
+        norm([imgs[0], imgs[1].astype("float32")])
+
+
+def test_dataloader_uses_native_batchify_end_to_end():
+    from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+    import mxnet_tpu as mx
+    rng = onp.random.RandomState(5)
+    X = rng.randn(64, 4).astype("float32")
+    Y = rng.randint(0, 3, (64,)).astype("int32")
+    ds = ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
+    dl = DataLoader(ds, batch_size=16, num_workers=2)
+    seen = 0
+    for xb, yb in dl:
+        assert xb.shape == (16, 4)
+        seen += xb.shape[0]
+    assert seen == 64
