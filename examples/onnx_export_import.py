@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""ONNX round trip (reference: example/onnx + contrib.onnx docs).
+
+Builds a small convnet as an mx.sym graph, exports a standard opset-13
+.onnx file (written by the framework's own protobuf serializer — no onnx
+package needed), re-imports it, and checks the two graphs agree.
+
+Run: python examples/onnx_export_import.py [--out /tmp/model.onnx]
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import onnx as mxonnx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/mxnet_tpu_model.onnx")
+    args = ap.parse_args()
+    rng = onp.random.RandomState(0)
+
+    x = sym.Variable("data")
+    c = sym.Convolution(x, sym.Variable("w"), sym.Variable("b"),
+                        kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        name="conv1")
+    r = sym.Activation(c, act_type="relu", name="relu1")
+    p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool1")
+    f = sym.Flatten(p, name="flat")
+    out = sym.softmax(sym.FullyConnected(
+        f, sym.Variable("fw"), sym.Variable("fb"), name="fc"), name="prob")
+
+    params = {
+        "w": nd.array(rng.randn(8, 3, 3, 3).astype("float32") * 0.1),
+        "b": nd.array(rng.randn(8).astype("float32") * 0.1),
+        "fw": nd.array(rng.randn(10, 8 * 16 * 16).astype("float32") * 0.02),
+        "fb": nd.array(rng.randn(10).astype("float32") * 0.1),
+    }
+    path = mxonnx.export_model(out, params, in_shapes=[(4, 3, 32, 32)],
+                               onnx_file_path=args.out, verbose=True)
+    meta = mxonnx.get_model_metadata(path)
+    print("inputs:", meta["input_tensor_data"])
+
+    sym2, arg_params, aux_params = mxonnx.import_model(path)
+    xv = nd.array(rng.randn(4, 3, 32, 32).astype("float32"))
+    want = out.eval(data=xv, **params).asnumpy()
+    got = sym2.eval(data=xv, **arg_params, **aux_params).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    print(f"round trip OK: {_os.path.getsize(path)} byte model, "
+          f"max |diff| = {abs(got - want).max():.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -112,12 +112,14 @@ class ImageNormalize:
         if any(a.ndim != 3 or a.dtype != onp.uint8 for a in arrs):
             raise ValueError("ImageNormalize expects HWC uint8 samples")
         h, w, c = first.shape
-        if self._mean.shape[0] != c:
-            raise ValueError(f"mean/std have {self._mean.shape[0]} channels,"
-                             f" images have {c}")
+        if self._mean.shape[0] != c or self._std.shape[0] != c:
+            raise ValueError(
+                f"mean has {self._mean.shape[0]} and std has "
+                f"{self._std.shape[0]} channels, images have {c}")
         n = len(arrs)
         lib = _native.get_lib()
         if lib is not None and n > 1 and \
+                n * first.nbytes >= _NATIVE_STACK_MIN_BYTES and \
                 all(a.shape == first.shape for a in arrs):
             out = onp.empty((n, c, h, w), "float32")
             ptrs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])

@@ -29,15 +29,17 @@ def _get_mesh(mesh):
     return mesh
 
 
-def _shard_map(fn, mesh: DeviceMesh, in_spec, out_spec):
+def _shard_map(fn, mesh, in_spec, out_spec):
     # check_vma off: e.g. a tiled all_gather's output IS replicated over the
     # axis but the varying-axis inference can't prove it; numerics are
-    # asserted in tests/test_parallel.py instead.
+    # asserted in tests/test_parallel.py instead. Accepts a DeviceMesh or a
+    # raw jax Mesh (version-compat entry point for examples/user code too).
+    raw = mesh.mesh if isinstance(mesh, DeviceMesh) else mesh
     try:
-        return jax.shard_map(fn, mesh=mesh.mesh, in_specs=in_spec,
+        return jax.shard_map(fn, mesh=raw, in_specs=in_spec,
                              out_specs=out_spec, check_vma=False)
     except TypeError:  # older jax without check_vma
-        return jax.shard_map(fn, mesh=mesh.mesh, in_specs=in_spec,
+        return jax.shard_map(fn, mesh=raw, in_specs=in_spec,
                              out_specs=out_spec)
 
 

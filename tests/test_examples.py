@@ -46,6 +46,20 @@ def test_example_mnist_one_epoch():
     _run("train_mnist_gluon.py", ("x", "--epochs", "1"))
 
 
+def test_example_sparse_embedding():
+    _run("sparse_embedding_lm.py", ("x", "--vocab", "2000", "--steps", "8"))
+
+
+def test_example_onnx_roundtrip(tmp_path):
+    _run("onnx_export_import.py", ("x", "--out",
+                                   str(tmp_path / "m.onnx")))
+
+
+def test_example_moe_pipeline():
+    # in-process: conftest already provisioned the 8-device CPU mesh
+    _run("moe_pipeline_parallel.py")
+
+
 @pytest.mark.slow
 def test_example_bert():
     _run("train_bert_classifier.py")

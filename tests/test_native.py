@@ -255,15 +255,23 @@ def test_native_image_normalize_fused():
 
 
 def test_dataloader_uses_native_batchify_end_to_end():
+    from mxnet_tpu import _native
     from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+    from mxnet_tpu.gluon.data.batchify import _native_stack
     import mxnet_tpu as mx
+    if not _native.available():
+        pytest.skip("native library unavailable")
     rng = onp.random.RandomState(5)
-    X = rng.randn(64, 4).astype("float32")
+    # samples big enough that a 16-batch crosses the native threshold
+    X = rng.randn(64, 128, 256).astype("float32")
     Y = rng.randint(0, 3, (64,)).astype("int32")
+    assert _native_stack([X[i] for i in range(16)]) is not None  # precond
     ds = ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
     dl = DataLoader(ds, batch_size=16, num_workers=2)
     seen = 0
     for xb, yb in dl:
-        assert xb.shape == (16, 4)
+        assert xb.shape == (16, 128, 256)
+        idx = seen
+        onp.testing.assert_array_equal(xb.asnumpy(), X[idx:idx + 16])
         seen += xb.shape[0]
     assert seen == 64
