@@ -22,7 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import mxnet_tpu as mx  # noqa: F401  (framework import sets up platform)
 from mxnet_tpu.ops import moe as moe_ops
-from mxnet_tpu.parallel.collectives import _shard_map
+from mxnet_tpu.parallel import shard_map
 from mxnet_tpu.parallel.pipeline import run_pipeline
 
 
@@ -46,8 +46,8 @@ def expert_parallel_demo():
         return moe_ops.moe_ffn(xs, gw, w1s, w2s, top_k=k,
                                capacity_factor=2.0, axis_name="ep")
 
-    f = jax.jit(_shard_map(shard_fn, mesh,
-                           (P(), P(), P("ep"), P("ep")), (P(), P())))
+    f = jax.jit(shard_map(shard_fn, mesh,
+                          (P(), P(), P("ep"), P("ep")), (P(), P())))
     out, aux = f(x, gate, w1, w2)
     print(f"MoE: {e} experts over {ep} devices, out {out.shape}, "
           f"balance aux {float(aux):.3f}")

@@ -82,6 +82,18 @@ def _declare(lib):
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int]
+    try:
+        # OPTIONAL symbols (need libjpeg at build time): a stale library
+        # without them must not poison engine/recordio/batchify — image.py
+        # hasattr-guards the decode fast path
+        lib.MXTImageJPEGInfo.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.MXTImageJPEGDecode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+    except AttributeError:
+        pass
 
 
 def get_lib():

@@ -2,10 +2,12 @@
 imresize/crops/jitter augmenters + CreateAugmenter, backed by OpenCV in the
 reference).
 
-TPU-native notes: decode uses PIL when present (OpenCV is not in this
-environment) with a raw-array fallback; resize lowers to ``jax.image.resize``
-(an XLA program — runs on TPU for on-device preprocessing); augmenters are
-numpy/NDArray transforms applied CPU-side in the data pipeline.
+TPU-native notes: JPEG decodes through the native libjpeg path
+(src/native/image.cc — GIL-free, the OpenCV-decode-thread analog), other
+formats through PIL, with a raw-array fallback; resize lowers to
+``jax.image.resize`` (an XLA program — runs on TPU for on-device
+preprocessing); augmenters are numpy/NDArray transforms applied CPU-side in
+the data pipeline.
 """
 from __future__ import annotations
 
@@ -34,28 +36,64 @@ def _as_np(img):
     return img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
 
 
+def _native_jpeg_decode(payload: bytes, flag: int):
+    """GIL-free libjpeg decode (src/native/image.cc — the OpenCV-thread
+    analog of the reference pipeline). None when unavailable / not JPEG."""
+    if not payload.startswith(b"\xff\xd8"):
+        return None  # not a JPEG stream
+    from .. import _native
+    lib = _native.get_lib()
+    if lib is None or not hasattr(lib, "MXTImageJPEGDecode"):
+        return None
+    import ctypes
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.MXTImageJPEGInfo(payload, len(payload), ctypes.byref(h),
+                            ctypes.byref(w), ctypes.byref(c)) != 0:
+        return None
+    # decompression-bomb guard (PIL's Image.MAX_IMAGE_PIXELS analog): the
+    # header dims are untrusted — don't allocate for absurd claims
+    if h.value * w.value > 178_956_970 or h.value <= 0 or w.value <= 0:
+        return None  # PIL path applies its own bomb check / error
+    out_c = 1 if flag == 0 else 3
+    out = onp.empty((h.value, w.value, out_c), onp.uint8)
+    rc = lib.MXTImageJPEGDecode(payload, len(payload),
+                                out.ctypes.data_as(
+                                    ctypes.POINTER(ctypes.c_uint8)),
+                                out_c)
+    return out if rc == 0 else None
+
+
 def imdecode(buf, flag: int = 1, to_rgb: bool = True) -> NDArray:
-    """Decode an encoded image buffer to HWC uint8 (reference imdecode)."""
-    try:
-        from PIL import Image
-    except ImportError as e:
-        raise MXNetError("imdecode requires PIL in this environment") from e
-    im = Image.open(_io.BytesIO(bytes(buf)))
-    if flag == 0:
-        im = im.convert("L")
-        arr = onp.asarray(im)[..., None]
-    else:
-        im = im.convert("RGB")
-        arr = onp.asarray(im)
-        if not to_rgb:
-            arr = arr[..., ::-1]
+    """Decode an encoded image buffer to HWC uint8 (reference imdecode).
+    JPEG rides the native libjpeg path when built; everything else (and
+    the fallback) decodes with PIL."""
+    payload = bytes(buf)
+    arr = _native_jpeg_decode(payload, flag)
+    if arr is None:
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise MXNetError(
+                "imdecode requires PIL in this environment") from e
+        im = Image.open(_io.BytesIO(payload))
+        if flag == 0:
+            arr = onp.asarray(im.convert("L"))[..., None]
+        else:
+            arr = onp.asarray(im.convert("RGB"))
+    if flag != 0 and not to_rgb:
+        arr = arr[..., ::-1]
     return nd_array(arr)
 
 
 def imdecode_or_raw(payload: bytes, data_shape) -> onp.ndarray:
-    """Decode via PIL, else interpret payload as a raw CHW/HWC uint8/float32
-    array of ``data_shape`` (the framework's synthetic-record escape used by
-    tests and im2rec-less pipelines)."""
+    """Decode via native libjpeg/PIL, else interpret payload as a raw
+    CHW/HWC uint8/float32 array of ``data_shape`` (the framework's
+    synthetic-record escape used by tests and im2rec-less pipelines)."""
+    native = _native_jpeg_decode(payload, 1)
+    if native is not None:
+        return native
     try:
         from PIL import Image
         im = Image.open(_io.BytesIO(payload)).convert("RGB")

@@ -11,5 +11,5 @@ from .mesh import (DeviceMesh, make_mesh, current_mesh, data_parallel_mesh,
 from .compression import GradientCompression
 from . import mesh, compression, dist, collectives, pipeline
 from .collectives import (allreduce, allgather, reduce_scatter,
-                          broadcast_axis, ppermute)
+                          broadcast_axis, ppermute, shard_map)
 from .pipeline import pipeline_apply, run_pipeline

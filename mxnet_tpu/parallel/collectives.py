@@ -29,11 +29,12 @@ def _get_mesh(mesh):
     return mesh
 
 
-def _shard_map(fn, mesh, in_spec, out_spec):
-    # check_vma off: e.g. a tiled all_gather's output IS replicated over the
-    # axis but the varying-axis inference can't prove it; numerics are
-    # asserted in tests/test_parallel.py instead. Accepts a DeviceMesh or a
-    # raw jax Mesh (version-compat entry point for examples/user code too).
+def shard_map(fn, mesh, in_spec, out_spec):
+    """Version-compat ``jax.shard_map`` with value-based replication checks
+    off (check_vma: e.g. a tiled all_gather's output IS replicated over the
+    axis but the varying-axis inference can't prove it; numerics are
+    asserted in tests/test_parallel.py instead). Accepts a DeviceMesh or a
+    raw jax Mesh — the supported entry point for user/example code."""
     raw = mesh.mesh if isinstance(mesh, DeviceMesh) else mesh
     try:
         return jax.shard_map(fn, mesh=raw, in_specs=in_spec,
@@ -41,6 +42,9 @@ def _shard_map(fn, mesh, in_spec, out_spec):
     except TypeError:  # older jax without check_vma
         return jax.shard_map(fn, mesh=raw, in_specs=in_spec,
                              out_specs=out_spec)
+
+
+_shard_map = shard_map  # internal alias (pre-existing call sites)
 
 
 def _on_mesh(x: NDArray, mesh: DeviceMesh, spec) -> jax.Array:

@@ -1,0 +1,93 @@
+// Native JPEG decode via libjpeg.
+//
+// Reference analog: the OpenCV-backed decode threads of the image pipeline
+// (src/io/iter_image_recordio_2.cc + image_aug_default.cc): JPEG decode is
+// the data-path hot loop, so it must run GIL-free on C++ threads. Two-phase
+// API: probe dimensions, then decode into a caller-allocated HWC uint8
+// buffer (grayscale sources expand to the requested channel count, like
+// cv::imread's IMREAD_COLOR).
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+#include "mxt_native.h"
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void ErrorExit(j_common_ptr cinfo) {
+  ErrorMgr *err = reinterpret_cast<ErrorMgr *>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, err->msg);
+  longjmp(err->jump, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXTImageJPEGInfo(const uint8_t *data, size_t len, int *h, int *w,
+                     int *c) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = ErrorExit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    MXTSetLastError(jerr.msg);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t *>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *h = static_cast<int>(cinfo.image_height);
+  *w = static_cast<int>(cinfo.image_width);
+  *c = cinfo.num_components;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode into out (h * w * out_c HWC uint8). out_c 3 = RGB (grayscale
+// sources replicate), 1 = grayscale (color sources luminance-convert via
+// libjpeg's JCS_GRAYSCALE output path).
+int MXTImageJPEGDecode(const uint8_t *data, size_t len, uint8_t *out,
+                       int out_c) {
+  if (out_c != 1 && out_c != 3) {
+    MXTSetLastError("MXTImageJPEGDecode: out_c must be 1 or 3");
+    return -1;
+  }
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = ErrorExit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    MXTSetLastError(jerr.msg);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t *>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = (out_c == 3) ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+  const int w = static_cast<int>(cinfo.output_width);
+  const int stride = w * out_c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t *row = out + static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

@@ -81,6 +81,13 @@ int MXTBatchifyImageNormalize(const uint8_t *const *srcs, int n, int h,
                               const float *stddev, float *dst,
                               int n_threads);
 
+/* ---- JPEG decode (libjpeg; the OpenCV-decode-thread analog) ---- */
+int MXTImageJPEGInfo(const uint8_t *data, size_t len, int *h, int *w,
+                     int *c);
+/* out: h*w*out_c HWC uint8; out_c = 3 (RGB) or 1 (grayscale). */
+int MXTImageJPEGDecode(const uint8_t *data, size_t len, uint8_t *out,
+                       int out_c);
+
 /* ---- threaded prefetching reader ---- */
 int MXTPrefetchCreate(const char *path, int capacity, MXTPrefetchHandle *out);
 /* Blocking pop; at EOF returns 0 with *out_len == 0. The buffer is owned

@@ -275,3 +275,41 @@ def test_dataloader_uses_native_batchify_end_to_end():
         onp.testing.assert_array_equal(xb.asnumpy(), X[idx:idx + 16])
         seen += xb.shape[0]
     assert seen == 64
+
+
+def test_native_jpeg_decode_matches_pil():
+    """src/native/image.cc libjpeg decode (the OpenCV-decode-thread analog,
+    iter_image_recordio_2.cc): RGB and grayscale paths match PIL."""
+    import io
+    from mxnet_tpu import _native
+    from mxnet_tpu.image.image import imdecode, _native_jpeg_decode
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("PIL unavailable")
+    rng = onp.random.RandomState(7)
+    img = rng.randint(0, 255, (32, 40, 3)).astype("uint8")
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=95)
+    payload = buf.getvalue()
+
+    native = _native_jpeg_decode(payload, 1)
+    assert native is not None
+    pil = onp.asarray(Image.open(io.BytesIO(payload)).convert("RGB"))
+    assert int(onp.abs(native.astype(int) - pil.astype(int)).max()) <= 2
+    gray = _native_jpeg_decode(payload, 0)
+    assert gray.shape == (32, 40, 1)
+    # public imdecode rides the native path; BGR flip still applies
+    rgb = imdecode(payload).asnumpy()
+    bgr = imdecode(payload, to_rgb=False).asnumpy()
+    onp.testing.assert_array_equal(rgb[..., ::-1], bgr)
+    # non-JPEG bytes fall back cleanly (PNG through PIL)
+    pbuf = io.BytesIO()
+    Image.fromarray(img).save(pbuf, format="PNG")
+    png = imdecode(pbuf.getvalue()).asnumpy()
+    onp.testing.assert_array_equal(png, img)
+    # corrupt JPEG raises through the fallback, not a crash
+    with pytest.raises(Exception):
+        imdecode(b"\xff\xd8corrupt")
