@@ -1,5 +1,6 @@
 """Gluon recurrent API (reference: python/mxnet/gluon/rnn/)."""
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, ZoneoutCell,
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell,
                        ResidualCell, BidirectionalCell)
 from .rnn_layer import RNN, LSTM, GRU

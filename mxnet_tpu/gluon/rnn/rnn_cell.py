@@ -18,8 +18,8 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
-           "BidirectionalCell"]
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
 
 
 def _cells_state_info(cells, batch_size):
@@ -221,6 +221,13 @@ class SequentialRNNCell(RecurrentCell):
 
     def __getitem__(self, i):
         return self._cells[i]
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybridizable stacked cells (reference HybridSequentialRNNCell).
+    In this build SequentialRNNCell is already trace-compatible (every cell
+    op funnels through jit-able kernels), so this is the same machinery
+    under the reference's name."""
 
 
 class DropoutCell(RecurrentCell):

@@ -20,7 +20,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "registry", "create"]
+           "Mixed", "Load", "registry", "create"]
 
 registry = {}
 
@@ -206,6 +206,66 @@ class LSTMBias(Initializer):
         n = shape[0] // 4
         b[n:2 * n] = self.forget_bias
         return jnp.asarray(b, dtype)
+
+
+class Mixed(Initializer):
+    """Pattern-dispatched initializer (reference Mixed): the first regex
+    matching the parameter name picks the initializer. Overrides
+    ``init_array`` (like the reference overrides __call__) so pattern
+    dispatch wins over the base bias/gamma suffix rules — the chosen
+    initializer then applies its own suffix handling."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed needs one initializer per pattern")
+        self._map = [(re.compile(p), create(i))
+                     for p, i in zip(patterns, initializers)]
+
+    def init_array(self, name: str, shape, dtype) -> NDArray:
+        for pat, ini in self._map:
+            if pat.match(name):
+                return ini.init_array(name, shape, dtype)
+        raise MXNetError(
+            f"no initializer pattern matched parameter {name!r}; add a "
+            f"catch-all '.*' pattern (reference Mixed semantics)")
+
+    def _init_weight(self, name, shape, dtype):
+        return self.init_array(name, shape, dtype)._data
+
+
+class Load(Initializer):
+    """Initialize from saved arrays by name (reference Load): a dict (or
+    nd.load result) of name->NDArray, with an optional default for missing
+    names. Overrides ``init_array`` so saved values win over the base
+    bias/gamma suffix rules (reference Load overrides __call__ for the
+    same reason — a restored bias must not be re-zeroed)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        self._params = {k.split(":", 1)[-1]: v for k, v in param.items()}
+        self._default = create(default_init) if default_init else None
+        self._verbose = verbose
+
+    def init_array(self, name: str, shape, dtype) -> NDArray:
+        if name in self._params:
+            arr = self._params[name]
+            data = arr._data if hasattr(arr, "_data") else jnp.asarray(arr)
+            if tuple(data.shape) != tuple(shape):
+                raise MXNetError(
+                    f"Load: parameter {name!r} has shape {tuple(data.shape)}"
+                    f" in the file but {tuple(shape)} in the model")
+            if self._verbose:
+                print(f"Load: initialized {name} from saved array")
+            return NDArray(jnp.asarray(data, dtype))
+        if self._default is None:
+            raise MXNetError(
+                f"Load: no saved array for {name!r} and no default_init")
+        return self._default.init_array(name, shape, dtype)
+
+    def _init_weight(self, name, shape, dtype):
+        return self.init_array(name, shape, dtype)._data
 
 
 def create(init, **kwargs) -> Initializer:
