@@ -844,3 +844,148 @@ def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
     return invoke_raw("hawkes_ll", fn,
                       _wrap([lda, alpha, beta, state, lags, marks,
                              valid_length, max_time]), n_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# AdamW update ops + candidate sampling + float checks
+# (reference python/mxnet/ndarray/contrib.py adamw_update :556,
+#  rand_zipfian :39, isinf/isfinite/isnan :469-524;
+#  kernels src/operator/contrib/adamw.cc)
+# ---------------------------------------------------------------------------
+
+__all__ += ["adamw_update", "mp_adamw_update", "multi_adamw_update",
+            "rand_zipfian", "isinf", "isfinite", "isnan"]
+
+
+def _require_state_handles(**named):
+    """The adamw ops mutate their state arguments in place; a raw jax/numpy
+    array would silently receive the update on a throwaway wrapper."""
+    for nm, a in named.items():
+        if not isinstance(a, NDArray):
+            raise MXNetError(
+                f"adamw_update: {nm} must be an NDArray handle (its update "
+                f"is written in place, reference stateful kernel "
+                f"contrib/adamw.cc); got {type(a).__name__}")
+
+
+def adamw_update(weight, grad, mean, var, rescale_grad, lr, eta, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, clip_gradient=-1,
+                 out=None, **_ignored):
+    """AdamW with DECOUPLED weight decay (reference contrib/adamw.cc):
+    w -= eta * (lr * m/(sqrt(v)+eps) + wd * w) — NO bias correction, same
+    as the reference kernel (callers fold the correction into lr/eta).
+    Updates mean/var in place like the reference's stateful kernel; returns
+    the new weight (written to ``out``/``weight``)."""
+    _require_state_handles(weight=weight, mean=mean, var=var)
+    weight, grad, mean, var = _wrap([weight, grad, mean, var])
+    rg = rescale_grad._data if hasattr(rescale_grad, "_data") \
+        else jnp.asarray(rescale_grad)
+
+    def fn(w, g, m, v):
+        g = g * rg.reshape(()).astype(w.dtype)
+        if clip_gradient is not None and clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m_new = beta1 * m + (1 - beta1) * g
+        v_new = beta2 * v + (1 - beta2) * g * g
+        upd = lr * m_new / (jnp.sqrt(v_new) + epsilon) + wd * w
+        return w - eta * upd, m_new, v_new
+
+    new_w, new_m, new_v = invoke_raw("adamw_update", fn,
+                                     [weight, grad, mean, var], n_outputs=3)
+    mean._data = new_m._data
+    var._data = new_v._data
+    target = out if out is not None else weight
+    target._data = new_w._data
+    return target
+
+
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr,
+                    eta, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    clip_gradient=-1, out=None, **_ignored):
+    """Mixed-precision AdamW: master fp32 weights carry the update, the
+    low-precision weight is the cast-down copy (reference mp_adamw_update)."""
+    _require_state_handles(weight=weight, weight32=weight32)
+    new32 = adamw_update(weight32, grad, mean, var, rescale_grad, lr, eta,
+                         beta1, beta2, epsilon, wd, clip_gradient)
+    target = out if out is not None else weight
+    target._data = new32._data.astype(weight._data.dtype)
+    return target
+
+
+def multi_adamw_update(weights, grads, means, varrs, rescale_grad, lrs,
+                       wds, etas, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                       clip_gradient=-1, out=None, **_ignored):
+    """Fused multi-tensor AdamW (reference multi_adamw_update,
+    src/operator/contrib/adamw.cc multi_*): ALL parameter updates run as
+    ONE dispatched computation — one invoke instead of one per parameter,
+    the same single-program shape as Optimizer._jitted_multi."""
+    n = len(weights)
+    for group, nm in ((weights, "weights"), (means, "means"),
+                      (varrs, "vars")):
+        for a in group:
+            _require_state_handles(**{nm: a})
+    ws, gs = _wrap(list(weights)), _wrap(list(grads))
+    ms, vs = _wrap(list(means)), _wrap(list(varrs))
+    rg = rescale_grad._data if hasattr(rescale_grad, "_data") \
+        else jnp.asarray(rescale_grad)
+
+    def fn(*arrs):
+        ws_, gs_ = arrs[:n], arrs[n:2 * n]
+        ms_, vs_ = arrs[2 * n:3 * n], arrs[3 * n:4 * n]
+        new_w, new_m, new_v = [], [], []
+        for i in range(n):
+            g = gs_[i] * rg.reshape(()).astype(ws_[i].dtype)
+            if clip_gradient is not None and clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            m = beta1 * ms_[i] + (1 - beta1) * g
+            v = beta2 * vs_[i] + (1 - beta2) * g * g
+            upd = lrs[i] * m / (jnp.sqrt(v) + epsilon) + wds[i] * ws_[i]
+            new_w.append(ws_[i] - etas[i] * upd)
+            new_m.append(m)
+            new_v.append(v)
+        return tuple(new_w) + tuple(new_m) + tuple(new_v)
+
+    res = invoke_raw("multi_adamw_update", fn, ws + gs + ms + vs,
+                     n_outputs=3 * n)
+    outs = []
+    for i in range(n):
+        ms[i]._data = res[n + i]._data
+        vs[i]._data = res[2 * n + i]._data
+        target = out[i] if out is not None else ws[i]
+        target._data = res[i]._data
+        outs.append(target)
+    return outs
+
+
+def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):
+    """Log-uniform (Zipfian) candidate sampler (reference contrib.py:39):
+    returns (sampled_candidates (num_sampled,), expected_count_true,
+    expected_count_sampled)."""
+    from .random import uniform as nd_uniform
+    from .ndarray import NDArray
+    log_range = float(onp.log(range_max + 1))
+    rand = nd_uniform(0, log_range, shape=(num_sampled,))
+    sampled = (jnp.exp(rand._data.astype(jnp.float32)) - 1.0) \
+        .astype(jnp.int32) % range_max
+    tc = (true_classes._data if hasattr(true_classes, "_data")
+          else jnp.asarray(true_classes)).astype(jnp.float32)
+    exp_true = jnp.log((tc + 2.0) / (tc + 1.0)) / log_range * num_sampled
+    sc = sampled.astype(jnp.float32)
+    exp_sampled = jnp.log((sc + 2.0) / (sc + 1.0)) / log_range * num_sampled
+    return NDArray(sampled), NDArray(exp_true), NDArray(exp_sampled)
+
+
+def isinf(data):
+    return invoke_raw("isinf", lambda x: jnp.isinf(x).astype(jnp.float32),
+                      _wrap([data]))
+
+
+def isfinite(data):
+    return invoke_raw("isfinite",
+                      lambda x: jnp.isfinite(x).astype(jnp.float32),
+                      _wrap([data]))
+
+
+def isnan(data):
+    return invoke_raw("isnan", lambda x: jnp.isnan(x).astype(jnp.float32),
+                      _wrap([data]))

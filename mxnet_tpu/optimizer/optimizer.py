@@ -22,9 +22,9 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Signum", "SGLD", "DCASGD", "Adam",
-           "AdaBelief", "Adamax", "Nadam", "AdaGrad", "AdaDelta", "RMSProp",
-           "Ftrl", "FTML", "LARS", "LAMB", "LANS", "Updater", "get_updater",
-           "create", "register"]
+           "AdamW", "AdaBelief", "Adamax", "Nadam", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "FTML", "LARS", "LAMB", "LANS", "Updater",
+           "get_updater", "create", "register"]
 
 _registry: Dict[str, type] = {}
 
@@ -475,6 +475,40 @@ class Adam(Optimizer):
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
             return w - lr * mhat / (jnp.sqrt(vhat) + eps), (m, v)
+        return rule
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with DECOUPLED weight decay (reference contrib adamw_update,
+    src/operator/contrib/adamw.cc): wd applies directly to the weight,
+    outside the adaptive moments — the transformer-training default."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+        self.lazy_update = True  # elementwise rule: sparse rows safe
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def _rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        correct = self.correct_bias
+
+        def rule(w, g, lr, wd, t, states):
+            m, v = states
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            if correct:
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+            else:
+                mhat, vhat = m, v
+            upd = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+            return w - lr * upd, (m, v)
         return rule
 
 

@@ -231,3 +231,91 @@ def test_moe_aux_counts_pre_drop_routing():
     w2 = jnp.asarray(rng.randn(4, 4, 8).astype("float32"))
     _, aux = moe_ffn(x, gate, w1, w2, top_k=1, capacity_factor=0.25)
     assert float(aux) > 3.5  # ~E at full imbalance, undamped by drops
+
+
+def test_adamw_update_op_matches_manual():
+    """reference contrib adamw_update (src/operator/contrib/adamw.cc):
+    decoupled wd — w -= eta*(lr*m/(sqrt(v)+eps) + wd*w)."""
+    w0 = onp.ones((4,), "float32")
+    g0 = onp.full((4,), 0.5, "float32")
+    w = nd.array(w0); g = nd.array(g0)
+    m = nd.zeros((4,)); v = nd.zeros((4,))
+    out = nd.contrib.adamw_update(w, g, m, v, rescale_grad=2.0, lr=0.1,
+                                  eta=1.0, wd=0.01)
+    gr = g0 * 2.0
+    m_ref = 0.1 * gr
+    v_ref = 0.001 * gr * gr
+    upd = 0.1 * m_ref / (onp.sqrt(v_ref) + 1e-8) + 0.01 * w0
+    onp.testing.assert_allclose(out.asnumpy(), w0 - upd, rtol=1e-5)
+    onp.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+    onp.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-6)
+    assert out is w  # in-place semantics on the weight handle
+
+    # multi-tensor variant walks every param
+    ws = [nd.array(w0), nd.array(w0 * 2)]
+    gs = [nd.array(g0), nd.array(g0)]
+    ms = [nd.zeros((4,)), nd.zeros((4,))]
+    vs = [nd.zeros((4,)), nd.zeros((4,))]
+    outs = nd.contrib.multi_adamw_update(ws, gs, ms, vs, 1.0,
+                                         lrs=[0.1, 0.2], wds=[0.0, 0.0],
+                                         etas=[1.0, 1.0])
+    assert len(outs) == 2 and (outs[1].asnumpy() != w0 * 2).any()
+
+    # mixed precision: bf16 weight follows the fp32 master
+    import jax.numpy as jnp
+    wlow = nd.array(onp.ones((4,), "float32")).astype("bfloat16")
+    w32 = nd.array(onp.ones((4,), "float32"))
+    m2, v2 = nd.zeros((4,)), nd.zeros((4,))
+    o = nd.contrib.mp_adamw_update(wlow, nd.array(g0), m2, v2, w32, 1.0,
+                                   lr=0.1, eta=1.0)
+    assert str(o._data.dtype) == "bfloat16"
+    onp.testing.assert_allclose(onp.asarray(o._data, "float32"),
+                                w32.asnumpy(), rtol=1e-2)
+
+
+def test_adamw_optimizer_decoupled_decay():
+    """AdamW wd must NOT flow through the moments (vs Adam's coupled wd)."""
+    from mxnet_tpu import optimizer as opt
+    w0 = onp.full((3,), 2.0, "float32")
+    g = nd.array(onp.zeros((3,), "float32"))  # zero grad isolates wd
+    aw = opt.create("adamw", learning_rate=0.1, wd=0.1)
+    w = nd.array(w0)
+    state = aw.create_state(0, w)
+    aw.update(0, w, g, state)
+    # zero grad: moments stay 0, update = lr * wd * w
+    onp.testing.assert_allclose(w.asnumpy(), w0 - 0.1 * 0.1 * w0, rtol=1e-5)
+    for s in state:
+        onp.testing.assert_allclose(s.asnumpy(), onp.zeros(3))
+
+
+def test_rand_zipfian_distribution_and_counts():
+    s, et, es = nd.contrib.rand_zipfian(nd.array([0, 3]), 2000, 50)
+    sv = s.asnumpy()
+    assert sv.shape == (2000,) and (sv >= 0).all() and (sv < 50).all()
+    # log-uniform: class 0 much more likely than class 40
+    assert (sv == 0).sum() > (sv == 40).sum()
+    # expected counts follow P(k) = log((k+2)/(k+1)) / log(range+1)
+    p0 = onp.log(2.0) / onp.log(51.0)
+    onp.testing.assert_allclose(et.asnumpy()[0], p0 * 2000, rtol=1e-4)
+    # empirical frequency of class 0 within 3 sigma of expectation
+    exp0 = p0 * 2000
+    assert abs((sv == 0).sum() - exp0) < 4 * onp.sqrt(exp0)
+
+
+def test_contrib_float_checks():
+    x = nd.array([float("inf"), float("nan"), 1.0])
+    onp.testing.assert_allclose(nd.contrib.isinf(x).asnumpy(), [1, 0, 0])
+    onp.testing.assert_allclose(nd.contrib.isnan(x).asnumpy(), [0, 1, 0])
+    onp.testing.assert_allclose(nd.contrib.isfinite(x).asnumpy(), [0, 0, 1])
+
+
+def test_adamw_rejects_raw_state_arrays():
+    """State args must be NDArray handles — a raw array would receive the
+    in-place moment update on a throwaway wrapper and silently lose it."""
+    import jax.numpy as jnp
+    from mxnet_tpu.base import MXNetError as MXE
+    w = nd.array(onp.ones((2,), "float32"))
+    g = nd.array(onp.ones((2,), "float32"))
+    with pytest.raises(MXE, match="mean"):
+        nd.contrib.adamw_update(w, g, jnp.zeros(2), nd.zeros((2,)),
+                                1.0, lr=0.1, eta=1.0)
