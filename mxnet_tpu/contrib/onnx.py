@@ -262,6 +262,97 @@ def _concat(name, attrs, ins, out, extra):
                   {"axis": int(attrs.get("dim", attrs.get("axis", 1)))})]
 
 
+@_mx2onnx("take", "embedding")
+def _gather(name, attrs, ins, out, extra):
+    # embedding is Gather(axis=0) over (weight, ids); take carries axis.
+    # ONNX Gather treats out-of-range indices as undefined (and allows
+    # negatives); take's clip/raise modes agree for all in-range indices,
+    # but wrap semantics cannot be expressed
+    if attrs.get("mode", "clip") == "wrap":
+        raise MXNetError(
+            f"ONNX export: take {name!r} with mode='wrap' has no Gather "
+            f"equivalent (ONNX treats out-of-range as undefined)")
+    if extra["mx_op"] == "embedding":
+        data_in = [ins[1], ins[0]]
+        axis = 0
+    else:
+        data_in = ins
+        axis = int(attrs.get("axis", 0))
+    return [_node("Gather", data_in, [out], name, {"axis": axis})]
+
+
+@_mx2onnx("layer_norm", "LayerNorm")
+def _layer_norm(name, attrs, ins, out, extra):
+    # LayerNormalization entered ai.onnx at opset 17: the model's declared
+    # opset is raised to match (other emitted ops are unchanged in 17)
+    extra["min_opset"] = max(extra.get("min_opset", P.ONNX_OPSET), 17)
+    return [_node("LayerNormalization", ins, [out], name,
+                  {"axis": int(attrs.get("axis", -1)),
+                   "epsilon": float(attrs.get("eps", 1e-5))})]
+
+
+@_mx2onnx("mean", "sum")
+def _reduce(name, attrs, ins, out, extra):
+    op = "ReduceMean" if extra["mx_op"] == "mean" else "ReduceSum"
+    if attrs.get("exclude", False):
+        raise MXNetError(
+            f"ONNX export: {extra['mx_op']} {name!r} with exclude=True "
+            f"needs the input rank to compute complement axes; list the "
+            f"axes explicitly instead")
+    a = {"keepdims": int(attrs.get("keepdims", False))}
+    axis = attrs.get("axis")
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        if op == "ReduceSum":
+            # opset 13 moved ReduceSum axes to an input tensor
+            aname = extra["unique"](f"{name}_axes")
+            extra["initializers"].append(
+                _tensor(aname, onp.asarray(axes, "int64")))
+            return [_node(op, [ins[0], aname], [out], name, a)]
+        a["axes"] = axes
+    return [_node(op, ins, [out], name, a)]
+
+
+@_mx2onnx("power", "broadcast_power")
+def _pow(name, attrs, ins, out, extra):
+    return [_node("Pow", ins, [out], name)]
+
+
+@_mx2onnx("erf")
+def _erf(name, attrs, ins, out, extra):
+    return [_node("Erf", ins, [out], name)]
+
+
+@_mx2onnx("squeeze", "expand_dims")
+def _squeeze(name, attrs, ins, out, extra):
+    # opset 13: axes ride an int64 input tensor for both ops
+    op = "Squeeze" if extra["mx_op"] == "squeeze" else "Unsqueeze"
+    axis = attrs.get("axis")
+    if axis is None and op == "Squeeze":
+        return [_node(op, ins, [out], name)]
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    aname = extra["unique"](f"{name}_axes")
+    extra["initializers"].append(
+        _tensor(aname, onp.asarray(axes, "int64")))
+    return [_node(op, [ins[0], aname], [out], name)]
+
+
+@_mx2onnx("slice_axis")
+def _slice_axis(name, attrs, ins, out, extra):
+    # opset 13 Slice: starts/ends/axes are input tensors
+    axis = int(attrs["axis"])
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end")
+    end = int(end) if end is not None else (1 << 62)
+    names = []
+    for suffix, val in (("starts", begin), ("ends", end), ("axes", axis)):
+        nm = extra["unique"](f"{name}_{suffix}")
+        extra["initializers"].append(
+            _tensor(nm, onp.asarray([val], "int64")))
+        names.append(nm)
+    return [_node("Slice", [ins[0]] + names, [out], name)]
+
+
 @_mx2onnx("Dropout", "dropout")
 def _dropout(name, attrs, ins, out, extra):
     # inference graph: Identity (reference exporter emits Dropout, which
@@ -368,7 +459,10 @@ def export_model(sym, params, in_shapes=None, in_types=None,
     model.write_string(3, "2.0")
     opset = P.MessageWriter()
     opset.write_string(1, "")
-    opset.write_int(2, opset_version)
+    # ops introduced after the requested opset raise the declared version
+    # (e.g. LayerNormalization -> 17); earlier ops are unchanged there
+    opset.write_int(2, max(opset_version,
+                           extra.get("min_opset", opset_version)))
     model.write_message(8, opset)
     model.write_message(7, graph)
     with open(onnx_file_path, "wb") as f:
@@ -532,9 +626,64 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
               "Abs": "abs", "Add": "broadcast_add", "Sub": "broadcast_sub",
               "Mul": "broadcast_mul", "Div": "broadcast_div",
               "MatMul": "dot", "Flatten": "Flatten",
-              "Identity": "identity", "Softplus": "softrelu"}
+              "Identity": "identity", "Softplus": "softrelu",
+              "Pow": "broadcast_power", "Erf": "erf"}
     if op in simple:
         return S(simple[op], ins)
+    if op == "Gather":
+        # mode='wrap': ONNX Gather permits negative indices (from the end);
+        # modulo indexing reproduces that exactly for indices in [-n, n)
+        return S("take", [ins[0], ins[1]],
+                 {"axis": int(attrs.get("axis", 0)), "mode": "wrap"})
+    if op == "LayerNormalization":
+        return S("LayerNorm", ins,
+                 {"axis": int(attrs.get("axis", -1)),
+                  "eps": float(attrs.get("epsilon", 1e-5))})
+    if op in ("ReduceMean", "ReduceSum"):
+        a = {"keepdims": bool(attrs.get("keepdims", 1))}
+        if len(ins) > 1:  # opset-13 axes input tensor
+            axes = consts.get(ins[1])
+            if axes is None:
+                raise MXNetError("ONNX import: dynamic reduce axes "
+                                 "unsupported")
+            a["axis"] = tuple(int(v) for v in axes)
+        elif "axes" in attrs:
+            a["axis"] = tuple(attrs["axes"])
+        return S("mean" if op == "ReduceMean" else "sum", ins[:1], a)
+    if op in ("Squeeze", "Unsqueeze"):
+        axes = None
+        if len(ins) > 1:
+            axes = consts.get(ins[1])
+            if axes is None:
+                raise MXNetError(f"ONNX import: dynamic {op} axes "
+                                 "unsupported")
+            axes = tuple(int(v) for v in axes)
+        elif "axes" in attrs:
+            axes = tuple(attrs["axes"])
+        if op == "Unsqueeze":
+            if axes is None or len(axes) != 1:
+                raise MXNetError("ONNX import: Unsqueeze needs one axis")
+            return S("expand_dims", ins[:1], {"axis": axes[0]})
+        a = {"axis": axes if axes is None or len(axes) > 1
+             else axes[0]} if axes is not None else {}
+        return S("squeeze", ins[:1], a)
+    if op == "Slice":
+        vals = [consts.get(i) for i in ins[1:]]
+        if any(v is None for v in vals[:2]):
+            raise MXNetError("ONNX import: dynamic Slice unsupported")
+        starts, ends = vals[0], vals[1]
+        axes = vals[2] if len(vals) > 2 and vals[2] is not None \
+            else list(range(len(starts)))
+        if len(vals) > 3 and vals[3] is not None \
+                and any(int(s) != 1 for s in vals[3]):
+            raise MXNetError(
+                "ONNX import: Slice with steps != 1 unsupported")
+        if len(starts) != 1:
+            raise MXNetError("ONNX import: multi-axis Slice unsupported")
+        end = int(ends[0])
+        return S("slice_axis", ins[:1],
+                 {"axis": int(axes[0]), "begin": int(starts[0]),
+                  "end": None if end >= (1 << 60) else end})
     if op == "Gemm":
         beta = attrs.get("beta", 1.0)
         if attrs.get("transB", 0) != 1 or attrs.get("alpha", 1.0) != 1.0 \

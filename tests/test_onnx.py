@@ -197,6 +197,69 @@ def test_unknown_shape_value_info_omits_shape(tmp_path):
     assert 2 in tt2
 
 
+def test_transformer_block_ops_roundtrip(tmp_path):
+    """The transformer-surface op set: Gather (embedding lookup),
+    LayerNormalization, reductions, Pow/Erf (exact GELU), Squeeze/Slice —
+    export then import reproduces the graph exactly."""
+    rng = onp.random.RandomState(4)
+    vocab, dim = 20, 8
+
+    ids = sym.Variable("ids")
+    emb = sym.embedding(ids, sym.Variable("emb_w"), name="emb")
+    ln = sym.LayerNorm(emb, sym.Variable("g"), sym.Variable("b"),
+                       axis=-1, eps=1e-5, name="ln")
+    # exact GELU: 0.5 * x * (1 + erf(x / sqrt(2)))
+    erf_in = sym.broadcast_mul(ln, sym.Variable("inv_sqrt2"), name="scl")
+    gelu = sym.broadcast_mul(
+        sym.broadcast_mul(ln, sym.Variable("half"), name="halfx"),
+        sym.broadcast_add(sym.erf(erf_in, name="erf1"),
+                          sym.Variable("one"), name="one_p"),
+        name="gelu")
+    pooled = sym.mean(gelu, axis=1, name="pool")        # (B, dim)
+    powd = sym.broadcast_power(pooled, sym.Variable("two"), name="sq")
+    sliced = sym.slice_axis(powd, axis=1, begin=0, end=4, name="sl")
+    out = sym.expand_dims(sliced, axis=1, name="unsq")
+
+    params = {
+        "emb_w": nd.array(rng.randn(vocab, dim).astype("float32")),
+        "g": nd.array(onp.abs(rng.randn(dim)).astype("float32") + 0.5),
+        "b": nd.array(rng.randn(dim).astype("float32") * 0.1),
+        "inv_sqrt2": nd.array(onp.array(1 / onp.sqrt(2), "float32")),
+        "half": nd.array(onp.array(0.5, "float32")),
+        "one": nd.array(onp.array(1.0, "float32")),
+        "two": nd.array(onp.array(2.0, "float32")),
+    }
+    path = str(tmp_path / "block.onnx")
+    mxonnx.export_model(out, params, in_shapes=[(2, 5)],
+                        in_types=["int32"], onnx_file_path=path)
+    sym2, args, aux = mxonnx.import_model(path)
+    x = nd.array(rng.randint(0, vocab, (2, 5)).astype("int32"))
+    want = out.eval(ids=x, **params).asnumpy()
+    got = sym2.eval(ids=x, **args).asnumpy()
+    assert want.shape == (2, 1, 4)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # the exported graph used the expected ONNX op set
+    with open(path, "rb") as f:
+        g = P.parse_message(P.parse_message(f.read())[7][0][1])
+    op_types = {P.parse_message(n)[4][0][1].decode() for w, n in g[1]}
+    assert {"Gather", "LayerNormalization", "Erf", "ReduceMean", "Pow",
+            "Slice", "Unsqueeze"} <= op_types
+    # LayerNormalization is opset-17: the declared opset must follow
+    with open(path, "rb") as f:
+        model = P.parse_message(f.read())
+    assert P.parse_message(model[8][0][1])[2][0][1] == 17
+    # unsupported semantics raise instead of exporting wrong graphs
+    with pytest.raises(MXNetError, match="exclude"):
+        mxonnx.export_model(
+            sym.mean(sym.Variable("z"), axis=1, exclude=True, name="m1"),
+            {}, onnx_file_path=str(tmp_path / "x.onnx"))
+    with pytest.raises(MXNetError, match="wrap"):
+        mxonnx.export_model(
+            sym.take(sym.Variable("z"), sym.Variable("i"), mode="wrap",
+                     name="t1"),
+            {}, onnx_file_path=str(tmp_path / "x.onnx"))
+
+
 def test_varint_edge_cases():
     w = P.MessageWriter()
     w.write_int(1, 0)
