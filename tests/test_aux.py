@@ -412,3 +412,24 @@ def test_multibox_target_negative_mining_thresh():
     assert ct[0] == 1.0          # positive
     assert ct[1] == -1.0         # near-miss: excluded from negatives
     assert ct[2] == 0.0          # true negative kept
+
+
+def test_profiler_memory_dump_and_summary(tmp_path):
+    """Storage-profiler parity (reference src/profiler/storage_profiler.cc):
+    pprof-format device memory snapshot + live-byte summary."""
+    live = mx.nd.ones((512, 512))  # keep a buffer alive for the snapshot
+    live.wait_to_read()
+    try:
+        p = mx.profiler.dump_memory(str(tmp_path / "mem.pprof"))
+    except MXNetError as e:
+        assert "axon" in str(e)  # tunneled plugin: refusal is the contract
+        pytest.skip("device memory profile unsupported on this PjRt plugin")
+    assert os.path.getsize(p) > 0
+    summary = mx.profiler.memory_summary()
+    # memory_stats is absent on some PjRt clients (summary empty there);
+    # when reported, the live buffer above must show up as positive bytes
+    for dev, stats in summary.items():
+        assert set(stats) == {"bytes_in_use", "peak_bytes_in_use",
+                              "bytes_limit"}
+        assert stats["bytes_in_use"] and stats["bytes_in_use"] > 0
+    del live
