@@ -337,6 +337,48 @@ def _squeeze(name, attrs, ins, out, extra):
     return [_node(op, [ins[0], aname], [out], name)]
 
 
+@_mx2onnx("clip")
+def _clip(name, attrs, ins, out, extra):
+    # opset 13: min/max ride input tensors; a missing bound is an empty
+    # input slot (ONNX optional-input convention, e.g. ReLU6 = max-only).
+    # Bounds take the graph's declared element type so Clip's same-type-T
+    # constraint holds for non-float32 models.
+    dt = extra.get("elem_np_dtype", "float32")
+    names = [ins[0]]
+    for suffix, key in (("min", "a_min"), ("max", "a_max")):
+        val = attrs.get(key)
+        if val is None:
+            names.append("")
+            continue
+        nm = extra["unique"](f"{name}_{suffix}")
+        extra["initializers"].append(
+            _tensor(nm, onp.asarray(val, dt)))
+        names.append(nm)
+    while names and names[-1] == "":
+        names.pop()  # trailing absent optionals are simply omitted
+    return [_node("Clip", names, [out], name)]
+
+
+@_mx2onnx("minimum", "broadcast_minimum", "maximum", "broadcast_maximum")
+def _minmax(name, attrs, ins, out, extra):
+    op = "Min" if "min" in extra["mx_op"] else "Max"
+    return [_node(op, ins, [out], name)]
+
+
+@_mx2onnx("LeakyReLU", "leaky_relu")
+def _leaky(name, attrs, ins, out, extra):
+    t = attrs.get("act_type", "leaky")
+    if t == "leaky":
+        return [_node("LeakyRelu", ins[:1], [out], name,
+                      {"alpha": float(attrs.get("slope", 0.25))})]
+    if t == "elu":
+        return [_node("Elu", ins[:1], [out], name,
+                      {"alpha": float(attrs.get("slope", 0.25))})]
+    if t == "prelu":
+        return [_node("PRelu", ins, [out], name)]
+    raise MXNetError(f"ONNX export: LeakyReLU act_type {t!r} unsupported")
+
+
 @_mx2onnx("slice_axis")
 def _slice_axis(name, attrs, ins, out, extra):
     # opset 13 Slice: starts/ends/axes are input tensors
@@ -390,6 +432,12 @@ def export_model(sym, params, in_shapes=None, in_types=None,
 
     graph = P.MessageWriter()
     extra: Dict[str, Any] = {"initializers": []}
+    if in_types:
+        # element type for typed scalar consts (Clip bounds must match T)
+        try:
+            extra["elem_np_dtype"] = str(onp.dtype(in_types[0]))
+        except TypeError:
+            pass
     emitted: Dict[int, str] = {}
     used_names: set = set()
     input_vis = []
@@ -630,6 +678,42 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
               "Pow": "broadcast_power", "Erf": "erf"}
     if op in simple:
         return S(simple[op], ins)
+    if op == "Clip":
+        a_min = a_max = None
+        dynamic = False
+        if len(ins) > 1 and ins[1]:
+            v = consts.get(ins[1])
+            a_min = float(v) if v is not None else None
+            dynamic |= v is None
+        if len(ins) > 2 and ins[2]:
+            v = consts.get(ins[2])
+            a_max = float(v) if v is not None else None
+            dynamic |= v is None
+        if "min" in attrs:  # pre-opset-11 attribute form
+            a_min = float(attrs["min"])
+        if "max" in attrs:
+            a_max = float(attrs["max"])
+        if dynamic:
+            raise MXNetError("ONNX import: Clip with non-constant bounds "
+                             "unsupported")
+        # one-sided clip (ReLU6 etc.): encode the absent bound as ∓inf —
+        # numerically identical, and it survives the executor's
+        # None-attr-means-unset filtering
+        return S("clip", ins[:1],
+                 {"a_min": float("-inf") if a_min is None else a_min,
+                  "a_max": float("inf") if a_max is None else a_max})
+    if op in ("Min", "Max"):
+        if len(ins) != 2:
+            raise MXNetError(f"ONNX import: variadic {op} with {len(ins)} "
+                             "inputs unsupported (2 expected)")
+        return S("broadcast_minimum" if op == "Min" else "broadcast_maximum",
+                 ins)
+    if op == "LeakyRelu":
+        return S("LeakyReLU", ins, {"act_type": "leaky",
+                                    "slope": float(attrs.get("alpha", 0.01))})
+    if op == "Elu":
+        return S("LeakyReLU", ins, {"act_type": "elu",
+                                    "slope": float(attrs.get("alpha", 1.0))})
     if op == "Gather":
         # mode='wrap': ONNX Gather permits negative indices (from the end);
         # modulo indexing reproduces that exactly for indices in [-n, n)

@@ -260,6 +260,42 @@ def test_transformer_block_ops_roundtrip(tmp_path):
             {}, onnx_file_path=str(tmp_path / "x.onnx"))
 
 
+def test_clip_minmax_leaky_roundtrip(tmp_path):
+    rng = onp.random.RandomState(5)
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    s = sym.clip(sym.broadcast_maximum(a, b, name="mx1"), a_min=-0.5,
+                 a_max=0.8, name="cl")
+    s = sym.LeakyReLU(s, act_type="leaky", slope=0.1, name="lr")
+    s = sym.broadcast_minimum(s, b, name="mn1")
+    path = str(tmp_path / "cm.onnx")
+    mxonnx.export_model(s, {}, in_shapes=[(3, 4), (3, 4)],
+                        onnx_file_path=path)
+    sym2, args, aux = mxonnx.import_model(path)
+    av = nd.array(rng.randn(3, 4).astype("float32"))
+    bv = nd.array(rng.randn(3, 4).astype("float32"))
+    onp.testing.assert_allclose(sym2.eval(a=av, b=bv).asnumpy(),
+                                s.eval(a=av, b=bv).asnumpy(),
+                                rtol=1e-6, atol=1e-7)
+    # one-sided clip (ReLU6 pattern): max-only bound round-trips
+    r6 = sym.clip(sym.Variable("y"), a_min=None, a_max=6.0, name="r6")
+    p6 = str(tmp_path / "r6.onnx")
+    mxonnx.export_model(r6, {}, in_shapes=[(4,)], onnx_file_path=p6)
+    s6, _, _ = mxonnx.import_model(p6)
+    yv = nd.array(onp.array([-3.0, 2.0, 7.0, 6.0], "float32"))
+    onp.testing.assert_allclose(s6.eval(y=yv).asnumpy(),
+                                [-3.0, 2.0, 6.0, 6.0])
+    # Elu round trip
+    e = sym.LeakyReLU(sym.Variable("x"), act_type="elu", slope=0.3,
+                      name="elu1")
+    p2 = str(tmp_path / "elu.onnx")
+    mxonnx.export_model(e, {}, in_shapes=[(5,)], onnx_file_path=p2)
+    s3, a3, _ = mxonnx.import_model(p2)
+    xv = nd.array(onp.array([-2.0, -0.5, 0.0, 0.5, 2.0], "float32"))
+    onp.testing.assert_allclose(s3.eval(x=xv).asnumpy(),
+                                e.eval(x=xv).asnumpy(), rtol=1e-6)
+
+
 def test_varint_edge_cases():
     w = P.MessageWriter()
     w.write_int(1, 0)
