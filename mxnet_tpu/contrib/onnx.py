@@ -374,8 +374,10 @@ def _leaky(name, attrs, ins, out, extra):
     if t == "elu":
         return [_node("Elu", ins[:1], [out], name,
                       {"alpha": float(attrs.get("slope", 0.25))})]
-    if t == "prelu":
-        return [_node("PRelu", ins, [out], name)]
+    # prelu is deliberately not exported: ONNX PRelu's slope broadcast
+    # (unidirectional from the left) differs from this op's gamma layout,
+    # and an asymmetric export (no importer) would break the round-trip
+    # contract — same-family Clip/LeakyRelu/Elu all have both directions
     raise MXNetError(f"ONNX export: LeakyReLU act_type {t!r} unsupported")
 
 
@@ -433,9 +435,14 @@ def export_model(sym, params, in_shapes=None, in_types=None,
     graph = P.MessageWriter()
     extra: Dict[str, Any] = {"initializers": []}
     if in_types:
-        # element type for typed scalar consts (Clip bounds must match T)
+        # element type for typed scalar consts (Clip bounds must match T).
+        # Only a FLOAT graph type is safe to adopt: for mixed graphs whose
+        # first input is integer (token ids), float32 bounds stay correct
+        # for the float activations clip actually runs on
         try:
-            extra["elem_np_dtype"] = str(onp.dtype(in_types[0]))
+            dt = onp.dtype(in_types[0])
+            if dt.kind == "f":
+                extra["elem_np_dtype"] = str(dt)
         except TypeError:
             pass
     emitted: Dict[int, str] = {}

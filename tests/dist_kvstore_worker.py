@@ -73,6 +73,21 @@ def main(outdir):
     kva.pushpull("a", a)
     results["async_sum"] = a.asnumpy().tolist()
 
+    # gradient compression ACROSS processes (reference kCompressedPushPull,
+    # kvstore_dist_server.h:52): 2bit quantization with per-rank error
+    # feedback applied before the cross-process reduction
+    kvc = mx.kvstore.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g1 = nd.array(onp.array([1.0, 0.2, -1.0, 0.0], "float32"))
+    kvc.pushpull("cg", g1)
+    # per rank quantized to [0.5, 0, -0.5, 0]; summed over 2 ranks
+    results["compressed_round1"] = g1.asnumpy().tolist()
+    # round 2 with zero grads: the residual [0.5, 0.2, -0.5, 0] re-emits
+    # the 0.5 magnitudes (error feedback survives the process boundary)
+    g2 = nd.zeros((4,))
+    kvc.pushpull("cg", g2)
+    results["compressed_round2"] = g2.asnumpy().tolist()
+
     kv.barrier()
     with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
         json.dump(results, f)

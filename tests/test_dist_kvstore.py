@@ -66,3 +66,10 @@ def test_launch_local_two_process_dist_kvstore(tmp_path):
     # async mode also reduced correctly
     onp.testing.assert_allclose(r0["async_sum"], [3.0] * 2)
     onp.testing.assert_allclose(r1["async_sum"], [3.0] * 2)
+    # 2bit compression before the cross-process reduce: each rank emits
+    # [±0.5, 0, ∓...] and error feedback re-emits held-back mass next round
+    for r in (r0, r1):
+        onp.testing.assert_allclose(r["compressed_round1"],
+                                    [1.0, 0.0, -1.0, 0.0])
+        onp.testing.assert_allclose(r["compressed_round2"],
+                                    [1.0, 0.0, -1.0, 0.0])
