@@ -436,13 +436,14 @@ def export_model(sym, params, in_shapes=None, in_types=None,
     extra: Dict[str, Any] = {"initializers": []}
     if in_types:
         # element type for typed scalar consts (Clip bounds must match T).
-        # Only a FLOAT graph type is safe to adopt: for mixed graphs whose
-        # first input is integer (token ids), float32 bounds stay correct
-        # for the float activations clip actually runs on
+        # Adopted only when EVERY declared input shares one dtype — then
+        # any clip in the graph runs on that type. Mixed-dtype graphs keep
+        # the float32 default (without per-node type inference the clip
+        # input's own type is unknown; documented limitation).
         try:
-            dt = onp.dtype(in_types[0])
-            if dt.kind == "f":
-                extra["elem_np_dtype"] = str(dt)
+            dts = {str(onp.dtype(t)) for t in in_types if t}
+            if len(dts) == 1:
+                extra["elem_np_dtype"] = next(iter(dts))
         except TypeError:
             pass
     emitted: Dict[int, str] = {}
@@ -651,7 +652,10 @@ def import_model(model_file: str):
         op = _get_str(f, 4)
         attrs = _parse_attrs(f.get(5, []))
         s = _import_node(op, name, ins, outs, attrs, sym_in, const_of)
-        sym_of[outs[0]] = s
+        if isinstance(s, dict):      # multi-output node (Split)
+            sym_of.update(s)
+        else:
+            sym_of[outs[0]] = s
         last_out = outs[0]
 
     out_names = [_get_str(P.parse_message(vi), 1)
@@ -830,6 +834,29 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
         return S("concat", ins, {"dim": int(attrs.get("axis", 1))})
     if op == "Dropout":
         return S("identity", ins[:1])
+    if op == "Split":
+        num = len(outs)
+        if len(ins) > 1 and ins[1]:   # opset-13 split-sizes input tensor
+            sizes = consts.get(ins[1])
+            if sizes is None:
+                raise MXNetError("ONNX import: dynamic Split sizes "
+                                 "unsupported")
+            if len(set(int(v) for v in sizes)) != 1:
+                raise MXNetError("ONNX import: unequal Split sizes "
+                                 "unsupported (equal chunks only)")
+        elif "split" in attrs and \
+                len(set(int(v) for v in attrs["split"])) != 1:
+            raise MXNetError("ONNX import: unequal Split sizes unsupported")
+        axis = int(attrs.get("axis", 0))
+        src = sym_in(ins[0])
+        group = object()  # one shared eval of the split per forward
+        result = {}
+        for i, o in enumerate(outs):
+            node = Symbol("split", name, [src],
+                          {"num_outputs": num, "axis": axis}, out_index=i)
+            node._group_key = group
+            result[o] = node
+        return result
     raise MXNetError(f"ONNX import: unsupported op {op!r} (node {name!r})")
 
 

@@ -43,6 +43,13 @@ def _eval_node(sym, feeds: Dict[str, NDArray], cache: Dict[int, NDArray]):
     # (reshape/Cast) — Variable nodes never reach this branch
     attrs = {k: v for k, v in sym._attrs.items() if v is not None}
     opname = sym._op
+    # sibling outputs of one multi-output node (ONNX Split import) share a
+    # _group_key: the op evaluates ONCE per forward, outputs index into it
+    gk = getattr(sym, "_group_key", None)
+    if gk is not None and gk in cache:
+        val = cache[gk][sym._out_index]
+        cache[id(sym)] = val
+        return val
     if opname.endswith("_scalar"):
         base = opname[:-len("_scalar")]
         scalar = attrs.pop("scalar")
@@ -54,6 +61,8 @@ def _eval_node(sym, feeds: Dict[str, NDArray], cache: Dict[int, NDArray]):
             raise MXNetError(f"symbol op {opname!r} has no nd implementation")
         val = fn(*ins, **attrs)
     if isinstance(val, (list, tuple)):
+        if gk is not None:
+            cache[gk] = val
         val = val[sym._out_index]
     cache[id(sym)] = val
     return val
