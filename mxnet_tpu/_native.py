@@ -94,6 +94,15 @@ def _declare(lib):
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
     except AttributeError:
         pass
+    try:
+        lib.MXTImagePNGInfo.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.MXTImagePNGDecode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+    except AttributeError:
+        pass
 
 
 def get_lib():

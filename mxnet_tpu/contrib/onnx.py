@@ -441,9 +441,13 @@ def export_model(sym, params, in_shapes=None, in_types=None,
         # the float32 default (without per-node type inference the clip
         # input's own type is unknown; documented limitation).
         try:
-            dts = {str(onp.dtype(t)) for t in in_types if t}
-            if len(dts) == 1:
-                extra["elem_np_dtype"] = next(iter(dts))
+            dts = {onp.dtype(t) for t in in_types if t}
+            # uniform AND float: clip almost always runs on float
+            # activations, so int-only declared inputs (embedding token
+            # ids feeding a float network) must NOT type the bounds;
+            # int-typed Clip graphs would need per-node type inference
+            if len(dts) == 1 and next(iter(dts)).kind == "f":
+                extra["elem_np_dtype"] = str(next(iter(dts)))
         except TypeError:
             pass
     emitted: Dict[int, str] = {}

@@ -37,20 +37,25 @@ def _as_np(img):
 
 
 def _native_jpeg_decode(payload: bytes, flag: int):
-    """GIL-free libjpeg decode (src/native/image.cc — the OpenCV-thread
-    analog of the reference pipeline). None when unavailable / not JPEG."""
-    if not payload.startswith(b"\xff\xd8"):
-        return None  # not a JPEG stream
+    """GIL-free libjpeg/libpng decode (src/native/image*.cc — the
+    OpenCV-thread analog of the reference pipeline). Dispatches on magic
+    bytes; None when unavailable or an unsupported format."""
+    if payload.startswith(b"\xff\xd8"):
+        info_name, dec_name = "MXTImageJPEGInfo", "MXTImageJPEGDecode"
+    elif payload.startswith(b"\x89PNG\r\n\x1a\n"):
+        info_name, dec_name = "MXTImagePNGInfo", "MXTImagePNGDecode"
+    else:
+        return None
     from .. import _native
     lib = _native.get_lib()
-    if lib is None or not hasattr(lib, "MXTImageJPEGDecode"):
+    if lib is None or not hasattr(lib, dec_name):
         return None
     import ctypes
     h = ctypes.c_int()
     w = ctypes.c_int()
     c = ctypes.c_int()
-    if lib.MXTImageJPEGInfo(payload, len(payload), ctypes.byref(h),
-                            ctypes.byref(w), ctypes.byref(c)) != 0:
+    if getattr(lib, info_name)(payload, len(payload), ctypes.byref(h),
+                               ctypes.byref(w), ctypes.byref(c)) != 0:
         return None
     # decompression-bomb guard (PIL's Image.MAX_IMAGE_PIXELS analog): the
     # header dims are untrusted — don't allocate for absurd claims
@@ -58,7 +63,7 @@ def _native_jpeg_decode(payload: bytes, flag: int):
         return None  # PIL path applies its own bomb check / error
     out_c = 1 if flag == 0 else 3
     out = onp.empty((h.value, w.value, out_c), onp.uint8)
-    rc = lib.MXTImageJPEGDecode(payload, len(payload),
+    rc = getattr(lib, dec_name)(payload, len(payload),
                                 out.ctypes.data_as(
                                     ctypes.POINTER(ctypes.c_uint8)),
                                 out_c)

@@ -1,0 +1,77 @@
+// Native PNG decode via libpng's simplified API.
+//
+// Reference analog: the same OpenCV decode-thread role as image.cc (JPEG);
+// PNG is the second format the reference pipeline decodes
+// (src/io/image_recordio parsing accepts any cv::imdecode format).
+//
+// Conversion parity contract (the Python fallback is PIL): the source is
+// always decoded as RGBA, then alpha is DROPPED (PIL convert("RGB")
+// semantics — no background compositing) and grayscale uses the ITU-R
+// 601-2 luma transform PIL applies (L = (299R + 587G + 114B) / 1000), so
+// native and fallback paths are pixel-identical.
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include <png.h>
+
+#include "mxt_native.h"
+
+extern "C" {
+
+int MXTImagePNGInfo(const uint8_t *data, size_t len, int *h, int *w,
+                    int *c) {
+  png_image img;
+  std::memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, data, len)) {
+    MXTSetLastError(img.message);
+    return -1;
+  }
+  *h = static_cast<int>(img.height);
+  *w = static_cast<int>(img.width);
+  *c = PNG_IMAGE_SAMPLE_CHANNELS(img.format);
+  png_image_free(&img);
+  return 0;
+}
+
+// Decode into out (h*w*out_c HWC uint8); out_c 3 = RGB, 1 = grayscale.
+int MXTImagePNGDecode(const uint8_t *data, size_t len, uint8_t *out,
+                      int out_c) {
+  if (out_c != 1 && out_c != 3) {
+    MXTSetLastError("MXTImagePNGDecode: out_c must be 1 or 3");
+    return -1;
+  }
+  png_image img;
+  std::memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, data, len)) {
+    MXTSetLastError(img.message);
+    return -1;
+  }
+  img.format = PNG_FORMAT_RGBA;  // deterministic: no background composite
+  const size_t n = static_cast<size_t>(img.height) * img.width;
+  std::vector<uint8_t> rgba(n * 4);
+  if (!png_image_finish_read(&img, nullptr, rgba.data(), 0, nullptr)) {
+    MXTSetLastError(img.message);
+    png_image_free(&img);
+    return -1;
+  }
+  const uint8_t *src = rgba.data();
+  if (out_c == 3) {
+    for (size_t i = 0; i < n; ++i) {  // drop alpha (PIL convert("RGB"))
+      out[i * 3 + 0] = src[i * 4 + 0];
+      out[i * 3 + 1] = src[i * 4 + 1];
+      out[i * 3 + 2] = src[i * 4 + 2];
+    }
+  } else {
+    for (size_t i = 0; i < n; ++i) {  // ITU-R 601-2 luma (PIL "L")
+      const uint32_t l = 299u * src[i * 4] + 587u * src[i * 4 + 1]
+                       + 114u * src[i * 4 + 2];
+      out[i] = static_cast<uint8_t>(l / 1000u);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -88,6 +88,12 @@ int MXTImageJPEGInfo(const uint8_t *data, size_t len, int *h, int *w,
 int MXTImageJPEGDecode(const uint8_t *data, size_t len, uint8_t *out,
                        int out_c);
 
+/* ---- PNG decode (libpng simplified API; optional like JPEG) ---- */
+int MXTImagePNGInfo(const uint8_t *data, size_t len, int *h, int *w,
+                    int *c);
+int MXTImagePNGDecode(const uint8_t *data, size_t len, uint8_t *out,
+                      int out_c);
+
 /* ---- threaded prefetching reader ---- */
 int MXTPrefetchCreate(const char *path, int capacity, MXTPrefetchHandle *out);
 /* Blocking pop; at EOF returns 0 with *out_len == 0. The buffer is owned

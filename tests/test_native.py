@@ -313,3 +313,53 @@ def test_native_jpeg_decode_matches_pil():
     # corrupt JPEG raises through the fallback, not a crash
     with pytest.raises(Exception):
         imdecode(b"\xff\xd8corrupt")
+
+
+def test_native_png_decode_lossless():
+    """src/native/image_png.cc: PNG decodes bit-exact (lossless format),
+    RGB and grayscale, dispatched by magic bytes through the same decode
+    entry as JPEG."""
+    import io
+    from mxnet_tpu import _native
+    from mxnet_tpu.image.image import imdecode, _native_jpeg_decode
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    lib = _native.get_lib()
+    if not hasattr(lib, "MXTImagePNGDecode"):
+        pytest.skip("built without libpng")
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("PIL unavailable")
+    rng = onp.random.RandomState(9)
+    img = rng.randint(0, 255, (24, 30, 3)).astype("uint8")
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    payload = buf.getvalue()
+    native = _native_jpeg_decode(payload, 1)
+    assert native is not None
+    onp.testing.assert_array_equal(native, img)
+    # grayscale conversion parity with the PIL fallback (ITU-R 601-2 luma,
+    # ±1 LSB integer rounding)
+    g = _native_jpeg_decode(payload, 0)[..., 0]
+    pil_g = onp.asarray(Image.open(io.BytesIO(payload)).convert("L"))
+    assert int(onp.abs(g.astype(int) - pil_g.astype(int)).max()) <= 1
+    onp.testing.assert_array_equal(imdecode(payload).asnumpy(), img)
+    # RGBA: deterministic and PIL-parity (alpha DROPPED, not composited)
+    rgba = rng.randint(0, 255, (12, 12, 4)).astype("uint8")
+    abuf = io.BytesIO()
+    Image.fromarray(rgba, "RGBA").save(abuf, format="PNG")
+    ap = abuf.getvalue()
+    d1 = _native_jpeg_decode(ap, 1)
+    onp.testing.assert_array_equal(d1, _native_jpeg_decode(ap, 1))
+    onp.testing.assert_array_equal(
+        d1, onp.asarray(Image.open(io.BytesIO(ap)).convert("RGB")))
+    # grayscale-source PNG expands to 3 channels on color decode
+    gbuf = io.BytesIO()
+    Image.fromarray(img[..., 0]).save(gbuf, format="PNG")
+    g3 = _native_jpeg_decode(gbuf.getvalue(), 1)
+    assert g3.shape == (24, 30, 3)
+    onp.testing.assert_array_equal(g3[..., 0], img[..., 0])
+    # corrupt PNG falls back (PIL raises) rather than crashing
+    with pytest.raises(Exception):
+        imdecode(b"\x89PNG\r\n\x1a\ncorrupt")
