@@ -434,20 +434,23 @@ def export_model(sym, params, in_shapes=None, in_types=None,
 
     graph = P.MessageWriter()
     extra: Dict[str, Any] = {"initializers": []}
-    if in_types:
-        # element type for typed scalar consts (Clip bounds must match T).
-        # Adopted only when EVERY declared input shares one dtype — then
-        # any clip in the graph runs on that type. Mixed-dtype graphs keep
-        # the float32 default (without per-node type inference the clip
-        # input's own type is unknown; documented limitation).
+    # element type for typed scalar consts (Clip bounds must match the
+    # tensor type T they clamp). Without per-node type inference the best
+    # available signal is, in order: a single float dtype shared by every
+    # PARAMETER (weights type the activations — covers int-token-id models
+    # with float weights), else a single dtype shared by every declared
+    # input (covers all-int graphs whose clip genuinely runs on ints),
+    # else the float32 default. Documented limitation for mixed graphs.
+    param_dts = {str(onp.asarray(v.asnumpy()).dtype)
+                 for v in params.values()
+                 if onp.asarray(v.asnumpy()).dtype.kind == "f"}
+    if len(param_dts) == 1:
+        extra["elem_np_dtype"] = next(iter(param_dts))
+    elif in_types:
         try:
-            dts = {onp.dtype(t) for t in in_types if t}
-            # uniform AND float: clip almost always runs on float
-            # activations, so int-only declared inputs (embedding token
-            # ids feeding a float network) must NOT type the bounds;
-            # int-typed Clip graphs would need per-node type inference
-            if len(dts) == 1 and next(iter(dts)).kind == "f":
-                extra["elem_np_dtype"] = str(next(iter(dts)))
+            dts = {str(onp.dtype(t)) for t in in_types if t}
+            if len(dts) == 1:
+                extra["elem_np_dtype"] = next(iter(dts))
         except TypeError:
             pass
     emitted: Dict[int, str] = {}
@@ -718,11 +721,19 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
                  {"a_min": float("-inf") if a_min is None else a_min,
                   "a_max": float("inf") if a_max is None else a_max})
     if op in ("Min", "Max"):
-        if len(ins) != 2:
-            raise MXNetError(f"ONNX import: variadic {op} with {len(ins)} "
-                             "inputs unsupported (2 expected)")
-        return S("broadcast_minimum" if op == "Min" else "broadcast_maximum",
-                 ins)
+        if len(ins) < 1:
+            raise MXNetError(f"ONNX import: {op} needs at least one input")
+        mx_op = "broadcast_minimum" if op == "Min" else "broadcast_maximum"
+        if len(ins) == 1:
+            return S("identity", ins)
+        # variadic form folds left as a chain of pairwise ops; the final
+        # link carries the ONNX node's name
+        acc = sym_in(ins[0])
+        last = len(ins) - 2
+        for j, nxt in enumerate(ins[1:]):
+            acc = Symbol(mx_op, name if j == last else f"{name}_fold{j}",
+                         [acc, sym_in(nxt)], {})
+        return acc
     if op == "LeakyRelu":
         return S("LeakyReLU", ins, {"act_type": "leaky",
                                     "slope": float(attrs.get("alpha", 0.01))})

@@ -36,6 +36,23 @@ def _as_np(img):
     return img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
 
 
+def _png_has_colorspace_chunk(payload: bytes) -> bool:
+    """Walk PNG chunks up to the pixel data; True when a colorspace chunk
+    (gAMA/iCCP/cHRM) is present — those files must decode through PIL."""
+    import struct as _s
+    pos = 8
+    n = len(payload)
+    while pos + 8 <= n:
+        (length,) = _s.unpack(">I", payload[pos:pos + 4])
+        ctype = payload[pos + 4:pos + 8]
+        if ctype in (b"gAMA", b"iCCP", b"cHRM"):
+            return True
+        if ctype in (b"IDAT", b"IEND"):
+            return False
+        pos += 12 + length
+    return False
+
+
 def _native_jpeg_decode(payload: bytes, flag: int):
     """GIL-free libjpeg/libpng decode (src/native/image*.cc — the
     OpenCV-thread analog of the reference pipeline). Dispatches on magic
@@ -43,6 +60,10 @@ def _native_jpeg_decode(payload: bytes, flag: int):
     if payload.startswith(b"\xff\xd8"):
         info_name, dec_name = "MXTImageJPEGInfo", "MXTImageJPEGDecode"
     elif payload.startswith(b"\x89PNG\r\n\x1a\n"):
+        if _png_has_colorspace_chunk(payload):
+            # libpng's simplified API gamma-converts gAMA/iCCP/cHRM files
+            # to sRGB; PIL ignores the tags — route to PIL for parity
+            return None
         info_name, dec_name = "MXTImagePNGInfo", "MXTImagePNGDecode"
     else:
         return None

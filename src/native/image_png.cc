@@ -50,6 +50,10 @@ int MXTImagePNGDecode(const uint8_t *data, size_t len, uint8_t *out,
     return -1;
   }
   img.format = PNG_FORMAT_RGBA;  // deterministic: no background composite
+  // NOTE: gamma/colorspace-tagged files (gAMA/iCCP/cHRM) never reach this
+  // path — the Python dispatcher routes them to PIL, because the
+  // simplified API unconditionally converts such files to sRGB while PIL
+  // ignores the tags (the pixel-parity contract in the header)
   const size_t n = static_cast<size_t>(img.height) * img.width;
   std::vector<uint8_t> rgba(n * 4);
   if (!png_image_finish_read(&img, nullptr, rgba.data(), 0, nullptr)) {

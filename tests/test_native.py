@@ -363,3 +363,33 @@ def test_native_png_decode_lossless():
     # corrupt PNG falls back (PIL raises) rather than crashing
     with pytest.raises(Exception):
         imdecode(b"\x89PNG\r\n\x1a\ncorrupt")
+
+
+def test_png_colorspace_chunks_route_to_pil():
+    """gAMA/iCCP/cHRM PNGs must decode through PIL (libpng's simplified
+    API would sRGB-convert them, PIL ignores the tags) — identical pixels
+    either way the library is built."""
+    import io
+    import struct as _s
+    import zlib
+    from mxnet_tpu.image.image import (_native_jpeg_decode, imdecode,
+                                       _png_has_colorspace_chunk)
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("PIL unavailable")
+    rng = onp.random.RandomState(11)
+    img = rng.randint(0, 255, (8, 8, 3)).astype("uint8")
+    b = io.BytesIO()
+    Image.fromarray(img).save(b, format="PNG")
+    raw = b.getvalue()
+    assert not _png_has_colorspace_chunk(raw)
+    ihdr_end = raw.index(b"IHDR") + 4 + 13 + 4
+    gama = _s.pack(">I", 100000)
+    chunk = _s.pack(">I", 4) + b"gAMA" + gama + \
+        _s.pack(">I", zlib.crc32(b"gAMA" + gama) & 0xffffffff)
+    tagged = raw[:ihdr_end] + chunk + raw[ihdr_end:]
+    assert _png_has_colorspace_chunk(tagged)
+    assert _native_jpeg_decode(tagged, 1) is None
+    pil = onp.asarray(Image.open(io.BytesIO(tagged)).convert("RGB"))
+    onp.testing.assert_array_equal(imdecode(tagged).asnumpy(), pil)

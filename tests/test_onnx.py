@@ -369,3 +369,35 @@ def test_split_evaluates_once_per_forward(tmp_path, monkeypatch):
     monkeypatch.setattr(ndm, "split", counting_split)
     test_split_import_multi_output(tmp_path)
     assert calls["n"] == 1, calls
+
+
+def test_variadic_min_import(tmp_path):
+    """ONNX Min/Max are variadic; 3+ inputs fold into pairwise chains."""
+    graph = P.MessageWriter()
+    node = P.MessageWriter()
+    for i in ("a", "b", "c"):
+        node.write_string(1, i)
+    node.write_string(2, "out")
+    node.write_string(3, "m1")
+    node.write_string(4, "Min")
+    graph.write_message(1, node)
+    graph.write_string(2, "g")
+    for nm in ("a", "b", "c"):
+        graph.write_message(11, mxonnx._value_info(nm, (4,)))
+    graph.write_message(12, mxonnx._value_info("out", None))
+    model = P.MessageWriter()
+    model.write_int(1, P.ONNX_IR_VERSION)
+    opset = P.MessageWriter()
+    opset.write_string(1, "")
+    opset.write_int(2, 13)
+    model.write_message(8, opset)
+    model.write_message(7, graph)
+    path = str(tmp_path / "min3.onnx")
+    with open(path, "wb") as f:
+        f.write(model.tobytes())
+    s, args, aux = mxonnx.import_model(path)
+    a = onp.array([1.0, 5.0, 3.0, 0.0], "float32")
+    b = onp.array([2.0, 1.0, 9.0, -1.0], "float32")
+    c = onp.array([0.5, 7.0, 2.0, 4.0], "float32")
+    got = s.eval(a=nd.array(a), b=nd.array(b), c=nd.array(c)).asnumpy()
+    onp.testing.assert_allclose(got, onp.minimum(onp.minimum(a, b), c))
