@@ -347,7 +347,10 @@ def _clip(name, attrs, ins, out, extra):
     names = [ins[0]]
     for suffix, key in (("min", "a_min"), ("max", "a_max")):
         val = attrs.get(key)
-        if val is None:
+        if val is None or (onp.dtype(dt).kind in "iu"
+                           and not onp.isfinite(val)):
+            # absent bound — or an infinite bound that an integer T cannot
+            # represent (a one-sided clip on int data): empty slot
             names.append("")
             continue
         nm = extra["unique"](f"{name}_{suffix}")
@@ -441,12 +444,21 @@ def export_model(sym, params, in_shapes=None, in_types=None,
     # with float weights), else a single dtype shared by every declared
     # input (covers all-int graphs whose clip genuinely runs on ints),
     # else the float32 default. Documented limitation for mixed graphs.
-    param_dts = {str(onp.asarray(v.asnumpy()).dtype)
-                 for v in params.values()
-                 if onp.asarray(v.asnumpy()).dtype.kind == "f"}
+    param_dts = set()
+    any_float_params = False
+    for v in params.values():
+        try:
+            dt = onp.dtype(v.dtype)
+        except TypeError:
+            continue
+        if dt.kind == "f":
+            any_float_params = True
+            param_dts.add(str(dt))
     if len(param_dts) == 1:
         extra["elem_np_dtype"] = next(iter(param_dts))
-    elif in_types:
+    elif in_types and not any_float_params:
+        # no float weights anywhere: the declared input dtype (when
+        # uniform) IS the tensor type clip runs on — safe even for ints
         try:
             dts = {str(onp.dtype(t)) for t in in_types if t}
             if len(dts) == 1:

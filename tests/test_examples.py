@@ -61,5 +61,15 @@ def test_example_moe_pipeline():
 
 
 @pytest.mark.slow
+def test_example_lstm_lm():
+    _run("train_lstm_lm.py", ("x", "--steps", "60"))
+
+
+@pytest.mark.slow
+def test_example_ssd():
+    _run("ssd_detection.py", ("x", "--steps", "25", "--batch", "8"))
+
+
+@pytest.mark.slow
 def test_example_bert():
     _run("train_bert_classifier.py")
