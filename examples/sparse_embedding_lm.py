@@ -4,8 +4,9 @@ example/sparse/matrix_factorization + the row_sparse Embedding docs).
 
 Demonstrates the O(rows-touched) path: ``Embedding(sparse_grad=True)``
 produces a row_sparse weight gradient whose dense (vocab, dim) mirror is
-never materialized, and lazy Adam updates only the rows a batch touched —
-vocabulary rows outside the batch stay bitwise identical.
+never materialized, and lazy Adam (``lazy_update=True`` — opt-in, as in
+the reference) updates only the rows a batch touched — vocabulary rows
+outside the batch stay bitwise identical.
 
 Run: python examples/sparse_embedding_lm.py [--vocab 50000] [--steps 30]
 """
@@ -46,7 +47,8 @@ def main():
     net.initialize()
     w0 = net.emb.weight.data().asnumpy().copy()
     trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 5e-3}, kvstore="tpu")
+                            {"learning_rate": 5e-3, "lazy_update": True},
+                            kvstore="tpu")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
     # synthetic task: class = whether the batch's tokens skew low or high

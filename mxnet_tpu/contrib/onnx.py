@@ -412,8 +412,23 @@ def _scalar_arith(name, attrs, ins, out, extra):
     op = {"add": "Add", "sub": "Sub", "mul": "Mul",
           "div": "Div"}[extra["mx_op"].split("_")[0]]
     cname = extra["unique"](f"{name}_const")
-    extra["initializers"].append(
-        _tensor(cname, onp.asarray(attrs["scalar"], "float32")))
+    # ONNX arithmetic is same-type-T on both operands: type the scalar
+    # like the graph's element dtype (same signal _clip uses)
+    dt = extra.get("elem_np_dtype", "float32")
+    scalar = float(attrs["scalar"])
+    with onp.errstate(over="ignore"):  # overflow raises MXNetError below
+        cast = onp.asarray(scalar, dt)
+    bad_int = onp.dtype(dt).kind in "iu" and float(cast) != scalar
+    bad_float = onp.isfinite(scalar) and not onp.all(onp.isfinite(cast))
+    if bad_int or bad_float:
+        # an integer T cannot carry a fractional/overflowing scalar, and a
+        # narrow float T overflows large scalars to inf — either way the
+        # const would make a silently wrong graph (in-range float rounding
+        # is fine: normal lossy representation)
+        raise MXNetError(
+            f"ONNX export: scalar {scalar} is not representable in the "
+            f"graph element type {dt} ({extra['mx_op']} node {name!r})")
+    extra["initializers"].append(_tensor(cname, cast))
     return [_node(op, [ins[0], cname], [out], name)]
 
 
@@ -670,6 +685,17 @@ def import_model(model_file: str):
         name = _get_str(f, 3) or outs[0]
         op = _get_str(f, 4)
         attrs = _parse_attrs(f.get(5, []))
+        if op == "Constant":
+            # fold into the const table: exporters commonly feed Reshape
+            # shapes / Clip bounds / Slice starts via Constant nodes.
+            # Also register as an initializer (so a Constant consumed as a
+            # tensor operand, e.g. Add, surfaces in arg_params like any
+            # other weight) and as a Variable (so a Constant feeding the
+            # graph output directly still resolves)
+            const_of[outs[0]] = inits[outs[0]] = _constant_value(name, attrs)
+            sym_of.setdefault(outs[0], Variable(outs[0]))
+            last_out = outs[0]
+            continue
         s = _import_node(op, name, ins, outs, attrs, sym_in, const_of)
         if isinstance(s, dict):      # multi-output node (Split)
             sym_of.update(s)
@@ -691,6 +717,23 @@ def import_model(model_file: str):
             else arg_params
         dest[nm] = NDArray(onp.ascontiguousarray(arr))
     return head, arg_params, aux_params
+
+
+def _constant_value(name, attrs) -> onp.ndarray:
+    """Evaluate an ONNX Constant node's single value attribute."""
+    if "value" in attrs:               # TENSOR attr, parsed to ndarray
+        return onp.asarray(attrs["value"])
+    if "value_float" in attrs:
+        return onp.asarray(attrs["value_float"], "float32")
+    if "value_int" in attrs:
+        return onp.asarray(attrs["value_int"], "int64")
+    if "value_floats" in attrs:
+        return onp.asarray(attrs["value_floats"], "float32")
+    if "value_ints" in attrs:
+        return onp.asarray(attrs["value_ints"], "int64")
+    raise MXNetError(f"ONNX import: Constant node {name!r} carries an "
+                     "unsupported value attribute (value/value_float[s]/"
+                     "value_int[s] are handled)")
 
 
 def _import_node(op, name, ins, outs, attrs, sym_in, consts):

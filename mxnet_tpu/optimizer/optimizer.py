@@ -331,12 +331,13 @@ class SGD(Optimizer):
     """SGD with momentum/nesterov-free path (reference optimizer/sgd.py;
     kernels src/operator/optimizer_op.cc sgd_update/sgd_mom_update)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
-        # reference sgd.py lazy_update=True default: engages only when the
-        # gradient arrives row_sparse (Embedding sparse_grad)
+        # reference sgd.py:95 lazy_update=False default; when opted in it
+        # engages only when the gradient arrives row_sparse (Embedding
+        # sparse_grad), skipping wd/momentum on untouched rows
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
@@ -454,11 +455,12 @@ class Adam(Optimizer):
     """Adam (reference optimizer/adam.py; kernel adam_update)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_update=True, **kwargs):
+                 epsilon=1e-8, lazy_update=False, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
-        # reference adam.py lazy_update: row_sparse grads touch only their
-        # rows (bias correction still uses the global step t, as upstream)
+        # reference adam.py:86 lazy_update=False default; when opted in,
+        # row_sparse grads touch only their rows (bias correction still
+        # uses the global step t, as upstream)
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):

@@ -6,9 +6,10 @@
 //
 // Conversion parity contract (the Python fallback is PIL): the source is
 // always decoded as RGBA, then alpha is DROPPED (PIL convert("RGB")
-// semantics — no background compositing) and grayscale uses the ITU-R
-// 601-2 luma transform PIL applies (L = (299R + 587G + 114B) / 1000), so
-// native and fallback paths are pixel-identical.
+// semantics — no background compositing) and grayscale uses the exact
+// fixed-point ITU-R 601-2 luma Pillow computes in ImagingConvert
+// (L = (19595R + 38470G + 7471B + 0x8000) >> 16), so native and fallback
+// paths are pixel-identical.
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -69,10 +70,10 @@ int MXTImagePNGDecode(const uint8_t *data, size_t len, uint8_t *out,
       out[i * 3 + 2] = src[i * 4 + 2];
     }
   } else {
-    for (size_t i = 0; i < n; ++i) {  // ITU-R 601-2 luma (PIL "L")
-      const uint32_t l = 299u * src[i * 4] + 587u * src[i * 4 + 1]
-                       + 114u * src[i * 4 + 2];
-      out[i] = static_cast<uint8_t>(l / 1000u);
+    for (size_t i = 0; i < n; ++i) {  // Pillow's exact fixed-point luma
+      const uint32_t l = 19595u * src[i * 4] + 38470u * src[i * 4 + 1]
+                       + 7471u * src[i * 4 + 2] + 0x8000u;  // L24 rounding
+      out[i] = static_cast<uint8_t>(l >> 16);
     }
   }
   return 0;

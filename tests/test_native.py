@@ -339,11 +339,13 @@ def test_native_png_decode_lossless():
     native = _native_jpeg_decode(payload, 1)
     assert native is not None
     onp.testing.assert_array_equal(native, img)
-    # grayscale conversion parity with the PIL fallback (ITU-R 601-2 luma,
-    # ±1 LSB integer rounding)
+    # grayscale conversion parity with the PIL fallback: bit-exact (the
+    # native path uses Pillow's own fixed-point luma, coefficients AND the
+    # +0x8000 rounding term ImagingConvert's L24 path has carried since
+    # 2013 — if a Pillow build without it ever appears, this drops to ±1)
     g = _native_jpeg_decode(payload, 0)[..., 0]
     pil_g = onp.asarray(Image.open(io.BytesIO(payload)).convert("L"))
-    assert int(onp.abs(g.astype(int) - pil_g.astype(int)).max()) <= 1
+    onp.testing.assert_array_equal(g, pil_g)
     onp.testing.assert_array_equal(imdecode(payload).asnumpy(), img)
     # RGBA: deterministic and PIL-parity (alpha DROPPED, not composited)
     rgba = rng.randint(0, 255, (12, 12, 4)).astype("uint8")

@@ -401,3 +401,90 @@ def test_variadic_min_import(tmp_path):
     c = onp.array([0.5, 7.0, 2.0, 4.0], "float32")
     got = s.eval(a=nd.array(a), b=nd.array(b), c=nd.array(c)).asnumpy()
     onp.testing.assert_allclose(got, onp.minimum(onp.minimum(a, b), c))
+
+
+def test_constant_node_folding_import(tmp_path):
+    """Third-party exporters feed Reshape shapes / operand tensors via
+    Constant nodes rather than initializers; both uses must import."""
+    graph = P.MessageWriter()
+    # Constant -> int64 shape tensor for Reshape
+    cshape = P.MessageWriter()
+    cshape.write_string(2, "shp")
+    cshape.write_string(3, "c_shape")
+    cshape.write_string(4, "Constant")
+    attr = P.MessageWriter()
+    attr.write_string(1, "value")
+    attr.write_message(5, mxonnx._tensor("", onp.asarray([2, 6], "int64")))
+    attr.write_int(20, P.AttrType.TENSOR)
+    cshape.write_message(5, attr)
+    graph.write_message(1, cshape)
+    # Constant -> float tensor consumed as a DATA operand of Add
+    cdata = P.MessageWriter()
+    cdata.write_string(2, "bias")
+    cdata.write_string(3, "c_bias")
+    cdata.write_string(4, "Constant")
+    attr2 = P.MessageWriter()
+    attr2.write_string(1, "value")
+    attr2.write_message(
+        5, mxonnx._tensor("", onp.full((1, 6), 0.5, "float32")))
+    attr2.write_int(20, P.AttrType.TENSOR)
+    cdata.write_message(5, attr2)
+    graph.write_message(1, cdata)
+    # x (3,4) --Reshape(shp)--> (2,6) --Add(bias)--> out
+    rsh = P.MessageWriter()
+    rsh.write_string(1, "x")
+    rsh.write_string(1, "shp")
+    rsh.write_string(2, "r")
+    rsh.write_string(3, "rshp")
+    rsh.write_string(4, "Reshape")
+    graph.write_message(1, rsh)
+    add = P.MessageWriter()
+    add.write_string(1, "r")
+    add.write_string(1, "bias")
+    add.write_string(2, "out")
+    add.write_string(3, "a0")
+    add.write_string(4, "Add")
+    graph.write_message(1, add)
+    graph.write_string(2, "g")
+    graph.write_message(11, mxonnx._value_info("x", (3, 4)))
+    graph.write_message(12, mxonnx._value_info("out", None))
+    model = P.MessageWriter()
+    model.write_int(1, P.ONNX_IR_VERSION)
+    opset = P.MessageWriter()
+    opset.write_string(1, "")
+    opset.write_int(2, 13)
+    model.write_message(8, opset)
+    model.write_message(7, graph)
+    path = str(tmp_path / "const.onnx")
+    with open(path, "wb") as f:
+        f.write(model.tobytes())
+
+    s, args, aux = mxonnx.import_model(path)
+    # shape constant folded away; data constant surfaced as a parameter
+    assert "shp" not in args and "shp" not in aux
+    assert "bias" in args
+    x = onp.arange(12.0, dtype="float32").reshape(3, 4)
+    got = s.eval(x=nd.array(x), bias=args["bias"]).asnumpy()
+    onp.testing.assert_allclose(got, x.reshape(2, 6) + 0.5)
+
+
+def test_scalar_arith_export_matches_param_dtype(tmp_path):
+    """Add/Mul scalar constants must carry the graph element type T, not
+    hardcoded float32 (ONNX same-type-T constraint)."""
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    y = (x * w + 2.0) * 3.0
+    params = {"w": nd.array(onp.ones((4,), "float16"))}
+    path = str(tmp_path / "f16.onnx")
+    mxonnx.export_model(y, params, in_shapes=[(4,)],
+                        in_types=["float16"], onnx_file_path=path)
+    with open(path, "rb") as f:
+        m = P.parse_message(f.read())
+    g = P.parse_message(m[7][0][1])
+    dtypes = set()
+    for wire, t in g.get(5, []):
+        tf = P.parse_message(t)
+        nm = mxonnx._get_str(tf, 8)
+        if "const" in nm:
+            dtypes.add(mxonnx._get_int(tf, 2, -1))
+    assert dtypes == {P.TensorDataType.FLOAT16}, dtypes

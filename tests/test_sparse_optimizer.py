@@ -3,7 +3,7 @@
 
 Reference analog: sparse Embedding grad (src/operator/tensor/indexing_op.cc
 FInferStorageType row_sparse), lazy updates
-(python/mxnet/optimizer/{sgd,adam}.py lazy_update=True backed by
+(python/mxnet/optimizer/{sgd,adam}.py lazy_update opt-in backed by
 src/operator/optimizer_op.cc sparse kernels), kvstore row_sparse push/pull
 (src/kvstore/kvstore_dist_server.h:52 kRowSparsePushPull).
 """
@@ -53,7 +53,8 @@ def test_sgd_lazy_update_touches_only_live_rows():
     vals = rng.randn(2, dim).astype("float32")
     grad = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
 
-    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                  lazy_update=True)
     assert sgd.lazy_update
     w = nd.array(w0)
     state = sgd.create_state(0, w)
@@ -79,7 +80,7 @@ def test_adam_lazy_update_touches_only_live_rows():
     rows = onp.array([0, 29], "int32")
     vals = rng.randn(2, dim).astype("float32")
     grad = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
-    adam = opt.Adam(learning_rate=0.01)
+    adam = opt.Adam(learning_rate=0.01, lazy_update=True)
     w = nd.array(w0)
     state = adam.create_state(0, w)
     adam.update(0, w, grad, state)
@@ -126,7 +127,8 @@ def test_trainer_embedding_sparse_end_to_end():
     net.initialize()
     w_init = emb.weight.data().asnumpy().copy()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.5, "momentum": 0.9},
+                            {"learning_rate": 0.5, "momentum": 0.9,
+                             "lazy_update": True},
                             kvstore="tpu")
     used = set()
     losses = []
@@ -161,7 +163,8 @@ def test_sparse_grad_lazy_mirror_not_materialized_in_train_step():
     net.add(emb)
     net.initialize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1}, kvstore="tpu")
+                            {"learning_rate": 0.1, "lazy_update": True},
+                            kvstore="tpu")
     x = nd.array(onp.array([1, 2, 3], "int32"))
     with autograd.record():
         loss = (net(x) ** 2).sum()
@@ -214,7 +217,7 @@ def test_sparse_update_bucketed_compiles():
     """Variable unique-token counts share compiled programs: the row count
     pads to the next power of two before the jitted sparse step."""
     vocab, dim = 64, 2
-    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, lazy_update=True)
     w = nd.array(onp.zeros((vocab, dim), "float32"))
     state = sgd.create_state(0, w)
     for n in (3, 4, 5, 7):   # all bucket to 4 or 8
