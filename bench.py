@@ -1,19 +1,43 @@
 #!/usr/bin/env python
-"""Headline benchmark: Gluon ResNet-50 training throughput, images/sec.
+"""Headline benchmark: Gluon ResNet-50 training throughput + efficiency.
 
 Baseline: reference MXNet-CUDA ResNet-50 training, bs=128 on V100 =
 363.69 img/s (docs/static_site/src/pages/api/faq/perf.md:254; BASELINE.md).
 The driver runs this on one real TPU chip; vs_baseline is img/s-per-chip
 against the V100 row, per BASELINE.json's north star.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+Prints ONE JSON line with the primary metric plus efficiency fields:
+  {"metric": "resnet50_v1_train_img_per_sec", "value": N, "unit": "img/s",
+   "vs_baseline": N, "dtype": "bf16", "tflops": N, "mfu": N,
+   "bert_tokens_per_sec": N, "bert_tflops": N, "bert_mfu": N,
+   "matmul_roofline_tflops": N, "peak_tflops": N, "device": "..."}
+
+- tflops    = FLOPs actually executed per second: XLA's cost_analysis of
+              the one compiled train step (fwd + bwd + update — the whole
+              program the chip runs) / 1e12. Note this is the compiled-
+              program count, not the "3x forward" analytic convention;
+              it is the honest numerator for what the silicon does.
+- mfu       = tflops / peak_tflops for the detected TPU generation.
+- matmul_roofline_tflops = achieved bf16 GEMM rate of a large square
+              matmul on the same chip — the practical ceiling the model
+              competes against (distinguishes "framework leaves perf on
+              the table" from "platform caps throughput").
 
 The whole training step (forward, loss, backward, SGD-momentum update) is one
 donated-buffer XLA computation — the TPU-native answer to the reference's
 CachedOp static_alloc + bulking + fused multi_sgd (SURVEY §3.2/§3.4).
+
+AMP note: ``mx.amp.init()`` is enabled AFTER the eager shape-materializing
+forward and applies inside the jitted step (one compile). bf16 then FLOWS
+between ops (amp/__init__.py), halving HBM activation traffic — the lever
+the reference's fp16 row pulls on V100 (perf.md:196,210).
+
+MXNET_BENCH_MODEL=resnet50|bert runs one model only (bert skips the
+resnet fields and vice versa); default "all" runs both and emits the
+combined line. MXNET_BENCH_DTYPE=fp32 disables AMP.
 """
 import json
+import os
 import sys
 import time
 
@@ -24,136 +48,232 @@ import jax.numpy as jnp
 
 BASELINE_IMG_S = 363.69  # V100 fp32 training, bs=128
 
+# bf16 peak TFLOP/s per chip by device_kind substring (public specs).
+_PEAK_BF16 = [
+    ("v5 lite", 197.0), ("v5litepod", 197.0), ("v5e", 197.0),
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5p", 459.0), ("v5", 459.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+]
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main():
-    import os
-    import mxnet_tpu as mx  # noqa: F401
+def _flush(x):
+    """Force execution to finish: host-fetch one element (the only reliable
+    flush on tunneled platforms where block_until_ready can return before
+    execution)."""
+    return float(jnp.reshape(x, (-1,))[0])
+
+
+def peak_tflops():
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    if jax.default_backend() == "cpu":
+        return None, kind or "cpu"
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak, kind
+    return None, kind
+
+
+def compile_step(step_fn, *args):
+    """AOT-compile the train step ONCE; return (callable, flops). The same
+    executable drives the timed loop — no second jit compile just to read
+    cost_analysis (compiles dominate bench startup on tunneled TPU)."""
+    jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+    try:
+        comp = jitted.lower(*args).compile()
+    except Exception as e:  # pragma: no cover - platform-dependent
+        log(f"bench: AOT lower/compile unavailable ({type(e).__name__}); "
+            "falling back to jit")
+        return jitted, None
+    flops = None
+    try:
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        f = float(ca.get("flops", 0.0))
+        flops = f if f > 0 else None
+    except Exception as e:  # pragma: no cover - platform-dependent
+        log(f"bench: cost_analysis unavailable ({type(e).__name__})")
+    return comp, flops
+
+
+def matmul_roofline():
+    """Achieved bf16 GEMM TFLOP/s on a large square matmul — the practical
+    single-chip ceiling. Skipped on CPU (meaningless there)."""
+    if jax.default_backend() == "cpu":
+        return None
+    n, iters = 8192, 30
+    a = jnp.asarray(onp.random.randn(n, n), jnp.bfloat16)
+    f = jax.jit(lambda a, c: a @ c)
+    c = f(a, a)
+    _flush(c)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c = f(a, c)
+    _flush(c)
+    dt = time.perf_counter() - t0
+    return 2 * n ** 3 * iters / dt / 1e12
+
+
+def bench_resnet(dtype):
+    import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     from __graft_entry__ import make_train_step, _init_net
 
-    backend = jax.default_backend()
-    on_accel = backend != "cpu"
+    on_accel = jax.default_backend() != "cpu"
     bs = 128 if on_accel else 4
     size = 224 if on_accel else 32
     warmup = 3 if on_accel else 1
     steps = 20 if on_accel else 2
-    # bf16 AMP by default (the MXU's native mode; reference's own fp16 row
-    # shows ~2x over fp32, perf.md:196,210). MXNET_BENCH_DTYPE=fp32 reverts.
-    dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
-    if dtype not in ("bf16", "fp32"):
-        raise SystemExit(f"MXNET_BENCH_DTYPE must be bf16|fp32, got {dtype}")
-    if dtype == "bf16":
-        mx.amp.init()  # bf16 compute on MXU ops, fp32 master weights
-    log(f"bench: backend={backend} bs={bs} size={size} steps={steps} "
-        f"dtype={dtype}")
 
     onp.random.seed(0)
     net = vision.resnet50_v1(classes=1000)
+    # eager init runs BEFORE amp.init(): the fp32 eager path is
+    # compile-cached across runs, while flowing-bf16 eager would trigger
+    # ~100 fresh remote compiles on tunneled platforms
     params = _init_net(net, (1, 3, size, size))
-    train_step = make_train_step(net, params, lr=0.1)
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    if dtype == "bf16":
+        mx.amp.init()
+    try:
+        train_step = make_train_step(net, params, lr=0.1)
 
-    # copy the initial buffers: donation must not invalidate the live
-    # Parameters still referenced by the Gluon net
-    pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
-    mom = tuple(jnp.zeros_like(d) for d in pd)
-    x = jnp.asarray(onp.random.uniform(size=(bs, 3, size, size))
-                    .astype("float32"))
-    y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,)).astype("int32"))
-    key = jax.random.PRNGKey(0)
+        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
+        mom = tuple(jnp.zeros_like(d) for d in pd)
+        x = jnp.asarray(onp.random.uniform(size=(bs, 3, size, size))
+                        .astype("float32"))
+        y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,))
+                        .astype("int32"))
+        key = jax.random.PRNGKey(0)
 
-    t0 = time.perf_counter()
-    for _ in range(warmup):
-        pd, mom, loss = step(pd, mom, x, y, key)
-    jax.block_until_ready(loss)
-    log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
-        f"loss={float(loss):.3f}")
+        step, flops = compile_step(train_step, pd, mom, x, y, key)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        pd, mom, loss = step(pd, mom, x, y, key)
-    lv = float(loss)  # host fetch: the only reliable flush on tunneled
-    # platforms where block_until_ready can return before execution
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(warmup):
+            pd, mom, loss = step(pd, mom, x, y, key)
+        _flush(loss)
+        log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
+            f"loss={float(loss):.3f}")
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pd, mom, loss = step(pd, mom, x, y, key)
+        _flush(loss)
+        dt = time.perf_counter() - t0
+        log(f"bench: final loss={float(loss):.3f}")
+    finally:
+        if dtype == "bf16":
+            mx.amp.uninit()
     img_s = bs * steps / dt
-    log(f"bench: final loss={lv:.3f}")
-
-    # NOTE on dtype: XLA-on-TPU runs fp32 convs/matmuls as bf16 MXU passes
-    # by DEFAULT precision, so fp32 and amp-bf16 throughput are within noise
-    # here — the V100's fp16-vs-fp32 2x (perf.md:196,210) has no TPU analog
-    # because there is no separate fp32 pipeline to escape from. The metric
-    # name stays constant across dtypes so the series (BENCH_r01 →) tracks;
-    # the dtype rides in its own field.
-    print(json.dumps({
-        "metric": "resnet50_v1_train_img_per_sec",
-        "value": round(img_s, 2),
-        "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-        "dtype": dtype,
-    }))
+    tfs = flops * steps / dt / 1e12 if flops and on_accel else None
+    return {"img_s": img_s, "tflops": tfs, "bs": bs}
 
 
-def main_bert():
-    """Secondary benchmark (MXNET_BENCH_MODEL=bert): BERT-base MLM-style
-    training tokens/sec/chip — the BASELINE.md north-star language metric.
-    Flash attention (Pallas on TPU) backs every layer."""
+def bench_bert(dtype):
+    import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import bert
     from __graft_entry__ import make_train_step
 
-    backend = jax.default_backend()
-    on_accel = backend != "cpu"
+    on_accel = jax.default_backend() != "cpu"
     bs, seqlen = (32, 512) if on_accel else (2, 32)
     warmup, steps = (3, 10) if on_accel else (1, 2)
-    log(f"bench[bert]: backend={backend} bs={bs} seq={seqlen}")
+    log(f"bench[bert]: bs={bs} seq={seqlen}")
 
     onp.random.seed(0)
     net = bert.BERTClassifier(
         bert.bert_base(max_length=seqlen) if on_accel
         else bert.bert_small_test(), num_classes=2)
-    tokens = onp.random.randint(0, 1000, size=(1, seqlen)).astype("int32")
+    vocab = 1000 if on_accel else 128  # stay inside the model's vocab
+    tokens = onp.random.randint(0, vocab, size=(1, seqlen)).astype("int32")
     net.initialize()
-    import mxnet_tpu as mx
-    net(mx.nd.array(tokens))
-    params = [p for p in net.collect_params().values()
-              if p._data is not None]
-    train_step = make_train_step(net, params, lr=0.01)
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    net(mx.nd.array(tokens))  # eager init pre-AMP (see bench_resnet note)
+    if dtype == "bf16":
+        mx.amp.init()
+    try:
+        params = [p for p in net.collect_params().values()
+                  if p._data is not None]
+        # lr small enough that random-label steps stay finite on every
+        # config (throughput is lr-independent)
+        train_step = make_train_step(net, params, lr=1e-3)
 
-    pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
-    mom = tuple(jnp.zeros_like(d) for d in pd)
-    x = jnp.asarray(onp.random.randint(0, 1000, size=(bs, seqlen))
-                    .astype("int32"))
-    y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
-    key = jax.random.PRNGKey(0)
+        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
+        mom = tuple(jnp.zeros_like(d) for d in pd)
+        x = jnp.asarray(onp.random.randint(0, vocab, size=(bs, seqlen))
+                        .astype("int32"))
+        y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
+        key = jax.random.PRNGKey(0)
 
-    t0 = time.perf_counter()
-    for _ in range(warmup):
-        pd, mom, loss = step(pd, mom, x, y, key)
-    jax.block_until_ready(loss)
-    log(f"bench[bert]: warmup {time.perf_counter() - t0:.1f}s, "
-        f"loss={float(loss):.3f}")
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        pd, mom, loss = step(pd, mom, x, y, key)
-    lv = float(loss)  # host fetch flush (see main())
-    dt = time.perf_counter() - t0
+        step, flops = compile_step(train_step, pd, mom, x, y, key)
+
+        t0 = time.perf_counter()
+        for _ in range(warmup):
+            pd, mom, loss = step(pd, mom, x, y, key)
+        _flush(loss)
+        log(f"bench[bert]: warmup {time.perf_counter() - t0:.1f}s, "
+            f"loss={float(loss):.3f}")
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pd, mom, loss = step(pd, mom, x, y, key)
+        _flush(loss)
+        dt = time.perf_counter() - t0
+        log(f"bench[bert]: final loss={float(loss):.3f}")
+    finally:
+        if dtype == "bf16":
+            mx.amp.uninit()
     tok_s = bs * seqlen * steps / dt
-    log(f"bench[bert]: final loss={lv:.3f}")
-    print(json.dumps({
-        "metric": "bert_base_train_tokens_per_sec",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": None,  # reference publishes no in-tree BERT number
-    }))
+    tfs = flops * steps / dt / 1e12 if flops and on_accel else None
+    return {"tok_s": tok_s, "tflops": tfs}
+
+
+def main():
+    model = os.environ.get("MXNET_BENCH_MODEL", "all")
+    dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
+    if dtype not in ("bf16", "fp32"):
+        raise SystemExit(f"MXNET_BENCH_DTYPE must be bf16|fp32, got {dtype}")
+    peak, kind = peak_tflops()
+    log(f"bench: backend={jax.default_backend()} device={kind} "
+        f"peak_bf16={peak} model={model} dtype={dtype}")
+
+    out = {}
+    if model in ("all", "resnet50"):
+        r = bench_resnet(dtype)
+        out.update({
+            "metric": "resnet50_v1_train_img_per_sec",
+            "value": round(r["img_s"], 2),
+            "unit": "img/s",
+            "vs_baseline": round(r["img_s"] / BASELINE_IMG_S, 3),
+            "dtype": dtype,
+            "tflops": round(r["tflops"], 2) if r["tflops"] else None,
+            "mfu": round(r["tflops"] / peak, 4)
+            if r["tflops"] and peak else None,
+        })
+    if model in ("all", "bert"):
+        b = bench_bert(dtype)
+        if model == "bert":
+            out.update({
+                "metric": "bert_base_train_tokens_per_sec",
+                "value": round(b["tok_s"], 1),
+                "unit": "tokens/s",
+                "vs_baseline": None,  # no in-tree reference BERT number
+                "dtype": dtype,
+            })
+        out.update({
+            "bert_tokens_per_sec": round(b["tok_s"], 1),
+            "bert_tflops": round(b["tflops"], 2) if b["tflops"] else None,
+            "bert_mfu": round(b["tflops"] / peak, 4)
+            if b["tflops"] and peak else None,
+        })
+    roof = matmul_roofline()
+    out.update({
+        "matmul_roofline_tflops": round(roof, 1) if roof else None,
+        "peak_tflops": peak,
+        "device": kind,
+    })
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    import os
-    if os.environ.get("MXNET_BENCH_MODEL", "resnet50") == "bert":
-        main_bert()
-    else:
-        main()
+    main()

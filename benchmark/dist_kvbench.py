@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Dist-kvstore transfer-path micro-benchmark (VERDICT r2 item 3 artifact).
+
+Measures, on the 2-process local CPU rig, one ResNet-18-shaped gradient
+set (62 dense arrays, ~11.7M params) pushed through KVStoreDist:
+
+- per-key   : one device_put + collective + host sync PER PARAMETER
+              (the reference's engine-op-per-key shape,
+              src/kvstore/kvstore_dist.h without batching)
+- fused     : KVStoreDist.pushpull_list — bucketed collectives
+              (MXNET_KVSTORE_SLICE_THRESHOLD), all dispatched, ONE host
+              sync per step
+
+Run:  python benchmark/dist_kvbench.py          (self-launches 2 workers)
+Prints one JSON line per mode with wall ms/step, collectives/step, and
+host syncs (blocks)/step, plus the sync-reduction ratio.
+
+Reference numbers (this rig, 2 CPU procs, 5 steps): see
+benchmark/dist_kvbench.reference.json.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ResNet-18 parameter shapes (conv OIHW + BN vectors + fc), classes=1000
+def resnet18_shapes():
+    shapes = [(64, 3, 7, 7)]
+    chans = [(64, 64), (64, 64), (64, 64), (64, 64),
+             (128, 64), (128, 128), (128, 128), (128, 128),
+             (256, 128), (256, 256), (256, 256), (256, 256),
+             (512, 256), (512, 512), (512, 512), (512, 512)]
+    for o, i in chans:
+        shapes.append((o, i, 3, 3))
+    for o, i in ((128, 64), (256, 128), (512, 256)):
+        shapes.append((o, i, 1, 1))  # downsample convs
+    for c in [64] + [o for o, _ in chans]:
+        shapes.append((c,))  # gamma
+        shapes.append((c,))  # beta
+    shapes.append((1000, 512))
+    shapes.append((1000,))
+    return shapes
+
+
+def worker(outdir):
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+    sys.path.insert(0, REPO)
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.parallel import dist
+
+    dist.initialize()
+    rank = jax.process_index()
+    shapes = resnet18_shapes()
+    rng = onp.random.RandomState(rank)
+    steps = 5
+    report = {}
+
+    for mode in ("perkey", "fused"):
+        kv = mx.kvstore.create("dist_sync")
+        grads = [nd.array(rng.randn(*s).astype("float32")) for s in shapes]
+        # warmup (compile the collectives)
+        if mode == "fused":
+            kv.pushpull_list(list(range(len(grads))), grads)
+        else:
+            for i, g in enumerate(grads):
+                kv.pushpull(i, g)
+        kv.stats = {"collectives": 0, "blocks": 0}
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            if mode == "fused":
+                kv.pushpull_list(list(range(len(grads))), grads)
+            else:
+                for i, g in enumerate(grads):
+                    kv.pushpull(i, g)
+        dt = time.perf_counter() - t0
+        report[mode] = {
+            "ms_per_step": round(dt / steps * 1e3, 2),
+            "collectives_per_step": kv.stats["collectives"] / steps,
+            "host_syncs_per_step": kv.stats["blocks"] / steps,
+        }
+    if rank == 0:
+        report["nparams"] = len(shapes)
+        report["sync_reduction"] = (
+            report["perkey"]["host_syncs_per_step"]
+            / max(report["fused"]["host_syncs_per_step"], 1))
+        with open(os.path.join(outdir, "kvbench.json"), "w") as f:
+            json.dump(report, f, indent=1)
+        print(json.dumps(report))
+
+
+def main():
+    import tempfile
+    outdir = tempfile.mkdtemp()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "-p", str(port),
+           sys.executable, os.path.abspath(__file__), "--worker", outdir]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=900,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = proc.stdout.decode("utf-8", "replace")
+    if proc.returncode != 0:
+        sys.exit(f"launch failed:\n{out[-3000:]}")
+    path = os.path.join(outdir, "kvbench.json")
+    print(open(path).read())
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker(sys.argv[sys.argv.index("--worker") + 1])
+    else:
+        main()

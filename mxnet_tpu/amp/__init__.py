@@ -6,12 +6,23 @@ symbol_fp16.py), runtime patching of op invocation (amp.py:282), dynamic
 
 TPU-native redesign: the mixed dtype is **bfloat16** — same exponent range
 as f32, so no loss scaling is *required* (the LossScaler is kept for API
-parity and for true fp16). ``amp.init()`` installs an invoke wrapper that
-casts inputs of MXU-bound ops (matmul/conv/attention/rnn) to bf16 and
-returns f32 outputs — XLA then runs the MXU in its native
-bf16-multiply/f32-accumulate mode, which is exactly the reference's
-"fp16 compute, fp32 master weights" recipe with the fragile parts removed.
-Reduction/normalization/loss ops stay f32 (the reference's FP32_FUNCS list).
+parity and for true fp16). ``amp.init()`` installs an invoke wrapper with
+the reference's list semantics (amp.py:282 runtime patching):
+
+- TARGET_DTYPE_OPS (MXU-bound: matmul/conv/attention/rnn) cast f32 inputs
+  down and their outputs FLOW in the low dtype — exactly like the
+  reference's FP16_FUNCS, whose fp16 outputs propagate. This is the
+  performance-critical half: activations between ops live in bf16, halving
+  HBM traffic (the TPU bottleneck), while master weights stay f32.
+- FP32_OPS (softmax/loss/exp-log reductions) cast low-precision inputs UP
+  to f32 (reference FP32_FUNCS).
+- Everything else follows its input dtypes (reference WIDEST_TYPE_CASTS
+  falls out of jnp promotion).
+
+Normalization layers are in FP32_OPS only for true fp16; under bf16 they
+flow bf16 — safe because every norm kernel computes its statistics in f32
+internally (ops/nn.py _stat_dtype), which is the half the reference's
+FP32 pinning actually protects.
 """
 from __future__ import annotations
 
@@ -28,51 +39,66 @@ __all__ = ["init", "uninit", "is_enabled", "init_trainer", "scale_loss",
            "convert_hybrid_block", "LossScaler", "TARGET_DTYPE_OPS",
            "FP32_OPS"]
 
-# MXU-bound ops: cast inputs to the target dtype (reference
-# lists/symbol_fp16.py FP16_FUNCS analog).
+# MXU-bound ops by their INVOKE-FUNNEL names (ops/registry.py invoke_raw
+# call sites — the names the wrapper actually sees): cast inputs to the
+# target dtype (reference lists/symbol_fp16.py FP16_FUNCS analog). The
+# fused RNN layers invoke as "rnn_<mode>", matched by prefix below.
 TARGET_DTYPE_OPS = {
-    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
-    "flash_attention", "flash_attention_vl", "masked_attention", "rnn",
-    "conv", "conv_transpose",
+    "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
+    "linalg_gemm2", "flash_attention", "flash_attention_vl",
+    "masked_attention", "bert_decoder_proj", "moe_ffn",
+    "Correlation", "DeformableConvolution",
 }
 
-# Numerically-sensitive ops pinned to f32 (reference FP32_FUNCS analog).
-# Everything else runs in whatever dtype flows in (WIDEST_TYPE_CASTS
-# behavior falls out of jnp promotion).
-FP32_OPS = {
-    "softmax", "log_softmax", "SoftmaxOutput", "BatchNorm", "LayerNorm",
-    "GroupNorm", "InstanceNorm", "batch_norm_train", "batch_norm_infer",
-    "layer_norm", "group_norm", "instance_norm", "norm", "mean", "sum",
-    "exp", "log", "erf", "smooth_l1",
+# Norm ops: f32-pinned only for true fp16 (their kernels already compute
+# statistics in f32 internally — ops/nn.py _stat_dtype — so bf16 may flow).
+NORM_OPS = {
+    "batch_norm", "layer_norm", "group_norm", "instance_norm",
+    "SyncBatchNorm",
+}
+
+# Numerically-sensitive ops pinned to f32 (reference FP32_FUNCS analog):
+# low-precision inputs are cast UP. Everything else runs in whatever dtype
+# flows in (WIDEST_TYPE_CASTS behavior falls out of jnp promotion).
+FP32_OPS = NORM_OPS | {
+    "softmax", "log_softmax", "softmax_cross_entropy", "norm", "moments",
+    "exp", "log", "l2_normalization", "lrn",
 }
 
 _state = {"enabled": False, "dtype": None, "wrapper": None}
 
 
-def _cast_tree(x, dtype):
+def _cast_down(x, dtype):
     if hasattr(x, "dtype") and hasattr(x, "astype") and \
             x.dtype == jnp.float32:
         return x.astype(dtype)
     return x
 
 
-def _make_wrapper(target_dtype):
-    def wrapper(name, fn):
-        if name not in TARGET_DTYPE_OPS:
-            return fn
+def _cast_up(x, dtype):
+    if hasattr(x, "dtype") and hasattr(x, "astype") and x.dtype == dtype:
+        return x.astype(jnp.float32)
+    return x
 
-        def amp_fn(*args, **kwargs):
-            cast_args = [_cast_tree(a, target_dtype) for a in args]
-            out = fn(*cast_args, **kwargs)
-            if isinstance(out, (tuple, list)):
-                return type(out)(
-                    o.astype(jnp.float32)
-                    if hasattr(o, "dtype") and o.dtype == target_dtype else o
-                    for o in out)
-            if hasattr(out, "dtype") and out.dtype == target_dtype:
-                return out.astype(jnp.float32)
-            return out
-        return amp_fn
+
+def _make_wrapper(target_dtype):
+    fp32_ops = FP32_OPS if target_dtype == jnp.float16 \
+        else FP32_OPS - NORM_OPS
+
+    def wrapper(name, fn):
+        if name in TARGET_DTYPE_OPS or name.startswith("rnn_"):
+            def amp_fn(*args, **kwargs):
+                cast_args = [_cast_down(a, target_dtype) for a in args]
+                # output flows in target_dtype (reference FP16_FUNCS
+                # semantics): activations stay low-precision between ops
+                return fn(*cast_args, **kwargs)
+            return amp_fn
+        if name in fp32_ops:
+            def fp32_fn(*args, **kwargs):
+                cast_args = [_cast_up(a, target_dtype) for a in args]
+                return fn(*cast_args, **kwargs)
+            return fp32_fn
+        return fn
     return wrapper
 
 

@@ -416,10 +416,14 @@ def _scalar_arith(name, attrs, ins, out, extra):
     # like the graph's element dtype (same signal _clip uses)
     dt = extra.get("elem_np_dtype", "float32")
     scalar = float(attrs["scalar"])
-    with onp.errstate(over="ignore"):  # overflow raises MXNetError below
-        cast = onp.asarray(scalar, dt)
-    bad_int = onp.dtype(dt).kind in "iu" and float(cast) != scalar
-    bad_float = onp.isfinite(scalar) and not onp.all(onp.isfinite(cast))
+    try:
+        with onp.errstate(over="ignore"):  # MXNetError raised below
+            cast = onp.asarray(scalar, dt)
+        bad_int = onp.dtype(dt).kind in "iu" and float(cast) != scalar
+        bad_float = onp.isfinite(scalar) and not onp.all(onp.isfinite(cast))
+    except (OverflowError, ValueError):
+        # numpy raises eagerly for int dtypes (out-of-range / NaN scalars)
+        bad_int, bad_float = True, False
     if bad_int or bad_float:
         # an integer T cannot carry a fractional/overflowing scalar, and a
         # narrow float T overflows large scalars to inf — either way the

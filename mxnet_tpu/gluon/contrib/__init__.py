@@ -1,2 +1,4 @@
 """gluon.contrib (reference: python/mxnet/gluon/contrib/)."""
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,114 @@
+"""Contrib layers (reference python/mxnet/gluon/contrib/nn/basic_layers.py).
+
+Concurrent/HybridConcurrent/Identity/SyncBatchNorm already exist in core
+``gluon.nn`` under their 2.0 names (Concatenate et al., the rename the
+reference performed for 2.0); contrib re-exports them under the contrib
+names so reference-era code imports unchanged. PixelShuffle1D/2D/3D are
+implemented here: on TPU they are pure reshape/transpose programs that XLA
+fuses into the surrounding convolutions (no data movement beyond the final
+layout change), the idiomatic form of the reference's sub-pixel
+convolution upsampling (arXiv:1609.05158).
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...nn.basic_layers import (Concatenate, HybridConcatenate, Identity,
+                                SyncBatchNorm, Embedding)
+from ....ndarray import ops as F
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Concatenate):
+    """Run children on the same input and concat outputs along ``axis``
+    (reference contrib Concurrent == 2.0 nn.Concatenate)."""
+
+
+class HybridConcurrent(HybridConcatenate):
+    """Hybridizable Concurrent (reference contrib HybridConcurrent)."""
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row_sparse gradients (reference contrib
+    SparseEmbedding, deprecated upstream in favor of
+    ``nn.Embedding(sparse_grad=True)`` — same here)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         sparse_grad=True, **kwargs)
+
+
+def _factors(factor, n):
+    try:
+        return (int(factor),) * n
+    except TypeError:
+        f = tuple(int(v) for v in factor)
+        if len(f) != n:
+            raise MXNetError(f"factor must be an int or {n}-tuple, got "
+                             f"{factor!r}")
+        return f
+
+
+class PixelShuffle1D(HybridBlock):
+    """(N, f*C, W) -> (N, C, W*f): channel groups of f become W-blocks
+    (reference contrib PixelShuffle1D)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def forward(self, x):
+        f = self._factor
+        n, fc, w = x.shape
+        c = fc // f
+        x = F.reshape(x, (n, c, f, w))          # channel index = c*f + j
+        x = F.transpose(x, axes=(0, 1, 3, 2))   # (N, C, W, f)
+        return F.reshape(x, (n, c, w * f))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factor})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, f1*f2*C, H, W) -> (N, C, H*f1, W*f2) (reference contrib
+    PixelShuffle2D)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factors = _factors(factor, 2)
+
+    def forward(self, x):
+        f1, f2 = self._factors
+        n, c_in, h, w = x.shape
+        c = c_in // (f1 * f2)
+        x = F.reshape(x, (n, c, f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))  # (N, C, H, f1, W, f2)
+        return F.reshape(x, (n, c, h * f1, w * f2))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, D*f1, H*f2, W*f3) (reference
+    contrib PixelShuffle3D; one transpose — XLA handles 7-D permutes, no
+    need for the reference's swapaxes chain that works around a 6-D
+    transpose limit)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factors = _factors(factor, 3)
+
+    def forward(self, x):
+        f1, f2, f3 = self._factors
+        n, c_in, d, h, w = x.shape
+        c = c_in // (f1 * f2 * f3)
+        x = F.reshape(x, (n, c, f1, f2, f3, d, h, w))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, (n, c, d * f1, h * f2, w * f3))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"

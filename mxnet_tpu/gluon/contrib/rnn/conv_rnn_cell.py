@@ -1,0 +1,224 @@
+"""Convolutional recurrent cells (reference
+python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py — 9 public classes).
+
+TPU-native: each step is two XLA convolutions (i2h on the input, h2h on
+the hidden state, both MXU-bound) plus fused gate arithmetic; unrolling
+under hybridize/jit produces one compiled program per sequence length.
+The h2h convolution is constrained to odd kernels with SAME padding
+(dilate*(k-1)//2) exactly like the reference, so the state keeps its
+spatial shape across steps.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ....ndarray import ops as F
+from ....ndarray.nn_ops import Convolution
+from ...parameter import Parameter
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n, name):
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    if len(t) != n:
+        raise MXNetError(f"{name} must be an int or length-{n} tuple, "
+                         f"got {v!r}")
+    return t
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared conv/parameter plumbing for the nine cells."""
+
+    _gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dims=2, conv_layout="NCHW", activation="tanh", **kwargs):
+        super().__init__(**kwargs)
+        if conv_layout != "NC" + "DHW"[3 - dims:]:
+            raise MXNetError(
+                f"only the channel-first layout is supported, got "
+                f"{conv_layout!r} (XLA lays out MXU convs internally; the "
+                "reference's layout knob is a cuDNN artifact)")
+        self._dims = dims
+        self._input_shape = tuple(input_shape)  # (C_in, *spatial)
+        self._hidden_channels = hidden_channels
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, dims, "i2h_kernel")
+        self._i2h_pad = _tup(i2h_pad, dims, "i2h_pad")
+        self._i2h_dilate = _tup(i2h_dilate, dims, "i2h_dilate")
+        self._h2h_kernel = _tup(h2h_kernel, dims, "h2h_kernel")
+        if any(k % 2 == 0 for k in self._h2h_kernel):
+            raise MXNetError(f"h2h_kernel must be odd (SAME padding keeps "
+                             f"the state shape), got {self._h2h_kernel}")
+        self._h2h_dilate = _tup(h2h_dilate, dims, "h2h_dilate")
+        self._h2h_pad = tuple(d * (k - 1) // 2
+                              for d, k in zip(self._h2h_dilate,
+                                              self._h2h_kernel))
+        c_in = self._input_shape[0]
+        ng = self._gates * hidden_channels
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(ng, c_in) + self._i2h_kernel,
+            init=i2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(ng, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng,),
+                                  init=h2h_bias_initializer)
+
+    @property
+    def _state_spatial(self):
+        """Spatial dims of the hidden state: the i2h conv output shape
+        over input_shape (stride 1), same rule as the reference."""
+        out = []
+        for x, k, p, d in zip(self._input_shape[1:], self._i2h_kernel,
+                              self._i2h_pad, self._i2h_dilate):
+            out.append((x + 2 * p - d * (k - 1) - 1) + 1)
+        return tuple(out)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[3 - self._dims:]}]
+
+    def _convs(self, x, h):
+        i2h = Convolution(
+            x, self.i2h_weight.data(), self.i2h_bias.data(),
+            kernel=self._i2h_kernel, stride=(1,) * self._dims,
+            dilate=self._i2h_dilate, pad=self._i2h_pad,
+            num_filter=self._gates * self._hidden_channels)
+        h2h = Convolution(
+            h, self.h2h_weight.data(), self.h2h_bias.data(),
+            kernel=self._h2h_kernel, stride=(1,) * self._dims,
+            dilate=self._h2h_dilate, pad=self._h2h_pad,
+            num_filter=self._gates * self._hidden_channels)
+        return i2h, h2h
+
+    def _act(self, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _gates = 1
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    """Conv LSTM (Shi et al. 2015; gate order [i, f, g, o] like the
+    reference)."""
+
+    _gates = 4
+
+    def state_info(self, batch_size=0):
+        info = super().state_info(batch_size)[0]
+        return [dict(info), dict(info)]
+
+    def forward(self, inputs, states):
+        h, c = states
+        i2h, h2h = self._convs(inputs, h)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(i)
+        f = F.sigmoid(f)
+        g = self._act(g)
+        o = F.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * self._act(c_new)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _gates = 3
+
+    def forward(self, inputs, states):
+        h = states[0]
+        i2h, h2h = self._convs(inputs, h)
+        xr, xz, xn = F.split(i2h, num_outputs=3, axis=1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = self._act(xn + r * hn)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class _DimCell:
+    """Mixin fixing dims/default layout for the public 1/2/3-D cells."""
+
+    _dims = 2
+    _layout = "NCHW"
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout=None, activation="tanh", **kwargs):
+        super().__init__(
+            input_shape=input_shape, hidden_channels=hidden_channels,
+            i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel, i2h_pad=i2h_pad,
+            i2h_dilate=i2h_dilate, h2h_dilate=h2h_dilate,
+            i2h_weight_initializer=i2h_weight_initializer,
+            h2h_weight_initializer=h2h_weight_initializer,
+            i2h_bias_initializer=i2h_bias_initializer,
+            h2h_bias_initializer=h2h_bias_initializer,
+            dims=self._dims,
+            conv_layout=conv_layout if conv_layout is not None
+            else self._layout,
+            activation=activation, **kwargs)
+
+
+class Conv1DRNNCell(_DimCell, _ConvRNNCell):
+    """1D conv RNN cell (reference Conv1DRNNCell)."""
+    _dims, _layout = 1, "NCW"
+
+
+class Conv2DRNNCell(_DimCell, _ConvRNNCell):
+    """2D conv RNN cell (reference Conv2DRNNCell)."""
+    _dims, _layout = 2, "NCHW"
+
+
+class Conv3DRNNCell(_DimCell, _ConvRNNCell):
+    """3D conv RNN cell (reference Conv3DRNNCell)."""
+    _dims, _layout = 3, "NCDHW"
+
+
+class Conv1DLSTMCell(_DimCell, _ConvLSTMCell):
+    """1D conv LSTM cell (reference Conv1DLSTMCell; Shi et al. 2015)."""
+    _dims, _layout = 1, "NCW"
+
+
+class Conv2DLSTMCell(_DimCell, _ConvLSTMCell):
+    """2D conv LSTM cell (reference Conv2DLSTMCell; Shi et al. 2015)."""
+    _dims, _layout = 2, "NCHW"
+
+
+class Conv3DLSTMCell(_DimCell, _ConvLSTMCell):
+    """3D conv LSTM cell (reference Conv3DLSTMCell; Shi et al. 2015)."""
+    _dims, _layout = 3, "NCDHW"
+
+
+class Conv1DGRUCell(_DimCell, _ConvGRUCell):
+    """1D conv GRU cell (reference Conv1DGRUCell)."""
+    _dims, _layout = 1, "NCW"
+
+
+class Conv2DGRUCell(_DimCell, _ConvGRUCell):
+    """2D conv GRU cell (reference Conv2DGRUCell)."""
+    _dims, _layout = 2, "NCHW"
+
+
+class Conv3DGRUCell(_DimCell, _ConvGRUCell):
+    """3D conv GRU cell (reference Conv3DGRUCell)."""
+    _dims, _layout = 3, "NCDHW"

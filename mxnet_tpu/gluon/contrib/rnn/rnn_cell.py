@@ -1,0 +1,128 @@
+"""Contrib recurrent cells (reference
+python/mxnet/gluon/contrib/rnn/rnn_cell.py: VariationalDropoutCell,
+LSTMPCell)."""
+from __future__ import annotations
+
+from ....ndarray import ops as F
+from ...parameter import Parameter
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Variational (time-locked) dropout around a base cell
+    (reference contrib VariationalDropoutCell; Gal & Ghahramani,
+    arXiv:1512.05287): ONE dropout mask per sequence for inputs, for the
+    first state channel, and for outputs — sampled at the first step and
+    reused until ``reset()``. Step manually? call ``reset()`` between
+    sequences, exactly like the reference."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def forward(self, inputs, states):
+        if self.drop_states and self.drop_states_mask is None:
+            self.drop_states_mask = F.Dropout(F.ones_like(states[0]),
+                                              p=self.drop_states)
+        if self.drop_inputs and self.drop_inputs_mask is None:
+            self.drop_inputs_mask = F.Dropout(F.ones_like(inputs),
+                                              p=self.drop_inputs)
+        if self.drop_states:
+            states = list(states)
+            # only h — always the first state channel (reference contract)
+            states[0] = states[0] * self.drop_states_mask
+        if self.drop_inputs:
+            inputs = inputs * self.drop_inputs_mask
+        out, next_states = self.base_cell(inputs, states)
+        if self.drop_outputs and self.drop_outputs_mask is None:
+            self.drop_outputs_mask = F.Dropout(F.ones_like(out),
+                                               p=self.drop_outputs)
+        if self.drop_outputs:
+            out = out * self.drop_outputs_mask
+        return out, next_states
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(p_out={self.drop_outputs}, "
+                f"p_state={self.drop_states})")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (reference contrib LSTMPCell;
+    Sak et al. 2014): the (N, H) hidden is projected to (N, P) before
+    recurring, shrinking the h2h matmul from H×H to 4H×P — the LSTMP
+    trick that keeps big-H cells MXU-efficient. Gate order [i, f, g, o];
+    states ``[r (N, P), c (N, H)]``; the projection has no bias."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer)
+        self.h2r_weight = Parameter(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        if self._input_size == 0:
+            self._input_size = inputs.shape[-1]
+            self.i2h_weight.shape = (self.i2h_weight.shape[0],
+                                     inputs.shape[-1])
+        for p in (self.i2h_weight, self.h2h_weight, self.h2r_weight,
+                  self.i2h_bias, self.h2h_bias):
+            if p._data is None and p._deferred_init_args is not None:
+                p._finish_deferred_init()
+        r, c = states
+        i2h = F.FullyConnected(inputs, self.i2h_weight.data(),
+                               self.i2h_bias.data(),
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(r, self.h2h_weight.data(),
+                               self.h2h_bias.data(),
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        c_new = F.sigmoid(f) * c + F.sigmoid(i) * F.tanh(g)
+        hidden = F.sigmoid(o) * F.tanh(c_new)
+        r_new = F.FullyConnected(hidden, self.h2r_weight.data(), None,
+                                 num_hidden=self._projection_size,
+                                 no_bias=True)
+        return r_new, [r_new, c_new]

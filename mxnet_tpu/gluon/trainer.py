@@ -114,12 +114,16 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if not self._update_on_kvstore:
+            # one fused multi-key call: a dist store packs the collectives
+            # into buckets and pays ONE host sync per step instead of one
+            # per parameter (kvstore.py pushpull_list)
+            keys = list(range(len(self._params)))
+            self._kvstore.pushpull_list(
+                keys, [p.list_grad() for p in self._params])
+            return
         for i, p in enumerate(self._params):
-            grads = p.list_grad()
-            if self._update_on_kvstore:
-                self._kvstore.push(i, grads)
-            else:
-                self._kvstore.pushpull(i, grads)
+            self._kvstore.push(i, p.list_grad())
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False):
         """Apply optimizer only (grads assumed reduced;

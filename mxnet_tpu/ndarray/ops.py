@@ -519,8 +519,14 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
         if _tape.is_recording() and not isinstance(data._data,
                                                    jax.core.Tracer):
             return _embedding_sparse_grad(data, weight)
+    # mode='clip': out-of-range ids clamp to the nearest row. The reference
+    # CPU kernel raises and its GPU kernel reads out of bounds
+    # (indexing_op.h); neither is expressible under jit, and jnp.take's
+    # default fill-with-NaN poisons gradients silently — clamping is the
+    # deterministic TPU-native choice (documented deviation).
     return invoke_raw("embedding",
-                      lambda idx, w: jnp.take(w, idx.astype(jnp.int32), axis=0),
+                      lambda idx, w: jnp.take(w, idx.astype(jnp.int32),
+                                              axis=0, mode="clip"),
                       [data, weight])
 
 

@@ -53,15 +53,16 @@ def conv(x, w, b=None, stride=None, dilate=None, pad=None, num_group: int = 1):
     dilate = _tup(dilate, ndim)
     pad = _tup(pad if pad is not None else 0, ndim)
     dn = _conv_dn(ndim)
+    # NOTE: no preferred_element_type — the TPU MXU accumulates bf16 convs
+    # in f32 internally regardless (one rounding at the output), and this
+    # jax version's conv VJP mis-types the transposed conv when preferred
+    # differs from the input dtype (bf16 primal vs f32 cotangent)
     out = lax.conv_general_dilated(
         x, w, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
         dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        feature_group_count=num_group)
     if b is not None:
         out = out + b.reshape((1, -1) + (1,) * ndim)
     return out
@@ -186,60 +187,88 @@ def _bcast_stats(ndim, v):
     return v.reshape((1, -1) + (1,) * (ndim - 2))
 
 
+def _stat_dtype(x):
+    """Normalization statistics accumulate in f32 even when activations
+    flow bf16/fp16 (AMP): same recipe as every production TPU BN — the
+    low-precision tensor is only the storage format, never the reduction
+    accumulator. f64 inputs keep f64."""
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
 def batch_norm_infer(x, gamma, beta, moving_mean, moving_var, eps: float):
-    """Inference-mode BN: normalize with running stats."""
-    mm, mv = _bcast_stats(x.ndim, moving_mean), _bcast_stats(x.ndim, moving_var)
-    g, b = _bcast_stats(x.ndim, gamma), _bcast_stats(x.ndim, beta)
+    """Inference-mode BN: normalize with running stats (f32 arithmetic,
+    output in the activation dtype)."""
+    dt = _stat_dtype(x)
+    xf = x.astype(dt)
+    mm = _bcast_stats(x.ndim, moving_mean).astype(dt)
+    mv = _bcast_stats(x.ndim, moving_var).astype(dt)
+    g = _bcast_stats(x.ndim, gamma).astype(dt)
+    b = _bcast_stats(x.ndim, beta).astype(dt)
     inv = lax.rsqrt(mv + eps)
-    return (x - mm) * inv * g + b
+    return ((xf - mm) * inv * g + b).astype(x.dtype)
 
 
 def batch_norm_train(x, gamma, beta, eps: float):
     """Training-mode BN: returns (out, batch_mean, batch_var) so the layer
     can fold the running-stat update into the same compiled step
-    (reference batch_norm.cc saves mean/var as aux outputs)."""
+    (reference batch_norm.cc saves mean/var as aux outputs). Stats are
+    f32; out keeps the activation dtype."""
+    dt = _stat_dtype(x)
+    xf = x.astype(dt)
     axes = (0,) + tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
     m = _bcast_stats(x.ndim, mean)
     v = _bcast_stats(x.ndim, var)
-    g, b = _bcast_stats(x.ndim, gamma), _bcast_stats(x.ndim, beta)
-    out = (x - m) * lax.rsqrt(v + eps) * g + b
+    g = _bcast_stats(x.ndim, gamma).astype(dt)
+    b = _bcast_stats(x.ndim, beta).astype(dt)
+    out = ((xf - m) * lax.rsqrt(v + eps) * g + b).astype(x.dtype)
     return out, mean, var
 
 
 def layer_norm(x, gamma, beta, axis: int = -1, eps: float = 1e-5):
-    """Reference LayerNorm (src/operator/nn/layer_norm.cc)."""
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
+    """Reference LayerNorm (src/operator/nn/layer_norm.cc). f32 stats,
+    activation-dtype output."""
+    dt = _stat_dtype(x)
+    xf = x.astype(dt)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    return (out * gamma.astype(dt).reshape(shape)
+            + beta.astype(dt).reshape(shape)).astype(x.dtype)
 
 
 def group_norm(x, gamma, beta, num_groups: int, eps: float = 1e-5):
-    """Reference GroupNorm (src/operator/nn/group_norm.cc). x: (N, C, ...)."""
+    """Reference GroupNorm (src/operator/nn/group_norm.cc). x: (N, C, ...).
+    f32 stats, activation-dtype output."""
+    dt = _stat_dtype(x)
     n, c = x.shape[:2]
     sp = x.shape[2:]
-    xg = x.reshape((n, num_groups, c // num_groups) + sp)
+    xg = x.astype(dt).reshape((n, num_groups, c // num_groups) + sp)
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
     var = jnp.var(xg, axis=axes, keepdims=True)
     xg = (xg - mean) * lax.rsqrt(var + eps)
     out = xg.reshape(x.shape)
     shape = (1, c) + (1,) * len(sp)
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    return (out * gamma.astype(dt).reshape(shape)
+            + beta.astype(dt).reshape(shape)).astype(x.dtype)
 
 
 def instance_norm(x, gamma, beta, eps: float = 1e-5):
-    """Reference InstanceNorm: normalize per (N, C) over spatial dims."""
+    """Reference InstanceNorm: normalize per (N, C) over spatial dims.
+    f32 stats, activation-dtype output."""
+    dt = _stat_dtype(x)
+    xf = x.astype(dt)
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    return (out * gamma.astype(dt).reshape(shape)
+            + beta.astype(dt).reshape(shape)).astype(x.dtype)
 
 
 def l2_norm(x, axis=None, eps: float = 1e-10, mode: str = "instance"):

@@ -100,12 +100,17 @@ def test_amp_matmul_runs_bf16():
         a = mx.nd.ones((4, 8))
         b = mx.nd.ones((8, 4))
         out = mx.nd.dot(a, b)
-        # f32 in, f32 out; compute went through bf16 (value still exact for ones)
-        assert out.dtype == onp.float32
-        onp.testing.assert_allclose(out.asnumpy(), 8 * onp.ones((4, 4)))
-        # f32-pinned op untouched
-        s = mx.nd.softmax(mx.nd.ones((2, 3)))
+        # f32 in, bf16 OUT: the low dtype flows between MXU ops (reference
+        # FP16_FUNCS semantics) so activations stay half-width in HBM
+        assert onp.dtype(out.dtype).name == "bfloat16", out.dtype
+        onp.testing.assert_allclose(out.asnumpy().astype("float32"),
+                                    8 * onp.ones((4, 4)))
+        # f32-pinned op casts UP: bf16 in, f32 out
+        s = mx.nd.softmax(out)
         assert s.dtype == onp.float32
+        # f32 input to a pinned op stays f32
+        s2 = mx.nd.softmax(mx.nd.ones((2, 3)))
+        assert s2.dtype == onp.float32
     finally:
         amp.uninit()
     assert not amp.is_enabled()

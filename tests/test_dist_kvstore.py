@@ -73,3 +73,18 @@ def test_launch_local_two_process_dist_kvstore(tmp_path):
                                     [1.0, 0.0, -1.0, 0.0])
         onp.testing.assert_allclose(r["compressed_round2"],
                                     [1.0, 0.0, -1.0, 0.0])
+    # fused multi-key pushpull: correct sums with >=5x fewer host syncs
+    # than the per-key path (VERDICT r2 item 3 done-criterion)
+    for r in (r0, r1):
+        assert r["fused_sums_ok"]
+        fused, perkey = r["fused_stats"], r["perkey_stats"]
+        assert fused["blocks"] * 5 <= perkey["blocks"], (fused, perkey)
+        assert fused["collectives"] * 5 <= perkey["collectives"], \
+            (fused, perkey)
+    # Trainer over dist_sync: identical weights across ranks (both
+    # update_on_kvstore modes) and equal to the serial summed-grad run
+    for key in ("trainer_w_updkv0", "trainer_w_updkv1"):
+        for w0, w1 in zip(r0[key], r1[key]):
+            onp.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-6)
+        for wd, ws in zip(r0[key], r0["trainer_w_serial"]):
+            onp.testing.assert_allclose(wd, ws, rtol=1e-4, atol=1e-5)

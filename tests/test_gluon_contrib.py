@@ -1,0 +1,217 @@
+"""gluon.contrib layer zoo (reference
+python/mxnet/gluon/contrib/nn/basic_layers.py + contrib/rnn/): Concurrent,
+PixelShuffle1/2/3D, the nine Conv RNN/LSTM/GRU cells, VariationalDropoutCell,
+LSTMPCell — shape and gradient checks per class."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+# ---------------------------------------------------------------------------
+# contrib.nn
+# ---------------------------------------------------------------------------
+
+def test_concurrent_concats_branch_outputs():
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3), nn.Dense(4), cnn.Identity())
+    net.initialize()
+    x = nd.array(onp.ones((2, 5), "float32"))
+    out = net(x)
+    assert out.shape == (2, 3 + 4 + 5)
+    # Identity branch passes the raw input through
+    onp.testing.assert_allclose(out.asnumpy()[:, 7:], onp.ones((2, 5)))
+
+
+def test_sparse_embedding_contrib_alias():
+    emb = cnn.SparseEmbedding(20, 4)
+    emb.initialize()
+    with autograd.record():
+        out = emb(nd.array(onp.array([1, 3], "int32")))
+        loss = (out * out).sum()
+    loss.backward()
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    assert isinstance(emb.weight.grad(), RowSparseNDArray)
+
+
+def _pixel_shuffle_ref(x, factors):
+    """Independent numpy model of the reference semantics: channel group
+    c*prod(f)+block-index maps onto the upsampled spatial grid."""
+    n, c_in, *sp = x.shape
+    f = list(factors)
+    c = c_in // int(onp.prod(f))
+    y = x.reshape([n, c] + f + sp)
+    # interleave: (N, C, f1..fk, s1..sk) -> (N, C, s1, f1, ..., sk, fk)
+    k = len(f)
+    perm = [0, 1]
+    for i in range(k):
+        perm.extend([2 + k + i, 2 + i])
+    y = y.transpose(perm)
+    return y.reshape([n, c] + [s * ff for s, ff in zip(sp, f)])
+
+
+@pytest.mark.parametrize("cls,factors,shape", [
+    (cnn.PixelShuffle1D, (2,), (1, 8, 3)),
+    (cnn.PixelShuffle2D, (2, 3), (1, 12, 3, 5)),
+    (cnn.PixelShuffle3D, (2, 3, 4), (1, 48, 3, 5, 7)),
+])
+def test_pixel_shuffle_matches_reference_semantics(cls, factors, shape):
+    arg = factors[0] if len(factors) == 1 else factors
+    ps = cls(arg)
+    x = onp.arange(onp.prod(shape), dtype="float32").reshape(shape)
+    got = ps(nd.array(x)).asnumpy()
+    onp.testing.assert_array_equal(got, _pixel_shuffle_ref(x, list(factors)))
+
+
+def test_pixel_shuffle_differentiable():
+    ps = cnn.PixelShuffle2D(2)
+    x = nd.array(onp.random.RandomState(0)
+                 .randn(1, 8, 2, 2).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        loss = (ps(x) ** 2).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_sync_batch_norm_block_exported():
+    bn = cnn.SyncBatchNorm(in_channels=4, num_devices=2)
+    bn.initialize()
+    x = nd.array(onp.random.RandomState(1)
+                 .randn(3, 4, 5, 5).astype("float32"))
+    with autograd.record():
+        out = bn(x)
+    assert out.shape == x.shape
+    m = out.asnumpy().mean(axis=(0, 2, 3))
+    onp.testing.assert_allclose(m, onp.zeros(4), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# contrib.rnn — conv cells
+# ---------------------------------------------------------------------------
+
+_CONV_CASES = [
+    (crnn.Conv1DRNNCell, (2, 10), (4, 2, 10), 1),
+    (crnn.Conv2DRNNCell, (2, 6, 7), (4, 2, 6, 7), 1),
+    (crnn.Conv3DRNNCell, (1, 4, 4, 4), (2, 1, 4, 4, 4), 1),
+    (crnn.Conv1DLSTMCell, (2, 10), (4, 2, 10), 2),
+    (crnn.Conv2DLSTMCell, (2, 6, 7), (4, 2, 6, 7), 2),
+    (crnn.Conv3DLSTMCell, (1, 4, 4, 4), (2, 1, 4, 4, 4), 2),
+    (crnn.Conv1DGRUCell, (2, 10), (4, 2, 10), 1),
+    (crnn.Conv2DGRUCell, (2, 6, 7), (4, 2, 6, 7), 1),
+    (crnn.Conv3DGRUCell, (1, 4, 4, 4), (2, 1, 4, 4, 4), 1),
+]
+
+
+@pytest.mark.parametrize("cls,ishape,xshape,nstates", _CONV_CASES)
+def test_conv_cell_shapes_and_grads(cls, ishape, xshape, nstates):
+    hidden = 3
+    cell = cls(input_shape=ishape, hidden_channels=hidden,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    batch = xshape[0]
+    x = nd.array(onp.random.RandomState(0).randn(*xshape)
+                 .astype("float32") * 0.3)
+    states = cell.begin_state(batch)
+    assert len(states) == nstates
+    with autograd.record():
+        out, next_states = cell(x, states)
+        loss = (out ** 2).sum()
+    loss.backward()
+    # SAME-padded convs: state keeps the spatial shape, channels -> hidden
+    assert out.shape == (batch, hidden) + xshape[2:]
+    assert len(next_states) == nstates
+    for s in next_states:
+        assert s.shape == (batch, hidden) + xshape[2:]
+    for name, p in cell.collect_params().items():
+        g = p.grad().asnumpy()
+        assert onp.isfinite(g).all(), name
+        if "i2h" in name:  # input path must carry signal
+            assert onp.abs(g).max() > 0, name
+
+
+def test_conv_cell_unroll_three_steps():
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = nd.array(onp.random.RandomState(2)
+                   .randn(2, 3, 3, 8, 8).astype("float32"))
+    outs, states = cell.unroll(3, seq, layout="NTC")
+    assert len(outs) == 3 and outs[0].shape == (2, 4, 8, 8)
+    assert len(states) == 2
+
+
+def test_conv_cell_rejects_even_h2h_kernel_and_bad_layout():
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        crnn.Conv2DRNNCell(input_shape=(2, 6, 6), hidden_channels=2,
+                           i2h_kernel=3, h2h_kernel=2)
+    with pytest.raises(MXNetError):
+        crnn.Conv2DRNNCell(input_shape=(2, 6, 6), hidden_channels=2,
+                           i2h_kernel=3, h2h_kernel=3, conv_layout="NHWC")
+
+
+# ---------------------------------------------------------------------------
+# contrib.rnn — VariationalDropoutCell / LSTMPCell
+# ---------------------------------------------------------------------------
+
+def test_variational_dropout_mask_locked_until_reset():
+    from mxnet_tpu.gluon import rnn as grnn
+    base = grnn.RNNCell(6, input_size=6)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                       drop_states=0.4, drop_outputs=0.3)
+    cell.initialize()
+    x = nd.array(onp.ones((2, 6), "float32"))
+    with autograd.record():
+        st = cell.begin_state(2)
+        _, st = cell(x, st)
+        masks1 = [m.asnumpy() for m in (cell.drop_inputs_mask,
+                                        cell.drop_states_mask,
+                                        cell.drop_outputs_mask)]
+        _, st = cell(x, st)
+        masks2 = [m.asnumpy() for m in (cell.drop_inputs_mask,
+                                        cell.drop_states_mask,
+                                        cell.drop_outputs_mask)]
+    for m1, m2 in zip(masks1, masks2):
+        onp.testing.assert_array_equal(m1, m2)  # time-locked
+    cell.reset()
+    assert cell.drop_inputs_mask is None
+    assert cell.drop_states_mask is None
+    assert cell.drop_outputs_mask is None
+
+
+def test_variational_dropout_identity_outside_training():
+    from mxnet_tpu.gluon import rnn as grnn
+    base = grnn.RNNCell(4, input_size=4)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.9)
+    cell.initialize()
+    x = nd.array(onp.ones((2, 4), "float32"))
+    out_plain, _ = base(x, base.begin_state(2))
+    out_wrapped, _ = cell(x, cell.begin_state(2))
+    # inference mode: Dropout is identity, wrapper output == base output
+    onp.testing.assert_allclose(out_wrapped.asnumpy(), out_plain.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_lstmp_projection_shapes_grads_and_unroll():
+    cell = crnn.LSTMPCell(hidden_size=16, projection_size=5)
+    cell.initialize()
+    x = nd.array(onp.random.RandomState(3).randn(4, 8).astype("float32"))
+    with autograd.record():
+        out, states = cell(x, cell.begin_state(4))
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert out.shape == (4, 5)            # projected
+    assert states[0].shape == (4, 5)      # r
+    assert states[1].shape == (4, 16)     # c
+    assert cell.h2r_weight.shape == (5, 16)
+    for name, p in cell.collect_params().items():
+        assert onp.isfinite(p.grad().asnumpy()).all(), name
+    seq = nd.array(onp.random.RandomState(4)
+                   .randn(4, 3, 8).astype("float32"))
+    outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (4, 3, 5)

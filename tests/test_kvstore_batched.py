@@ -1,0 +1,144 @@
+"""Fused multi-key pushpull (KVStoreDist.pushpull_list).
+
+Reference analog: ps-lite message batching + big-array slicing in
+src/kvstore/kvstore_dist.h (MXNET_KVSTORE_SLICE_THRESHOLD) and the
+engine-ordering contract include/mxnet/kvstore.h:129-141. Cross-process
+behavior is covered by tests/test_dist_kvstore.py; here the packing,
+bucketing, write-back, and stats accounting run single-process with the
+fuse path forced."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore.kvstore import KVStoreDist
+
+
+def _mk_store(**kwargs):
+    kv = mx.kvstore.create("dist_sync")
+    kv._force_fuse = True  # exercise the fused path without 2 processes
+    return kv
+
+
+def test_fused_matches_per_key_results():
+    rng = onp.random.RandomState(0)
+    shapes = [(4, 3), (7,), (2, 2, 2), (5, 1)]
+    vals = [rng.randn(*s).astype("float32") for s in shapes]
+
+    kv_f = _mk_store()
+    arrs_f = [nd.array(v) for v in vals]
+    kv_f.pushpull_list(list(range(len(shapes))), arrs_f)
+
+    kv_s = mx.kvstore.create("dist_sync")
+    arrs_s = [nd.array(v) for v in vals]
+    for i, a in enumerate(arrs_s):
+        kv_s.pushpull(i, a)
+
+    for f, s in zip(arrs_f, arrs_s):
+        onp.testing.assert_allclose(f.asnumpy(), s.asnumpy(), rtol=1e-6)
+
+
+def test_fused_mixed_dtypes_bucket_separately():
+    # int32 vs float32: genuinely distinct dtypes under x64-disabled JAX
+    # (float64 would silently downcast to float32 and share a bucket)
+    kv = _mk_store()
+    a = nd.array(onp.ones((3,), "float32"))
+    b = nd.array(onp.full((3,), 4, "int32"))
+    c = nd.array(onp.full((2,), 2.0, "float32"))
+    kv.pushpull_list([0, 1, 2], [a, b, c])
+    onp.testing.assert_allclose(a.asnumpy(), onp.ones(3))
+    assert str(b.dtype).endswith("int32")
+    onp.testing.assert_array_equal(b.asnumpy(), onp.full((3,), 4))
+    onp.testing.assert_allclose(c.asnumpy(), 2 * onp.ones(2))
+
+
+def test_fused_slice_threshold_splits_buckets(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_SLICE_THRESHOLD", "8")
+    kv = _mk_store()
+    arrs = [nd.array(onp.full((6,), float(i + 1), "float32"))
+            for i in range(4)]
+    kv.pushpull_list(list(range(4)), arrs)
+    for i, a in enumerate(arrs):
+        onp.testing.assert_allclose(a.asnumpy(), (i + 1) * onp.ones(6))
+
+
+def test_fused_with_updater_runs_store_optimizer():
+    from mxnet_tpu import optimizer as opt
+    kv = _mk_store()
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    w0 = nd.array(onp.zeros((3,), "float32"))
+    w1 = nd.array(onp.zeros((2, 2), "float32"))
+    kv.init(0, w0)
+    kv.init(1, w1)
+    g0 = nd.array(onp.ones((3,), "float32"))
+    g1 = nd.array(onp.full((2, 2), 2.0, "float32"))
+    o0 = nd.zeros((3,))
+    o1 = nd.zeros((2, 2))
+    kv.pushpull_list([0, 1], [g0, g1], outs=[o0, o1])
+    onp.testing.assert_allclose(o0.asnumpy(), -0.5 * onp.ones(3))
+    onp.testing.assert_allclose(o1.asnumpy(), -1.0 * onp.ones((2, 2)))
+
+
+def test_fused_sparse_values_fall_back_per_key():
+    kv = _mk_store()
+    dense = nd.array(onp.ones((3,), "float32"))
+    sp = nd.sparse.row_sparse_array(
+        (onp.ones((1, 2), "float32"), onp.array([1], "int32")),
+        shape=(4, 2))
+    kv.pushpull_list([0, 1], [dense, sp])
+    onp.testing.assert_allclose(dense.asnumpy(), onp.ones(3))
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    assert isinstance(sp, RowSparseNDArray)
+    assert sp.indices.asnumpy().tolist() == [1]
+
+
+def test_trainer_uses_fused_path_and_stats_shrink():
+    """Trainer._allreduce_grads makes ONE pushpull_list call; on a forced
+    dist store the per-step host-sync count is 1 and collectives = number
+    of dtype buckets, not number of parameters."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    calls = {"list": 0, "single": 0}
+    orig_list = KVStoreDist.pushpull_list
+    orig_single = KVStoreDist.pushpull
+
+    net = nn.Sequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(4, in_units=8),
+            nn.Dense(2, in_units=4))
+    net.initialize()
+    kv = _mk_store()
+
+    def counting_list(self, *a, **k):
+        calls["list"] += 1
+        return orig_list(self, *a, **k)
+
+    def counting_single(self, *a, **k):
+        calls["single"] += 1
+        return orig_single(self, *a, **k)
+
+    KVStoreDist.pushpull_list = counting_list
+    KVStoreDist.pushpull = counting_single
+    try:
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore=kv,
+                           update_on_kvstore=False)
+        x = nd.array(onp.random.RandomState(0)
+                     .randn(4, 4).astype("float32"))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(4)
+    finally:
+        KVStoreDist.pushpull_list = orig_list
+        KVStoreDist.pushpull = orig_single
+    nparams = 6  # 3 layers x (weight, bias)
+    assert calls["list"] == 1
+    assert calls["single"] == 0  # all keys dense: nothing fell back
+    # all six f32 params packed into ONE bucket -> one collective dispatch
+    # accounted; zero blocking (single process never waits)
+    assert kv.stats["collectives"] <= 1, kv.stats
+    assert kv.stats["blocks"] <= 1, kv.stats
+    assert nparams == len(tr._params)
