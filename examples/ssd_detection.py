@@ -7,9 +7,12 @@ anchors, conv heads predict per-anchor class scores and box offsets,
 MultiBoxTarget builds training targets, and inference decodes with
 MultiBoxDetection — whose NMS runs ON DEVICE as one XLA program (the
 reference needed a custom CUDA NMS kernel; here box_nms is a lax.fori_loop
-the compiler fuses). Synthetic scenes contain one bright square whose
-location is the label, so falling loss + a sane detection prove the
-anchor/target/NMS plumbing end to end.
+the compiler fuses). Data rides ``ImageDetIter`` + ``CreateDetAugmenter``
+(reference python/mxnet/image/detection.py): synthetic scenes with one
+bright square are augmented with label-aware random crop / pad / mirror,
+so falling loss + a sane detection prove the whole detection pipeline —
+iterator, box-transforming augmenters, anchor/target matching, and NMS —
+end to end.
 
 Run: python examples/ssd_detection.py [--steps 40]
 """
@@ -53,17 +56,19 @@ class TinySSD(gluon.Block):
         return anchors, cls, loc
 
 
-def make_scene(rng, n, size=32):
-    """One bright 8px square per image; label = its corner box."""
-    imgs = rng.rand(n, 1, size, size).astype("float32") * 0.1
-    labels = onp.zeros((n, 1, 5), "float32")
-    for i in range(n):
+def make_dataset(rng, n, size=32):
+    """Bright 8px squares on noise; labels are the corner boxes — the
+    (label, image) pairs ImageDetIter consumes."""
+    items = []
+    for _ in range(n):
+        img = (rng.rand(size, size, 3) * 25).astype("uint8")
         x0 = rng.randint(0, size - 8)
         y0 = rng.randint(0, size - 8)
-        imgs[i, 0, y0:y0 + 8, x0:x0 + 8] = 1.0
-        labels[i, 0] = [0, x0 / size, y0 / size,
-                        (x0 + 8) / size, (y0 + 8) / size]
-    return imgs, labels
+        img[y0:y0 + 8, x0:x0 + 8] = 255
+        label = onp.array([[0, x0 / size, y0 / size,
+                            (x0 + 8) / size, (y0 + 8) / size]], "float32")
+        items.append((label, img))
+    return items
 
 
 def main():
@@ -73,6 +78,14 @@ def main():
     args = ap.parse_args()
     rng = onp.random.RandomState(0)
 
+    from mxnet_tpu.image.detection import ImageDetIter
+    train_iter = ImageDetIter(
+        batch_size=args.batch, data_shape=(3, 32, 32),
+        imglist=make_dataset(rng, 64), shuffle=True,
+        rand_crop=0.3, rand_pad=0.3, rand_mirror=True,
+        min_object_covered=0.9, area_range=(0.5, 1.5),
+        mean=True, std=True)
+
     net = TinySSD()
     net.initialize()
     trainer = gluon.Trainer(net.collect_params(), "adam",
@@ -81,12 +94,19 @@ def main():
     l1 = gluon.loss.L1Loss()
 
     first = last = None
-    for step in range(args.steps):
-        imgs, labels = make_scene(rng, args.batch)
+    step = 0
+    while step < args.steps:
+        try:
+            batch = train_iter.next()
+        except StopIteration:
+            train_iter.reset()
+            continue
+        imgs, labels = batch.data[0], batch.label[0]
+        step += 1
         with autograd.record():
-            anchors, cls, loc = net(nd.array(imgs))
+            anchors, cls, loc = net(imgs)
             loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
-                anchors, nd.array(labels), cls.transpose((0, 2, 1)))
+                anchors, labels, cls.transpose((0, 2, 1)))
             loss = ce(cls, cls_t).mean() + \
                 (l1(loc * loc_mask, loc_t * loc_mask)).mean()
         loss.backward()
@@ -99,9 +119,12 @@ def main():
             print(f"step {step:3d} loss {v:.4f}")
     assert last < first, (first, last)
 
-    # inference: decode + ON-DEVICE NMS via MultiBoxDetection
-    imgs, labels = make_scene(rng, 4)
-    anchors, cls, loc = net(nd.array(imgs))
+    # inference: decode + ON-DEVICE NMS via MultiBoxDetection; eval data
+    # rides the same iterator without random augmentation
+    eval_iter = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                             imglist=make_dataset(rng, 4),
+                             mean=True, std=True)
+    anchors, cls, loc = net(eval_iter.next().data[0])
     probs = nd.softmax(cls.transpose((0, 2, 1)), axis=1)
     det = nd.contrib.MultiBoxDetection(probs, loc, anchors,
                                        nms_threshold=0.45, threshold=0.01)

@@ -1,3 +1,7 @@
 """Image processing API (reference: python/mxnet/image/)."""
 from .image import *  # noqa: F401,F403
-from .image import __all__  # noqa: F401
+from .detection import *  # noqa: F401,F403
+from .image import __all__ as _image_all
+from .detection import __all__ as _det_all
+
+__all__ = list(_image_all) + list(_det_all)

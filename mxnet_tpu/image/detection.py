@@ -1,0 +1,529 @@
+"""Detection data pipeline (reference python/mxnet/image/detection.py):
+label-aware augmenters that transform bounding boxes together with the
+image, and ``ImageDetIter`` batching variable-object labels.
+
+Label convention (reference ImageDetIter): per image a float array of
+shape (num_objects, width>=5) whose rows are
+``[class_id, xmin, ymin, xmax, ymax, ...]`` with coordinates normalized
+to [0, 1]. Batches pad the object axis with -1 rows (class_id < 0 means
+"no object" — the same sentinel MultiBoxTarget consumes).
+
+TPU-native notes: augmentation is host-side numpy (it is per-image,
+branchy, and cheap next to decode); everything the accelerator touches is
+the final fixed-shape (B, C, H, W) / (B, max_obj, width) pair, so the
+compiled training step never sees a dynamic shape.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from math import sqrt
+from typing import List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray.ndarray import array as nd_array
+from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
+                    ForceResizeAug, HueJitterAug, LightingAug, RandomGrayAug,
+                    ResizeAug, _as_np, fixed_crop, imdecode_or_raw,
+                    imresize_np)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+def _box_areas(boxes: onp.ndarray) -> onp.ndarray:
+    """Areas of normalized [x1, y1, x2, y2] rows (clipped at 0)."""
+    return (onp.maximum(0.0, boxes[:, 2] - boxes[:, 0])
+            * onp.maximum(0.0, boxes[:, 3] - boxes[:, 1]))
+
+
+class DetAugmenter:
+    """Base detection augmenter: ``aug(src, label) -> (src, label)``
+    (reference DetAugmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift a plain image Augmenter into the detection pipeline: it
+    touches pixels only, labels pass through (reference DetBorrowAug).
+    Only geometry-preserving augmenters are safe to borrow."""
+
+    def __init__(self, augmenter: Augmenter):
+        super().__init__(augmenter=augmenter._kwargs)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick ONE augmenter from a list, or skip entirely with
+    ``skip_prob`` (reference DetRandomSelectAug)."""
+
+    def __init__(self, aug_list: Sequence[DetAugmenter],
+                 skip_prob: float = 0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and boxes horizontally with probability p (reference
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p: float = 0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = nd_array(_as_np(src)[:, ::-1].copy())
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop that re-expresses boxes in crop
+    coordinates (reference DetRandomCropAug): the crop must have aspect
+    ratio and relative area within range, must cover at least
+    ``min_object_covered`` of some object, and objects keeping less than
+    ``min_eject_coverage`` of their area are ejected. ``max_attempts``
+    failed proposals -> return the input unchanged."""
+
+    def __init__(self, min_object_covered: float = 0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage: float = 0.3, max_attempts: int = 50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (0 < area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0]
+                        <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        img = _as_np(src)
+        height, width = img.shape[0], img.shape[1]
+        prop = self._propose(label, height, width)
+        if prop is None:
+            return src, label
+        x, y, w, h, new_label = prop
+        return fixed_crop(src, x, y, w, h, None), new_label
+
+    def _covered_enough(self, boxes, x1, y1, x2, y2) -> bool:
+        """Does the crop cover > min_object_covered of some object?"""
+        areas = _box_areas(boxes)
+        valid = areas > 0
+        if not valid.any():
+            return False
+        b = boxes[valid]
+        ix1 = onp.maximum(b[:, 0], x1)
+        iy1 = onp.maximum(b[:, 1], y1)
+        ix2 = onp.minimum(b[:, 2], x2)
+        iy2 = onp.minimum(b[:, 3], y2)
+        inter = (onp.maximum(0.0, ix2 - ix1)
+                 * onp.maximum(0.0, iy2 - iy1))
+        cov = inter / areas[valid]
+        cov = cov[cov > 0]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def _shift_labels(self, label, x1, y1, cw, ch) -> Optional[onp.ndarray]:
+        """Re-express boxes in crop coordinates; eject shrunken objects."""
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - x1) / cw
+        out[:, (2, 4)] = (out[:, (2, 4)] - y1) / ch
+        out[:, 1:5] = onp.clip(out[:, 1:5], 0.0, 1.0)
+        old = _box_areas(label[:, 1:5])
+        new = _box_areas(out[:, 1:5]) * cw * ch
+        with onp.errstate(divide="ignore", invalid="ignore"):
+            coverage = onp.where(old > 0, new / old, 0.0)
+        keep = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) \
+            & (coverage > self.min_eject_coverage)
+        if not keep.any():
+            return None
+        return out[keep]
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h_lo = int(round(sqrt(min_area / ratio)))
+            h_hi = min(int(round(sqrt(max_area / ratio))), height,
+                       int(width / ratio))
+            if h_lo > h_hi or h_hi <= 0:
+                continue
+            h = pyrandom.randint(max(1, h_lo), h_hi)
+            w = min(int(round(h * ratio)), width)
+            if not (min_area * 0.99 <= w * h <= max_area * 1.01):
+                continue
+            if w * h < 2:
+                continue
+            y = pyrandom.randint(0, height - h)
+            x = pyrandom.randint(0, width - w)
+            nx1, ny1 = x / width, y / height
+            nx2, ny2 = (x + w) / width, (y + h) / height
+            if not self._covered_enough(label[:, 1:5], nx1, ny1, nx2, ny2):
+                continue
+            new_label = self._shift_labels(label, nx1, ny1,
+                                           nx2 - nx1, ny2 - ny1)
+            if new_label is not None:
+                return x, y, w, h, new_label
+        return None
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding: place the image on a larger canvas and
+    shrink boxes accordingly (reference DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts: int = 50,
+                 pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (tuple, list)):
+            pad_val = (pad_val,) * 3
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0
+                        and 0 < aspect_ratio_range[0]
+                        <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        img = _as_np(src)
+        height, width = img.shape[0], img.shape[1]
+        prop = self._propose(height, width)
+        if prop is None:
+            return src, label
+        x, y, w, h = prop
+        c = img.shape[2]
+        pv = onp.asarray(self.pad_val, img.dtype)
+        if pv.size != c:  # e.g. 3-tuple pad on a grayscale image
+            pv = pv.flat[0]
+        canvas = onp.empty((h, w, c), img.dtype)
+        canvas[...] = pv
+        canvas[y:y + height, x:x + width] = img
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] * width + x) / w
+        out[:, (2, 4)] = (out[:, (2, 4)] * height + y) / h
+        return nd_array(canvas), out
+
+    def _propose(self, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h_lo = max(height, int(round(sqrt(min_area / ratio))),
+                       int(round(width / ratio)))
+            h_hi = int(round(sqrt(max_area / ratio)))
+            if h_lo > h_hi:
+                continue
+            h = pyrandom.randint(h_lo, h_hi)
+            w = int(round(h * ratio))
+            if (h - height) < 2 or (w - width) < 2:
+                continue
+            y = pyrandom.randint(0, h - height)
+            x = pyrandom.randint(0, w - width)
+            return x, y, w, h
+        return None
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0) -> DetRandomSelectAug:
+    """One DetRandomCropAug per element when the constraint arguments are
+    lists (SSD-style multi-constraint sampling), randomly selected per
+    image (reference CreateMultiRandCropAugmenter)."""
+    def as_list(v):
+        return list(v) if isinstance(v, (list, tuple)) \
+            and isinstance(v[0], (list, tuple)) else None
+
+    covered = min_object_covered if isinstance(min_object_covered,
+                                               (list, tuple)) \
+        else [min_object_covered]
+    ratios = as_list(aspect_ratio_range) or [aspect_ratio_range]
+    areas = as_list(area_range) or [area_range]
+    ejects = min_eject_coverage if isinstance(min_eject_coverage,
+                                              (list, tuple)) \
+        else [min_eject_coverage]
+    attempts = max_attempts if isinstance(max_attempts, (list, tuple)) \
+        else [max_attempts]
+    n = max(len(covered), len(ratios), len(areas), len(ejects),
+            len(attempts))
+
+    def pick(lst, i):
+        return lst[i] if i < len(lst) else lst[-1]
+
+    augs = [DetRandomCropAug(pick(covered, i), pick(ratios, i),
+                             pick(areas, i), pick(ejects, i),
+                             pick(attempts, i)) for i in range(n)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50,
+                       pad_val=(127, 127, 127)) -> List[DetAugmenter]:
+    """Standard detection augmenter stack (reference CreateDetAugmenter):
+    resize -> constrained random crop -> mirror -> random pad -> force
+    resize -> cast -> color jitter/hue/PCA/gray -> normalize, with boxes
+    transformed wherever geometry changes."""
+    augs: List[DetAugmenter] = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        augs.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])), min_eject_coverage,
+            max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        augs.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range,
+                             (1.0, max(1.0 + 1e-6, area_range[1])),
+                             max_attempts, pad_val)],
+            skip_prob=1 - rand_pad))
+    augs.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    augs.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        augs.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        augs.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53], "float32")
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375], "float32")
+    if mean is not None or std is not None:
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter:
+    """Detection batch iterator (reference ImageDetIter): variable-object
+    labels padded with -1 rows into a fixed (batch, max_obj, width)
+    tensor so the compiled step sees static shapes.
+
+    Sources: ``imglist`` — a list of ``(label, image)`` pairs where label
+    is an (N, >=5) float array (or the reference's flat header form
+    ``[header_width, obj_width, ...]``) and image is an HWC uint8 numpy
+    array or a file path under ``path_root`` — or ``path_imgrec``, a
+    RecordIO file whose headers carry the flat label form.
+    """
+
+    def __init__(self, batch_size: int, data_shape, path_imgrec=None,
+                 imglist=None, path_root: str = "", shuffle: bool = False,
+                 aug_list: Optional[List[DetAugmenter]] = None,
+                 label_shape=None, last_batch_handle: str = "pad",
+                 **kwargs):
+        if (path_imgrec is None) == (imglist is None):
+            raise MXNetError(
+                "ImageDetIter needs exactly one of path_imgrec / imglist")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.path_root = path_root
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None \
+            else CreateDetAugmenter(data_shape, **kwargs)
+        self._samples = []
+        if imglist is not None:
+            for label, img in imglist:
+                self._samples.append((self._parse_label(label), img))
+        else:
+            from .. import recordio as rio
+            reader = rio.MXRecordIO(path_imgrec, "r")
+            while True:
+                rec = reader.read()
+                if rec is None:
+                    break
+                header, payload = rio.unpack(rec)
+                self._samples.append(
+                    (self._parse_label(onp.asarray(header.label)), payload))
+            reader.close()
+        if not self._samples:
+            raise MXNetError("ImageDetIter: empty data source")
+        self.label_width = self._samples[0][0].shape[1]
+        if label_shape is None:
+            max_obj = max(s[0].shape[0] for s in self._samples)
+            label_shape = (max_obj, self.label_width)
+        self.label_shape = tuple(label_shape)
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"last_batch_handle must be pad/discard/"
+                             f"roll_over, got {last_batch_handle!r}")
+        self._order = list(range(len(self._samples)))
+        self._cursor = 0
+        self._leftover: List[int] = []
+        self._last_batch_handle = last_batch_handle
+        self.reset()
+
+    # ---------------- label plumbing ----------------
+    @staticmethod
+    def _parse_label(label) -> onp.ndarray:
+        """Accept (N, >=5) arrays or the reference flat form
+        ``[header_width, obj_width, <header...>, obj fields...]``."""
+        arr = onp.asarray(label, "float32")
+        if arr.ndim == 2:
+            if arr.shape[1] < 5:
+                raise MXNetError(f"label width must be >= 5, got "
+                                 f"{arr.shape[1]}")
+            return arr
+        raw = arr.ravel()
+        if raw.size < 7:
+            raise MXNetError(f"label is too short: {raw.size}")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise MXNetError(f"object width must be >= 5, got {obj_width}")
+        body = raw[header_width:]
+        body = body[:(body.size // obj_width) * obj_width]
+        out = body.reshape(-1, obj_width)
+        return out[out[:, 0] >= 0]  # drop -1 padding rows
+
+    def _pad_label(self, label: onp.ndarray) -> onp.ndarray:
+        max_obj, width = self.label_shape
+        out = onp.full((max_obj, width), -1.0, "float32")
+        n = min(label.shape[0], max_obj)
+        out[:n, :min(width, label.shape[1])] = \
+            label[:n, :min(width, label.shape[1])]
+        return out
+
+    def sync_label_shape(self, it: "ImageDetIter", verbose: bool = False):
+        """Make two iterators (train/val) agree on the padded label shape
+        (reference ImageDetIter.sync_label_shape)."""
+        shape = (max(self.label_shape[0], it.label_shape[0]),
+                 max(self.label_shape[1], it.label_shape[1]))
+        self.label_shape = shape
+        it.label_shape = shape
+        return it
+
+    # ---------------- iteration ----------------
+    @property
+    def provide_data(self):
+        from ..io.io import DataDesc
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from ..io.io import DataDesc
+        return [DataDesc("label",
+                         (self.batch_size,) + self.label_shape)]
+
+    def reset(self):
+        order = list(range(len(self._samples)))
+        if self.shuffle:
+            pyrandom.shuffle(order)
+        # roll_over: the deferred tail of last epoch leads this one
+        self._order = self._leftover + order
+        self._leftover = []
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def _load_image(self, img):
+        if isinstance(img, bytes):
+            return imdecode_or_raw(img, self.data_shape)
+        if isinstance(img, str):
+            import os
+            with open(os.path.join(self.path_root, img), "rb") as f:
+                return imdecode_or_raw(f.read(), self.data_shape)
+        return onp.asarray(img)
+
+    def _augment(self, img: onp.ndarray, label: onp.ndarray):
+        src: NDArray = nd_array(onp.ascontiguousarray(img))
+        for aug in self.auglist:
+            src, label = aug(src, label) if isinstance(aug, DetAugmenter) \
+                else (aug(src), label)
+        arr = _as_np(src).astype("float32")
+        c, h, w = self.data_shape
+        if arr.shape[0] != h or arr.shape[1] != w:
+            arr = imresize_np(arr, w, h)
+        return arr.transpose(2, 0, 1), self._pad_label(label)
+
+    def next(self):
+        from ..io.io import DataBatch
+        remaining = len(self._order) - self._cursor
+        if remaining <= 0:
+            raise StopIteration
+        if remaining < self.batch_size:
+            if self._last_batch_handle == "discard":
+                raise StopIteration
+            if self._last_batch_handle == "roll_over":
+                # defer the tail to the next epoch instead of padding
+                self._leftover = self._order[self._cursor:]
+                self._cursor = len(self._order)
+                raise StopIteration
+        datas, labels = [], []
+        while len(datas) < self.batch_size \
+                and self._cursor < len(self._order):
+            label, img = self._samples[self._order[self._cursor]]
+            self._cursor += 1
+            d, l = self._augment(self._load_image(img), label)
+            datas.append(d)
+            labels.append(l)
+        pad = self.batch_size - len(datas)
+        while len(datas) < self.batch_size:
+            datas.append(datas[-1])
+            labels.append(labels[-1])
+        return DataBatch([nd_array(onp.stack(datas))],
+                         [nd_array(onp.stack(labels))], pad=pad)
+
+    __next__ = next

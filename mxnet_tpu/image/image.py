@@ -29,7 +29,9 @@ __all__ = ["imdecode", "imresize", "imresize_np", "imdecode_or_raw",
            "SequentialAug", "ResizeAug", "ForceResizeAug", "CastAug",
            "HorizontalFlipAug", "RandomCropAug", "CenterCropAug",
            "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
-           "SaturationJitterAug", "RandomGrayAug", "CreateAugmenter"]
+           "SaturationJitterAug", "RandomGrayAug", "HueJitterAug",
+           "LightingAug", "RandomOrderAug", "ColorJitterAug",
+           "CreateAugmenter"]
 
 
 def _as_np(img):
@@ -297,10 +299,16 @@ class CenterCropAug(Augmenter):
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__()
-        self.mean, self.std = onp.asarray(mean, "float32"), \
-            onp.asarray(std, "float32") if std is not None else None
+        # either side may be None (reference color_normalize subtracts /
+        # divides only what is given)
+        self.mean = onp.asarray(mean, "float32") if mean is not None \
+            else None
+        self.std = onp.asarray(std, "float32") if std is not None else None
 
     def __call__(self, src):
+        if self.mean is None:
+            img = _as_np(src).astype("float32")
+            return nd_array(img / self.std if self.std is not None else img)
         return color_normalize(src, self.mean, self.std)
 
 
@@ -355,6 +363,84 @@ class RandomGrayAug(Augmenter):
             gray = (img * self._COEF).sum(-1, keepdims=True)
             img = onp.broadcast_to(gray, img.shape).copy()
         return nd_array(img)
+
+
+class HueJitterAug(Augmenter):
+    """Random hue rotation (reference HueJitterAug): rotate the chroma
+    plane in YIQ space by a random angle in [-hue, hue] (units of pi)."""
+
+    # standard RGB<->YIQ matrices (public constants)
+    _TYIQ = onp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], "float32")
+    _ITYIQ = onp.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], "float32")
+
+    def __init__(self, hue: float):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        img = _as_np(src).astype("float32")
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        rot = onp.array([[1.0, 0.0, 0.0],
+                         [0.0, u, -w],
+                         [0.0, w, u]], "float32")
+        t = (self._ITYIQ @ rot @ self._TYIQ).T
+        return nd_array(img @ t)
+
+
+class LightingAug(Augmenter):
+    """PCA-based RGB noise (reference LightingAug; AlexNet-style): add
+    eigvec @ (eigval * N(0, alphastd)) to every pixel."""
+
+    def __init__(self, alphastd: float, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, "float32")
+        self.eigvec = onp.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        img = _as_np(src).astype("float32")
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return nd_array(img + rgb.astype("float32"))
+
+
+class RandomOrderAug(Augmenter):
+    """Apply a list of augmenters in random order (reference
+    RandomOrderAug)."""
+
+    def __init__(self, ts: List[Augmenter]):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(range(len(self.ts)))
+        pyrandom.shuffle(order)
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Brightness/contrast/saturation jitter in random order (reference
+    ColorJitterAug)."""
+
+    def __init__(self, brightness: float, contrast: float,
+                 saturation: float):
+        ts: List[Augmenter] = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+        self.brightness, self.contrast, self.saturation = \
+            brightness, contrast, saturation
 
 
 def CreateAugmenter(data_shape, resize: int = 0, rand_crop: bool = False,

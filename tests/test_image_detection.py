@@ -1,0 +1,221 @@
+"""Detection data pipeline (reference python/mxnet/image/detection.py):
+box-transforming augmenters keep labels consistent with the pixels, and
+ImageDetIter batches variable-object labels into fixed shapes."""
+import random as pyrandom
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.image.detection import (CreateDetAugmenter,
+                                       CreateMultiRandCropAugmenter,
+                                       DetBorrowAug, DetHorizontalFlipAug,
+                                       DetRandomCropAug, DetRandomPadAug,
+                                       DetRandomSelectAug, ImageDetIter)
+
+
+def _scene(rng, size=64, square=12):
+    """Bright square on dark noise; label = its normalized corner box."""
+    img = (rng.rand(size, size, 3) * 20).astype("uint8")
+    x0 = rng.randint(2, size - square - 2)
+    y0 = rng.randint(2, size - square - 2)
+    img[y0:y0 + square, x0:x0 + square] = 255
+    label = onp.array([[1, x0 / size, y0 / size,
+                        (x0 + square) / size, (y0 + square) / size]],
+                      "float32")
+    return label, img
+
+
+def _box_pixels(img, box):
+    """Mean intensity inside the normalized box of an HWC image."""
+    h, w = img.shape[:2]
+    x1, y1, x2, y2 = (int(box[1] * w), int(box[2] * h),
+                      int(onp.ceil(box[3] * w)), int(onp.ceil(box[4] * h)))
+    region = img[y1:y2, x1:x2]
+    return float(region.mean()) if region.size else 0.0
+
+
+def test_flip_moves_boxes_with_pixels():
+    rng = onp.random.RandomState(0)
+    label, img = _scene(rng)
+    aug = DetHorizontalFlipAug(p=1.0)
+    src, lab = aug(nd.array(img.astype("float32")), label)
+    assert _box_pixels(src.asnumpy(), lab[0]) > 150
+    # class id untouched
+    assert lab[0, 0] == 1
+
+
+def test_random_crop_keeps_box_on_object():
+    rng = onp.random.RandomState(1)
+    pyrandom.seed(1)
+    aug = DetRandomCropAug(min_object_covered=0.9, area_range=(0.3, 0.9),
+                           max_attempts=100)
+    crops = 0
+    for _ in range(10):
+        label, img = _scene(rng)
+        src, lab = aug(nd.array(img.astype("float32")), label)
+        a = src.asnumpy()
+        if a.shape != img.shape:
+            crops += 1
+        assert lab.shape[0] >= 1  # min_object_covered=0.9 keeps the object
+        assert _box_pixels(a, lab[0]) > 120, (a.shape, lab)
+        assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    assert crops >= 5  # the augmenter did actually crop most of the time
+
+
+def test_random_crop_ejects_uncovered_objects():
+    # crop confined to the left half can never cover a right-half object
+    pyrandom.seed(3)
+    img = onp.zeros((64, 64, 3), "uint8")
+    img[10:20, 40:50] = 255
+    label = onp.array([[0, 40 / 64, 10 / 64, 50 / 64, 20 / 64]], "float32")
+    aug = DetRandomCropAug(min_object_covered=0.99, area_range=(0.9, 1.0),
+                           max_attempts=5)
+    src, lab = aug(nd.array(img.astype("float32")), label)
+    # either no acceptable crop (unchanged) or object still covered
+    if src.asnumpy().shape == img.shape:
+        onp.testing.assert_array_equal(lab, label)
+    else:
+        assert _box_pixels(src.asnumpy(), lab[0]) > 120
+
+
+def test_random_pad_shrinks_boxes_onto_canvas():
+    rng = onp.random.RandomState(2)
+    pyrandom.seed(2)
+    aug = DetRandomPadAug(area_range=(1.5, 3.0), pad_val=(7, 7, 7))
+    label, img = _scene(rng)
+    src, lab = aug(nd.array(img.astype("float32")), label)
+    a = src.asnumpy()
+    assert a.shape[0] > img.shape[0] or a.shape[1] > img.shape[1]
+    assert _box_pixels(a, lab[0]) > 120
+    # area under padding: boxes shrink proportionally
+    assert (lab[0, 3] - lab[0, 1]) < (label[0, 3] - label[0, 1])
+
+
+def test_select_aug_skip_prob_and_multicrop_factory():
+    aug = CreateMultiRandCropAugmenter(
+        min_object_covered=[0.3, 0.9], area_range=[(0.3, 0.9), (0.5, 1.0)],
+        skip_prob=0.0)
+    assert isinstance(aug, DetRandomSelectAug)
+    assert len(aug.aug_list) == 2
+    skip = DetRandomSelectAug(aug.aug_list, skip_prob=1.0)
+    rng = onp.random.RandomState(4)
+    label, img = _scene(rng)
+    src, lab = skip(nd.array(img.astype("float32")), label)
+    onp.testing.assert_array_equal(lab, label)  # skipped: untouched
+
+
+def test_create_det_augmenter_full_stack_preserves_object():
+    rng = onp.random.RandomState(5)
+    pyrandom.seed(5)
+    augs = CreateDetAugmenter((3, 48, 48), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, min_object_covered=0.9,
+                              area_range=(0.5, 2.0), brightness=0.1,
+                              contrast=0.1, saturation=0.1, hue=0.1,
+                              pca_noise=0.05, rand_gray=0.1,
+                              mean=True, std=True)
+    for _ in range(5):
+        label, img = _scene(rng)
+        src, lab = nd.array(img.astype("float32")), label
+        for a in augs:
+            src, lab = a(src, lab)
+        out = src.asnumpy()
+        assert out.shape == (48, 48, 3)  # forced to data_shape
+        assert lab.shape[0] >= 1
+        assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+
+
+def test_image_det_iter_batches_and_pads_labels():
+    rng = onp.random.RandomState(6)
+    items = []
+    for i in range(7):
+        label, img = _scene(rng)
+        if i % 2:  # second object on some images: variable object count
+            label = onp.concatenate([label, label + [0, .01, .01, .01, .01]])
+        items.append((label, img))
+    it = ImageDetIter(batch_size=3, data_shape=(3, 32, 32), imglist=items,
+                      mean=True, std=True)
+    assert it.label_shape == (2, 5)
+    b = it.next()
+    assert b.data[0].shape == (3, 3, 32, 32)
+    assert b.label[0].shape == (3, 2, 5)
+    lab = b.label[0].asnumpy()
+    # padding rows carry the -1 no-object sentinel
+    assert ((lab[:, :, 0] >= 0) | (lab[:, :, 0] == -1)).all()
+    n = 1
+    for _ in it:
+        n += 1
+    assert n == 3  # ceil(7/3) with pad
+    it.reset()
+    it.next()
+
+
+def test_image_det_iter_parses_flat_header_labels():
+    flat = onp.array([2, 5,  # header_width, obj_width
+                      1, 0.1, 0.2, 0.5, 0.6,
+                      0, 0.3, 0.3, 0.7, 0.9,
+                      -1, -1, -1, -1, -1], "float32")
+    parsed = ImageDetIter._parse_label(flat)
+    assert parsed.shape == (2, 5)
+    onp.testing.assert_allclose(parsed[0], [1, 0.1, 0.2, 0.5, 0.6])
+
+
+def test_image_det_iter_sync_label_shape():
+    rng = onp.random.RandomState(7)
+    a = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                     imglist=[_scene(rng) for _ in range(2)])
+    lab2, img2 = _scene(rng)
+    lab2 = onp.concatenate([lab2, lab2, lab2])
+    b = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                     imglist=[(lab2, img2)])
+    a.sync_label_shape(b)
+    assert a.label_shape == b.label_shape == (3, 5)
+    assert a.next().label[0].shape == (2, 3, 5)
+
+
+def test_image_det_iter_rejects_bad_args():
+    with pytest.raises(MXNetError):
+        ImageDetIter(batch_size=2, data_shape=(3, 32, 32))
+    with pytest.raises(MXNetError):
+        ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                     imglist=[(onp.zeros((1, 4), "float32"),
+                               onp.zeros((8, 8, 3), "uint8"))])
+
+
+def test_std_only_normalization_stays_finite():
+    rng = onp.random.RandomState(8)
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=[_scene(rng) for _ in range(2)], std=True)
+    data = it.next().data[0].asnumpy()
+    assert onp.isfinite(data).all()
+    assert data.max() <= 8.0  # divided by ~58, not raw uint8
+
+
+def test_random_pad_grayscale_image():
+    pyrandom.seed(9)
+    img = onp.zeros((40, 40, 1), "uint8")
+    img[5:15, 5:15] = 200
+    label = onp.array([[0, 5 / 40, 5 / 40, 15 / 40, 15 / 40]], "float32")
+    aug = DetRandomPadAug(area_range=(1.5, 2.5), pad_val=(9, 9, 9),
+                          max_attempts=100)
+    src, lab = aug(nd.array(img.astype("float32")), label)
+    a = src.asnumpy()
+    assert a.shape[2] == 1
+    assert a.shape[0] > 40 or a.shape[1] > 40
+
+
+def test_last_batch_roll_over_and_validation():
+    rng = onp.random.RandomState(10)
+    it = ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                      imglist=[_scene(rng) for _ in range(7)],
+                      last_batch_handle="roll_over")
+    n1 = sum(1 for _ in it)          # 2 full batches, 1 deferred
+    assert n1 == 2
+    it.reset()                        # leftover leads the new epoch: 8 items
+    n2 = sum(1 for _ in it)
+    assert n2 == 2  # 8 -> 2 full batches, 2 deferred
+    with pytest.raises(MXNetError):
+        ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                     imglist=[_scene(rng)], last_batch_handle="dicard")
