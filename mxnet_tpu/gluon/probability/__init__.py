@@ -6,7 +6,10 @@ hood); log_prob/entropy/kl are pure ops XLA fuses into surrounding
 computation.
 """
 from .distributions import *  # noqa: F401,F403
+from .transformation import *  # noqa: F401,F403
 from .block import StochasticBlock  # noqa: F401
-from . import distributions, block
+from . import constraint  # noqa: F401
+from . import distributions, transformation, block
 
-__all__ = list(distributions.__all__) + ["StochasticBlock"]
+__all__ = list(distributions.__all__) + list(transformation.__all__) \
+    + ["StochasticBlock", "constraint"]

@@ -1,0 +1,220 @@
+"""Constraints on distribution parameters/supports (reference
+python/mxnet/gluon/probability/distributions/constraint.py).
+
+``check(value)`` validates eagerly and returns the value (host-side
+numpy check: parameter validation is a construction-time concern, never
+part of the compiled step — the TPU-native reading of the reference's
+``npx.constraint_check`` op)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Constraint", "Real", "Boolean", "Interval", "OpenInterval",
+           "HalfOpenInterval", "IntegerInterval", "IntegerOpenInterval",
+           "IntegerHalfOpenInterval", "GreaterThan", "GreaterThanEq",
+           "LessThan", "LessThanEq", "Positive", "NonNegative",
+           "PositiveInteger", "NonNegativeInteger", "UnitInterval",
+           "Simplex", "LowerTriangular", "LowerCholesky",
+           "PositiveDefinite", "is_dependent", "dependent"]
+
+
+def _np(value):
+    return value.asnumpy() if isinstance(value, NDArray) \
+        else onp.asarray(value)
+
+
+class Constraint:
+    """Base constraint: ``check(v)`` raises MXNetError on violation and
+    returns ``v`` unchanged otherwise (reference Constraint.check)."""
+
+    _err = "constraint violated"
+
+    def _ok(self, v: onp.ndarray) -> bool:
+        raise NotImplementedError
+
+    def check(self, value):
+        if not bool(self._ok(_np(value))):
+            raise MXNetError(
+                f"Constraint violated: {self._err} ({type(self).__name__})")
+        return value
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class _Dependent(Constraint):
+    """Placeholder whose meaning depends on other parameters (reference
+    _Dependent); checking it directly is an error."""
+
+    def check(self, value):
+        raise MXNetError("cannot check a dependent constraint directly")
+
+
+dependent = _Dependent()
+
+
+def is_dependent(constraint) -> bool:
+    return isinstance(constraint, _Dependent)
+
+
+class Real(Constraint):
+    _err = "value must be a real tensor (no NaN)"
+
+    def _ok(self, v):
+        return not onp.isnan(v).any()
+
+
+class Boolean(Constraint):
+    _err = "value must be 0 or 1"
+
+    def _ok(self, v):
+        return onp.isin(v, (0, 1)).all()
+
+
+class Interval(Constraint):
+    def __init__(self, lower_bound, upper_bound):
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self._err = f"value must be in [{lower_bound}, {upper_bound}]"
+
+    def _ok(self, v):
+        return ((v >= self.lower_bound) & (v <= self.upper_bound)).all()
+
+
+class OpenInterval(Interval):
+    def __init__(self, lower_bound, upper_bound):
+        super().__init__(lower_bound, upper_bound)
+        self._err = f"value must be in ({lower_bound}, {upper_bound})"
+
+    def _ok(self, v):
+        return ((v > self.lower_bound) & (v < self.upper_bound)).all()
+
+
+class HalfOpenInterval(Interval):
+    def __init__(self, lower_bound, upper_bound):
+        super().__init__(lower_bound, upper_bound)
+        self._err = f"value must be in [{lower_bound}, {upper_bound})"
+
+    def _ok(self, v):
+        return ((v >= self.lower_bound) & (v < self.upper_bound)).all()
+
+
+class _IntegerMixin:
+    @staticmethod
+    def _integral(v):
+        return (v == onp.floor(v)).all()
+
+
+class IntegerInterval(Interval, _IntegerMixin):
+    def _ok(self, v):
+        return self._integral(v) and super()._ok(v)
+
+
+class IntegerOpenInterval(OpenInterval, _IntegerMixin):
+    def _ok(self, v):
+        return self._integral(v) and super()._ok(v)
+
+
+class IntegerHalfOpenInterval(HalfOpenInterval, _IntegerMixin):
+    def _ok(self, v):
+        return self._integral(v) and super()._ok(v)
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower_bound):
+        self.lower_bound = lower_bound
+        self._err = f"value must be > {lower_bound}"
+
+    def _ok(self, v):
+        return (v > self.lower_bound).all()
+
+
+class GreaterThanEq(Constraint):
+    def __init__(self, lower_bound):
+        self.lower_bound = lower_bound
+        self._err = f"value must be >= {lower_bound}"
+
+    def _ok(self, v):
+        return (v >= self.lower_bound).all()
+
+
+class LessThan(Constraint):
+    def __init__(self, upper_bound):
+        self.upper_bound = upper_bound
+        self._err = f"value must be < {upper_bound}"
+
+    def _ok(self, v):
+        return (v < self.upper_bound).all()
+
+
+class LessThanEq(Constraint):
+    def __init__(self, upper_bound):
+        self.upper_bound = upper_bound
+        self._err = f"value must be <= {upper_bound}"
+
+    def _ok(self, v):
+        return (v <= self.upper_bound).all()
+
+
+class Positive(GreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegative(GreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class PositiveInteger(Positive, _IntegerMixin):
+    def _ok(self, v):
+        return self._integral(v) and super()._ok(v)
+
+
+class NonNegativeInteger(NonNegative, _IntegerMixin):
+    def _ok(self, v):
+        return self._integral(v) and super()._ok(v)
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0, 1)
+
+
+class Simplex(Constraint):
+    _err = "value must be non-negative and sum to 1 on the last axis"
+
+    def _ok(self, v):
+        return (v >= 0).all() and \
+            onp.allclose(v.sum(-1), 1.0, atol=1e-5)
+
+
+class LowerTriangular(Constraint):
+    _err = "value must be lower-triangular"
+
+    def _ok(self, v):
+        return onp.allclose(v, onp.tril(v))
+
+
+class LowerCholesky(Constraint):
+    _err = "value must be lower-triangular with positive diagonal"
+
+    def _ok(self, v):
+        return onp.allclose(v, onp.tril(v)) and \
+            (onp.diagonal(v, axis1=-2, axis2=-1) > 0).all()
+
+
+class PositiveDefinite(Constraint):
+    _err = "value must be symmetric positive-definite"
+
+    def _ok(self, v):
+        if not onp.allclose(v, onp.swapaxes(v, -1, -2), atol=1e-6):
+            return False
+        try:
+            onp.linalg.cholesky(v)
+            return True
+        except onp.linalg.LinAlgError:
+            return False

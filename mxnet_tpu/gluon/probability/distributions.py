@@ -27,7 +27,8 @@ __all__ = ["Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace",
            "Gamma", "Beta", "Chi2", "StudentT", "Weibull", "Pareto",
            "Bernoulli", "Geometric", "Poisson", "Categorical",
            "OneHotCategorical", "Dirichlet", "MultivariateNormal",
-           "kl_divergence", "register_kl"]
+           "Binomial", "NegativeBinomial", "Multinomial", "FisherSnedecor",
+           "Independent", "kl_divergence", "register_kl"]
 
 _EULER = 0.5772156649015329
 
@@ -47,6 +48,8 @@ class Distribution:
 
     has_grad = True
     support = None
+    event_dim = 0  # trailing dims that form one event (reference
+    # Distribution.event_dim; 1 for simplex/vector-valued laws)
 
     def __init__(self, **params):
         # keep the caller's NDArray objects: their tape identity is what
@@ -105,6 +108,16 @@ class Distribution:
     def variance(self) -> NDArray:
         return NDArray(self._variance_impl(*self._params.values()))
 
+    def cdf(self, value) -> NDArray:
+        fn = lambda v, *ps: self._cdf_impl(v, *ps)
+        return _op(f"{type(self).__name__}_cdf", fn,
+                   [value] + list(self._nd_params.values()))
+
+    def icdf(self, value) -> NDArray:
+        fn = lambda v, *ps: self._icdf_impl(v, *ps)
+        return _op(f"{type(self).__name__}_icdf", fn,
+                   [value] + list(self._nd_params.values()))
+
     # -- per-distribution hooks ------------------------------------------
     def _sample_impl(self, key, shape, *params):
         raise NotImplementedError
@@ -120,6 +133,12 @@ class Distribution:
 
     def _variance_impl(self, *params):
         raise NotImplementedError
+
+    def _cdf_impl(self, value, *params):
+        raise MXNetError(f"{type(self).__name__} has no closed-form cdf")
+
+    def _icdf_impl(self, value, *params):
+        raise MXNetError(f"{type(self).__name__} has no closed-form icdf")
 
 
 class Normal(Distribution):
@@ -144,6 +163,12 @@ class Normal(Distribution):
     def _variance_impl(self, loc, scale):
         return jnp.broadcast_to(scale * scale,
                                 jnp.broadcast_shapes(loc.shape, scale.shape))
+
+    def _cdf_impl(self, v, loc, scale):
+        return 0.5 * (1 + lax.erf((v - loc) / (scale * math.sqrt(2.0))))
+
+    def _icdf_impl(self, v, loc, scale):
+        return loc + scale * math.sqrt(2.0) * lax.erf_inv(2 * v - 1)
 
 
 class LogNormal(Normal):
@@ -273,6 +298,12 @@ class Uniform(Distribution):
     def _variance_impl(self, low, high):
         return (high - low) ** 2 / 12
 
+    def _cdf_impl(self, v, low, high):
+        return jnp.clip((v - low) / (high - low), 0.0, 1.0)
+
+    def _icdf_impl(self, v, low, high):
+        return low + v * (high - low)
+
 
 class Exponential(Distribution):
     def __init__(self, scale=1.0):
@@ -292,6 +323,12 @@ class Exponential(Distribution):
 
     def _variance_impl(self, scale):
         return scale * scale
+
+    def _cdf_impl(self, v, scale):
+        return -jnp.expm1(-v / scale)
+
+    def _icdf_impl(self, v, scale):
+        return -scale * jnp.log1p(-v)
 
 
 class Gamma(Distribution):
@@ -485,6 +522,7 @@ class Categorical(Distribution):
 
 
 class OneHotCategorical(Categorical):
+    event_dim = 1
     def _sample_impl(self, key, shape, logit):
         idx = jax.random.categorical(key, logit, axis=-1, shape=shape)
         return jax.nn.one_hot(idx, logit.shape[-1])
@@ -498,6 +536,7 @@ class OneHotCategorical(Categorical):
 
 
 class Dirichlet(Distribution):
+    event_dim = 1
     def __init__(self, alpha):
         super().__init__(alpha=alpha)
 
@@ -521,6 +560,7 @@ class Dirichlet(Distribution):
 
 
 class MultivariateNormal(Distribution):
+    event_dim = 1
     """MVN parameterized by loc and covariance (or scale_tril)."""
 
     def __init__(self, loc, cov=None, scale_tril=None):
@@ -554,6 +594,206 @@ class MultivariateNormal(Distribution):
 
     def _mean_impl(self, loc, tril):
         return loc
+
+
+def _prob_or_logit(prob, logit):
+    """Reference prob/logit duality (utils.py prob2logit/logit2prob):
+    exactly one must be given. Returns ``(prob, logit)`` as NDArrays with
+    the derived side computed THROUGH the op funnel, so whichever
+    parameter the caller recorded keeps its tape identity and gradients
+    flow to it (the base-class contract every distribution honors)."""
+    if (prob is None) == (logit is None):
+        raise MXNetError("specify exactly one of prob/logit")
+    eps = 1e-7
+    if prob is not None:
+        pn = prob if isinstance(prob, NDArray) \
+            else NDArray(jnp.asarray(prob, jnp.float32))
+
+        def p2l(p):
+            pc = jnp.clip(p, eps, 1 - eps)
+            return jnp.log(pc) - jnp.log1p(-pc)
+        return pn, _op("prob2logit", p2l, [pn])
+    ln = logit if isinstance(logit, NDArray) \
+        else NDArray(jnp.asarray(logit, jnp.float32))
+    return _op("logit2prob", lambda lg: 1 / (1 + jnp.exp(-lg)), [ln]), ln
+
+
+class Binomial(Distribution):
+    """Binomial(n, prob) (reference distributions/binomial.py). ``n`` is a
+    static Python int (static shapes: a data-dependent trial count cannot
+    be compiled)."""
+
+    def __init__(self, n=1, prob=None, logit=None):
+        self.n = int(n)
+        p, lg = _prob_or_logit(prob, logit)
+        super().__init__(prob=p)
+        self.logit = lg
+
+    def _sample_impl(self, key, shape, prob):
+        u = jax.random.uniform(key, (self.n,) + shape)
+        return jnp.sum(u < prob, axis=0).astype(jnp.float32)
+
+    def _log_prob_impl(self, v, prob):
+        eps = 1e-7
+        p = jnp.clip(prob, eps, 1 - eps)
+        n = float(self.n)
+        return (lax.lgamma(n + 1.) - lax.lgamma(v + 1.)
+                - lax.lgamma(n - v + 1.)
+                + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def _mean_impl(self, prob):
+        return self.n * prob
+
+    def _variance_impl(self, prob):
+        return self.n * prob * (1 - prob)
+
+
+class NegativeBinomial(Distribution):
+    """NegativeBinomial(n, prob) counting occurrences at per-trial
+    probability ``prob`` against ``n`` stopping failures (reference
+    distributions/negative_binomial.py: mean = n*p/(1-p)). Sampling is
+    the Gamma-Poisson mixture — two MXU-friendly primitives instead of a
+    sequential trial loop."""
+
+    def __init__(self, n, prob=None, logit=None):
+        p, lg = _prob_or_logit(prob, logit)
+        super().__init__(n=n, prob=p)
+        self.logit = lg
+
+    def _sample_impl(self, key, shape, n, prob):
+        kg, kp = jax.random.split(key)
+        eps = 1e-7
+        rate = jnp.clip(prob, eps, 1 - eps) / jnp.clip(1 - prob, eps, 1.)
+        lam = jax.random.gamma(kg, jnp.broadcast_to(n, shape)) * rate
+        return jax.random.poisson(kp, lam).astype(jnp.float32)
+
+    def _log_prob_impl(self, v, n, prob):
+        eps = 1e-7
+        p = jnp.clip(prob, eps, 1 - eps)
+        return (lax.lgamma(v + n) - lax.lgamma(v + 1.) - lax.lgamma(n)
+                + n * jnp.log1p(-p) + v * jnp.log(p))
+
+    def _mean_impl(self, n, prob):
+        return n * prob / (1 - prob)
+
+    def _variance_impl(self, n, prob):
+        return n * prob / (1 - prob) ** 2
+
+
+class Multinomial(Distribution):
+    """Multinomial(num_events, prob/logit, total_count) (reference
+    distributions/multinomial.py). event_dim=1: the trailing axis is the
+    category count vector."""
+
+    event_dim = 1
+
+    def __init__(self, num_events, prob=None, logit=None, total_count=1):
+        self.num_events = int(num_events)
+        self.total_count = int(total_count)
+        p, lg = _prob_or_logit(prob, logit)
+        super().__init__(prob=p)
+        self.logit = lg
+
+    def _sample_shape(self, size):
+        base = self._p("prob").shape
+        if size is None:
+            return base
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return size + base
+
+    def _sample_impl(self, key, shape, prob):
+        logits = jnp.log(jnp.clip(prob, 1e-7, 1.0))
+        draws = jax.random.categorical(
+            key, logits, shape=(self.total_count,) + shape[:-1])
+        onehot = jax.nn.one_hot(draws, self.num_events)
+        return jnp.sum(onehot, axis=0)
+
+    def _log_prob_impl(self, v, prob):
+        p = jnp.clip(prob, 1e-7, 1.0)
+        n = float(self.total_count)
+        return (lax.lgamma(n + 1.)
+                - jnp.sum(lax.lgamma(v + 1.), axis=-1)
+                + jnp.sum(v * jnp.log(p), axis=-1))
+
+    def _mean_impl(self, prob):
+        return self.total_count * prob
+
+    def _variance_impl(self, prob):
+        return self.total_count * prob * (1 - prob)
+
+
+class FisherSnedecor(Distribution):
+    """F-distribution (reference distributions/fishersnedecor.py):
+    ratio of scaled chi-squares, sampled via two gamma draws."""
+
+    def __init__(self, df1, df2):
+        super().__init__(df1=df1, df2=df2)
+
+    def _sample_impl(self, key, shape, df1, df2):
+        k1, k2 = jax.random.split(key)
+        x1 = jax.random.gamma(k1, jnp.broadcast_to(df1 / 2, shape)) * 2
+        x2 = jax.random.gamma(k2, jnp.broadcast_to(df2 / 2, shape)) * 2
+        return (x1 / df1) / (x2 / df2)
+
+    def _log_prob_impl(self, v, df1, df2):
+        h1, h2 = df1 / 2, df2 / 2
+        return (h1 * jnp.log(df1) + h2 * jnp.log(df2)
+                + (h1 - 1) * jnp.log(v)
+                - (h1 + h2) * jnp.log(df2 + df1 * v)
+                - (lax.lgamma(h1) + lax.lgamma(h2)
+                   - lax.lgamma(h1 + h2)))
+
+    def _mean_impl(self, df1, df2):
+        return jnp.where(df2 > 2, df2 / (df2 - 2), jnp.nan)
+
+    def _variance_impl(self, df1, df2):
+        num = 2 * df2 ** 2 * (df1 + df2 - 2)
+        den = df1 * (df2 - 2) ** 2 * (df2 - 4)
+        return jnp.where(df2 > 4, num / den, jnp.nan)
+
+
+class Independent(Distribution):
+    """Reinterpret the last ``reinterpreted_batch_ndims`` batch dims of a
+    base distribution as event dims: log_prob sums over them (reference
+    distributions/independent.py)."""
+
+    def __init__(self, base_distribution: Distribution,
+                 reinterpreted_batch_ndims: int):
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        super().__init__()
+        self.event_dim = getattr(base_distribution, "event_dim", 0) \
+            + self.reinterpreted_batch_ndims
+
+    def sample(self, size=None) -> NDArray:
+        return self.base_dist.sample(size)
+
+    def sample_n(self, size=None):
+        return self.base_dist.sample_n(size)
+
+    def log_prob(self, value) -> NDArray:
+        lp = self.base_dist.log_prob(value)
+        n = self.reinterpreted_batch_ndims
+        return _op("independent_sum",
+                   lambda x: jnp.sum(x, axis=tuple(range(x.ndim - n,
+                                                         x.ndim)))
+                   if n else x, [lp])
+
+    def entropy(self) -> NDArray:
+        ent = self.base_dist.entropy()
+        n = self.reinterpreted_batch_ndims
+        return _op("independent_sum",
+                   lambda x: jnp.sum(x, axis=tuple(range(x.ndim - n,
+                                                         x.ndim)))
+                   if n else x, [ent])
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
 
 
 # ---------------------------------------------------------------------------

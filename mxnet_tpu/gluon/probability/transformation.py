@@ -1,0 +1,427 @@
+"""Invertible transformations + TransformedDistribution (reference
+python/mxnet/gluon/probability/transformation/transformation.py and
+distributions/transformed_distribution.py).
+
+Each Transformation is a pure jnp bijection with a tractable
+log|det J|; TransformedDistribution composes them over a base
+distribution with the change-of-variables rule
+``log p(y) = log p_base(x) - sum log|det J_i|``. Everything flows through
+the op invoke funnel so transformed densities are differentiable on the
+tape and fusable by XLA like any other op.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .distributions import Distribution, _op
+
+__all__ = ["Transformation", "ComposeTransform", "ExpTransform",
+           "AffineTransform", "PowerTransform", "SigmoidTransform",
+           "SoftmaxTransform", "AbsTransform", "TransformedDistribution",
+           "RelaxedBernoulli", "RelaxedOneHotCategorical"]
+
+
+def _sum_rightmost(x, n):
+    return jnp.sum(x, axis=tuple(range(x.ndim - n, x.ndim))) if n else x
+
+
+class Transformation:
+    """Bijection y = f(x) with log|det J| (reference Transformation).
+    ``t(x)`` applies forward; ``t.inv`` is the inverse transformation;
+    ``t.log_det_jacobian(x, y)`` evaluates log|dy/dx|."""
+
+    bijective = True
+    event_dim = 0  # dims consumed by one application (0 = elementwise)
+    sign = 1       # monotonicity sign for cdf routing, when defined
+
+    def __call__(self, x):
+        return _op(f"{type(self).__name__}_fwd", self._forward, [x])
+
+    def _inv_call(self, y):
+        return _op(f"{type(self).__name__}_inv", self._inverse, [y])
+
+    @property
+    def inv(self) -> "Transformation":
+        return _InverseTransformation(self)
+
+    def log_det_jacobian(self, x, y) -> NDArray:
+        if not self.bijective:
+            raise MXNetError(
+                f"{type(self).__name__} is not bijective; log_det_jacobian "
+                "is undefined")
+        return _op(f"{type(self).__name__}_logdet",
+                   self._log_det, [x, y])
+
+    # hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det(self, x, y):
+        raise NotImplementedError
+
+
+class _InverseTransformation(Transformation):
+    """The inverse view of a transformation (reference
+    _InverseTransformation): swaps forward/inverse and negates the
+    jacobian log-determinant."""
+
+    def __init__(self, base: Transformation):
+        self._base = base
+        self.bijective = base.bijective
+        self.event_dim = base.event_dim
+        self.sign = base.sign
+
+    def __call__(self, x):
+        return self._base._inv_call(x)
+
+    def _inv_call(self, y):
+        return self._base(y)
+
+    @property
+    def inv(self):
+        return self._base
+
+    def log_det_jacobian(self, x, y):
+        neg = self._base.log_det_jacobian(y, x)
+        return _op("negative", jnp.negative, [neg])
+
+
+class ComposeTransform(Transformation):
+    """Apply transforms left-to-right (reference ComposeTransform)."""
+
+    def __init__(self, parts: Sequence[Transformation]):
+        self.parts = list(parts)
+        self.bijective = all(p.bijective for p in self.parts)
+        self.event_dim = max((p.event_dim for p in self.parts), default=0)
+        s = 1
+        for p in self.parts:
+            s *= p.sign
+        self.sign = s
+
+    def __call__(self, x):
+        for p in self.parts:
+            x = p(x)
+        return x
+
+    def _inv_call(self, y):
+        for p in reversed(self.parts):
+            y = p._inv_call(y)
+        return y
+
+    @property
+    def inv(self):
+        return ComposeTransform([p.inv for p in reversed(self.parts)])
+
+    def log_det_jacobian(self, x, y):
+        # re-walk the chain to recover intermediates
+        xs: List = [x]
+        for p in self.parts[:-1]:
+            xs.append(p(xs[-1]))
+        xs.append(y)
+        total = None
+        for p, a, b in zip(self.parts, xs[:-1], xs[1:]):
+            ld = p.log_det_jacobian(a, b)
+            # align event dims: a part with smaller event_dim contributes
+            # elementwise and must be summed to this transform's event rank
+            extra = self.event_dim - p.event_dim
+            if extra:
+                ld = _op("sum_rightmost",
+                         lambda v, n=extra: _sum_rightmost(v, n), [ld])
+            total = ld if total is None else total + ld
+        return total
+
+
+class ExpTransform(Transformation):
+    """y = exp(x) (reference ExpTransform)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _log_det(self, x, y):
+        return x
+
+
+class AffineTransform(Transformation):
+    """y = loc + scale * x (reference AffineTransform)."""
+
+    def __init__(self, loc, scale, event_dim: int = 0):
+        self.loc = loc
+        self.scale = scale
+        self.event_dim = event_dim
+
+    def _np(self, v):
+        return v._data if isinstance(v, NDArray) else jnp.asarray(v)
+
+    def _forward(self, x):
+        return self._np(self.loc) + self._np(self.scale) * x
+
+    def _inverse(self, y):
+        return (y - self._np(self.loc)) / self._np(self.scale)
+
+    @property
+    def sign(self):
+        import numpy as onp
+        s = onp.asarray(self._np(self.scale))
+        if (s > 0).all():
+            return 1
+        if (s < 0).all():
+            return -1
+        raise MXNetError("AffineTransform with mixed-sign scale has no "
+                         "single monotonicity sign")
+
+    def _log_det(self, x, y):
+        ld = jnp.broadcast_to(jnp.log(jnp.abs(self._np(self.scale))),
+                              x.shape)
+        return _sum_rightmost(ld, self.event_dim)
+
+
+class PowerTransform(Transformation):
+    """y = x ** exponent on positives (reference PowerTransform)."""
+
+    def __init__(self, exponent):
+        if exponent == 0:
+            raise MXNetError("PowerTransform exponent must be nonzero")
+        self.exponent = exponent
+        # on the positive domain x^e is increasing iff e > 0 (cdf routing)
+        self.sign = 1 if exponent > 0 else -1
+
+    def _forward(self, x):
+        return jnp.power(x, self.exponent)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.exponent)
+
+    def _log_det(self, x, y):
+        return jnp.log(jnp.abs(self.exponent * y / x))
+
+
+class SigmoidTransform(Transformation):
+    """y = sigmoid(x) (reference SigmoidTransform)."""
+
+    def _forward(self, x):
+        return jnp.clip(1 / (1 + jnp.exp(-x)), 1e-7, 1 - 1e-7)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det(self, x, y):
+        # -softplus(-x) - softplus(x)
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class SoftmaxTransform(Transformation):
+    """y = softmax(x, -1): normalizing, NOT bijective (reference
+    SoftmaxTransform)."""
+
+    bijective = False
+    event_dim = 1
+
+    def _forward(self, x):
+        z = x - jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(z)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)  # one representative pre-image
+
+
+class AbsTransform(Transformation):
+    """y = |x|: NOT bijective; inverse returns the positive pre-image
+    (reference AbsTransform)."""
+
+    bijective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of y = f_k(...f_1(x)) for x ~ base (reference
+    transformed_distribution.py)."""
+
+    def __init__(self, base_dist: Distribution, transforms):
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.base_dist = base_dist
+        self.transforms = list(transforms)
+        for t in self.transforms:
+            if not t.bijective:
+                raise MXNetError(
+                    f"{type(t).__name__} is not bijective — a transformed "
+                    "density needs invertibility")
+        super().__init__()
+
+    def sample(self, size=None) -> NDArray:
+        x = self.base_dist.sample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def sample_n(self, size=None):
+        return self.sample(size)
+
+    def log_prob(self, value) -> NDArray:
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(value, jnp.float32))
+        # walk backwards through inverses, accumulating log|det J|; every
+        # contribution is summed up to the OVERALL event rank — the max of
+        # the base law's and every transform's (reference
+        # transformed_distribution.py event_dim bookkeeping)
+        base_ed = getattr(self.base_dist, "event_dim", 0)
+        event_dim = max([base_ed] + [t.event_dim for t in self.transforms])
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t._inv_call(y)
+            ld = t.log_det_jacobian(x, y)
+            gap = event_dim - t.event_dim
+            if gap > 0:
+                ld = _op("sum_rightmost",
+                         lambda v, n=gap: _sum_rightmost(v, n), [ld])
+            lp = ld if lp is None else lp + ld
+            y = x  # next (outer-to-inner) inverse consumes this x
+        base_lp = self.base_dist.log_prob(y)
+        gap = event_dim - base_ed
+        if gap > 0:
+            base_lp = _op("sum_rightmost",
+                          lambda v, n=gap: _sum_rightmost(v, n), [base_lp])
+        return base_lp - lp if lp is not None else base_lp
+
+    def cdf(self, value) -> NDArray:
+        x = value
+        sign = 1
+        for t in reversed(self.transforms):
+            sign *= t.sign
+            x = t._inv_call(x)
+        base_cdf = self.base_dist.cdf(x)
+        if sign == 1:
+            return base_cdf
+        return _op("one_minus", lambda c: 1.0 - c, [base_cdf])
+
+    def icdf(self, value) -> NDArray:
+        sign = 1
+        for t in self.transforms:
+            sign *= t.sign
+        if sign != 1:
+            value = _op("one_minus", lambda c: 1.0 - c, [value])
+        x = self.base_dist.icdf(value)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Relaxed (Concrete) distributions: differentiable discrete surrogates,
+# built exactly like the reference — a logit-space base distribution under
+# a squashing transform (reference relaxed_bernoulli.py /
+# relaxed_one_hot_categorical.py)
+# ---------------------------------------------------------------------------
+
+import jax as _jax
+from jax import lax
+
+from .distributions import _prob_or_logit
+
+
+class _LogitRelaxedBernoulli(Distribution):
+    """Unnormalized logit-space relaxed Bernoulli (reference
+    _LogitRelaxedBernoulli): x = (logit + logistic noise) / T."""
+
+    def __init__(self, T, prob=None, logit=None):
+        # shared duality helper: the given side keeps its tape identity,
+        # the derived side flows through the op funnel
+        _, logit = _prob_or_logit(prob, logit)
+        super().__init__(T=T, logit=logit)
+
+    def _sample_impl(self, key, shape, T, logit):
+        u = _jax.random.uniform(key, shape, minval=1e-7, maxval=1 - 1e-7)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return (logit + logistic) / T
+
+    def _log_prob_impl(self, v, T, logit):
+        # density of the logit of a binary Concrete variable
+        diff = logit - v * T
+        return jnp.log(T) + diff - 2 * jnp.logaddexp(0.0, diff)
+
+
+class RelaxedBernoulli(TransformedDistribution):
+    """Concrete/Gumbel-sigmoid relaxation of Bernoulli at temperature T
+    (reference RelaxedBernoulli = _LogitRelaxedBernoulli + sigmoid).
+    Samples live in (0, 1) and gradients flow through them."""
+
+    def __init__(self, T, prob=None, logit=None):
+        base = _LogitRelaxedBernoulli(T, prob=prob, logit=logit)
+        super().__init__(base, SigmoidTransform())
+        self.T = base.T
+        self.logit = base.logit
+
+
+class _ExpRelaxedCategorical(Distribution):
+    """log-space relaxed categorical (reference
+    _ExpRelaxedCategorical): x = log_softmax((logits + Gumbel) / T)."""
+
+    event_dim = 1
+
+    def __init__(self, num_events, T, prob=None, logit=None):
+        self.num_events = int(num_events)
+        if (prob is None) == (logit is None):
+            raise MXNetError("specify exactly one of prob/logit")
+        if logit is None:
+            logit = _op("prob2logit",
+                        lambda p: jnp.log(jnp.clip(p, 1e-7, 1.0)), [prob])
+        super().__init__(T=T, logit=logit)
+
+    def _sample_shape(self, size):
+        base = self._p("logit").shape
+        if size is None:
+            return base
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return size + base
+
+    def _sample_impl(self, key, shape, T, logit):
+        g = _jax.random.gumbel(key, shape)
+        z = (logit + g) / T
+        return z - _jax.scipy.special.logsumexp(z, axis=-1, keepdims=True)
+
+    def _log_prob_impl(self, v, T, logit):
+        # ExpConcrete density (Maddison et al. 2017, eq. 22): for y on the
+        # log-simplex, log p = log((n-1)!) + (n-1) log T
+        #   + sum_i(logit_i - T y_i) - n * logsumexp_i(logit_i - T y_i)
+        n = self.num_events
+        score = logit - v * T
+        return (lax.lgamma(jnp.asarray(float(n)))
+                + (n - 1) * jnp.log(T)
+                + score.sum(-1)
+                - n * _jax.scipy.special.logsumexp(score, axis=-1))
+
+
+class RelaxedOneHotCategorical(TransformedDistribution):
+    """Concrete relaxation of OneHotCategorical at temperature T
+    (reference RelaxedOneHotCategorical = _ExpRelaxedCategorical + exp).
+    Samples live on the interior of the simplex."""
+
+    def __init__(self, T, num_events=None, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("specify exactly one of prob/logit")
+        if num_events is None:
+            import numpy as _onp
+            ref = prob if prob is not None else logit
+            num_events = int(_onp.shape(
+                ref.asnumpy() if isinstance(ref, NDArray) else ref)[-1])
+        base = _ExpRelaxedCategorical(num_events, T, prob=prob, logit=logit)
+        super().__init__(base, ExpTransform())
+        self.T = base.T
+        self.logit = base.logit

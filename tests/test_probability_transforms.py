@@ -1,0 +1,303 @@
+"""Probability: TransformedDistribution + transformations + constraints +
+the round-3 distributions (Binomial, NegativeBinomial, Multinomial,
+FisherSnedecor, Independent, RelaxedBernoulli, RelaxedOneHotCategorical)
+— log_prob/moments checked against scipy golden values (reference
+python/mxnet/gluon/probability/distributions/*, transformation/*)."""
+import numpy as onp
+import pytest
+import scipy.stats as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import probability as P
+
+
+# ---------------------------------------------------------------------------
+# new distributions vs scipy
+# ---------------------------------------------------------------------------
+
+def test_binomial_log_prob_and_moments_vs_scipy():
+    d = P.Binomial(n=10, prob=0.3)
+    ks = onp.array([0.0, 3.0, 7.0, 10.0], "float32")
+    got = d.log_prob(nd.array(ks)).asnumpy()
+    want = sps.binom.logpmf(ks, 10, 0.3)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(float(d.mean.asnumpy()), 3.0, rtol=1e-6)
+    onp.testing.assert_allclose(float(d.variance.asnumpy()), 2.1, rtol=1e-6)
+    s = d.sample(4000).asnumpy()
+    assert s.min() >= 0 and s.max() <= 10
+    onp.testing.assert_allclose(s.mean(), 3.0, atol=0.2)
+
+
+def test_binomial_logit_parameterization():
+    p = 0.3
+    logit = onp.log(p / (1 - p))
+    d = P.Binomial(n=5, logit=onp.float32(logit))
+    want = sps.binom.logpmf([2.0], 5, p)
+    onp.testing.assert_allclose(d.log_prob(nd.array([2.0])).asnumpy(),
+                                want, rtol=1e-4)
+
+
+def test_negative_binomial_vs_scipy():
+    n, p = 4.0, 0.4  # reference convention: mean = n*p/(1-p)
+    d = P.NegativeBinomial(n=n, prob=p)
+    ks = onp.array([0.0, 2.0, 5.0, 11.0], "float32")
+    got = d.log_prob(nd.array(ks)).asnumpy()
+    # scipy nbinom(n, p_success) counts failures at prob 1-p_success
+    want = sps.nbinom.logpmf(ks, n, 1 - p)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(float(d.mean.asnumpy()), n * p / (1 - p),
+                                rtol=1e-6)
+    s = d.sample(6000).asnumpy()
+    onp.testing.assert_allclose(s.mean(), n * p / (1 - p), rtol=0.1)
+
+
+def test_multinomial_vs_scipy():
+    probs = onp.array([0.2, 0.3, 0.5], "float32")
+    d = P.Multinomial(3, prob=probs, total_count=8)
+    x = onp.array([2.0, 3.0, 3.0], "float32")
+    got = float(d.log_prob(nd.array(x)).asnumpy())
+    want = sps.multinomial.logpmf(x, 8, probs)
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+    s = d.sample(2000).asnumpy()
+    assert s.shape == (2000, 3)
+    onp.testing.assert_array_equal(s.sum(-1), onp.full(2000, 8.0))
+    onp.testing.assert_allclose(s.mean(0), 8 * probs, atol=0.25)
+
+
+def test_fishersnedecor_vs_scipy():
+    d1, d2 = 5.0, 12.0
+    d = P.FisherSnedecor(d1, d2)
+    xs = onp.array([0.3, 1.0, 2.5], "float32")
+    got = d.log_prob(nd.array(xs)).asnumpy()
+    want = sps.f.logpdf(xs, d1, d2)
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+    onp.testing.assert_allclose(float(d.mean.asnumpy()), d2 / (d2 - 2),
+                                rtol=1e-6)
+    s = d.sample(8000).asnumpy()
+    assert (s > 0).all()
+    onp.testing.assert_allclose(s.mean(), d2 / (d2 - 2), rtol=0.15)
+
+
+def test_independent_sums_event_dims():
+    base = P.Normal(loc=nd.array(onp.zeros((4, 3), "float32")),
+                    scale=nd.array(onp.ones((4, 3), "float32")))
+    ind = P.Independent(base, 1)
+    v = onp.random.RandomState(0).randn(4, 3).astype("float32")
+    got = ind.log_prob(nd.array(v)).asnumpy()
+    want = sps.norm.logpdf(v).sum(-1)
+    assert got.shape == (4,)
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+    ent = ind.entropy().asnumpy()
+    onp.testing.assert_allclose(ent, onp.full(4, 3 * sps.norm.entropy()),
+                                rtol=1e-6)
+
+
+def test_relaxed_bernoulli_density_and_grad():
+    T, p = 0.5, 0.3
+    d = P.RelaxedBernoulli(T, prob=p)
+    s = d.sample(1000).asnumpy()
+    assert ((s > 0) & (s < 1)).all()
+    # golden value: binary Concrete density (Maddison et al. 2017, eq. 24)
+    # p(x) = T a x^{-T-1} (1-x)^{-T-1} / (a x^{-T} + (1-x)^{-T})^2
+    x = onp.array([0.2, 0.5, 0.8], "float32")
+    a = p / (1 - p)
+    dens = (T * a * x ** (-T - 1) * (1 - x) ** (-T - 1)
+            / (a * x ** (-T) + (1 - x) ** (-T)) ** 2)
+    got = d.log_prob(nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(got, onp.log(dens), rtol=1e-4)
+    # reparameterized: gradients flow to the logit parameter
+    logit = nd.array(onp.zeros((), "float32"))
+    logit.attach_grad()
+    with autograd.record():
+        dd = P.RelaxedBernoulli(T, logit=logit)
+        out = (dd.sample(16) ** 2).sum()
+    out.backward()
+    assert float(onp.abs(logit.grad.asnumpy())) > 0
+
+
+def test_relaxed_one_hot_categorical_simplex_and_density():
+    T = 0.7
+    probs = onp.array([0.2, 0.5, 0.3], "float32")
+    d = P.RelaxedOneHotCategorical(T, prob=probs)
+    s = d.sample(500).asnumpy()
+    assert s.shape == (500, 3)
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(500), rtol=1e-4)
+    assert (s > 0).all()
+    # density integrates sensibly: compare against itself under the
+    # ExpConcrete change of variables at a fixed point
+    x = onp.array([0.2, 0.5, 0.3], "float32")
+    lp = float(d.log_prob(nd.array(x)).asnumpy())
+    assert onp.isfinite(lp)
+    # golden: Concrete density on the simplex (Maddison et al. eq. 23)
+    n = 3
+    import math
+    import scipy.special as spe
+    logits = onp.log(probs)
+    num = spe.gammaln(n) + (n - 1) * onp.log(T) \
+        + (logits - (T + 1) * onp.log(x)).sum() \
+        - n * spe.logsumexp(logits - T * onp.log(x))
+    onp.testing.assert_allclose(lp, num, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# transformations
+# ---------------------------------------------------------------------------
+
+def test_lognormal_via_transformed_distribution_matches_closed_form():
+    mu, sigma = 0.4, 0.8
+    td = P.TransformedDistribution(P.Normal(mu, sigma), P.ExpTransform())
+    xs = onp.array([0.5, 1.0, 2.3], "float32")
+    got = td.log_prob(nd.array(xs)).asnumpy()
+    want = sps.lognorm.logpdf(xs, sigma, scale=onp.exp(mu))
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+    direct = P.LogNormal(mu, sigma).log_prob(nd.array(xs)).asnumpy()
+    onp.testing.assert_allclose(got, direct, rtol=1e-5)
+
+
+def test_affine_compose_and_inverse_round_trip():
+    t = P.ComposeTransform([P.AffineTransform(1.0, 2.0),
+                            P.ExpTransform()])
+    x = nd.array(onp.array([0.1, -0.3, 0.7], "float32"))
+    y = t(x)
+    back = t.inv(y)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(), rtol=1e-5)
+    # y = exp(1 + 2x): log_det = log(2) + (1 + 2x)
+    ld = t.log_det_jacobian(x, y).asnumpy()
+    want = onp.log(2.0) + (1 + 2 * x.asnumpy())
+    onp.testing.assert_allclose(ld, want, rtol=1e-5)
+
+
+def test_transformed_cdf_icdf_with_sign():
+    # y = -x for x ~ Uniform(0,1): cdf_y(v) = 1 - cdf_x(-v)
+    td = P.TransformedDistribution(P.Uniform(0.0, 1.0),
+                                   P.AffineTransform(0.0, -1.0))
+    v = nd.array(onp.array([-0.25], "float32"))
+    onp.testing.assert_allclose(td.cdf(v).asnumpy(), [0.75], rtol=1e-6)
+    q = td.icdf(nd.array(onp.array([0.75], "float32"))).asnumpy()
+    onp.testing.assert_allclose(q, [-0.25], rtol=1e-6)
+
+
+def test_sigmoid_transform_density_matches_logistic():
+    td = P.TransformedDistribution(P.Normal(0.0, 1.0),
+                                   P.SigmoidTransform())
+    xs = onp.array([0.2, 0.5, 0.9], "float32")
+    got = td.log_prob(nd.array(xs)).asnumpy()
+    # manual change of variables
+    logit = onp.log(xs) - onp.log1p(-xs)
+    want = sps.norm.logpdf(logit) - onp.log(xs * (1 - xs))
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_non_bijective_transform_rejected():
+    with pytest.raises(MXNetError):
+        P.TransformedDistribution(P.Normal(0.0, 1.0), P.AbsTransform())
+    with pytest.raises(MXNetError):
+        P.AbsTransform().log_det_jacobian(nd.array([1.0]), nd.array([1.0]))
+
+
+def test_power_and_softmax_transforms():
+    t = P.PowerTransform(2.0)
+    x = nd.array(onp.array([1.5, 2.0], "float32"))
+    onp.testing.assert_allclose(t(x).asnumpy(), [2.25, 4.0], rtol=1e-6)
+    onp.testing.assert_allclose(t.inv(t(x)).asnumpy(), x.asnumpy(),
+                                rtol=1e-6)
+    sm = P.SoftmaxTransform()
+    y = sm(nd.array(onp.array([1.0, 2.0, 3.0], "float32"))).asnumpy()
+    onp.testing.assert_allclose(y.sum(), 1.0, rtol=1e-6)
+    assert not sm.bijective
+
+
+# ---------------------------------------------------------------------------
+# constraints
+# ---------------------------------------------------------------------------
+
+def test_constraints_accept_and_reject():
+    C = P.constraint
+    assert C.Positive().check(nd.array([1.0, 2.0])) is not None
+    with pytest.raises(MXNetError):
+        C.Positive().check(nd.array([0.0]))
+    C.Interval(0, 1).check(nd.array([0.0, 0.5, 1.0]))
+    with pytest.raises(MXNetError):
+        C.OpenInterval(0, 1).check(nd.array([0.0]))
+    C.IntegerInterval(0, 5).check(nd.array([0.0, 3.0, 5.0]))
+    with pytest.raises(MXNetError):
+        C.IntegerInterval(0, 5).check(nd.array([2.5]))
+    C.Boolean().check(nd.array([0.0, 1.0]))
+    with pytest.raises(MXNetError):
+        C.Boolean().check(nd.array([2.0]))
+    C.Simplex().check(nd.array([[0.2, 0.8], [0.5, 0.5]]))
+    with pytest.raises(MXNetError):
+        C.Simplex().check(nd.array([[0.2, 0.9]]))
+    tril = onp.array([[1.0, 0.0], [0.5, 2.0]], "float32")
+    C.LowerCholesky().check(nd.array(tril))
+    with pytest.raises(MXNetError):
+        C.LowerCholesky().check(nd.array(-tril))
+    C.PositiveDefinite().check(nd.array(tril @ tril.T))
+    with pytest.raises(MXNetError):
+        C.PositiveDefinite().check(nd.array(onp.array([[1.0, 3.0],
+                                                       [3.0, 1.0]])))
+    with pytest.raises(MXNetError):
+        C.dependent.check(nd.array([1.0]))
+    assert C.is_dependent(C.dependent)
+
+
+def test_discrete_distributions_grad_flows_to_params():
+    for mk in (lambda p: P.Binomial(n=5, prob=p),
+               lambda p: P.NegativeBinomial(n=3.0, prob=p),
+               lambda p: P.Multinomial(2, prob=nd.stack(p, 1 - p, axis=-1),
+                                       total_count=4)):
+        p = nd.array(onp.array(0.3, "float32"))
+        p.attach_grad()
+        with autograd.record():
+            d = mk(p)
+            v = nd.array([2.0, 2.0]) if isinstance(d, P.Multinomial) \
+                else nd.array([2.0])
+            lp = d.log_prob(v).sum()
+        lp.backward()
+        assert float(onp.abs(p.grad.asnumpy())) > 0, type(d).__name__
+    # logit parameterization too
+    lg = nd.array(onp.array(0.0, "float32"))
+    lg.attach_grad()
+    with autograd.record():
+        lp = P.Binomial(n=5, logit=lg).log_prob(nd.array([2.0])).sum()
+    lp.backward()
+    assert float(onp.abs(lg.grad.asnumpy())) > 0
+
+
+def test_transform_event_dim_above_base_sums_base_log_prob():
+    td = P.TransformedDistribution(
+        P.Normal(nd.array(onp.zeros(3, "f")), nd.array(onp.ones(3, "f"))),
+        P.AffineTransform(0.0, 2.0, event_dim=1))
+    lp = td.log_prob(nd.array(onp.full(3, 2.0, "f"))).asnumpy()
+    assert lp.shape == ()
+    want = sps.norm.logpdf([1.0] * 3).sum() - 3 * onp.log(2.0)
+    onp.testing.assert_allclose(lp, want, rtol=1e-5)
+
+
+def test_independent_under_transform_scalar_density():
+    base = P.Independent(
+        P.Normal(nd.array(onp.zeros(3, "f")), nd.array(onp.ones(3, "f"))),
+        1)
+    assert base.event_dim == 1
+    td = P.TransformedDistribution(base, P.ExpTransform())
+    lp = td.log_prob(nd.array(onp.ones(3, "f"))).asnumpy()
+    assert lp.shape == ()
+    want = sps.lognorm.logpdf(onp.ones(3), 1.0).sum()
+    onp.testing.assert_allclose(lp, want, rtol=1e-5)
+
+
+def test_power_transform_negative_exponent_cdf():
+    td = P.TransformedDistribution(P.Exponential(1.0),
+                                   P.PowerTransform(-1.0))
+    got = float(td.cdf(nd.array([2.0])).asnumpy())
+    # P(1/X <= 2) = P(X >= 0.5) = exp(-0.5)
+    onp.testing.assert_allclose(got, onp.exp(-0.5), rtol=1e-5)
+    q = float(td.icdf(nd.array([onp.float32(onp.exp(-0.5))])).asnumpy())
+    onp.testing.assert_allclose(q, 2.0, rtol=1e-4)
+
+
+def test_relaxed_one_hot_requires_param():
+    with pytest.raises(MXNetError):
+        P.RelaxedOneHotCategorical(0.5)
