@@ -157,17 +157,105 @@ class QuantizedConv(HybridBlock):
         return invoke_raw("quantized_conv", fn, [x])
 
 
+def _smooth_distribution(p: onp.ndarray, eps: float = 1e-4) -> onp.ndarray:
+    """Shift a little mass onto zero bins so KL(p||q) is defined
+    (reference calibrate.cc SmoothDistribution)."""
+    is_zero = p == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = p.size - n_zero
+    if n_nonzero == 0:
+        return p
+    eps1 = eps * n_zero / n_nonzero
+    out = p.astype("float64").copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps1
+    # bins smaller than the borrowed mass would go negative -> log() NaN
+    # and the candidate would be silently discarded; floor instead
+    return onp.maximum(out, 1e-12)
+
+
+def _optimal_threshold(arr: onp.ndarray, num_bins: int = 8001,
+                       num_quantized_bins: int = 255,
+                       max_candidates: int = 512) -> float:
+    """KL-entropy threshold search (reference calibrate.cc
+    LayerHistogramCollector + GetOptimalThreshold; the TensorRT-style
+    algorithm): over candidate clip thresholds, pick the one whose
+    255-level quantized distribution has minimum KL divergence from the
+    clipped reference distribution. Symmetric int8: the histogram is over
+    |x|; bin resolution follows the reference's 8001 so coarsening cost
+    at the full range genuinely competes with clipping cost."""
+    a = onp.abs(onp.asarray(arr, "float64").ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if amax <= 0:
+        return 1e-8
+    hist, edges = onp.histogram(a, bins=num_bins, range=(0.0, amax))
+    hist = hist.astype("float64")
+    width = edges[1] - edges[0]
+    # tail[i] == hist[i:].sum(); tail[num_bins] == 0 (nothing clipped)
+    tail = onp.concatenate([onp.cumsum(hist[::-1])[::-1], [0.0]])
+    nonzero = hist != 0
+    stride = max(1, (num_bins - num_quantized_bins) // max_candidates)
+    best_kl, best_th = onp.inf, amax
+    for i in range(num_quantized_bins, num_bins + 1, stride):
+        p = hist[:i].copy()
+        p[-1] += tail[i]  # clipped outlier mass lands on the edge bin
+        total = p.sum()
+        if total == 0:
+            continue
+        # quantize the i reference bins down to num_quantized_bins levels,
+        # then expand each level's mass evenly over its NONZERO source
+        # bins (segment sums via reduceat)
+        bounds = onp.round(onp.arange(num_quantized_bins + 1)
+                           * (i / num_quantized_bins)).astype("int64")
+        seg_sum = onp.add.reduceat(hist[:i], bounds[:-1])
+        seg_cnt = onp.add.reduceat(nonzero[:i].astype("float64"),
+                                   bounds[:-1])
+        level = onp.where(seg_cnt > 0, seg_sum / onp.maximum(seg_cnt, 1),
+                          0.0)
+        q = onp.repeat(level, onp.diff(bounds))
+        q[~nonzero[:i]] = 0.0
+        qsum = q.sum()
+        if qsum == 0:
+            continue
+        ps = _smooth_distribution(p / total)
+        qs = _smooth_distribution(q / qsum)
+        kl = float(onp.sum(ps * onp.log(ps / qs)))
+        if kl < best_kl:
+            best_kl = kl
+            best_th = (i + 0.5) * width
+    return best_th
+
+
 def _collect_ranges(net, calib_data, max_batches: int,
-                    mode: str, percentile: float) -> Dict[int, tuple]:
-    """Run calibration batches, recording per-layer input ranges via
-    forward hooks (the reference's calibration pass, calibrate.cc)."""
+                    mode: str, percentile: float,
+                    max_samples_per_layer: int = 1 << 21
+                    ) -> Dict[int, tuple]:
+    """Run calibration batches, recording per-layer input statistics via
+    forward hooks (the reference's calibration pass, calibrate.cc).
+    naive/percentile fold batches into running ranges; entropy keeps a
+    bounded activation sample per layer — an equal per-batch budget of
+    max_samples_per_layer/max_batches random elements, so every
+    calibration batch contributes uniformly (ordered calibration data
+    cannot skew the histogram toward early batches) — and runs the KL
+    threshold search at the end."""
     ranges: Dict[int, List] = {}
+    samples: Dict[int, List[onp.ndarray]] = {}
     hooks = []
+    rng = onp.random.RandomState(0)
+    per_batch_budget = max(1, max_samples_per_layer // max(1, max_batches))
 
     def make_hook(key):
         def hook(block, inputs):
             x = inputs[0]
             arr = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            if mode == "entropy":
+                flat = arr.ravel()
+                if flat.size > per_batch_budget:
+                    flat = flat[rng.randint(0, flat.size,
+                                            size=per_batch_budget)]
+                samples.setdefault(key, []).append(
+                    flat.astype("float32", copy=True))
+                return
             if mode == "percentile":
                 lo = float(onp.percentile(arr, 100 - percentile))
                 hi = float(onp.percentile(arr, percentile))
@@ -189,6 +277,10 @@ def _collect_ranges(net, calib_data, max_batches: int,
             break
     for h in hooks:
         h.detach()
+    if mode == "entropy":
+        for key, chunks in samples.items():
+            th = _optimal_threshold(onp.concatenate(chunks))
+            ranges[key] = [-th, th]
     return {k: tuple(v) for k, v in ranges.items()}
 
 
@@ -208,9 +300,9 @@ def quantize_net(net, calib_data, calib_mode: str = "naive",
                  exclude_first: bool = False):
     """Calibrate + swap Dense/Conv children for INT8 versions, in place
     (reference quantize_net, contrib/quantization.py)."""
-    if calib_mode not in ("naive", "percentile"):
-        raise MXNetError("calib_mode must be 'naive' or 'percentile' "
-                         "(KL-entropy not implemented on TPU build)")
+    if calib_mode not in ("naive", "percentile", "entropy"):
+        raise MXNetError("calib_mode must be 'naive', 'percentile' or "
+                         "'entropy'")
     ranges = _collect_ranges(net, calib_data, num_calib_batches,
                              calib_mode, percentile)
 

@@ -43,6 +43,12 @@ def _op(name, fn, inputs):
                                  for x in inputs])
 
 
+def _sum_rightmost(x, n):
+    """Sum the trailing n axes (event-dim reduction; shared by
+    Independent and the transformation module)."""
+    return jnp.sum(x, axis=tuple(range(x.ndim - n, x.ndim))) if n else x
+
+
 class Distribution:
     """Base distribution (reference distribution.py Distribution)."""
 
@@ -775,17 +781,13 @@ class Independent(Distribution):
         lp = self.base_dist.log_prob(value)
         n = self.reinterpreted_batch_ndims
         return _op("independent_sum",
-                   lambda x: jnp.sum(x, axis=tuple(range(x.ndim - n,
-                                                         x.ndim)))
-                   if n else x, [lp])
+                   lambda x: _sum_rightmost(x, n), [lp])
 
     def entropy(self) -> NDArray:
         ent = self.base_dist.entropy()
         n = self.reinterpreted_batch_ndims
         return _op("independent_sum",
-                   lambda x: jnp.sum(x, axis=tuple(range(x.ndim - n,
-                                                         x.ndim)))
-                   if n else x, [ent])
+                   lambda x: _sum_rightmost(x, n), [ent])
 
     @property
     def mean(self):

@@ -17,16 +17,12 @@ import jax.numpy as jnp
 
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
-from .distributions import Distribution, _op
+from .distributions import Distribution, _op, _sum_rightmost
 
 __all__ = ["Transformation", "ComposeTransform", "ExpTransform",
            "AffineTransform", "PowerTransform", "SigmoidTransform",
            "SoftmaxTransform", "AbsTransform", "TransformedDistribution",
            "RelaxedBernoulli", "RelaxedOneHotCategorical"]
-
-
-def _sum_rightmost(x, n):
-    return jnp.sum(x, axis=tuple(range(x.ndim - n, x.ndim))) if n else x
 
 
 class Transformation:
@@ -152,26 +148,28 @@ class ExpTransform(Transformation):
 
 
 class AffineTransform(Transformation):
-    """y = loc + scale * x (reference AffineTransform)."""
+    """y = loc + scale * x (reference AffineTransform). loc/scale ride
+    the op funnel as INPUTS, so recorded parameters receive gradients —
+    learned affine flows train (the tape only sees explicit op inputs)."""
 
     def __init__(self, loc, scale, event_dim: int = 0):
         self.loc = loc
         self.scale = scale
         self.event_dim = event_dim
 
-    def _np(self, v):
-        return v._data if isinstance(v, NDArray) else jnp.asarray(v)
+    def __call__(self, x):
+        return _op("AffineTransform_fwd",
+                   lambda xx, l, s: l + s * xx, [x, self.loc, self.scale])
 
-    def _forward(self, x):
-        return self._np(self.loc) + self._np(self.scale) * x
-
-    def _inverse(self, y):
-        return (y - self._np(self.loc)) / self._np(self.scale)
+    def _inv_call(self, y):
+        return _op("AffineTransform_inv",
+                   lambda yy, l, s: (yy - l) / s, [y, self.loc, self.scale])
 
     @property
     def sign(self):
         import numpy as onp
-        s = onp.asarray(self._np(self.scale))
+        s = self.scale
+        s = onp.asarray(s.asnumpy() if isinstance(s, NDArray) else s)
         if (s > 0).all():
             return 1
         if (s < 0).all():
@@ -179,10 +177,13 @@ class AffineTransform(Transformation):
         raise MXNetError("AffineTransform with mixed-sign scale has no "
                          "single monotonicity sign")
 
-    def _log_det(self, x, y):
-        ld = jnp.broadcast_to(jnp.log(jnp.abs(self._np(self.scale))),
-                              x.shape)
-        return _sum_rightmost(ld, self.event_dim)
+    def log_det_jacobian(self, x, y):
+        ed = self.event_dim
+
+        def fn(xx, l, s):
+            ld = jnp.broadcast_to(jnp.log(jnp.abs(s)), xx.shape)
+            return _sum_rightmost(ld, ed)
+        return _op("AffineTransform_logdet", fn, [x, self.loc, self.scale])
 
 
 class PowerTransform(Transformation):

@@ -4,6 +4,8 @@ import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
 from mxnet_tpu.contrib import quantization as q
 from mxnet_tpu.gluon import nn
 
@@ -65,3 +67,73 @@ def test_quantized_conv():
     qc = q.QuantizedConv(conv, -1.0, 1.0)
     out = qc(x).asnumpy()
     assert onp.abs(out - ref).max() < 0.1, onp.abs(out - ref).max()
+
+
+def test_entropy_threshold_clips_long_tail():
+    """KL-entropy calibration (reference calibrate.cc entropy mode): on a
+    long-tailed activation the optimal threshold ignores outliers, giving
+    lower int8 round-trip error than naive min/max."""
+    from mxnet_tpu.contrib.quantization import _optimal_threshold
+    rng = onp.random.RandomState(0)
+    bulk = rng.randn(200000).astype("float32")
+    outliers = rng.choice([-80.0, 80.0], size=40).astype("float32")
+    arr = onp.concatenate([bulk, outliers])
+
+    th = _optimal_threshold(arr)
+    assert th < 20.0, th          # naive would use 80
+    assert th > 1.0, th           # but must still cover the bulk
+
+    def int8_mse(x, threshold):
+        scale = threshold / 127.0
+        q = onp.clip(onp.round(x / scale), -127, 127)
+        return float(((q * scale - x) ** 2).mean())
+
+    # the KL threshold trades the rare outliers for bulk fidelity: error
+    # on the 99.98% bulk drops by >10x vs the naive full-range scale
+    assert int8_mse(bulk, th) < int8_mse(bulk, float(onp.abs(arr).max())) / 10
+
+
+def test_quantize_net_entropy_beats_naive_on_outlier_input():
+    from mxnet_tpu.contrib.quantization import quantize_net
+    rng = onp.random.RandomState(1)
+
+    def make_net():
+        onp.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu", in_units=16),
+                nn.Dense(8, in_units=32))
+        net.initialize()
+        for p in net.collect_params().values():
+            p.set_data(nd.array(onp.random.RandomState(
+                p.shape[0]).uniform(-0.3, 0.3, p.shape).astype("float32")))
+        return net
+
+    # calibration data: gaussian bulk + rare extreme spikes
+    batches = []
+    for _ in range(6):
+        x = rng.randn(32, 16).astype("float32")
+        x[0, 0] = 300.0  # one extreme outlier element per batch
+        batches.append(nd.array(x))
+    x_eval = nd.array(rng.randn(64, 16).astype("float32"))
+
+    ref = make_net()
+    want = ref(x_eval).asnumpy()
+
+    outs = {}
+    for mode in ("naive", "entropy"):
+        qnet = make_net()
+        quantize_net(qnet, list(batches), calib_mode=mode,
+                     num_calib_batches=6)
+        outs[mode] = qnet(x_eval).asnumpy()
+    err_naive = float(((outs["naive"] - want) ** 2).mean())
+    err_entropy = float(((outs["entropy"] - want) ** 2).mean())
+    assert err_entropy < err_naive, (err_entropy, err_naive)
+
+
+def test_quantize_net_rejects_unknown_mode():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4))
+    net.initialize()
+    from mxnet_tpu.contrib.quantization import quantize_net
+    with pytest.raises(MXNetError):
+        quantize_net(net, [nd.ones((2, 4))], calib_mode="klentropy")
