@@ -407,6 +407,27 @@ def _dropout(name, attrs, ins, out, extra):
     return [_node("Identity", ins, [out], name)]
 
 
+@_mx2onnx("UpSampling", "upsampling")
+def _upsampling(name, attrs, ins, out, extra):
+    # opset-13 Resize: X, roi(''), scales. Integer upscaling is identical
+    # across coordinate conventions; asymmetric+floor states it exactly
+    s = float(attrs.get("scale", 2))
+    sname = extra["unique"](f"{name}_scales")
+    extra["initializers"].append(
+        _tensor(sname, onp.asarray([1.0, 1.0, s, s], "float32")))
+    if attrs.get("sample_type", "nearest") == "nearest":
+        # integer nearest upscaling is identical across coordinate
+        # conventions; asymmetric+floor states it exactly
+        a = {"mode": "nearest",
+             "coordinate_transformation_mode": "asymmetric",
+             "nearest_mode": "floor"}
+    else:
+        # the op lowers to jax.image.resize linear = half-pixel centers
+        a = {"mode": "linear",
+             "coordinate_transformation_mode": "half_pixel"}
+    return [_node("Resize", [ins[0], "", sname], [out], name, a)]
+
+
 @_mx2onnx("add_scalar", "sub_scalar", "mul_scalar", "div_scalar")
 def _scalar_arith(name, attrs, ins, out, extra):
     op = {"add": "Add", "sub": "Sub", "mul": "Mul",
@@ -899,6 +920,54 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
             raise MXNetError("ONNX import: dynamic Reshape unsupported")
         return S("reshape", ins[:1],
                  {"shape": tuple(int(v) for v in shape)})
+    if op == "Resize":
+        mode = attrs.get("mode", "nearest")
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+        ctm = attrs.get("coordinate_transformation_mode", "half_pixel")
+        if isinstance(ctm, bytes):
+            ctm = ctm.decode()
+        scales = consts.get(ins[2]) if len(ins) > 2 and ins[2] else None
+        sizes = consts.get(ins[3]) if len(ins) > 3 and ins[3] else None
+        if scales is None and sizes is None:
+            raise MXNetError("ONNX import: Resize needs constant scales "
+                             "or sizes")
+        # supported numerics only — NEVER silently substitute another
+        # interpolation: linear requires half-pixel (what jax.image.resize
+        # computes); nearest requires equal integer scales (convention-
+        # independent). Everything else raises.
+        if mode == "linear" and ctm != "half_pixel":
+            raise MXNetError(
+                f"ONNX import: Resize linear with coordinate mode {ctm!r} "
+                "unsupported (half_pixel only; align_corners/asymmetric "
+                "would import with different interior numerics)")
+        if mode not in ("nearest", "linear"):
+            raise MXNetError(f"ONNX import: Resize mode {mode!r} "
+                             "unsupported (nearest/linear)")
+        if sizes is not None:
+            if mode != "linear":
+                raise MXNetError("ONNX import: Resize with explicit sizes "
+                                 "supports mode=linear only (nearest needs"
+                                 " shape inference this importer skips)")
+            h, w = int(sizes[-2]), int(sizes[-1])
+            return S("BilinearResize2D", ins[:1],
+                     {"height": h, "width": w})
+        sc = [float(v) for v in scales]
+        if len(sc) != 4 or sc[0] != 1 or sc[1] != 1:
+            raise MXNetError("ONNX import: Resize scales must be "
+                             "[1,1,sh,sw] (NCHW spatial resize)")
+        if mode == "nearest":
+            if not (sc[2] == sc[3] and float(sc[2]).is_integer()
+                    and sc[2] >= 1):
+                raise MXNetError(
+                    "ONNX import: nearest Resize supports equal integer "
+                    f"upscale factors only, got {sc[2:]} (substituting "
+                    "linear would silently change the numerics)")
+            return S("UpSampling", ins[:1],
+                     {"scale": int(sc[2]), "sample_type": "nearest"})
+        return S("BilinearResize2D", ins[:1],
+                 {"scale_height": sc[2], "scale_width": sc[3],
+                  "mode": "scale"})
     if op == "Transpose":
         a = {}
         if "perm" in attrs:

@@ -16,7 +16,9 @@ from ...nn.basic_layers import Sequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomCrop",
            "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting",
+           "RandomGray"]
 
 
 class Compose(Sequential):
@@ -181,3 +183,78 @@ class RandomSaturation(_RandomJitter):
         arr = x.asnumpy().astype("float32")
         gray = arr.mean(axis=-1, keepdims=True)
         return NDArray(gray + (arr - gray) * self._factor())
+
+
+class RandomHue(_RandomJitter):
+    """Random hue rotation (reference transforms RandomHue): chroma-plane
+    rotation in YIQ space, same math as image.HueJitterAug."""
+
+    def forward(self, x):
+        from ....image.image import HueJitterAug
+        return HueJitterAug(self._amount)(x)
+
+
+class RandomColorJitter(Block):
+    """Brightness/contrast/saturation/hue jitter applied in random order
+    (reference transforms RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = onp.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[int(i)](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference transforms
+    RandomLighting): alpha_std scales N(0,1) draws along the ImageNet RGB
+    eigenvectors."""
+
+    _EIGVAL = onp.array([55.46, 4.794, 1.148], "float32")
+    _EIGVEC = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], "float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        alpha = onp.random.normal(0, self._alpha, size=(3,))
+        rgb = (self._EIGVEC * alpha * self._EIGVAL).sum(axis=1)
+        return NDArray(x.asnumpy().astype("float32")
+                       + rgb.astype("float32"))
+
+
+class RandomGray(Block):
+    """Convert to 3-channel grayscale with probability p (reference
+    transforms RandomGray). Luma weights shared with the image-module
+    augmenters (single source of truth)."""
+
+    @property
+    def _COEF(self):
+        from ....image.image import ContrastJitterAug
+        return ContrastJitterAug._COEF
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            arr = x.asnumpy().astype("float32")
+            gray = (arr * self._COEF).sum(-1, keepdims=True)
+            return NDArray(onp.broadcast_to(gray, arr.shape).copy())
+        return x

@@ -79,8 +79,6 @@ class PipelineTrainer(Trainer):
             from jax.sharding import Mesh
             devs = jax.devices()
             pp = num_stages if len(devs) >= num_stages else 1
-            if num_stages % pp:
-                pp = 1
             mesh = Mesh(onp.array(devs[:pp]), ("pp",))
         self._mesh = mesh
         self._grad_fn = None

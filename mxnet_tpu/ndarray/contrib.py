@@ -989,3 +989,12 @@ def isfinite(data):
 def isnan(data):
     return invoke_raw("isnan", lambda x: jnp.isnan(x).astype(jnp.float32),
                       _wrap([data]))
+
+
+def BilinearResize2D(data, **kwargs):
+    """Reference contrib.BilinearResize2D (alias of the nn op)."""
+    from .nn_ops import BilinearResize2D as _br
+    return _br(data, **kwargs)
+
+
+__all__ += ["BilinearResize2D"]

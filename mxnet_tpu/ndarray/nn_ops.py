@@ -13,7 +13,7 @@ from .ndarray import NDArray
 
 __all__ = ["Convolution", "Deconvolution", "Pooling", "BatchNorm",
            "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
-           "LRN", "UpSampling"]
+           "LRN", "UpSampling", "BilinearResize2D"]
 
 
 def _wrap(x):
@@ -145,3 +145,31 @@ def UpSampling(data, scale=2, sample_type="nearest", num_args=1, **_ignored):
         method = "nearest" if sample_type == "nearest" else "linear"
         return jax.image.resize(x, (n, c, h * scale, w * scale), method=method)
     return invoke_raw("upsampling", fn, [data])
+
+
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, mode="size", **_ignored):
+    """Resize NCHW to an explicit (height, width) (``mode='size'``) or by
+    scale factors (``mode='scale'``, output = floor(in * scale) — the
+    ONNX Resize convention the importer maps onto); half-pixel linear
+    interpolation via jax.image.resize (reference contrib
+    BilinearResize2D, src/operator/contrib/bilinear_resize.cc)."""
+    import math as _math
+    data = _wrap(data)
+    n, c, h, w = data.shape
+    if mode == "size":
+        if height is None or width is None:
+            raise MXNetError(
+                "BilinearResize2D mode='size' needs height and width")
+    elif mode == "scale":
+        if scale_height is None or scale_width is None:
+            raise MXNetError("BilinearResize2D mode='scale' needs "
+                             "scale_height and scale_width")
+        height = int(_math.floor(h * scale_height))
+        width = int(_math.floor(w * scale_width))
+    else:
+        raise MXNetError(f"BilinearResize2D mode {mode!r} unsupported "
+                         "(size/scale)")
+    return invoke_raw(
+        "bilinear_resize",
+        lambda x: K.bilinear_resize(x, int(height), int(width)), [data])

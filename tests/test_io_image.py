@@ -109,3 +109,60 @@ def test_augmenter_pipeline():
         out = a(out)
     assert out.shape == (24, 24, 3)
     assert str(out.dtype) == "float32"
+
+
+def test_vision_transforms_color_tail():
+    """RandomHue / RandomColorJitter / RandomLighting / RandomGray
+    (reference gluon/data/vision/transforms.py round-3 tail)."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    from mxnet_tpu import nd as _nd
+    rng = onp.random.RandomState(0)
+    img = _nd.array(rng.randint(0, 255, (6, 5, 3)).astype("float32"))
+
+    onp.random.seed(0)
+    hued = T.RandomHue(0.3)(img).asnumpy()
+    assert hued.shape == img.shape and onp.isfinite(hued).all()
+    # hue rotation preserves luma (Y row of the YIQ matrix) closely
+    coef = onp.array([0.299, 0.587, 0.114], "float32")
+    onp.testing.assert_allclose((hued * coef).sum(-1),
+                                (img.asnumpy() * coef).sum(-1), rtol=0.02,
+                                atol=0.7)
+
+    jit = T.RandomColorJitter(0.2, 0.2, 0.2, 0.2)
+    assert jit(img).shape == img.shape
+
+    onp.random.seed(1)
+    lit = T.RandomLighting(0.1)(img).asnumpy()
+    # lighting adds a constant per-channel shift
+    delta = lit - img.asnumpy()
+    for c in range(3):
+        onp.testing.assert_allclose(delta[..., c],
+                                    delta[0, 0, c], rtol=1e-5, atol=1e-4)
+
+    gray = T.RandomGray(1.0)(img).asnumpy()
+    onp.testing.assert_allclose(gray[..., 0], gray[..., 1], rtol=1e-6)
+    onp.testing.assert_allclose(gray[..., 0], gray[..., 2], rtol=1e-6)
+    # p=0 is identity
+    onp.testing.assert_array_equal(T.RandomGray(0.0)(img).asnumpy(),
+                                   img.asnumpy())
+
+
+def test_bilinear_resize_2d_op():
+    """nd.BilinearResize2D (+ contrib alias): size and scale modes."""
+    from mxnet_tpu import nd as _nd
+    x = _nd.array(onp.arange(16.0, dtype="float32").reshape(1, 1, 4, 4))
+    out = _nd.BilinearResize2D(x, height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    out2 = _nd.contrib.BilinearResize2D(x, scale_height=0.5,
+                                        scale_width=0.5, mode="scale")
+    assert out2.shape == (1, 1, 2, 2)
+    assert onp.isfinite(out2.asnumpy()).all()
+    # scale mode floors (ONNX Resize convention): 5 * 1.1 -> 5
+    x5 = _nd.array(onp.zeros((1, 1, 5, 5), "float32"))
+    out3 = _nd.BilinearResize2D(x5, scale_height=1.1, scale_width=1.1,
+                                mode="scale")
+    assert out3.shape == (1, 1, 5, 5)
+    from mxnet_tpu.base import MXNetError as _E
+    import pytest as _pytest
+    with _pytest.raises(_E):
+        _nd.BilinearResize2D(x)  # size mode without height/width

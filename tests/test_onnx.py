@@ -488,3 +488,104 @@ def test_scalar_arith_export_matches_param_dtype(tmp_path):
         if "const" in nm:
             dtypes.add(mxonnx._get_int(tf, 2, -1))
     assert dtypes == {P.TensorDataType.FLOAT16}, dtypes
+
+
+def test_resize_export_import_roundtrip(tmp_path):
+    """UpSampling exports as opset-13 Resize and round-trips; a
+    foreign-style Resize with linear sizes imports via BilinearResize2D."""
+    x = sym.Variable("x")
+    y = sym.UpSampling(x, scale=2, sample_type="nearest")
+    path = str(tmp_path / "resize.onnx")
+    mxonnx.export_model(y, {}, in_shapes=[(1, 2, 3, 3)],
+                        onnx_file_path=path)
+    s, args, aux = mxonnx.import_model(path)
+    xv = onp.arange(18.0, dtype="float32").reshape(1, 2, 3, 3)
+    got = s.eval(x=nd.array(xv)).asnumpy()
+    want = xv.repeat(2, axis=2).repeat(2, axis=3)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # hand-built foreign Resize: linear mode with explicit sizes
+    graph = P.MessageWriter()
+    szs = mxonnx._tensor("szs", onp.asarray([1, 2, 6, 6], "int64"))
+    graph.write_message(5, szs)
+    node = P.MessageWriter()
+    node.write_string(1, "x")
+    node.write_string(1, "")
+    node.write_string(1, "")
+    node.write_string(1, "szs")
+    node.write_string(2, "out")
+    node.write_string(3, "r0")
+    node.write_string(4, "Resize")
+    attr = P.MessageWriter()
+    attr.write_string(1, "mode")
+    attr.write_bytes(4, b"linear")
+    attr.write_int(20, P.AttrType.STRING)
+    node.write_message(5, attr)
+    graph.write_message(1, node)
+    graph.write_string(2, "g")
+    graph.write_message(11, mxonnx._value_info("x", (1, 2, 3, 3)))
+    graph.write_message(12, mxonnx._value_info("out", None))
+    model = P.MessageWriter()
+    model.write_int(1, P.ONNX_IR_VERSION)
+    opset = P.MessageWriter()
+    opset.write_string(1, "")
+    opset.write_int(2, 13)
+    model.write_message(8, opset)
+    model.write_message(7, graph)
+    p2 = str(tmp_path / "resize_sizes.onnx")
+    with open(p2, "wb") as f:
+        f.write(model.tobytes())
+    s2, args2, aux2 = mxonnx.import_model(p2)
+    out = s2.eval(x=nd.array(xv)).asnumpy()
+    assert out.shape == (1, 2, 6, 6)
+    assert onp.isfinite(out).all()
+
+
+def test_resize_import_rejects_unsupported_numerics(tmp_path):
+    """Resize import must never silently substitute interpolation:
+    nearest with fractional scales and linear with align_corners raise."""
+    def build(mode, ctm, scales):
+        graph = P.MessageWriter()
+        sc = mxonnx._tensor("sc", onp.asarray(scales, "float32"))
+        graph.write_message(5, sc)
+        node = P.MessageWriter()
+        node.write_string(1, "x")
+        node.write_string(1, "")
+        node.write_string(1, "sc")
+        node.write_string(2, "out")
+        node.write_string(3, "r0")
+        node.write_string(4, "Resize")
+        for k, v in (("mode", mode),
+                     ("coordinate_transformation_mode", ctm)):
+            a = P.MessageWriter()
+            a.write_string(1, k)
+            a.write_bytes(4, v.encode())
+            a.write_int(20, P.AttrType.STRING)
+            node.write_message(5, a)
+        graph.write_message(1, node)
+        graph.write_string(2, "g")
+        graph.write_message(11, mxonnx._value_info("x", (1, 2, 4, 4)))
+        graph.write_message(12, mxonnx._value_info("out", None))
+        model = P.MessageWriter()
+        model.write_int(1, P.ONNX_IR_VERSION)
+        opset = P.MessageWriter()
+        opset.write_string(1, "")
+        opset.write_int(2, 13)
+        model.write_message(8, opset)
+        model.write_message(7, graph)
+        path = str(tmp_path / f"{mode}_{ctm}.onnx")
+        with open(path, "wb") as f:
+            f.write(model.tobytes())
+        return path
+
+    with pytest.raises(MXNetError):
+        mxonnx.import_model(build("nearest", "asymmetric",
+                                  [1, 1, 1.5, 1.5]))
+    with pytest.raises(MXNetError):
+        mxonnx.import_model(build("linear", "align_corners",
+                                  [1, 1, 2.0, 2.0]))
+    # half-pixel linear fractional scales DO import (floor sizing)
+    s, args, aux = mxonnx.import_model(
+        build("linear", "half_pixel", [1, 1, 1.5, 1.5]))
+    out = s.eval(x=nd.array(onp.ones((1, 2, 4, 4), "float32"))).asnumpy()
+    assert out.shape == (1, 2, 6, 6)
