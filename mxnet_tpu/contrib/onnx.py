@@ -43,7 +43,11 @@ def _attr(name: str, value) -> P.MessageWriter:
         a.write_bytes(4, value.encode())
         a.write_int(20, P.AttrType.STRING)
     elif isinstance(value, (list, tuple)):
-        if value and isinstance(value[0], float):
+        if value and isinstance(value[0], str):
+            for v in value:  # AttributeProto.strings (field 9)
+                a.write_bytes(9, v.encode())
+            a.write_int(20, P.AttrType.STRINGS)
+        elif value and isinstance(value[0], float):
             a.write_packed_floats(7, value)
             a.write_int(20, P.AttrType.FLOATS)
         else:
@@ -428,6 +432,94 @@ def _upsampling(name, attrs, ins, out, extra):
     return [_node("Resize", [ins[0], "", sname], [out], name, a)]
 
 
+# mx gate blocks -> ONNX gate blocks (row-block permutation of W/R/B):
+# LSTM ours [i, f, g, o] -> ONNX [i, o, f, c]; GRU ours [r, z, n] ->
+# ONNX [z, r, h]; vanilla RNN is single-gate
+_RNN_GATE_PERM = {"lstm": [0, 3, 1, 2], "gru": [1, 0, 2],
+                  "rnn_tanh": [0], "rnn_relu": [0]}
+_RNN_ONNX_OP = {"lstm": "LSTM", "gru": "GRU",
+                "rnn_tanh": "RNN", "rnn_relu": "RNN"}
+
+
+def _rnn_gate_reorder(mat, perm, h):
+    """Permute gate blocks (rows of size h) of a (G*h, ...) or (G*h,)
+    array."""
+    blocks = [mat[i * h:(i + 1) * h] for i in range(len(perm))]
+    return onp.concatenate([blocks[p] for p in perm], axis=0)
+
+
+@_mx2onnx("RNN")
+def _rnn_export(name, attrs, ins, out, extra):
+    """Reference RNN op -> ONNX LSTM/GRU/RNN node (single layer; the
+    reference exporter has the same constraint — multi-layer needs a node
+    chain). The packed cuDNN parameter vector is repacked into the ONNX
+    W (D, G*H, C) / R (D, G*H, H) / B (D, 2*G*H) tensors with the gate
+    order translated."""
+    from ..ndarray.nn_ops import _rnn_layout
+    mode = attrs.get("mode", "lstm")
+    if int(attrs.get("num_layers", 1)) != 1:
+        raise MXNetError("ONNX export: RNN supports num_layers=1 (chain "
+                         "single-layer nodes for deeper stacks)")
+    if attrs.get("state_outputs") or attrs.get("onnx_outputs"):
+        raise MXNetError("ONNX export: RNN with state/onnx outputs has no "
+                         "single-output translation; export the output-"
+                         "only form")
+    h = int(attrs["state_size"])
+    bi = bool(attrs.get("bidirectional", False))
+    dirs = 2 if bi else 1
+    g = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    pv = extra.get("param_values", {}).get(ins[1])
+    if pv is None:
+        raise MXNetError("ONNX export: RNN parameters must be a bound "
+                         "parameter (initializer), not a graph input")
+    total = pv.size
+    # invert rnn_packed_param_size for L=1: total = D*(G*H*(C+H) + 2*G*H)
+    c_in = (total // dirs - g * h * h - 2 * g * h) // (g * h)
+    order, expect = _rnn_layout(mode, int(c_in), h, 1, bi)
+    if expect != total:
+        raise MXNetError(f"ONNX export: RNN packed size {total} does not "
+                         f"factor as a single layer (inferred C={c_in})")
+    perm = _RNN_GATE_PERM[mode]
+    flat = [pv[o:o + int(onp.prod(s))].reshape(s) for o, s in order]
+    Ws, Rs, Bs = [], [], []
+    for d in range(dirs):
+        w_ih, w_hh, b_ih, b_hh = flat[4 * d:4 * d + 4]
+        Ws.append(_rnn_gate_reorder(w_ih, perm, h))
+        Rs.append(_rnn_gate_reorder(w_hh, perm, h))
+        Bs.append(onp.concatenate([_rnn_gate_reorder(b_ih, perm, h),
+                                   _rnn_gate_reorder(b_hh, perm, h)]))
+    names = {}
+    for key, arr in (("W", onp.stack(Ws)), ("R", onp.stack(Rs)),
+                     ("B", onp.stack(Bs))):
+        nm = extra["unique"](f"{name}_{key}")
+        extra["initializers"].append(_tensor(nm, arr.astype("float32")))
+        names[key] = nm
+    extra.setdefault("drop_initializers", set()).add(ins[1])
+    node_in = [ins[0], names["W"], names["R"], names["B"], ""]
+    node_in.append(ins[2] if len(ins) > 2 else "")   # initial_h
+    if mode == "lstm":
+        node_in.append(ins[3] if len(ins) > 3 else "")  # initial_c
+    while node_in and node_in[-1] == "":
+        node_in.pop()
+    a: Dict[str, Any] = {"hidden_size": h,
+                         "direction": "bidirectional" if bi else "forward"}
+    if mode == "gru":
+        a["linear_before_reset"] = 1  # our GRU applies r to (h W_hh + b)
+    if mode == "rnn_relu":
+        a["activations"] = ["Relu"] * dirs
+    y_raw = extra["unique"](f"{name}_Y")
+    nodes = [_node(_RNN_ONNX_OP[mode], node_in, [y_raw], name, a)]
+    # ONNX Y is (T, D, N, H); the op's output is (T, N, D*H)
+    y_tr = extra["unique"](f"{name}_Ytr")
+    nodes.append(_node("Transpose", [y_raw], [y_tr], f"{name}_tr",
+                       {"perm": [0, 2, 1, 3]}))
+    shp = extra["unique"](f"{name}_Yshape")
+    extra["initializers"].append(
+        _tensor(shp, onp.asarray([0, 0, -1], "int64")))
+    nodes.append(_node("Reshape", [y_tr, shp], [out], f"{name}_rs"))
+    return nodes
+
+
 @_mx2onnx("add_scalar", "sub_scalar", "mul_scalar", "div_scalar")
 def _scalar_arith(name, attrs, ins, out, extra):
     op = {"add": "Add", "sub": "Sub", "mul": "Mul",
@@ -531,8 +623,13 @@ def export_model(sym, params, in_shapes=None, in_types=None,
             nm = unique(s._name)
             emitted[id(s)] = nm
             if s._name in params:
-                extra["initializers"].append(
-                    _tensor(nm, onp.asarray(params[s._name].asnumpy())))
+                arr = onp.asarray(params[s._name].asnumpy())
+                t = _tensor(nm, arr)
+                extra["initializers"].append(t)
+                # translators that REPACK a parameter (RNN's packed
+                # vector) need its value and may drop the raw tensor
+                extra.setdefault("param_values", {})[nm] = arr
+                extra.setdefault("param_tensors", {})[nm] = t
             else:
                 shape = s._attrs.get("shape")
                 if shape is None and var_idx[0] < len(in_shapes):
@@ -562,7 +659,11 @@ def export_model(sym, params, in_shapes=None, in_types=None,
 
     head = visit(sym)
     graph.write_string(2, "mxnet_tpu")
+    dropped = {extra.get("param_tensors", {}).get(n)
+               for n in extra.get("drop_initializers", ())}
     for t in extra["initializers"]:
+        if t in dropped:
+            continue  # repacked by a translator (RNN packed vector)
         graph.write_message(5, t)
     for vi in input_vis:
         graph.write_message(11, vi)
@@ -656,6 +757,8 @@ def _parse_attrs(entries) -> Dict[str, Any]:
         elif atype == P.AttrType.FLOATS or (atype == 0 and 7 in f):
             blob = f[7][0][1]
             out[name] = tuple(_s.unpack(f"<{len(blob) // 4}f", blob))
+        elif atype == P.AttrType.STRINGS or (atype == 0 and 9 in f):
+            out[name] = tuple(v.decode() for w, v in f.get(9, []))
         elif atype == P.AttrType.TENSOR:
             out[name] = _parse_tensor(f[5][0][1])[1]
     return out
@@ -727,6 +830,11 @@ def import_model(model_file: str):
         else:
             sym_of[outs[0]] = s
         last_out = outs[0]
+
+    # values synthesized by node importers (RNN's repacked parameter
+    # vector) surface as parameters like any initializer
+    for k, v in const_of.items():
+        inits.setdefault(k, v)
 
     out_names = [_get_str(P.parse_message(vi), 1)
                  for w, vi in g.get(12, [])]
@@ -963,6 +1071,19 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
                     "ONNX import: nearest Resize supports equal integer "
                     f"upscale factors only, got {sc[2:]} (substituting "
                     "linear would silently change the numerics)")
+            # integer upscaling equals pixel replication ONLY under
+            # asymmetric or half_pixel coordinates with floor /
+            # round_prefer_floor rounding (the defaults); ceil and
+            # align_corners shift the mapping
+            nm_attr = attrs.get("nearest_mode", "round_prefer_floor")
+            if isinstance(nm_attr, bytes):
+                nm_attr = nm_attr.decode()
+            if ctm not in ("asymmetric", "half_pixel") or \
+                    nm_attr not in ("floor", "round_prefer_floor"):
+                raise MXNetError(
+                    f"ONNX import: nearest Resize with coordinate mode "
+                    f"{ctm!r} / nearest_mode {nm_attr!r} is not pixel "
+                    "replication — unsupported")
             return S("UpSampling", ins[:1],
                      {"scale": int(sc[2]), "sample_type": "nearest"})
         return S("BilinearResize2D", ins[:1],
@@ -997,6 +1118,102 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
         for i, o in enumerate(outs):
             node = Symbol("split", name, [src],
                           {"num_outputs": num, "axis": axis}, out_index=i)
+            node._group_key = group
+            result[o] = node
+        return result
+    if op in ("LSTM", "GRU", "RNN"):
+        g = {"LSTM": 4, "GRU": 3, "RNN": 1}[op]
+        h = int(attrs["hidden_size"])
+        W = consts.get(ins[1]) if len(ins) > 1 else None
+        R = consts.get(ins[2]) if len(ins) > 2 else None
+        B = consts.get(ins[3]) if len(ins) > 3 and ins[3] else None
+        if W is None or R is None:
+            raise MXNetError("ONNX import: recurrent W/R must be constant "
+                             "initializers")
+        if len(ins) > 4 and ins[4]:
+            raise MXNetError("ONNX import: recurrent sequence_lens is "
+                             "unsupported (the backend runs full length "
+                             "T — importing would silently change padded-"
+                             "batch numerics)")
+        if op == "LSTM" and len(ins) > 7 and ins[7]:
+            raise MXNetError("ONNX import: LSTM peephole weights (P) "
+                             "unsupported")
+        if attrs.get("clip") is not None:
+            raise MXNetError("ONNX import: recurrent cell clip "
+                             "unsupported")
+        direction = attrs.get("direction", "forward")
+        if isinstance(direction, bytes):
+            direction = direction.decode()
+        if direction == "reverse":
+            raise MXNetError("ONNX import: direction=reverse unsupported")
+        bi = direction == "bidirectional"
+        dirs = W.shape[0]
+        acts = tuple(a.lower() if isinstance(a, str) else a.decode().lower()
+                     for a in attrs.get("activations", ()))
+        if op == "RNN":
+            if acts and len(set(acts)) > 1:
+                raise MXNetError(f"ONNX import: per-direction RNN "
+                                 f"activations {acts} unsupported "
+                                 "(uniform only)")
+            a0 = acts[0] if acts else "tanh"
+            if a0 == "tanh":
+                mode = "rnn_tanh"
+            elif a0 == "relu":
+                mode = "rnn_relu"
+            else:
+                raise MXNetError(f"ONNX import: RNN activation {a0!r} "
+                                 "unsupported")
+        else:
+            mode = op.lower()
+            default = (("sigmoid", "tanh", "tanh") if mode == "lstm"
+                       else ("sigmoid", "tanh")) * dirs
+            if acts and acts != default:
+                raise MXNetError(f"ONNX import: {op} custom activations "
+                                 f"{acts} unsupported")
+        if mode == "gru" and int(attrs.get("linear_before_reset", 0)) != 1:
+            raise MXNetError(
+                "ONNX import: GRU linear_before_reset=0 applies the reset "
+                "gate before the hidden projection — different recurrence "
+                "than this backend computes (=1 supported)")
+        perm = _RNN_GATE_PERM[mode]
+        inv = [perm.index(i) for i in range(len(perm))]
+        ws, bs = [], []
+        for d in range(dirs):
+            ws.append(_rnn_gate_reorder(W[d], inv, h).astype("float32"))
+            ws.append(_rnn_gate_reorder(R[d], inv, h).astype("float32"))
+            if B is not None:
+                half = B[d][:g * h], B[d][g * h:2 * g * h]
+                bs.append(_rnn_gate_reorder(half[0], inv, h)
+                          .astype("float32"))
+                bs.append(_rnn_gate_reorder(half[1], inv, h)
+                          .astype("float32"))
+            else:
+                bs.append(onp.zeros(g * h, "float32"))
+                bs.append(onp.zeros(g * h, "float32"))
+        packed = onp.concatenate([a.ravel() for a in ws + bs])
+        pname = f"{name}_parameters"
+        while pname in consts:  # anonymous nodes could collide
+            pname += "_"
+        consts[pname] = packed
+        initial_h = ins[5] if len(ins) > 5 and ins[5] else None
+        initial_c = ins[6] if len(ins) > 6 and ins[6] else None
+        if initial_c and not initial_h:
+            raise MXNetError("ONNX import: LSTM initial_c without "
+                             "initial_h unsupported")
+        sym_inputs = [ins[0], pname]
+        if initial_h:
+            sym_inputs.append(initial_h)
+        if initial_c:
+            sym_inputs.append(initial_c)
+        a = {"state_size": h, "mode": mode, "num_layers": 1,
+             "bidirectional": bi, "onnx_outputs": True}
+        group = object()
+        result = {}
+        for i, o in enumerate(outs):
+            if not o:
+                continue
+            node = Symbol("RNN", name, [sym_in(n) for n in sym_inputs],
+                          dict(a), out_index=i)
             node._group_key = group
             result[o] = node
         return result

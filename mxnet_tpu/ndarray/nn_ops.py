@@ -5,6 +5,10 @@ Parameter names/semantics follow the reference ops so model code ports 1:1.
 """
 from __future__ import annotations
 
+import numpy as onp
+
+import jax.numpy as jnp
+
 from .. import _tape
 from ..base import MXNetError
 from ..ops import nn as K
@@ -13,7 +17,7 @@ from .ndarray import NDArray
 
 __all__ = ["Convolution", "Deconvolution", "Pooling", "BatchNorm",
            "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
-           "LRN", "UpSampling", "BilinearResize2D"]
+           "LRN", "UpSampling", "BilinearResize2D", "RNN"]
 
 
 def _wrap(x):
@@ -173,3 +177,116 @@ def BilinearResize2D(data, height=None, width=None, scale_height=None,
     return invoke_raw(
         "bilinear_resize",
         lambda x: K.bilinear_resize(x, int(height), int(width)), [data])
+
+
+def _rnn_layout(mode, input_size, state_size, num_layers, bidirectional):
+    """Slice table for the reference RNN op's packed parameter vector
+    (rnn-inl.h: all weights layer/direction-major, then all biases):
+    returns [(offset, shape)] in fused_rnn's [w_ih, w_hh, b_ih, b_hh]
+    per-(layer, dir) order."""
+    from ..ops.rnn import GATES
+    g = GATES[mode]
+    h = state_size
+    dirs = 2 if bidirectional else 1
+    w_slices, b_slices = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * dirs
+        for _ in range(dirs):
+            w_slices.append((off, (g * h, in_sz)))
+            off += g * h * in_sz
+            w_slices.append((off, (g * h, h)))
+            off += g * h * h
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            b_slices.append((off, (g * h,)))
+            off += g * h
+            b_slices.append((off, (g * h,)))
+            off += g * h
+    order = []
+    for i in range(num_layers * dirs):
+        order.append(w_slices[2 * i])       # w_ih
+        order.append(w_slices[2 * i + 1])   # w_hh
+        order.append(b_slices[2 * i])       # b_ih
+        order.append(b_slices[2 * i + 1])   # b_hh
+    return order, off
+
+
+def RNN(data, parameters, state=None, state_cell=None, state_size=None,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, onnx_outputs=False, **_ignored):
+    """Legacy fused RNN op over a single packed parameter vector
+    (reference src/operator/rnn.cc; cuDNN packing: weights then biases,
+    layer/direction-major). data: (T, N, C); state/state_cell:
+    (L*D, N, H). Returns output (T, N, D*H), or
+    ``[output, state_h(, state_cell)]`` with ``state_outputs=True``.
+    ``onnx_outputs=True`` instead emits the ONNX recurrent-node layout
+    ``[Y (T, D, N, H), Y_h(, Y_c)]`` (the importer's target)."""
+    from ..ops import rnn as K_rnn
+    if state_size is None:
+        raise MXNetError("RNN requires state_size")
+    data = _wrap(data)
+    h = int(state_size)
+    num_layers = int(num_layers)
+    dirs = 2 if bidirectional else 1
+    c_in = data.shape[-1]
+    order, total = _rnn_layout(mode, c_in, h, num_layers, bidirectional)
+    inputs = [data, _wrap(parameters)]
+    have_h = state is not None
+    have_c = state_cell is not None
+    if have_h:
+        inputs.append(_wrap(state))
+    if have_c:
+        inputs.append(_wrap(state_cell))
+
+    # inter-layer dropout (reference rnn-inl.h p): training-mode only,
+    # keyed from the framework RNG stream (captured host-side)
+    train = _tape.is_training() and float(p) > 0.0 and num_layers > 1
+    if train:
+        from .random import next_key
+        drop_key = next_key()
+    else:
+        drop_key = None
+
+    def fn(x, pv, *states):
+        if pv.size != total:
+            raise MXNetError(
+                f"RNN packed parameter size {pv.size} != expected {total} "
+                f"(mode={mode}, input={c_in}, hidden={h}, "
+                f"layers={num_layers}, dirs={dirs})")
+        flat = [pv[o:o + int(onp.prod(s))].reshape(s) for o, s in order]
+        n = x.shape[1]
+        zero = jnp.zeros((num_layers * dirs, n, h), x.dtype)
+        si = 0
+        if have_h:
+            h0 = states[si]
+            si += 1
+        else:
+            h0 = zero
+        if mode == "lstm":
+            c0 = states[si] if have_c else zero
+        else:
+            c0 = None
+        y, h_out, c_out = K_rnn.fused_rnn(x, h0, c0, flat, mode,
+                                          num_layers, bool(bidirectional),
+                                          dropout=float(p), train=train,
+                                          key=drop_key)
+        if onnx_outputs:
+            t = y.shape[0]
+            y_onnx = y.reshape(t, n, dirs, h).transpose(0, 2, 1, 3)
+            outs = [y_onnx, h_out]
+            if mode == "lstm":
+                outs.append(c_out)
+            return tuple(outs)
+        if state_outputs:
+            outs = [y, h_out]
+            if mode == "lstm":
+                outs.append(c_out)
+            return tuple(outs)
+        return y
+
+    n_out = 1
+    if onnx_outputs or state_outputs:
+        n_out = 3 if mode == "lstm" else 2
+    res = invoke_raw("rnn_packed", fn, inputs, n_outputs=n_out)
+    return res

@@ -589,3 +589,85 @@ def test_resize_import_rejects_unsupported_numerics(tmp_path):
         build("linear", "half_pixel", [1, 1, 1.5, 1.5]))
     out = s.eval(x=nd.array(onp.ones((1, 2, 4, 4), "float32"))).asnumpy()
     assert out.shape == (1, 2, 6, 6)
+
+
+@pytest.mark.parametrize("mode,bi", [
+    ("lstm", False), ("gru", False), ("rnn_tanh", False),
+    ("rnn_relu", False), ("lstm", True), ("gru", True),
+])
+def test_rnn_onnx_roundtrip(tmp_path, mode, bi):
+    """Reference RNN op (packed cuDNN parameters) exports as ONNX
+    LSTM/GRU/RNN with gate reorder + layout conversion and reimports to
+    identical numerics."""
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    rng = onp.random.RandomState(0)
+    T, N, C, H = 6, 3, 5, 7
+    n = rnn_packed_param_size(mode, C, H, 1, bi)
+    pv = rng.randn(n).astype("float32") * 0.2
+    x = sym.Variable("x")
+    p = sym.Variable("p")
+    y = sym.RNN(x, p, state_size=H, mode=mode, bidirectional=bi)
+    path = str(tmp_path / f"rnn_{mode}_{bi}.onnx")
+    mxonnx.export_model(y, {"p": nd.array(pv)}, in_shapes=[(T, N, C)],
+                        onnx_file_path=path)
+    s, args, aux = mxonnx.import_model(path)
+    # the packed vector was repacked into W/R/B: no raw initializer left
+    assert "p" not in args
+    xv = rng.randn(T, N, C).astype("float32")
+    got = s.eval(x=nd.array(xv), **args).asnumpy()
+    want = nd.RNN(nd.array(xv), nd.array(pv), state_size=H, mode=mode,
+                  bidirectional=bi).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_import_rejects_foreign_semantics(tmp_path):
+    """GRU linear_before_reset=0 and direction=reverse have different
+    recurrences than this backend — import must refuse, not approximate."""
+    def build(op, g, extra_attrs):
+        H, C, D = 4, 3, 1
+        graph = P.MessageWriter()
+        for key, shape in (("W", (D, g * H, C)), ("R", (D, g * H, H))):
+            graph.write_message(
+                5, mxonnx._tensor(key, onp.zeros(shape, "float32")))
+        node = P.MessageWriter()
+        for i in ("x", "W", "R"):
+            node.write_string(1, i)
+        node.write_string(2, "out")
+        node.write_string(3, "n0")
+        node.write_string(4, op)
+        for k, v in [("hidden_size", H)] + extra_attrs:
+            a = P.MessageWriter()
+            a.write_string(1, k)
+            if isinstance(v, str):
+                a.write_bytes(4, v.encode())
+                a.write_int(20, P.AttrType.STRING)
+            else:
+                a.write_int(3, v)
+                a.write_int(20, P.AttrType.INT)
+            node.write_message(5, a)
+        graph.write_message(1, node)
+        graph.write_string(2, "g")
+        graph.write_message(11, mxonnx._value_info("x", (5, 2, C)))
+        graph.write_message(12, mxonnx._value_info("out", None))
+        model = P.MessageWriter()
+        model.write_int(1, P.ONNX_IR_VERSION)
+        opset = P.MessageWriter()
+        opset.write_string(1, "")
+        opset.write_int(2, 13)
+        model.write_message(8, opset)
+        model.write_message(7, graph)
+        path = str(tmp_path / f"{op}{len(extra_attrs)}.onnx")
+        with open(path, "wb") as f:
+            f.write(model.tobytes())
+        return path
+
+    with pytest.raises(MXNetError):
+        mxonnx.import_model(build("GRU", 3, []))  # lbr defaults to 0
+    with pytest.raises(MXNetError):
+        mxonnx.import_model(build("LSTM", 4, [("direction", "reverse")]))
+    # plain LSTM without B imports fine (zero biases)
+    s, args, aux = mxonnx.import_model(
+        build("LSTM", 4, [("direction", "forward")]))
+    out = s.eval(x=nd.array(onp.ones((5, 2, 3), "float32")),
+                 **args).asnumpy()
+    assert out.shape == (5, 1, 2, 4)  # ONNX Y layout (T, D, N, H)
