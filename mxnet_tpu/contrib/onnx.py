@@ -480,7 +480,8 @@ def _rnn_export(name, attrs, ins, out, extra):
         raise MXNetError(f"ONNX export: RNN packed size {total} does not "
                          f"factor as a single layer (inferred C={c_in})")
     perm = _RNN_GATE_PERM[mode]
-    flat = [pv[o:o + int(onp.prod(s))].reshape(s) for o, s in order]
+    from ..ndarray.nn_ops import _rnn_unpack
+    flat = _rnn_unpack(pv, order)
     Ws, Rs, Bs = [], [], []
     for d in range(dirs):
         w_ih, w_hh, b_ih, b_hh = flat[4 * d:4 * d + 4]
@@ -642,6 +643,9 @@ def export_model(sym, params, in_shapes=None, in_types=None,
                 input_vis.append(_value_info(nm, shape, elem))
             return nm
         ins = [visit(i) for i in s._inputs]
+        for nm in ins:  # consumer counts gate drop_initializers below
+            refs = extra.setdefault("input_refs", {})
+            refs[nm] = refs.get(nm, 0) + 1
         builder = _MX2ONNX.get(s._op)
         if builder is None:
             raise MXNetError(
@@ -659,11 +663,16 @@ def export_model(sym, params, in_shapes=None, in_types=None,
 
     head = visit(sym)
     graph.write_string(2, "mxnet_tpu")
+    # drop repacked parameters (RNN packed vector) ONLY when the
+    # repacking node was their sole consumer — another node may still
+    # reference the raw tensor
+    refs = extra.get("input_refs", {})
     dropped = {extra.get("param_tensors", {}).get(n)
-               for n in extra.get("drop_initializers", ())}
+               for n in extra.get("drop_initializers", ())
+               if refs.get(n, 0) <= 1}
     for t in extra["initializers"]:
         if t in dropped:
-            continue  # repacked by a translator (RNN packed vector)
+            continue
         graph.write_message(5, t)
     for vi in input_vis:
         graph.write_message(11, vi)
@@ -1078,8 +1087,14 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
             nm_attr = attrs.get("nearest_mode", "round_prefer_floor")
             if isinstance(nm_attr, bytes):
                 nm_attr = nm_attr.decode()
-            if ctm not in ("asymmetric", "half_pixel") or \
-                    nm_attr not in ("floor", "round_prefer_floor"):
+            # exact-replication PAIRS only: asymmetric+floor maps dst i ->
+            # floor(i/s); half_pixel+round_prefer_floor maps to
+            # round_pf((i+.5)/s - .5) — both equal replication for every
+            # integer s. Mixed pairs (half_pixel+floor, asymmetric+round)
+            # shift sources at some scales
+            if (ctm, nm_attr) not in (("asymmetric", "floor"),
+                                      ("half_pixel",
+                                       "round_prefer_floor")):
                 raise MXNetError(
                     f"ONNX import: nearest Resize with coordinate mode "
                     f"{ctm!r} / nearest_mode {nm_attr!r} is not pixel "
@@ -1126,10 +1141,15 @@ def _import_node(op, name, ins, outs, attrs, sym_in, consts):
         h = int(attrs["hidden_size"])
         W = consts.get(ins[1]) if len(ins) > 1 else None
         R = consts.get(ins[2]) if len(ins) > 2 else None
-        B = consts.get(ins[3]) if len(ins) > 3 and ins[3] else None
+        has_b = len(ins) > 3 and ins[3]
+        B = consts.get(ins[3]) if has_b else None
         if W is None or R is None:
             raise MXNetError("ONNX import: recurrent W/R must be constant "
                              "initializers")
+        if has_b and B is None:
+            # a PRESENT but non-constant B must not silently become zeros
+            raise MXNetError("ONNX import: recurrent B must be a constant "
+                             "initializer when given")
         if len(ins) > 4 and ins[4]:
             raise MXNetError("ONNX import: recurrent sequence_lens is "
                              "unsupported (the backend runs full length "

@@ -212,6 +212,13 @@ def _rnn_layout(mode, input_size, state_size, num_layers, bidirectional):
     return order, off
 
 
+def _rnn_unpack(pv, order):
+    """Slice a packed parameter vector by an _rnn_layout order table
+    (single owner of the slice/reshape contract; used by the op kernel
+    and the ONNX exporter)."""
+    return [pv[o:o + int(onp.prod(s))].reshape(s) for o, s in order]
+
+
 def RNN(data, parameters, state=None, state_cell=None, state_size=None,
         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
         state_outputs=False, onnx_outputs=False, **_ignored):
@@ -234,6 +241,11 @@ def RNN(data, parameters, state=None, state_cell=None, state_size=None,
     inputs = [data, _wrap(parameters)]
     have_h = state is not None
     have_c = state_cell is not None
+    if have_c and not have_h:
+        # positional symbol/executor binding would silently feed the cell
+        # in as the hidden state — refuse the ambiguous form
+        raise MXNetError("RNN: state_cell without state is unsupported; "
+                         "pass both (in that order for symbolic calls)")
     if have_h:
         inputs.append(_wrap(state))
     if have_c:
@@ -254,7 +266,7 @@ def RNN(data, parameters, state=None, state_cell=None, state_size=None,
                 f"RNN packed parameter size {pv.size} != expected {total} "
                 f"(mode={mode}, input={c_in}, hidden={h}, "
                 f"layers={num_layers}, dirs={dirs})")
-        flat = [pv[o:o + int(onp.prod(s))].reshape(s) for o, s in order]
+        flat = _rnn_unpack(pv, order)
         n = x.shape[1]
         zero = jnp.zeros((num_layers * dirs, n, h), x.dtype)
         si = 0

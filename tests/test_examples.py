@@ -99,3 +99,7 @@ def test_opbench_runs_and_reports():
     per_op = lines[:-1]
     assert any(r["op"].startswith("dot_") and r["gflops"] > 0
                for r in per_op)
+
+
+def test_example_pipeline_trainer():
+    _run("pipeline_trainer.py", ("x", "--steps", "12", "--width", "16"))
