@@ -172,8 +172,13 @@ class Optimizer:
             import os
             rule = self._rule()
             has_clip = self.clip_gradient is not None
+            # distinct compiled signatures (row buckets), recorded at
+            # trace time into a SET — stable under jit-cache eviction
+            # retraces, unlike jit's internal cache size
+            self._sparse_trace_buckets = set()
 
             def stepfn(w, ids, vals, lr, wd, t, rescale, clip, states):
+                self._sparse_trace_buckets.add(int(ids.shape[0]))
                 g = vals * rescale
                 if has_clip:
                     g = jnp.clip(g, -clip, clip)

@@ -225,7 +225,9 @@ def test_sparse_update_bucketed_compiles():
         vals = onp.ones((n, dim), "float32")
         g = nd.sparse.row_sparse_array((vals, rows), shape=(vocab, dim))
         sgd.update(0, w, g, state)
-    assert sgd._jit_sparse._cache_size() == 2  # buckets {4, 8}
+    # buckets {4, 8}: exactly two distinct signatures (trace-time set —
+    # stable under jit-cache eviction/retraces, unlike _cache_size)
+    assert sgd._sparse_trace_buckets == {4, 8}
     # padding rows are dropped: row `vocab-1` was never touched
     assert w.asnumpy()[vocab - 1].tolist() == [0.0, 0.0]
 
