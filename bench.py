@@ -251,22 +251,37 @@ def main():
             if r["tflops"] and peak else None,
         })
     if model in ("all", "bert"):
-        b = bench_bert(dtype)
-        if model == "bert":
+        # isolate: a secondary-model failure must not destroy the
+        # primary metric's JSON line
+        try:
+            b = bench_bert(dtype)
+        except Exception as e:
+            if model == "bert":
+                raise
+            log(f"bench[bert]: FAILED ({type(e).__name__}: {e}); "
+                "continuing with resnet metrics only")
+            b = None
+        if b is not None:
+            if model == "bert":
+                out.update({
+                    "metric": "bert_base_train_tokens_per_sec",
+                    "value": round(b["tok_s"], 1),
+                    "unit": "tokens/s",
+                    "vs_baseline": None,  # no in-tree reference number
+                    "dtype": dtype,
+                })
             out.update({
-                "metric": "bert_base_train_tokens_per_sec",
-                "value": round(b["tok_s"], 1),
-                "unit": "tokens/s",
-                "vs_baseline": None,  # no in-tree reference BERT number
-                "dtype": dtype,
+                "bert_tokens_per_sec": round(b["tok_s"], 1),
+                "bert_tflops": round(b["tflops"], 2)
+                if b["tflops"] else None,
+                "bert_mfu": round(b["tflops"] / peak, 4)
+                if b["tflops"] and peak else None,
             })
-        out.update({
-            "bert_tokens_per_sec": round(b["tok_s"], 1),
-            "bert_tflops": round(b["tflops"], 2) if b["tflops"] else None,
-            "bert_mfu": round(b["tflops"] / peak, 4)
-            if b["tflops"] and peak else None,
-        })
-    roof = matmul_roofline()
+    try:
+        roof = matmul_roofline()
+    except Exception as e:
+        log(f"bench: roofline probe failed ({type(e).__name__}: {e})")
+        roof = None
     out.update({
         "matmul_roofline_tflops": round(roof, 1) if roof else None,
         "peak_tflops": peak,

@@ -235,3 +235,25 @@ def test_flash_attention_pallas_backward_multiblock(causal):
     for a, b in zip((dq, dk, dv), (rq, rk, rv)):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
                                     rtol=2e-4, atol=2e-4)
+
+
+def test_flash_pallas_bf16_interpret():
+    """bf16 flash attention (interpret mode): the dtype the AMP path now
+    feeds the Pallas kernels on TPU — fwd matches the reference, bwd
+    grads are finite and keep the activation dtype."""
+    rng = onp.random.RandomState(0)
+    B, H, S, D = 2, 2, 64, 32
+    q, k, v, do = (jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+                   for _ in range(4))
+    out, lse = A._flash_fwd_pallas(q, k, v, causal=True,
+                                   sm_scale=D ** -0.5, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = A.attention_reference(q, k, v, causal=True, sm_scale=D ** -0.5)
+    onp.testing.assert_allclose(onp.asarray(out, "float32"),
+                                onp.asarray(ref, "float32"),
+                                rtol=3e-2, atol=3e-2)
+    dq, dk, dv = A._flash_bwd_pallas(q, k, v, out, lse, do, causal=True,
+                                     sm_scale=D ** -0.5, interpret=True)
+    for g in (dq, dk, dv):
+        assert g.dtype == jnp.bfloat16
+        assert onp.isfinite(onp.asarray(g, "float32")).all()
