@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""ConvLSTM next-frame prediction (reference: the contrib
+Conv2DLSTMCell use case from gluon/contrib/rnn/conv_rnn_cell.py; Shi et
+al. 2015 precipitation nowcasting).
+
+A moving bright square bounces around a grid; a Conv2DLSTMCell encoder
+unrolls over the input clip and a 1x1 conv head predicts the NEXT frame.
+Falling loss + the predicted square landing on the true next position
+prove the contrib conv-recurrent path end to end. Every timestep is two
+MXU convolutions; hybridize-style unrolling keeps the whole clip one XLA
+program under the jitted CachedOp when wrapped in a HybridBlock.
+
+Run: python examples/convlstm_video.py [--steps 60]
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+def make_clip(rng, batch, length=6, size=16):
+    """Square moving with constant velocity; returns clip and next frame."""
+    clips = onp.zeros((batch, length, 1, size, size), "float32")
+    nxt = onp.zeros((batch, 1, size, size), "float32")
+    for b in range(batch):
+        x, y = rng.randint(2, size - 6, 2)
+        dx, dy = rng.choice([-1, 1], 2)
+        for t in range(length + 1):
+            xx = int(onp.clip(x + dx * t, 0, size - 4))
+            yy = int(onp.clip(y + dy * t, 0, size - 4))
+            target = clips[b, t] if t < length else nxt[b]
+            target[0, yy:yy + 4, xx:xx + 4] = 1.0
+    return clips, nxt
+
+
+class NextFrame(gluon.Block):
+    def __init__(self, size=16):
+        super().__init__()
+        self.cell = crnn.Conv2DLSTMCell(input_shape=(1, size, size),
+                                        hidden_channels=8, i2h_kernel=3,
+                                        h2h_kernel=3, i2h_pad=1)
+        self.head = nn.Conv2D(1, 1, in_channels=8)
+
+    def forward(self, clip):
+        # clip: (B, T, 1, H, W)
+        outs, _ = self.cell.unroll(clip.shape[1], clip, layout="NTC")
+        return self.head(outs[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    rng = onp.random.RandomState(0)
+
+    net = NextFrame()
+    # Xavier at conv-RNN scale: the default tiny-uniform init leaves the
+    # gate pre-activations so small the model stalls at the base rate
+    net.initialize(mx.init.Xavier(magnitude=2.5))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3}, kvstore="tpu")
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+
+    first = last = None
+    for step in range(args.steps):
+        clips, nxt = make_clip(rng, args.batch)
+        with autograd.record():
+            pred = net(nd.array(clips))
+            loss = loss_fn(pred, nd.array(nxt)).mean()
+        loss.backward()
+        trainer.step(args.batch)
+        v = float(loss.asnumpy())
+        first, last = (v if first is None else first), v
+        if step % 20 == 0 or step == args.steps - 1:
+            print(f"step {step:3d} loss {v:.4f}")
+    assert last < first, (first, last)
+
+    # the predicted square should overlap the true next position
+    clips, nxt = make_clip(rng, 4)
+    pred = 1 / (1 + onp.exp(-net(nd.array(clips)).asnumpy()))
+    hits = 0
+    for b in range(4):
+        mask = nxt[b, 0] > 0.5
+        hits += pred[b, 0][mask].mean() > pred[b, 0][~mask].mean()
+    print(f"ConvLSTM: loss {first:.4f} -> {last:.4f}; "
+          f"{hits}/4 predictions localize the moving square")
+    assert hits >= 3
+
+
+if __name__ == "__main__":
+    main()

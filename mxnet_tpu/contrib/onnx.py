@@ -450,20 +450,23 @@ def _rnn_gate_reorder(mat, perm, h):
 
 @_mx2onnx("RNN")
 def _rnn_export(name, attrs, ins, out, extra):
-    """Reference RNN op -> ONNX LSTM/GRU/RNN node (single layer; the
-    reference exporter has the same constraint — multi-layer needs a node
-    chain). The packed cuDNN parameter vector is repacked into the ONNX
-    W (D, G*H, C) / R (D, G*H, H) / B (D, 2*G*H) tensors with the gate
-    order translated."""
-    from ..ndarray.nn_ops import _rnn_layout
+    """Reference RNN op -> chain of ONNX LSTM/GRU/RNN nodes (one per
+    layer — ONNX recurrent nodes are single-layer). The packed cuDNN
+    parameter vector is repacked into per-layer ONNX W (D, G*H, C) /
+    R (D, G*H, H) / B (D, 2*G*H) tensors with the gate order translated;
+    each layer's Y converts (T, D, N, H) -> (T, N, D*H) to feed the
+    next."""
+    from ..ndarray.nn_ops import _rnn_layout, _rnn_unpack
     mode = attrs.get("mode", "lstm")
-    if int(attrs.get("num_layers", 1)) != 1:
-        raise MXNetError("ONNX export: RNN supports num_layers=1 (chain "
-                         "single-layer nodes for deeper stacks)")
+    num_layers = int(attrs.get("num_layers", 1))
     if attrs.get("state_outputs") or attrs.get("onnx_outputs"):
         raise MXNetError("ONNX export: RNN with state/onnx outputs has no "
                          "single-output translation; export the output-"
                          "only form")
+    if num_layers > 1 and len(ins) > 2:
+        raise MXNetError("ONNX export: multi-layer RNN with explicit "
+                         "initial states needs per-layer state slicing — "
+                         "export the zero-state form")
     h = int(attrs["state_size"])
     bi = bool(attrs.get("bidirectional", False))
     dirs = 2 if bi else 1
@@ -473,51 +476,67 @@ def _rnn_export(name, attrs, ins, out, extra):
         raise MXNetError("ONNX export: RNN parameters must be a bound "
                          "parameter (initializer), not a graph input")
     total = pv.size
-    # invert rnn_packed_param_size for L=1: total = D*(G*H*(C+H) + 2*G*H)
-    c_in = (total // dirs - g * h * h - 2 * g * h) // (g * h)
-    order, expect = _rnn_layout(mode, int(c_in), h, 1, bi)
-    if expect != total:
+    # invert rnn_packed_param_size: layer 0 sees C inputs, deeper layers
+    # see H*dirs -> C = total/(D*G*H) - (L-1)*(H*D + H + 2) - H - 2
+    c_in = (total // (dirs * g * h)
+            - (num_layers - 1) * (h * dirs + h + 2) - h - 2)
+    order, expect = _rnn_layout(mode, int(c_in), h, num_layers, bi)
+    if c_in < 1 or expect != total:
         raise MXNetError(f"ONNX export: RNN packed size {total} does not "
-                         f"factor as a single layer (inferred C={c_in})")
+                         f"factor as {num_layers} layer(s) (inferred "
+                         f"C={c_in})")
     perm = _RNN_GATE_PERM[mode]
-    from ..ndarray.nn_ops import _rnn_unpack
     flat = _rnn_unpack(pv, order)
-    Ws, Rs, Bs = [], [], []
-    for d in range(dirs):
-        w_ih, w_hh, b_ih, b_hh = flat[4 * d:4 * d + 4]
-        Ws.append(_rnn_gate_reorder(w_ih, perm, h))
-        Rs.append(_rnn_gate_reorder(w_hh, perm, h))
-        Bs.append(onp.concatenate([_rnn_gate_reorder(b_ih, perm, h),
-                                   _rnn_gate_reorder(b_hh, perm, h)]))
-    names = {}
-    for key, arr in (("W", onp.stack(Ws)), ("R", onp.stack(Rs)),
-                     ("B", onp.stack(Bs))):
-        nm = extra["unique"](f"{name}_{key}")
-        extra["initializers"].append(_tensor(nm, arr.astype("float32")))
-        names[key] = nm
     extra.setdefault("drop_initializers", set()).add(ins[1])
-    node_in = [ins[0], names["W"], names["R"], names["B"], ""]
-    node_in.append(ins[2] if len(ins) > 2 else "")   # initial_h
-    if mode == "lstm":
-        node_in.append(ins[3] if len(ins) > 3 else "")  # initial_c
-    while node_in and node_in[-1] == "":
-        node_in.pop()
-    a: Dict[str, Any] = {"hidden_size": h,
-                         "direction": "bidirectional" if bi else "forward"}
-    if mode == "gru":
-        a["linear_before_reset"] = 1  # our GRU applies r to (h W_hh + b)
-    if mode == "rnn_relu":
-        a["activations"] = ["Relu"] * dirs
-    y_raw = extra["unique"](f"{name}_Y")
-    nodes = [_node(_RNN_ONNX_OP[mode], node_in, [y_raw], name, a)]
-    # ONNX Y is (T, D, N, H); the op's output is (T, N, D*H)
-    y_tr = extra["unique"](f"{name}_Ytr")
-    nodes.append(_node("Transpose", [y_raw], [y_tr], f"{name}_tr",
-                       {"perm": [0, 2, 1, 3]}))
+
     shp = extra["unique"](f"{name}_Yshape")
     extra["initializers"].append(
         _tensor(shp, onp.asarray([0, 0, -1], "int64")))
-    nodes.append(_node("Reshape", [y_tr, shp], [out], f"{name}_rs"))
+    nodes = []
+    layer_in = ins[0]
+    for layer in range(num_layers):
+        Ws, Rs, Bs = [], [], []
+        for d in range(dirs):
+            base = 4 * (layer * dirs + d)
+            w_ih, w_hh, b_ih, b_hh = flat[base:base + 4]
+            Ws.append(_rnn_gate_reorder(w_ih, perm, h))
+            Rs.append(_rnn_gate_reorder(w_hh, perm, h))
+            Bs.append(onp.concatenate(
+                [_rnn_gate_reorder(b_ih, perm, h),
+                 _rnn_gate_reorder(b_hh, perm, h)]))
+        names = {}
+        for key, arr in (("W", onp.stack(Ws)), ("R", onp.stack(Rs)),
+                         ("B", onp.stack(Bs))):
+            nm = extra["unique"](f"{name}_l{layer}_{key}")
+            extra["initializers"].append(_tensor(nm, arr.astype("float32")))
+            names[key] = nm
+        node_in = [layer_in, names["W"], names["R"], names["B"], ""]
+        if num_layers == 1:
+            node_in.append(ins[2] if len(ins) > 2 else "")   # initial_h
+            if mode == "lstm":
+                node_in.append(ins[3] if len(ins) > 3 else "")
+        while node_in and node_in[-1] == "":
+            node_in.pop()
+        a: Dict[str, Any] = {
+            "hidden_size": h,
+            "direction": "bidirectional" if bi else "forward"}
+        if mode == "gru":
+            a["linear_before_reset"] = 1  # r applies to (h W_hh + b)
+        if mode == "rnn_relu":
+            a["activations"] = ["Relu"] * dirs
+        y_raw = extra["unique"](f"{name}_l{layer}_Y")
+        nodes.append(_node(_RNN_ONNX_OP[mode], node_in, [y_raw],
+                           f"{name}_l{layer}" if num_layers > 1 else name,
+                           a))
+        # ONNX Y is (T, D, N, H); the op/next layer wants (T, N, D*H)
+        y_tr = extra["unique"](f"{name}_l{layer}_Ytr")
+        nodes.append(_node("Transpose", [y_raw], [y_tr],
+                           f"{name}_l{layer}_tr", {"perm": [0, 2, 1, 3]}))
+        last = layer == num_layers - 1
+        y_out = out if last else extra["unique"](f"{name}_l{layer}_Yflat")
+        nodes.append(_node("Reshape", [y_tr, shp], [y_out],
+                           f"{name}_l{layer}_rs"))
+        layer_in = y_out
     return nodes
 
 

@@ -103,3 +103,8 @@ def test_opbench_runs_and_reports():
 
 def test_example_pipeline_trainer():
     _run("pipeline_trainer.py", ("x", "--steps", "12", "--width", "16"))
+
+
+@pytest.mark.slow
+def test_example_convlstm():
+    _run("convlstm_video.py", ("x", "--steps", "200"))

@@ -671,3 +671,29 @@ def test_rnn_import_rejects_foreign_semantics(tmp_path):
     out = s.eval(x=nd.array(onp.ones((5, 2, 3), "float32")),
                  **args).asnumpy()
     assert out.shape == (5, 1, 2, 4)  # ONNX Y layout (T, D, N, H)
+
+
+@pytest.mark.parametrize("mode,bi,layers", [
+    ("lstm", False, 2), ("gru", True, 3), ("rnn_tanh", False, 3),
+])
+def test_rnn_onnx_multilayer_chain(tmp_path, mode, bi, layers):
+    """Multi-layer RNN exports as a chain of single-layer ONNX nodes
+    (each layer's Y reshaped to feed the next) and round-trips."""
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    rng = onp.random.RandomState(1)
+    T, N, C, H = 5, 2, 4, 6
+    n = rnn_packed_param_size(mode, C, H, layers, bi)
+    pv = rng.randn(n).astype("float32") * 0.2
+    x = sym.Variable("x")
+    p = sym.Variable("p")
+    y = sym.RNN(x, p, state_size=H, mode=mode, bidirectional=bi,
+                num_layers=layers)
+    path = str(tmp_path / "ml.onnx")
+    mxonnx.export_model(y, {"p": nd.array(pv)}, in_shapes=[(T, N, C)],
+                        onnx_file_path=path)
+    s, args, aux = mxonnx.import_model(path)
+    xv = rng.randn(T, N, C).astype("float32")
+    got = s.eval(x=nd.array(xv), **args).asnumpy()
+    want = nd.RNN(nd.array(xv), nd.array(pv), state_size=H, mode=mode,
+                  bidirectional=bi, num_layers=layers).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
