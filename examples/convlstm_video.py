@@ -60,6 +60,7 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     args = ap.parse_args()
     rng = onp.random.RandomState(0)
+    mx.random.seed(0)  # initializer draws from the framework RNG stream
 
     net = NextFrame()
     # Xavier at conv-RNN scale: the default tiny-uniform init leaves the
