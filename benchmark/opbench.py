@@ -101,6 +101,50 @@ def _cases():
         lambda idx=nd.array(rng.randint(0, 1000, (64, 128))
                             .astype("int32")), w=arr(1000, 64):
         nd.Embedding(idx, w, input_dim=1000, output_dim=64))
+    # second tier: deconv, batched matmul, activations, shape/index ops
+    xd = arr(8, 32, 16, 16)
+    wd = arr(32, 16, 2, 2)
+    # kernel 2 stride 2: each INPUT pixel contributes k*k taps; counting
+    # by inputs avoids over-counting the stride-partitioned output
+    add("deconv2x2_stride2",
+        lambda xd=xd, wd=wd: nd.Deconvolution(
+            xd, wd, kernel=(2, 2), stride=(2, 2), num_filter=16),
+        2 * 8 * 32 * 16 * 4 * 16 * 16)
+    ba, bb = arr(64, 128, 64), arr(64, 64, 128)
+    add("batch_dot_64x128x64",
+        lambda a=ba, b=bb: nd.batch_dot(a, b), 2 * 64 * 128 * 64 * 128)
+    xg = arr(64, 1024)
+    for act in ("sigmoid", "tanh", "gelu"):
+        add(f"{act}_64x1024",
+            lambda xg=xg, act=act: getattr(nd, act)(xg), 64 * 1024)
+    add("log_softmax_128x1000",
+        lambda xs=xs: nd.log_softmax(xs), 5 * 128 * 1000)
+    add("avgpool2x2", lambda xp=xp: nd.Pooling(
+        xp, kernel=(2, 2), stride=(2, 2), pool_type="avg"))
+    add("global_avg_pool", lambda xp=xp: nd.Pooling(
+        xp, global_pool=True, pool_type="avg"))
+    xt2 = arr(1 << 18)
+    add("cumsum_256k", lambda x=xt2: nd.cumsum(x))
+    cond = xa > 0  # prebuilt: the timed fn measures where alone
+    add("where_1M", lambda c=cond, a=xa, b=xb2: nd.where(c, a, b),
+        1 << 20)
+    add("take_rows", lambda w=arr(4096, 256),
+        idx=nd.array(rng.randint(0, 4096, 1024).astype("int32")):
+        nd.take(w, idx))
+    add("tile_2x", lambda x=arr(512, 128): nd.tile(x, reps=(2, 2)))
+    add("pad_edge", lambda x=arr(8, 16, 32, 32): nd.pad(
+        x, mode="edge", pad_width=(0, 0, 0, 0, 2, 2, 2, 2)))
+    add("one_hot_32k", lambda idx=nd.array(
+        rng.randint(0, 512, 32768).astype("int32")):
+        nd.one_hot(idx, depth=512))
+    T, N, C, H = 32, 16, 64, 128
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    npk = rnn_packed_param_size("lstm", C, H, 1, False)
+    xr2 = arr(T, N, C)
+    pv = arr(npk)
+    add("lstm_T32_N16_H128",
+        lambda x=xr2, p=pv: nd.RNN(x, p, state_size=H, mode="lstm"),
+        2 * T * N * 4 * H * (C + H))
     return cases
 
 
