@@ -233,7 +233,30 @@ def main():
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
     if dtype not in ("bf16", "fp32"):
         raise SystemExit(f"MXNET_BENCH_DTYPE must be bf16|fp32, got {dtype}")
+
+    # first-contact watchdog: a wedged accelerator tunnel hangs inside
+    # PJRT init/dispatch with no Python-level timeout; fail fast with a
+    # diagnosis instead of eating the driver's whole time budget
+    import threading
+    contact = threading.Event()
+    try:
+        budget = float(os.environ.get("MXNET_BENCH_CONTACT_TIMEOUT",
+                                      "600"))
+    except ValueError:
+        raise SystemExit("MXNET_BENCH_CONTACT_TIMEOUT must be a number "
+                         "of seconds (<= 0 disables the watchdog)")
+    if budget > 0:
+        def watchdog():
+            if not contact.wait(budget):
+                log(f"bench: FATAL — no device contact within "
+                    f"{budget:.0f}s (accelerator tunnel wedged?); "
+                    "aborting")
+                os._exit(3)
+        threading.Thread(target=watchdog, daemon=True).start()
+
     peak, kind = peak_tflops()
+    _flush(jnp.ones((2, 2)).sum())  # one real device round-trip
+    contact.set()
     log(f"bench: backend={jax.default_backend()} device={kind} "
         f"peak_bf16={peak} model={model} dtype={dtype}")
 
