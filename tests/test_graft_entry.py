@@ -1,0 +1,52 @@
+"""Driver-artifact robustness: the dryrun's first-contact watchdog.
+
+Round 3 lost the MULTICHIP artifact (rc=124) because
+``dryrun_multichip`` touched ``jax.devices()`` on a wedged accelerator
+tunnel before deciding to re-exec on the virtual CPU mesh. These tests
+pin the fix: the probe times out in a daemon thread and reports None so
+the caller falls through to the tunnel-independent virtual-mesh path
+(reference analog: the driver-facing robustness the reference gets from
+its engine shutdown watchdogs, src/engine/threaded_engine_perdevice.cc).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft
+
+
+def test_probe_devices_returns_devices_on_healthy_platform():
+    devs = graft._probe_devices(timeout=60)
+    assert devs is not None and len(devs) >= 1
+
+
+def test_probe_devices_times_out_on_hung_platform(monkeypatch):
+    import jax
+
+    def hung(*a, **k):
+        time.sleep(300)
+
+    monkeypatch.setattr(jax, "devices", hung)
+    t0 = time.time()
+    assert graft._probe_devices(timeout=1.0) is None
+    assert time.time() - t0 < 30  # returned promptly, didn't block on hang
+
+
+def test_probe_devices_reports_error_as_none(monkeypatch):
+    import jax
+
+    def broken(*a, **k):
+        raise RuntimeError("tunnel reset")
+
+    monkeypatch.setattr(jax, "devices", broken)
+    assert graft._probe_devices(timeout=10) is None
+
+
+def test_probe_child_mode_is_authoritative(monkeypatch):
+    # the virtual-mesh child must NOT thread/timeout: its result gates the
+    # recursion-abort check in _reexec_dryrun_on_virtual_mesh
+    monkeypatch.setenv("MXNET_DRYRUN_CHILD", "1")
+    devs = graft._probe_devices()
+    assert devs is not None and len(devs) >= 1
