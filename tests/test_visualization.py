@@ -1,0 +1,187 @@
+"""mx.visualization: print_summary + plot_network.
+
+Reference analog: python/mxnet/visualization.py (:46 print_summary,
+:210 plot_network) — exercised the way the reference's users do
+(mx.viz.* over a Symbol graph), with the summary's parameter math
+cross-checked against the Gluon model zoo's real parameter count for
+the same ResNet-18 architecture.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+
+def _conv_bn_fc():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, sym.Variable("conv1_weight"), kernel=(3, 3),
+                         num_filter=16, pad=(1, 1), no_bias=True,
+                         name="conv1")
+    bn = sym.BatchNorm(c1, sym.Variable("bn1_gamma"),
+                       sym.Variable("bn1_beta"),
+                       sym.Variable("bn1_moving_mean"),
+                       sym.Variable("bn1_moving_var"), name="bn1")
+    act = sym.Activation(bn, act_type="relu", name="relu1")
+    fl = sym.Flatten(act, name="flat1")
+    fc = sym.FullyConnected(fl, sym.Variable("fc1_weight"),
+                            sym.Variable("fc1_bias"), num_hidden=10,
+                            name="fc1")
+    shapes = {"data": (1, 3, 8, 8), "conv1_weight": (16, 3, 3, 3),
+              "bn1_gamma": (16,), "bn1_beta": (16,),
+              "bn1_moving_mean": (16,), "bn1_moving_var": (16,),
+              "fc1_weight": (10, 16 * 8 * 8), "fc1_bias": (10,)}
+    return fc, shapes
+
+
+def test_print_summary_table_and_params(capsys):
+    fc, shapes = _conv_bn_fc()
+    total = mx.viz.print_summary(fc, shape=shapes, line_length=90)
+    out = capsys.readouterr().out
+    # conv 3*16*3*3=432; bn gamma+beta=32; fc (1024+1)*10=10250
+    assert total == 432 + 32 + 10250
+    assert "Total params: 10714" in out
+    assert "Layer (type)" in out and "Output Shape" in out
+    assert "conv1(Convolution)" in out
+    assert "16x8x8" in out          # batch axis stripped
+    assert "fc1(FullyConnected)" in out and "10250" in out
+
+
+def test_print_summary_requires_symbol_and_complete_shape():
+    with pytest.raises(TypeError):
+        mx.viz.print_summary("not a symbol")
+    fc, shapes = _conv_bn_fc()
+    del shapes["conv1_weight"]
+    with pytest.raises(mx.MXNetError, match="incomplete"):
+        mx.viz.print_summary(fc, shape=shapes)
+
+
+def test_plot_network_source_and_hide_weights():
+    fc, shapes = _conv_bn_fc()
+    dot = mx.viz.plot_network(fc, shape=shapes)
+    src = dot.source
+    for want in ("conv1", "bn1", "relu1", "fc1", "digraph"):
+        assert want in src
+    assert "conv1_weight" not in src and "fc1_bias" not in src
+    # edges carry the producer's (batch-stripped) shape
+    assert "16x8x8" in src
+
+    dot2 = mx.viz.plot_network(fc, shape=shapes, hide_weights=False)
+    assert "conv1_weight" in dot2.source
+
+
+def test_plot_network_fallback_digraph_without_graphviz(monkeypatch):
+    import builtins
+    real_import = builtins.__import__
+
+    def no_graphviz(name, *a, **k):
+        if name == "graphviz":
+            raise ImportError("simulated absence")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_graphviz)
+    fc, shapes = _conv_bn_fc()
+    dot = mx.viz.plot_network(fc, shape=shapes)
+    src = dot.source
+    assert src.startswith("digraph") and "conv1" in src
+    with pytest.raises(mx.MXNetError):
+        dot.render()
+
+
+# ---------------------------------------------------------------------------
+# ResNet-18: symbolic graph whose summary total must equal the Gluon
+# model zoo's trainable-parameter count for the same architecture
+# ---------------------------------------------------------------------------
+
+def _sym_resnet18(classes=1000):
+    """Symbolic ResNet-18 v1 mirroring gluon.model_zoo.vision.resnet18_v1
+    (BasicBlockV1: conv3x3-bn-relu-conv3x3-bn + identity/1x1-downsample)."""
+    names = iter(range(10000))
+
+    def v(prefix, shape=None):
+        return sym.Variable(f"{prefix}")
+
+    def conv(x, ci, co, k, s, p, name):
+        return sym.Convolution(x, v(f"{name}_weight"), kernel=(k, k),
+                               stride=(s, s), pad=(p, p), num_filter=co,
+                               no_bias=True, name=name)
+
+    def bn(x, name):
+        return sym.BatchNorm(x, v(f"{name}_gamma"), v(f"{name}_beta"),
+                             v(f"{name}_moving_mean"),
+                             v(f"{name}_moving_var"), name=name)
+
+    shapes = {"data": (1, 3, 224, 224)}
+
+    def reg_conv(name, ci, co, k):
+        shapes[f"{name}_weight"] = (co, ci, k, k)
+
+    def reg_bn(name, c):
+        for s in ("gamma", "beta", "moving_mean", "moving_var"):
+            shapes[f"{name}_{s}"] = (c,)
+
+    data = sym.Variable("data")
+    x = conv(data, 3, 64, 7, 2, 3, "conv0")
+    reg_conv("conv0", 3, 64, 7)
+    x = bn(x, "bn0")
+    reg_bn("bn0", 64)
+    x = sym.Activation(x, act_type="relu", name="relu0")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                    pool_type="max", name="pool0")
+
+    ci = 64
+    bi = 0
+    for stage, (co, s0) in enumerate([(64, 1), (128, 2), (256, 2),
+                                      (512, 2)]):
+        for blk in range(2):
+            s = s0 if blk == 0 else 1
+            n = f"s{stage}b{blk}"
+            y = conv(x, ci, co, 3, s, 1, f"{n}_conv1")
+            reg_conv(f"{n}_conv1", ci, co, 3)
+            y = bn(y, f"{n}_bn1")
+            reg_bn(f"{n}_bn1", co)
+            y = sym.Activation(y, act_type="relu", name=f"{n}_relu1")
+            y = conv(y, co, co, 3, 1, 1, f"{n}_conv2")
+            reg_conv(f"{n}_conv2", co, co, 3)
+            y = bn(y, f"{n}_bn2")
+            reg_bn(f"{n}_bn2", co)
+            if s != 1 or ci != co:
+                sc = conv(x, ci, co, 1, s, 0, f"{n}_down")
+                reg_conv(f"{n}_down", ci, co, 1)
+                sc = bn(sc, f"{n}_downbn")
+                reg_bn(f"{n}_downbn", co)
+            else:
+                sc = x
+            x = sym.Activation(y + sc, act_type="relu", name=f"{n}_out")
+            ci = co
+            bi += 1
+
+    x = sym.Pooling(x, global_pool=True, pool_type="avg", name="gap")
+    x = sym.Flatten(x, name="flat")
+    fc = sym.FullyConnected(x, sym.Variable("fc_weight"),
+                            sym.Variable("fc_bias"), num_hidden=classes,
+                            name="fc")
+    shapes["fc_weight"] = (classes, 512)
+    shapes["fc_bias"] = (classes,)
+    return fc, shapes
+
+
+def test_resnet18_summary_matches_gluon_param_count(capsys):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=1000)
+    net.initialize()
+    net(mx.nd.array(onp.zeros((1, 3, 32, 32), "float32")))
+    gluon_trainable = sum(
+        int(onp.prod(p.shape)) for p in net.collect_params().values()
+        if p._data is not None and p.grad_req != "null")
+
+    fc, shapes = _sym_resnet18()
+    total = mx.viz.print_summary(fc, shape=shapes)
+    out = capsys.readouterr().out
+    assert total == gluon_trainable == 11689512
+    assert "conv0(Convolution)" in out
+    assert "64x112x112" in out      # stride-2 stem at 224 input
+    assert "fc(FullyConnected)" in out
+
+    dot = mx.viz.plot_network(fc, shape=shapes)
+    assert "s3b1_conv2" in dot.source
