@@ -4,3 +4,4 @@ from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401

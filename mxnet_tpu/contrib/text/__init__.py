@@ -1,0 +1,8 @@
+"""Text utilities: vocabulary + pretrained token embeddings.
+
+Reference analog: python/mxnet/contrib/text/ — same module layout
+(``vocab``, ``embedding``, ``utils``)."""
+from . import embedding
+from . import utils
+from . import vocab
+from .vocab import Vocabulary

@@ -1,0 +1,20 @@
+"""Constants for contrib.text (reference contrib/text/_constants.py)."""
+
+UNKNOWN_IDX = 0
+
+# Known pretrained-file catalogs. The reference ships sha1 maps used to
+# download from an S3 bucket (reference embedding.py:525-534,617); this
+# environment has no egress, so these name lists exist only to validate
+# `pretrained_file_name` and to answer `get_pretrained_file_names` — the
+# files themselves must be placed under `embedding_root` by the user.
+GLOVE_PRETRAINED_FILE_NAMES = [
+    "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+    "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+    "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+    "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt",
+]
+
+FASTTEXT_PRETRAINED_FILE_NAMES = [
+    "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.de.vec",
+    "wiki.fr.vec", "wiki.es.vec", "wiki.ja.vec", "wiki.ru.vec",
+]

@@ -1,0 +1,344 @@
+"""Pretrained token embeddings: GloVe / FastText / CustomEmbedding.
+
+Reference analog: python/mxnet/contrib/text/embedding.py (:133
+_TokenEmbedding, :481 GloVe, :553 FastText, :635 CustomEmbedding, :677
+CompositeEmbedding, :40/:63 register/create). Same loading contract —
+one token per line, ``elem_delim``-separated floats, first-seen wins,
+1-element lines treated as headers, unknown vector from the file when
+present else ``init_unknown_vec`` — with one environment difference:
+this image has no network egress, so pretrained files are resolved
+ONLY against ``embedding_root`` (default ``~/.mxnet/embedding/<name>``)
+and a missing file is an error telling the user where to place it,
+instead of a download.
+"""
+import io
+import logging
+import os
+import warnings
+
+from ... import ndarray as nd
+from . import _constants as C
+from . import vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "GloVe", "FastText", "CustomEmbedding", "CompositeEmbedding"]
+
+
+class _Registry:
+    embeddings = {}
+
+
+def register(embedding_cls):
+    """Register a subclass of ``_TokenEmbedding`` for ``create``."""
+    _Registry.embeddings[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create an embedding instance by name, e.g.
+    ``create('glove', pretrained_file_name='glove.6B.50d.txt')``."""
+    name = embedding_name.lower()
+    if name not in _Registry.embeddings:
+        raise KeyError(
+            f"Cannot find registered token embedding {embedding_name}. "
+            f"Valid: {', '.join(sorted(_Registry.embeddings))}")
+    return _Registry.embeddings[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or as a dict."""
+    if embedding_name is not None:
+        name = embedding_name.lower()
+        if name not in _Registry.embeddings:
+            raise KeyError(f"Cannot find registered token embedding "
+                           f"{embedding_name}.")
+        return list(_Registry.embeddings[name].pretrained_file_names)
+    return {n: list(cls.pretrained_file_names)
+            for n, cls in _Registry.embeddings.items()}
+
+
+class _TokenEmbedding(vocab.Vocabulary):
+    """Indexed tokens + their embedding vectors. Subclasses either load
+    a catalogued pretrained file (GloVe/FastText) or a user file
+    (CustomEmbedding); all expose ``idx_to_vec``, ``vec_len``,
+    ``get_vecs_by_tokens`` and ``update_token_vectors``."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = None
+        self._idx_to_vec = None
+
+    # ---------------- local-file resolution (no egress) ----------------
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        embedding_name = cls.__name__.lower()
+        root = os.path.expanduser(embedding_root)
+        path = os.path.join(root, embedding_name, pretrained_file_name)
+        if not os.path.isfile(path):
+            raise ValueError(
+                f"Pretrained file {pretrained_file_name} for embedding "
+                f"{embedding_name} not found at {path}. This environment "
+                "cannot download; place the file there or use "
+                "CustomEmbedding with a local path.")
+        return path
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_names:
+            embedding_name = cls.__name__.lower()
+            raise KeyError(
+                f"Cannot find pretrained file {pretrained_file_name} for "
+                f"token embedding {embedding_name}. Valid pretrained "
+                f"files for embedding {embedding_name}: "
+                f"{', '.join(cls.pretrained_file_names)}")
+
+    # ---------------- loading ----------------
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError(
+                "`pretrained_file_path` must be a valid path to the "
+                "pre-trained token embedding file.")
+        logging.info("Loading pre-trained token embedding vectors from %s",
+                     pretrained_file_path)
+        vec_len = None
+        all_elems = []
+        # rows for EVERY pre-seeded token (unknown + reserved), so file
+        # tokens' matrix rows stay aligned with their vocabulary indices
+        # even when the embedding was built with reserved_tokens
+        n_preseeded = len(self._idx_to_token)
+        seen = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f, start=1):
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) <= 1:
+                    raise ValueError(
+                        f"At line {line_num} of the pre-trained text "
+                        "embedding file: unexpected data format.")
+                token, vals = elems[0], elems[1:]
+                if token == self.unknown_token and \
+                        loaded_unknown_vec is None:
+                    loaded_unknown_vec = [float(v) for v in vals]
+                    seen.add(token)
+                elif token in seen:
+                    warnings.warn(
+                        f"At line {line_num}: duplicate embedding for "
+                        f"token {token} is seen and skipped.")
+                elif len(vals) == 1:
+                    warnings.warn(
+                        f"At line {line_num}: token {token} with "
+                        "1-dimensional vector is likely a header; "
+                        "skipped.")
+                else:
+                    vec = [float(v) for v in vals]
+                    if vec_len is None:
+                        vec_len = len(vec)
+                        all_elems.extend([0.0] * vec_len * n_preseeded)
+                    elif len(vec) != vec_len:
+                        raise ValueError(
+                            f"At line {line_num}: dimension of token "
+                            f"{token} is {len(vec)} but previous tokens "
+                            f"have {vec_len}. All dimensions must match.")
+                    all_elems.extend(vec)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = len(self._idx_to_token) - 1
+                    seen.add(token)
+
+        if vec_len is None:
+            raise ValueError(
+                f"No embedding vectors loaded from {pretrained_file_path} "
+                "(note: 1-dimensional vectors are treated as header lines, "
+                "matching the reference loader).")
+        self._vec_len = vec_len
+        mat = nd.array(all_elems).reshape((-1, self._vec_len))
+        if loaded_unknown_vec is None:
+            mat[C.UNKNOWN_IDX] = init_unknown_vec(shape=(self._vec_len,))
+        else:
+            mat[C.UNKNOWN_IDX] = nd.array(loaded_unknown_vec)
+        # reserved tokens (indices 1..n_preseeded-1) get the init vector
+        for i in range(1, n_preseeded):
+            mat[i] = init_unknown_vec(shape=(self._vec_len,))
+        self._idx_to_vec = mat
+
+    # ---------------- vocabulary composition ----------------
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = (None if vocabulary.reserved_tokens is None
+                                 else list(vocabulary.reserved_tokens))
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len,
+                                      vocab_idx_to_token):
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        new_idx_to_vec = nd.zeros((vocab_len, new_vec_len))
+        col_start = 0
+        for embed in token_embeddings:
+            col_end = col_start + embed.vec_len
+            new_idx_to_vec[0, col_start:col_end] = embed.idx_to_vec[0]
+            new_idx_to_vec[1:, col_start:col_end] = \
+                embed.get_vecs_by_tokens(vocab_idx_to_token[1:])
+            col_start = col_end
+        self._vec_len = new_vec_len
+        self._idx_to_vec = new_idx_to_vec
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        if vocabulary is not None:
+            if not isinstance(vocabulary, vocab.Vocabulary):
+                raise TypeError(
+                    "The argument `vocabulary` must be an instance of "
+                    "mxnet_tpu.contrib.text.vocab.Vocabulary.")
+            self._set_idx_to_vec_by_embeddings(
+                [self], len(vocabulary), vocabulary.idx_to_token)
+            self._index_tokens_from_vocabulary(vocabulary)
+
+    # ---------------- lookup / update ----------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        """NDArray of shape (num_tokens, vec_len)."""
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Embedding vector(s) for token(s); unknown tokens get the
+        unknown vector. 1-D out for a str, 2-D for a list."""
+        to_reduce = not isinstance(tokens, list)
+        if to_reduce:
+            tokens = [tokens]
+        if not lower_case_backup:
+            indices = [self._token_to_idx.get(t, C.UNKNOWN_IDX)
+                       for t in tokens]
+        else:
+            indices = [self._token_to_idx[t] if t in self._token_to_idx
+                       else self._token_to_idx.get(t.lower(),
+                                                   C.UNKNOWN_IDX)
+                       for t in tokens]
+        vecs = nd.Embedding(
+            nd.array(indices, dtype="int32"), self._idx_to_vec,
+            input_dim=self._idx_to_vec.shape[0],
+            output_dim=self._idx_to_vec.shape[1])
+        return vecs[0] if to_reduce else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Assign ``new_vectors`` to the rows of known ``tokens``;
+        unknown tokens raise (update the unknown vector by naming
+        ``unknown_token`` explicitly)."""
+        if self._idx_to_vec is None:
+            raise ValueError(
+                "The property `idx_to_vec` has not been properly set.")
+        if not isinstance(tokens, list) or len(tokens) == 1:
+            if not (isinstance(new_vectors, nd.NDArray) and
+                    len(new_vectors.shape) in (1, 2)):
+                raise ValueError(
+                    "`new_vectors` must be a 1-D or 2-D NDArray if "
+                    "`tokens` is a singleton.")
+            if not isinstance(tokens, list):
+                tokens = [tokens]
+            if len(new_vectors.shape) == 1:
+                new_vectors = nd.expand_dims(new_vectors, axis=0)
+        elif not (isinstance(new_vectors, nd.NDArray) and
+                  len(new_vectors.shape) == 2):
+            raise ValueError(
+                "`new_vectors` must be a 2-D NDArray if `tokens` is a "
+                "list of multiple strings.")
+        if new_vectors.shape != (len(tokens), self.vec_len):
+            raise ValueError(
+                "The length of new_vectors must equal the number of "
+                "tokens and its width the embedding dimension.")
+        indices = []
+        for token in tokens:
+            if token in self._token_to_idx:
+                indices.append(self._token_to_idx[token])
+            else:
+                raise ValueError(
+                    f"Token {token} is unknown. To update the embedding "
+                    "vector for an unknown token, please specify it "
+                    "explicitly as the `unknown_token` "
+                    f"{self._idx_to_token[C.UNKNOWN_IDX]} in `tokens`. "
+                    "This is to avoid unintended updates.")
+        for i, row in zip(indices, range(len(tokens))):
+            self._idx_to_vec[i] = new_vectors[row]
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings (reference embedding.py:481), loaded from a
+    local file under ``embedding_root``/glove/."""
+
+    pretrained_file_names = tuple(C.GLOVE_PRETRAINED_FILE_NAMES)
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embedding"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        GloVe._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = GloVe._get_pretrained_file(embedding_root,
+                                          pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """FastText embeddings (reference embedding.py:553), loaded from a
+    local file under ``embedding_root``/fasttext/. FastText ``.vec``
+    files start with a count/dim header line, which the loader already
+    skips as a 1-element-vector warning case."""
+
+    pretrained_file_names = tuple(C.FASTTEXT_PRETRAINED_FILE_NAMES)
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embedding"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        FastText._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = FastText._get_pretrained_file(embedding_root,
+                                             pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """User-provided embedding file: '<token><elem_delim><v0>...'
+    per line (reference embedding.py:635)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=nd.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenation of multiple token embeddings over one vocabulary
+    (reference embedding.py:677)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, vocab.Vocabulary):
+            raise TypeError(
+                "The argument `vocabulary` must be an instance of "
+                "mxnet_tpu.contrib.text.vocab.Vocabulary.")
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for embed in token_embeddings:
+            if not isinstance(embed, _TokenEmbedding):
+                raise TypeError(
+                    "The argument `token_embeddings` must be an instance "
+                    "or a list of instances of `_TokenEmbedding`.")
+        super().__init__()
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(vocabulary), vocabulary.idx_to_token)
+        self._index_tokens_from_vocabulary(vocabulary)

@@ -1,0 +1,21 @@
+"""Text corpus utilities (reference contrib/text/utils.py:26)."""
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str``, splitting sequences on
+    ``seq_delim`` and tokens on ``token_delim`` (both regular
+    expressions). Updates and returns ``counter_to_update`` when given,
+    else a fresh ``collections.Counter``."""
+    source_str = filter(
+        None, re.split(token_delim + "|" + seq_delim, source_str))
+    if to_lower:
+        source_str = [t.lower() for t in source_str]
+    counter = (collections.Counter() if counter_to_update is None
+               else counter_to_update)
+    counter.update(source_str)
+    return counter

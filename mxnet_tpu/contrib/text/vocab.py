@@ -1,0 +1,107 @@
+"""Text token indexing: ``Vocabulary``.
+
+Reference analog: python/mxnet/contrib/text/vocab.py:28 — identical
+indexing contract (index 0 is the unknown token, then reserved tokens,
+then counter keys by descending frequency with alphabetical tie-break,
+filtered by ``min_freq`` and capped by ``most_freq_count``).
+"""
+import collections
+
+from . import _constants as C
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens of a corpus counter for text experiments.
+
+    Index 0 maps to ``unknown_token``; reserved tokens follow; counter
+    keys are indexed by descending frequency (ties broken
+    alphabetically), skipping tokens with frequency below ``min_freq``
+    and stopping after ``most_freq_count`` counter keys."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq <= 0:
+            raise ValueError("`min_freq` must be set to a positive value.")
+        if reserved_tokens is not None:
+            reserved_set = set(reserved_tokens)
+            if unknown_token in reserved_set:
+                raise ValueError(
+                    "`reserved_tokens` cannot contain `unknown_token`.")
+            if len(reserved_set) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` cannot contain "
+                                 "duplicate reserved tokens.")
+
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._reserved_tokens = (None if reserved_tokens is None
+                                 else list(reserved_tokens))
+        if reserved_tokens:
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        if not isinstance(counter, collections.Counter):
+            raise TypeError("`counter` must be an instance of "
+                            "collections.Counter.")
+        special = set(self._idx_to_token)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        cap = len(special) + (len(counter) if most_freq_count is None
+                              else most_freq_count)
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) == cap:
+                break
+            if token not in special:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        """dict: token -> index."""
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        """list of str: index -> token."""
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        to_reduce = not isinstance(tokens, list)
+        if to_reduce:
+            tokens = [tokens]
+        indices = [self._token_to_idx.get(t, C.UNKNOWN_IDX)
+                   for t in tokens]
+        return indices[0] if to_reduce else indices
+
+    def to_tokens(self, indices):
+        """Index/indices -> token(s); invalid indices raise ValueError."""
+        to_reduce = not isinstance(indices, list)
+        if to_reduce:
+            indices = [indices]
+        max_idx = len(self._idx_to_token) - 1
+        tokens = []
+        for idx in indices:
+            if not isinstance(idx, int) or idx > max_idx or idx < 0:
+                raise ValueError(
+                    f"Token index {idx} in the provided `indices` is "
+                    "invalid.")
+            tokens.append(self._idx_to_token[idx])
+        return tokens[0] if to_reduce else tokens

@@ -1,0 +1,211 @@
+"""contrib.text: Vocabulary + token embeddings.
+
+Reference analog: tests/python/unittest/test_contrib_text.py — the same
+contracts (index 0 = unknown, frequency-then-alphabetical ordering,
+first-seen-wins embedding load, header-line skip, strict
+update_token_vectors) against local-file fixtures (no egress).
+"""
+import collections
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens_from_str():
+    source = "life is a peach \n life is good"
+    c = text.utils.count_tokens_from_str(source)
+    assert c["life"] == 2 and c["is"] == 2 and c["peach"] == 1
+    c2 = text.utils.count_tokens_from_str("Life", to_lower=True,
+                                          counter_to_update=c)
+    assert c2["life"] == 3
+
+
+def test_vocabulary_indexing_order():
+    counter = collections.Counter(
+        ["a", "b", "b", "c", "c", "c", "some_word$"])
+    v = text.Vocabulary(counter)
+    # unknown first, then by descending freq, ties alphabetical
+    assert v.idx_to_token == ["<unk>", "c", "b", "a", "some_word$"]
+    assert v.to_indices("c") == 1
+    assert v.to_indices(["c", "missing"]) == [1, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "b"]
+    assert len(v) == 5
+
+
+def test_vocabulary_limits_and_reserved():
+    counter = collections.Counter(["a", "b", "b", "c", "c", "c"])
+    v = text.Vocabulary(counter, most_freq_count=2, min_freq=2,
+                        unknown_token="<UNK>",
+                        reserved_tokens=["<pad>", "<bos>"])
+    assert v.idx_to_token[:3] == ["<UNK>", "<pad>", "<bos>"]
+    # most_freq_count=2 caps counter keys; min_freq=2 drops 'a'
+    assert "a" not in v.token_to_idx
+    assert v.reserved_tokens == ["<pad>", "<bos>"]
+    with pytest.raises(ValueError):
+        text.Vocabulary(counter, min_freq=0)
+    with pytest.raises(ValueError):
+        text.Vocabulary(counter, unknown_token="<pad>",
+                        reserved_tokens=["<pad>"])
+    with pytest.raises(ValueError):
+        text.Vocabulary(counter, reserved_tokens=["<pad>", "<pad>"])
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def _write_embedding(path, lines):
+    with open(path, "w", encoding="utf8") as f:
+        f.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_custom_embedding_loading(tmp_path):
+    p = _write_embedding(tmp_path / "emb.txt", [
+        "a 0.1 0.2 0.3",
+        "b 0.5 0.6 0.7",
+        "<unk> 9.0 9.0 9.0",
+    ])
+    e = text.embedding.CustomEmbedding(p)
+    assert e.vec_len == 3
+    assert e.to_indices("a") == 1 and e.to_indices("b") == 2
+    # unknown vector comes from the file's <unk> line
+    onp.testing.assert_allclose(e.idx_to_vec[0].asnumpy(),
+                                [9.0, 9.0, 9.0], rtol=1e-6)
+    vec = e.get_vecs_by_tokens("b")
+    assert vec.shape == (3,)
+    onp.testing.assert_allclose(vec.asnumpy(), [0.5, 0.6, 0.7], rtol=1e-6)
+    vecs = e.get_vecs_by_tokens(["a", "nope"])
+    assert vecs.shape == (2, 3)
+    onp.testing.assert_allclose(vecs.asnumpy()[1], [9.0, 9.0, 9.0],
+                                rtol=1e-6)
+
+
+def test_custom_embedding_header_dup_and_unknown_init(tmp_path):
+    p = _write_embedding(tmp_path / "emb.txt", [
+        "2 3",                  # fasttext-style header: skipped w/ warning
+        "a 0.1 0.2 0.3",
+        "a 0.9 0.9 0.9",        # duplicate: skipped w/ warning
+        "b 0.5 0.6 0.7",
+    ])
+    with pytest.warns(UserWarning):
+        e = text.embedding.CustomEmbedding(
+            p, init_unknown_vec=nd.ones)
+    onp.testing.assert_allclose(e.idx_to_vec[0].asnumpy(), [1.0, 1.0, 1.0],
+                                rtol=1e-6)
+    onp.testing.assert_allclose(
+        e.get_vecs_by_tokens("a").asnumpy(), [0.1, 0.2, 0.3], rtol=1e-6)
+    # dimension mismatch raises
+    bad = _write_embedding(tmp_path / "bad.txt",
+                           ["a 0.1 0.2 0.3", "b 0.5 0.6"])
+    with pytest.raises(ValueError, match="[Dd]imension"):
+        text.embedding.CustomEmbedding(bad)
+
+
+def test_lower_case_backup(tmp_path):
+    p = _write_embedding(tmp_path / "emb.txt", ["hello 1 2"])
+    e = text.embedding.CustomEmbedding(p)
+    onp.testing.assert_allclose(
+        e.get_vecs_by_tokens("HELLO",
+                             lower_case_backup=True).asnumpy(),
+        [1.0, 2.0], rtol=1e-6)
+    onp.testing.assert_allclose(
+        e.get_vecs_by_tokens("HELLO").asnumpy(), [0.0, 0.0], atol=1e-6)
+
+
+def test_update_token_vectors(tmp_path):
+    p = _write_embedding(tmp_path / "emb.txt", ["a 1 1", "b 2 2"])
+    e = text.embedding.CustomEmbedding(p)
+    e.update_token_vectors("a", nd.array([7.0, 8.0]))
+    onp.testing.assert_allclose(e.get_vecs_by_tokens("a").asnumpy(),
+                                [7.0, 8.0], rtol=1e-6)
+    e.update_token_vectors(["a", "b"],
+                           nd.array([[1.5, 2.5], [3.5, 4.5]]))
+    onp.testing.assert_allclose(e.idx_to_vec[1:].asnumpy(),
+                                [[1.5, 2.5], [3.5, 4.5]], rtol=1e-6)
+    with pytest.raises(ValueError, match="unknown"):
+        e.update_token_vectors("nope", nd.array([0.0, 0.0]))
+    # the unknown vector updates only when named explicitly
+    e.update_token_vectors("<unk>", nd.array([5.0, 5.0]))
+    onp.testing.assert_allclose(e.idx_to_vec[0].asnumpy(), [5.0, 5.0],
+                                rtol=1e-6)
+    with pytest.raises(ValueError):
+        e.update_token_vectors(["a", "b"], nd.array([1.0, 2.0]))
+
+
+def test_embedding_with_reserved_tokens_alignment(tmp_path):
+    """Pre-seeded reserved tokens must not shift file tokens' vector
+    rows (review finding round 4)."""
+    p = _write_embedding(tmp_path / "emb.txt",
+                         ["a 1 1", "b 2 2", "c 3 3"])
+    e = text.embedding.CustomEmbedding(
+        p, reserved_tokens=["<pad>", "<bos>"], init_unknown_vec=nd.ones)
+    assert e.idx_to_token[:3] == ["<unk>", "<pad>", "<bos>"]
+    assert e.idx_to_vec.shape == (6, 2)
+    onp.testing.assert_allclose(e.get_vecs_by_tokens("a").asnumpy(),
+                                [1.0, 1.0], rtol=1e-6)
+    onp.testing.assert_allclose(e.get_vecs_by_tokens("c").asnumpy(),
+                                [3.0, 3.0], rtol=1e-6)
+    onp.testing.assert_allclose(e.get_vecs_by_tokens("<pad>").asnumpy(),
+                                [1.0, 1.0], rtol=1e-6)  # init vector
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    p = _write_embedding(tmp_path / "emb.txt",
+                         ["a 1 1", "b 2 2", "c 3 3"])
+    counter = collections.Counter(["b", "b", "zzz"])
+    v = text.Vocabulary(counter)
+    e = text.embedding.CustomEmbedding(p, vocabulary=v)
+    # embedding reindexed to the vocabulary, not the file
+    assert e.idx_to_token == v.idx_to_token
+    assert e.idx_to_vec.shape == (len(v), 2)
+    onp.testing.assert_allclose(
+        e.get_vecs_by_tokens("b").asnumpy(), [2.0, 2.0], rtol=1e-6)
+    # vocab token absent from the file gets the unknown vector
+    onp.testing.assert_allclose(
+        e.get_vecs_by_tokens("zzz").asnumpy(), [0.0, 0.0], atol=1e-6)
+
+
+def test_composite_embedding(tmp_path):
+    p1 = _write_embedding(tmp_path / "e1.txt", ["a 1 1", "b 2 2"])
+    p2 = _write_embedding(tmp_path / "e2.txt", ["b 9 9 9", "c 8 8 8"])
+    v = text.Vocabulary(collections.Counter(["a", "b", "c"]))
+    ce = text.embedding.CompositeEmbedding(
+        v, [text.embedding.CustomEmbedding(p1),
+            text.embedding.CustomEmbedding(p2)])
+    assert ce.vec_len == 5
+    vb = ce.get_vecs_by_tokens("b").asnumpy()
+    onp.testing.assert_allclose(vb, [2.0, 2.0, 9.0, 9.0, 9.0], rtol=1e-6)
+    va = ce.get_vecs_by_tokens("a").asnumpy()
+    onp.testing.assert_allclose(va, [1.0, 1.0, 0.0, 0.0, 0.0], atol=1e-6)
+    # a file whose every vector row is skipped fails loudly
+    p3 = _write_embedding(tmp_path / "e3.txt", ["b 9", "c 8"])
+    with pytest.raises(ValueError, match="No embedding vectors"):
+        text.embedding.CustomEmbedding(p3)
+
+
+def test_glove_fasttext_local_root(tmp_path):
+    root = tmp_path / "embroot"
+    gdir = root / "glove"
+    gdir.mkdir(parents=True)
+    _write_embedding(gdir / "glove.6B.50d.txt", ["a 1 2", "b 3 4"])
+    g = text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt",
+                              embedding_root=str(root))
+    assert g.vec_len == 2
+    onp.testing.assert_allclose(g.get_vecs_by_tokens("b").asnumpy(),
+                                [3.0, 4.0], rtol=1e-6)
+    # unknown catalogue name rejected before touching the filesystem
+    with pytest.raises(KeyError):
+        text.embedding.GloVe(pretrained_file_name="not_a_file.txt")
+    # catalogued but missing locally: actionable error, no download
+    with pytest.raises(ValueError, match="download"):
+        text.embedding.GloVe(
+            pretrained_file_name="glove.6B.100d.txt",
+            embedding_root=str(root))
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "wiki.simple.vec" in \
+        text.embedding.get_pretrained_file_names("fasttext")
