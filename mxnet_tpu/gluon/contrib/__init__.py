@@ -1,4 +1,6 @@
 """gluon.contrib (reference: python/mxnet/gluon/contrib/)."""
+from . import cnn  # noqa: F401
+from . import data  # noqa: F401
 from . import estimator  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401

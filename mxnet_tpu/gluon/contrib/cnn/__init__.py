@@ -1,0 +1,5 @@
+"""Contributed convolutional layers
+(reference: python/mxnet/gluon/contrib/cnn/)."""
+from .conv_layers import DeformableConvolution, ModulatedDeformableConvolution
+
+__all__ = ["DeformableConvolution", "ModulatedDeformableConvolution"]

@@ -1,0 +1,141 @@
+"""Deformable convolution Blocks (DCN v1/v2).
+
+Reference analog: python/mxnet/gluon/contrib/cnn/conv_layers.py
+(:30 DeformableConvolution, :224 ModulatedDeformableConvolution).
+Each Block owns BOTH convolutions of the construct — the plain offset
+(and, for v2, mask) generator and the deformable conv itself — exactly
+as the reference does; the offset conv initializes to zeros so training
+starts at a regular sampling grid. The underlying deformable sampling
+op is ndarray/vision_ops.py's pure-XLA grid-sample + einsum kernel.
+"""
+from ....base import MXNetError
+from .... import ndarray as nd
+from ...block import HybridBlock
+from ...parameter import Parameter
+
+__all__ = ["DeformableConvolution", "ModulatedDeformableConvolution"]
+
+
+def _tup2(v):
+    return (v, v) if isinstance(v, (int, float)) else tuple(v)
+
+
+class _DeformableBase(HybridBlock):
+    _modulated = False
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if layout != "NCHW":
+            raise MXNetError("only NCHW layout is supported")
+        kernel_size = _tup2(kernel_size)
+        strides = _tup2(strides)
+        padding = _tup2(padding)
+        dilation = _tup2(dilation)
+        self._channels = channels
+        self._kernel = kernel_size
+        self._strides = strides
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._num_deformable_group = num_deformable_group
+        self._activation = activation
+        kh, kw = kernel_size
+        # v1: (dy,dx) per tap; v2 appends one modulation channel per tap
+        per_tap = 3 if self._modulated else 2
+        self._offset_channels = per_tap * kh * kw * num_deformable_group
+        self._mask_split = 2 * kh * kw * num_deformable_group
+
+        self.offset_weight = Parameter(
+            "offset_weight",
+            shape=(self._offset_channels,
+                   in_channels // groups if in_channels else 0, kh, kw),
+            init=offset_weight_initializer)
+        self.offset_bias = Parameter(
+            "offset_bias", shape=(self._offset_channels,),
+            init=offset_bias_initializer) if offset_use_bias else None
+        self.deformable_conv_weight = Parameter(
+            "deformable_conv_weight",
+            shape=(channels,
+                   in_channels // groups if in_channels else 0, kh, kw),
+            init=weight_initializer)
+        self.deformable_conv_bias = Parameter(
+            "deformable_conv_bias", shape=(channels,),
+            init=bias_initializer) if use_bias else None
+
+    def _infer(self, x):
+        if self.deformable_conv_weight._data is None:
+            in_ch = x.shape[1]
+            kh, kw = self._kernel
+            g = self._groups
+            self.offset_weight.shape = (self._offset_channels,
+                                        in_ch // g, kh, kw)
+            self.deformable_conv_weight.shape = (self._channels,
+                                                 in_ch // g, kh, kw)
+            for p in (self.offset_weight, self.offset_bias,
+                      self.deformable_conv_weight,
+                      self.deformable_conv_bias):
+                if p is not None and p._deferred_init_args is not None:
+                    p._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        ob = None if self.offset_bias is None else self.offset_bias.data()
+        offset = nd.Convolution(
+            x, self.offset_weight.data(), ob, kernel=self._kernel,
+            stride=self._strides, dilate=self._dilation,
+            pad=self._padding, num_filter=self._offset_channels,
+            num_group=self._groups, no_bias=ob is None)
+        db = None if self.deformable_conv_bias is None \
+            else self.deformable_conv_bias.data()
+        if self._modulated:
+            off = nd.slice_axis(offset, axis=1, begin=0,
+                                end=self._mask_split)
+            mask = nd.slice_axis(offset, axis=1, begin=self._mask_split,
+                                 end=None)
+            mask = nd.sigmoid(mask) * 2
+            out = nd.contrib.ModulatedDeformableConvolution(
+                x, off, mask, self.deformable_conv_weight.data(), db,
+                kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                num_deformable_group=self._num_deformable_group,
+                no_bias=db is None)
+        else:
+            out = nd.contrib.DeformableConvolution(
+                x, offset, self.deformable_conv_weight.data(), db,
+                kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                num_deformable_group=self._num_deformable_group,
+                no_bias=db is None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        shape = self.deformable_conv_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape and shape[1] else None, self._channels)
+        return (f"{type(self).__name__}({mapping}, "
+                f"kernel_size={self._kernel}, stride={self._strides})")
+
+
+class DeformableConvolution(_DeformableBase):
+    """DCNv1 Block (reference conv_layers.py:30): a zero-initialized
+    plain conv produces per-tap sampling offsets, the deformable conv
+    consumes them."""
+    _modulated = False
+
+
+class ModulatedDeformableConvolution(_DeformableBase):
+    """DCNv2 Block (reference conv_layers.py:224): the generator conv
+    additionally emits per-tap modulation logits, mapped through
+    ``2*sigmoid`` (reference :381) before modulating the samples."""
+    _modulated = True

@@ -1,0 +1,7 @@
+"""Contributed datasets + samplers
+(reference: python/mxnet/gluon/contrib/data/)."""
+from . import text
+from .sampler import IntervalSampler
+from .text import WikiText2, WikiText103
+
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103", "text"]

@@ -1,0 +1,7 @@
+"""Contributed samplers (reference:
+python/mxnet/gluon/contrib/data/sampler.py:25). IntervalSampler lives
+with the core samplers here; this module keeps the reference's import
+path ``gluon.contrib.data.IntervalSampler`` working."""
+from ...data.sampler import IntervalSampler
+
+__all__ = ["IntervalSampler"]

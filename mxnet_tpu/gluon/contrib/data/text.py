@@ -1,0 +1,122 @@
+"""Language-model text datasets: WikiText2 / WikiText103.
+
+Reference analog: python/mxnet/gluon/contrib/data/text.py (:104
+WikiText2, :142 WikiText103) — same construction: read the segment's
+token file, append ``<eos>`` per line, index through a
+``contrib.text.Vocabulary`` (built from the corpus when none is given),
+and expose (data, label) = (tokens[:-1], tokens[1:]) reshaped to
+``seq_len`` windows.
+
+Environment difference: no egress, so nothing is downloaded. The
+dataset looks for the official token files (``wiki.train.tokens`` etc.)
+under ``root``; when absent it falls back to a small deterministic
+synthetic corpus so pipelines remain runnable end-to-end, and records
+which source was used in ``.source``.
+"""
+import os
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ....base import data_dir
+from ....contrib import text
+from ...data import dataset
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+_SYNTHETIC_SENTENCES = [
+    "the quick brown fox jumps over the lazy dog",
+    "language modeling predicts the next token in a sequence",
+    "wikitext is a collection of articles from wikipedia",
+    "the model reads tokens and learns long term dependencies",
+    "a vocabulary maps tokens to integer indices",
+    "training minimizes the negative log likelihood of the corpus",
+    "the quick brown fox returns because corpora repeat words",
+    "evaluation uses perplexity on the held out segments",
+]
+
+
+class _WikiText(dataset.Dataset):
+    _segments = ("train", "validation", "test")
+
+    def __init__(self, root, namespace, segment, vocab, seq_len):
+        if segment not in self._segments:
+            raise ValueError(f"segment must be one of {self._segments}, "
+                             f"got {segment!r}")
+        self._root = os.path.expanduser(root)
+        self._namespace = namespace
+        self._segment = segment
+        self._vocab = vocab
+        self._seq_len = seq_len
+        self._counter = None
+        self.source = None  # 'file' or 'synthetic'
+        self._load()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _content(self):
+        fname = {"train": "wiki.train.tokens",
+                 "validation": "wiki.valid.tokens",
+                 "test": "wiki.test.tokens"}[self._segment]
+        path = os.path.join(self._root, fname)
+        if os.path.isfile(path):
+            self.source = "file"
+            with open(path, "r", encoding="utf8") as f:
+                return f.read()
+        # deterministic synthetic fallback, segment-dependent slice
+        self.source = "synthetic"
+        reps = {"train": 8, "validation": 2, "test": 2}[self._segment]
+        return "\n".join(_SYNTHETIC_SENTENCES * reps)
+
+    def _load(self):
+        content = self._content()
+        self._counter = text.utils.count_tokens_from_str(content)
+        if self._vocab is None:
+            self._vocab = text.vocab.Vocabulary(
+                counter=self._counter, reserved_tokens=[EOS_TOKEN])
+        lines = [ln.strip().split() for ln in content.splitlines()]
+        tokens = []
+        for ln in lines:
+            if ln:
+                tokens.extend(ln)
+                tokens.append(EOS_TOKEN)
+        raw = self._vocab.to_indices(tokens)
+        data = onp.array(raw[:-1], dtype="int32")
+        label = onp.array(raw[1:], dtype="int32")
+        n = (len(data) // self._seq_len) * self._seq_len
+        self._data = nd.array(data[:n]).reshape((-1, self._seq_len))
+        self._label = nd.array(label[:n]).reshape((-1, self._seq_len))
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset (reference text.py:104).
+    Place the official ``wiki.{train,valid,test}.tokens`` under
+    ``root`` to use real data; otherwise a synthetic corpus loads."""
+
+    def __init__(self, root=os.path.join(data_dir(), "datasets",
+                                         "wikitext-2"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, "wikitext-2", segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 word-level LM dataset (reference text.py:142)."""
+
+    def __init__(self, root=os.path.join(data_dir(), "datasets",
+                                         "wikitext-103"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, "wikitext-103", segment, vocab, seq_len)

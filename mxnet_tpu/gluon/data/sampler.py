@@ -61,7 +61,9 @@ class IntervalSampler(Sampler):
             yield from range(s, self._length, self._interval)
 
     def __len__(self):
-        return self._length
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))
 
 
 class BatchSampler(Sampler):

@@ -32,11 +32,14 @@ __all__ = ["foreach", "while_loop", "cond"]
 # exposes these under mx.nd.contrib.* (contrib/deformable_convolution.cc,
 # deformable_psroi_pooling.cc, proposal.cc, count_sketch.cc,
 # sync_batch_norm.cc)
-from .vision_ops import (DeformableConvolution, DeformablePSROIPooling,  # noqa: E402,F401
+from .vision_ops import (DeformableConvolution,  # noqa: E402,F401
+                         ModulatedDeformableConvolution,
+                         DeformablePSROIPooling,
                          Proposal, MultiProposal, count_sketch,
                          SyncBatchNorm, BilinearSampler, GridGenerator,
                          SpatialTransformer, Correlation)
-__all__ += ["DeformableConvolution", "DeformablePSROIPooling", "Proposal",
+__all__ += ["DeformableConvolution", "ModulatedDeformableConvolution",
+            "DeformablePSROIPooling", "Proposal",
             "MultiProposal", "count_sketch", "SyncBatchNorm",
             "BilinearSampler", "GridGenerator", "SpatialTransformer",
             "Correlation"]

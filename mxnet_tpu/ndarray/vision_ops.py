@@ -36,7 +36,8 @@ from ..ops.registry import invoke_raw
 from .ndarray import NDArray
 
 __all__ = ["BilinearSampler", "GridGenerator", "SpatialTransformer",
-           "DeformableConvolution", "DeformablePSROIPooling", "Proposal",
+           "DeformableConvolution", "ModulatedDeformableConvolution",
+           "DeformablePSROIPooling", "Proposal",
            "MultiProposal", "Correlation", "count_sketch", "SyncBatchNorm"]
 
 
@@ -174,7 +175,49 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=None,
     ph, pw = int(pad[0]), int(pad[1])
     dg = int(num_deformable_group)
 
-    def fn(x, off, w, *maybe_b):
+    fn = _make_deformable_fn(kh, kw, sh, sw, dh, dw, ph, pw, dg,
+                             num_group, modulated=False)
+    args = [data, offset, weight]
+    if not no_bias and bias is not None:
+        args.append(_wrap(bias))
+    return invoke_raw("DeformableConvolution", fn, args)
+
+
+def ModulatedDeformableConvolution(data, offset, mask, weight, bias=None,
+                                   kernel=None, stride=(1, 1),
+                                   dilate=(1, 1), pad=(0, 0),
+                                   num_filter=None, num_group: int = 1,
+                                   num_deformable_group: int = 1,
+                                   no_bias=False, **_ignored):
+    """DCNv2 (reference contrib/modulated_deformable_convolution.cc):
+    v1's offset sampling plus a learned per-tap modulation scalar
+    multiplied into each sampled column before the einsum. ``mask`` has
+    ``num_deformable_group*kh*kw`` channels ordered like the offset
+    pairs (modulated_deformable_im2col.cuh tap layout)."""
+    data, offset, mask, weight = (_wrap(data), _wrap(offset), _wrap(mask),
+                                  _wrap(weight))
+    kh, kw = (int(kernel[0]), int(kernel[1])) if kernel is not None \
+        else (int(weight.shape[2]), int(weight.shape[3]))
+    fn = _make_deformable_fn(kh, kw, int(stride[0]), int(stride[1]),
+                             int(dilate[0]), int(dilate[1]),
+                             int(pad[0]), int(pad[1]),
+                             int(num_deformable_group), num_group,
+                             modulated=True)
+    args = [data, offset, mask, weight]
+    if not no_bias and bias is not None:
+        args.append(_wrap(bias))
+    return invoke_raw("ModulatedDeformableConvolution", fn, args)
+
+
+def _make_deformable_fn(kh, kw, sh, sw, dh, dw, ph, pw, dg, num_group,
+                        modulated):
+    def fn(x, off, *rest):
+        if modulated:
+            msk, w = rest[0], rest[1]
+            maybe_b = rest[2:]
+        else:
+            msk, w = None, rest[0]
+            maybe_b = rest[1:]
         B, C, H, W = x.shape
         Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
         Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
@@ -192,8 +235,10 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=None,
                 ox = off[:, (g * kh * kw + k) * 2 + 1]
                 ys = gy[None] + i * dh + oy
                 xs = gx[None] + j * dw + ox
-                per_g.append(_grid_sample(
-                    x[:, g * cpg:(g + 1) * cpg], ys, xs))
+                smp = _grid_sample(x[:, g * cpg:(g + 1) * cpg], ys, xs)
+                if msk is not None:
+                    smp = smp * msk[:, g * kh * kw + k][:, None]
+                per_g.append(smp)
             cols.append(jnp.concatenate(per_g, axis=1) if dg > 1
                         else per_g[0])
         col = jnp.stack(cols, axis=2)                     # (B, C, K, Ho, Wo)
@@ -209,10 +254,7 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=None,
             out = out + maybe_b[0].reshape(1, -1, 1, 1)
         return out
 
-    args = [data, offset, weight]
-    if not no_bias and bias is not None:
-        args.append(_wrap(bias))
-    return invoke_raw("DeformableConvolution", fn, args)
+    return fn
 
 
 def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,

@@ -1,0 +1,165 @@
+"""gluon.contrib cnn Blocks + data tail.
+
+Reference analogs: tests/python/unittest/test_gluon_contrib.py
+(DeformableConvolution block tests), gluon/contrib/data/sampler.py
+doctest, gluon/contrib/data/text.py datasets.
+"""
+import collections
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import contrib as gcontrib
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution / ModulatedDeformableConvolution blocks
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """Offset net initializes to zeros, so DCNv1 == plain convolution."""
+    onp.random.seed(0)
+    x = nd.array(onp.random.randn(2, 4, 10, 10).astype("float32"))
+
+    dcn = gcontrib.cnn.DeformableConvolution(
+        8, kernel_size=3, padding=1, in_channels=4)
+    dcn.initialize()
+    out = dcn(x)
+    assert out.shape == (2, 8, 10, 10)
+
+    ref = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=4)
+    ref.initialize()
+    ref.weight.set_data(dcn.deformable_conv_weight.data())
+    ref.bias.set_data(dcn.deformable_conv_bias.data())
+    onp.testing.assert_allclose(out.asnumpy(), ref(x).asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_modulated_deformable_conv_zero_init():
+    """At zero init the mask is 2*sigmoid(0)=1, so DCNv2 also reduces
+    to the plain convolution (reference conv_layers.py:381 scaling)."""
+    onp.random.seed(1)
+    x = nd.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+    dcn = gcontrib.cnn.ModulatedDeformableConvolution(
+        6, kernel_size=3, padding=1, in_channels=3)
+    dcn.initialize()
+    out = dcn(x)
+    assert out.shape == (2, 6, 8, 8)
+
+    ref = nn.Conv2D(6, kernel_size=3, padding=1, in_channels=3)
+    ref.initialize()
+    ref.weight.set_data(dcn.deformable_conv_weight.data())
+    ref.bias.set_data(dcn.deformable_conv_bias.data())
+    onp.testing.assert_allclose(out.asnumpy(), ref(x).asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("cls", [gcontrib.cnn.DeformableConvolution,
+                                 gcontrib.cnn.ModulatedDeformableConvolution])
+def test_deformable_conv_grads_flow(cls):
+    onp.random.seed(2)
+    net = cls(5, kernel_size=3, padding=1, num_deformable_group=1,
+              activation="relu")
+    net.initialize()
+    x = nd.array(onp.random.randn(2, 4, 6, 6).astype("float32"))
+    with autograd.record():
+        y = net(x)
+        loss = (y ** 2).mean()
+    loss.backward()
+    gw = net.deformable_conv_weight.grad().asnumpy()
+    gow = net.offset_weight.grad().asnumpy()
+    assert onp.isfinite(gw).all() and onp.abs(gw).sum() > 0
+    # offset weights start at zero but must receive gradient through the
+    # bilinear sampling coordinates
+    assert onp.isfinite(gow).all() and onp.abs(gow).sum() > 0
+
+
+def test_deformable_conv_deferred_init_and_repr():
+    net = gcontrib.cnn.DeformableConvolution(7, kernel_size=(3, 3),
+                                             padding=(1, 1))
+    net.initialize()
+    x = nd.array(onp.zeros((1, 5, 9, 9), "float32"))
+    y = net(x)
+    assert y.shape == (1, 7, 9, 9)
+    assert net.deformable_conv_weight.shape == (7, 5, 3, 3)
+    assert "5 -> 7" in repr(net)
+
+
+def test_deformable_conv_nonzero_offset_differs():
+    """With a real offset field the result must differ from the plain
+    conv (the sampling grid actually moved)."""
+    onp.random.seed(3)
+    dcn = gcontrib.cnn.DeformableConvolution(4, kernel_size=3, padding=1,
+                                             in_channels=4)
+    dcn.initialize()
+    # push the offset weights away from zero
+    dcn.offset_weight.set_data(
+        nd.array(onp.random.randn(
+            *dcn.offset_weight.shape).astype("float32") * 0.5))
+    x = nd.array(onp.random.randn(1, 4, 8, 8).astype("float32"))
+    ref = nn.Conv2D(4, kernel_size=3, padding=1, in_channels=4)
+    ref.initialize()
+    ref.weight.set_data(dcn.deformable_conv_weight.data())
+    ref.bias.set_data(dcn.deformable_conv_bias.data())
+    assert onp.abs(dcn(x).asnumpy() - ref(x).asnumpy()).max() > 1e-3
+
+
+# ---------------------------------------------------------------------------
+# contrib.data: IntervalSampler + WikiText
+# ---------------------------------------------------------------------------
+
+def test_interval_sampler_reference_examples():
+    s = gcontrib.data.IntervalSampler(13, interval=3)
+    assert list(s) == [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert len(s) == 13
+    s = gcontrib.data.IntervalSampler(13, interval=3, rollover=False)
+    assert list(s) == [0, 3, 6, 9, 12]
+    assert len(s) == 5
+
+
+def test_wikitext2_synthetic_fallback(tmp_path):
+    ds = gcontrib.data.WikiText2(root=str(tmp_path), seq_len=7)
+    assert ds.source == "synthetic"
+    assert len(ds) > 0
+    data, label = ds[0]
+    assert data.shape == (7,) and label.shape == (7,)
+    # label is data shifted by one position in the flat stream
+    d2, _ = ds[1]
+    flat_data = onp.concatenate([ds[i][0].asnumpy()
+                                 for i in range(len(ds))])
+    flat_label = onp.concatenate([ds[i][1].asnumpy()
+                                  for i in range(len(ds))])
+    onp.testing.assert_array_equal(flat_data[1:], flat_label[:-1])
+    # vocabulary built from corpus, has <eos> reserved
+    assert "<eos>" in ds.vocabulary.token_to_idx
+
+
+def test_wikitext_file_source_and_custom_vocab(tmp_path):
+    content = "hello world\nhello again\n"
+    (tmp_path / "wiki.valid.tokens").write_text(content, encoding="utf8")
+    ds = gcontrib.data.WikiText2(root=str(tmp_path), segment="validation",
+                                 seq_len=2)
+    assert ds.source == "file"
+    toks = ds.vocabulary.to_tokens(
+        [int(i) for i in ds[0][0].asnumpy().tolist()])
+    assert toks[0] == "hello"
+    assert ds.frequencies["hello"] == 2
+    # explicit vocabulary is honored, not rebuilt
+    from mxnet_tpu.contrib import text
+    v = text.Vocabulary(collections.Counter(["hello", "world"]),
+                        reserved_tokens=["<eos>"])
+    ds2 = gcontrib.data.WikiText2(root=str(tmp_path),
+                                  segment="validation", vocab=v,
+                                  seq_len=2)
+    assert ds2.vocabulary is v
+    with pytest.raises(ValueError):
+        gcontrib.data.WikiText2(root=str(tmp_path), segment="bogus")
+
+
+def test_wikitext103_constructs(tmp_path):
+    ds = gcontrib.data.WikiText103(root=str(tmp_path), segment="test",
+                                   seq_len=5)
+    assert ds.source == "synthetic" and len(ds) > 0
