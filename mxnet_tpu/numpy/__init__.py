@@ -26,6 +26,14 @@ from . import linalg  # noqa: F401
 from . import random  # noqa: F401
 from . import fft  # noqa: F401
 
+# NumPy-fallback tail (reference numpy/fallback.py): installs ONLY the
+# names without a native TPU implementation above.
+from . import fallback as _fallback  # noqa: E402
+for _n in _fallback._INSTALLED:
+    if _n not in globals():
+        globals()[_n] = getattr(_fallback, _n)
+del _fallback, _n
+
 # dtype aliases (reference python/mxnet/numpy/__init__.py re-exports numpy's)
 float16 = _onp.float16
 float32 = _onp.float32

@@ -1,0 +1,110 @@
+"""Operators that fall back to official NumPy.
+
+Reference analog: python/mxnet/numpy/fallback.py (explicit name list)
++ numpy_op_fallback.py (CustomOp-based wrappers). TPU rebuild: one
+generic host-side wrapper — convert mx arrays to host numpy, run the
+official implementation, wrap array results back as ``mx.np.ndarray``
+on the current context. Fallbacks are host compute: they are refused
+inside ``autograd.record()`` (no gradient path, matching the
+reference's recording guard, multiarray.py:339) and warn once per op
+so silent CPU detours are visible.
+
+Names already implemented natively in mx.np are NOT routed here; the
+module exposes only the residual set, and `numpy/__init__.py` installs
+them without shadowing native implementations.
+"""
+import functools
+import logging
+
+import numpy as onp
+
+__all__ = [
+    "__version__", "_NoValue", "allclose", "alltrue", "apply_along_axis",
+    "apply_over_axes", "argpartition", "argwhere", "array_equal",
+    "array_equiv", "choose", "compress", "corrcoef", "correlate",
+    "count_nonzero", "cov", "digitize", "divmod", "dtype", "extract",
+    "float_power", "frexp", "heaviside", "histogram2d",
+    "histogram_bin_edges", "histogramdd", "i0", "in1d", "intersect1d",
+    "isclose", "isin", "ix_", "lexsort", "min_scalar_type", "mirr",
+    "modf", "msort", "nanargmax", "nanargmin", "nancumprod", "nancumsum",
+    "nanmax", "nanmedian", "nanmin", "nanpercentile", "nanprod",
+    "nanquantile", "ndim", "npv", "partition", "piecewise", "packbits",
+    "poly", "polyadd", "polydiv", "polyfit", "polyint", "polymul",
+    "polysub", "positive", "ppmt", "promote_types", "ptp", "pv", "rate",
+    "real", "result_type", "roots", "searchsorted", "select",
+    "setdiff1d", "setxor1d", "signbit", "size", "spacing",
+    "take_along_axis", "trapz", "tril_indices_from", "trim_zeros",
+    "union1d", "unpackbits", "unwrap", "vander",
+]
+
+# utilities that neither take nor return data arrays: passthrough as-is
+_PASSTHROUGH = {"__version__", "_NoValue", "dtype", "promote_types",
+                "result_type", "min_scalar_type"}
+
+_WARNED = set()
+
+
+def _to_onp(x):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_onp(v) for v in x)
+    return x
+
+
+def _to_mx(x):
+    from .multiarray import ndarray
+    if isinstance(x, onp.ndarray):
+        return ndarray(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_mx(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_mx(v) for k, v in x.items()}
+    return x
+
+
+def make_fallback(name, onp_func=None):
+    """Build the mx-facing wrapper around an official-NumPy function."""
+    fn = onp_func if onp_func is not None else getattr(onp, name)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from .. import _tape
+        from ..base import MXNetError
+        if _tape.is_recording():
+            raise MXNetError(
+                f"np.{name} is a NumPy-fallback operator (host compute, "
+                "no gradient); it cannot run inside autograd.record(). "
+                "Move it outside the recorded scope.")
+        if name not in _WARNED:
+            _WARNED.add(name)
+            logging.warning(
+                "np.%s is a fallback operator, using the official "
+                "numpy implementation on host", name)
+        out = fn(*_to_onp(args), **{k: _to_onp(v)
+                                    for k, v in kwargs.items()})
+        return _to_mx(out)
+
+    wrapper.__name__ = name
+    wrapper._is_np_fallback = True
+    return wrapper
+
+
+def _install():
+    installed = []
+    for name in __all__:
+        if name in _PASSTHROUGH:
+            if hasattr(onp, name):
+                globals()[name] = getattr(onp, name)
+                installed.append(name)
+        elif hasattr(onp, name):
+            globals()[name] = make_fallback(name)
+            installed.append(name)
+        # names dropped from modern numpy (msort, the financial ops)
+        # simply don't install — same observable behavior as the
+        # reference on a numpy without them
+    return installed
+
+
+_INSTALLED = _install()

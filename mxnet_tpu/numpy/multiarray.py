@@ -145,6 +145,46 @@ class ndarray(NDArray):
 
     __hash__ = None  # like numpy arrays
 
+    # ---- NumPy interop protocols (reference multiarray.py:310,:367) ----
+    # With these, official-NumPy calls dispatch on mx arrays:
+    # ``onp.mean(mx_arr)`` runs mx.np.mean (on device); unimplemented
+    # functions fall back to host numpy with a warning + recording guard.
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        out = kwargs.pop("out", None)
+        if out is not None:
+            if not isinstance(out, tuple) or len(out) != 1:
+                return NotImplemented
+            out = out[0]
+        if method != "__call__" or isinstance(out, onp.ndarray):
+            # ufunc methods (reduce/accumulate/outer/...) and writes
+            # into a host-numpy out= buffer keep host semantics: run the
+            # real ufunc on host values (the pre-protocol behavior via
+            # __array__ coercion; reference casting table keeps `a += b`
+            # with onp `a` an onp result, multiarray.py:316)
+            from . import fallback as _fb
+            from .. import _tape
+            if _tape.is_recording():
+                raise MXNetError(
+                    f"np.{ufunc.__name__}.{method} falls back to host "
+                    "numpy (no gradient); it cannot run inside "
+                    "autograd.record().")
+            host_in = _fb._to_onp(inputs)
+            bound = getattr(ufunc, method) if method != "__call__" \
+                else ufunc
+            if out is not None:
+                return bound(*host_in, out=out, **_fb._to_onp(kwargs))
+            return _fb._to_mx(bound(*host_in, **_fb._to_onp(kwargs)))
+        if out is not None:
+            kwargs["out"] = out
+        return _dispatch_to_mx(ufunc.__name__, ufunc, inputs, kwargs)
+
+    def __array_function__(self, func, types, args, kwargs):
+        if not builtins.all(
+                issubclass(t, ndarray) or t is onp.ndarray
+                for t in types):
+            return NotImplemented
+        return _dispatch_to_mx(func.__name__, func, args, kwargs)
+
     def __repr__(self):
         if self._data is None:
             return "array(<uninitialized>)"
@@ -266,6 +306,21 @@ set_np_ndarray_cls(ndarray)
 # ------------------------------------------------------------------
 # helpers
 # ------------------------------------------------------------------
+def _dispatch_to_mx(name, onp_func, args, kwargs):
+    """Route an official-NumPy function/ufunc call whose arguments
+    include mx arrays: prefer the mx.np implementation (device compute,
+    tape-recordable); otherwise fall back to host numpy via the
+    fallback wrapper (warn once, refuse under autograd recording)."""
+    from . import fallback as _fb
+    import mxnet_tpu.numpy as mx_np
+    mx_fn = getattr(mx_np, name, None)
+    if callable(mx_fn) and not getattr(mx_fn, "_is_np_fallback", False):
+        return mx_fn(*_fb._to_mx(args), **_fb._to_mx(kwargs))
+    if getattr(mx_fn, "_is_np_fallback", False):
+        return mx_fn(*args, **kwargs)  # installed wrapper converts itself
+    return _fb.make_fallback(name, onp_func)(*args, **kwargs)
+
+
 def _seq_has_nd(x):
     return isinstance(x, (list, tuple)) and builtins.any(
         isinstance(e, NDArray) for e in x)
@@ -1362,6 +1417,36 @@ def diag_indices_from(arr):
 
 def tril_indices(n, k=0, m=None):
     return tuple(ndarray(i) for i in jnp.tril_indices(n, k, m))
+
+
+def triu_indices(n, k=0, m=None, ctx=None):
+    """Indices of the upper triangle of an (n, m) array (reference
+    numpy/multiarray.py:5902)."""
+    return tuple(ndarray(i) for i in jnp.triu_indices(n, k, m))
+
+
+def triu_indices_from(arr, k=0):
+    a = arr._data if hasattr(arr, "_data") else jnp.asarray(arr)
+    return tuple(ndarray(i) for i in jnp.triu_indices_from(a, k))
+
+
+def tril_indices_from(arr, k=0):
+    a = arr._data if hasattr(arr, "_data") else jnp.asarray(arr)
+    return tuple(ndarray(i) for i in jnp.tril_indices_from(a, k))
+
+
+def unravel_index(indices, shape, order="C"):
+    """Flat index/indices -> coordinate rows, stacked as one ndarray
+    (reference numpy/multiarray.py:7876 returns the stacked form, not
+    numpy's tuple)."""
+    if order != "C":
+        raise MXNetError("only row-major (order='C') is supported")
+    idx = indices._data if hasattr(indices, "_data") else \
+        jnp.asarray(indices)
+    coords = jnp.unravel_index(idx, shape)
+    if jnp.ndim(idx) == 0:
+        return ndarray(jnp.stack([c.reshape(()) for c in coords]))
+    return ndarray(jnp.stack(coords))
 
 
 def fill_diagonal(a, val, wrap=False):
