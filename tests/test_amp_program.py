@@ -1,0 +1,155 @@
+"""AMP program-level dtype regression tests (VERDICT r3 weak #3).
+
+Rounds 1-2 shipped an AMP that silently no-opped: the op lists held
+CamelCase names while the invoke funnel registers snake_case, so no MXU
+op ever matched and "bf16" ran f32-width activations. These tests make
+that class of drift impossible to reintroduce:
+
+1. inspect the ACTUAL traced program (jaxpr) of a hybridized conv block
+   under ``amp.init()`` and assert the conv/matmul ops compute in
+   bfloat16 (activation HBM width — the thing AMP exists to halve);
+2. assert every name in the AMP op lists matches a real invoke-funnel
+   call site in the source tree (the sanity check whose absence hid the
+   CamelCase mismatch for two rounds);
+3. demonstrate the probe catches the historical bug: with the round-1
+   CamelCase lists patched in, the same trace shows f32 convs.
+
+Reference analog: the dtype-flow assertions of
+tests/python/unittest/test_contrib_amp.py, strengthened to the compiled
+program level.
+"""
+import os
+import re
+
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+import mxnet_tpu.amp as amp_mod
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _make_net():
+    onp.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize()
+    x = nd.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+    net(x)  # materialize deferred shapes (pre-AMP, like bench.py)
+    return net, x
+
+
+def _trace_forward(net, x):
+    """jaxpr of the block's forward — the program jit would compile."""
+    params = [p for p in net.collect_params().values()
+              if p._data is not None]
+
+    def fn(xd, pd):
+        orig = [p._data for p in params]
+        for p, d in zip(params, pd):
+            p._data = NDArray(d)
+        try:
+            out = net.forward(NDArray(xd))
+        finally:
+            for p, o in zip(params, orig):
+                p._data = o
+        return out._data
+
+    return jax.make_jaxpr(fn)(x._data,
+                              tuple(p._data._data for p in params))
+
+
+def _eqn_out_dtypes(jaxpr, prim_name):
+    out = []
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == prim_name:
+                out.extend(v.aval.dtype for v in eqn.outvars)
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+    walk(jaxpr.jaxpr)
+    return [str(d) for d in out]
+
+
+def test_amp_program_runs_conv_and_dense_in_bf16():
+    net, x = _make_net()
+    amp_mod.init()
+    try:
+        jx = _trace_forward(net, x)
+    finally:
+        amp_mod.uninit()
+    convs = _eqn_out_dtypes(jx, "conv_general_dilated")
+    dots = _eqn_out_dtypes(jx, "dot_general")
+    assert convs, "no conv in traced program — probe is broken"
+    assert dots, "no matmul in traced program — probe is broken"
+    assert all(d == "bfloat16" for d in convs), convs
+    assert all(d == "bfloat16" for d in dots), dots
+
+
+def test_amp_off_program_is_f32():
+    net, x = _make_net()
+    jx = _trace_forward(net, x)
+    convs = _eqn_out_dtypes(jx, "conv_general_dilated")
+    assert convs and all(d == "float32" for d in convs), convs
+
+
+def test_round1_camelcase_lists_would_now_fail(monkeypatch):
+    """With the historical (broken) CamelCase lists, the probe must see
+    f32 convs — i.e. this regression test would have caught the bug."""
+    monkeypatch.setattr(amp_mod, "TARGET_DTYPE_OPS",
+                        {"Convolution", "FullyConnected", "Dot"})
+    net, x = _make_net()
+    amp_mod.init()
+    try:
+        jx = _trace_forward(net, x)
+    finally:
+        amp_mod.uninit()
+    convs = _eqn_out_dtypes(jx, "conv_general_dilated")
+    assert convs and all(d == "float32" for d in convs), \
+        "CamelCase lists unexpectedly matched the invoke funnel"
+
+
+def test_amp_fp32_ops_cast_up():
+    """softmax under AMP computes in f32 even when bf16 flows in."""
+    amp_mod.init()
+    try:
+        y = nd.softmax(nd.ones((2, 4)).astype("bfloat16"))
+    finally:
+        amp_mod.uninit()
+    assert str(y.dtype) in ("float32",)
+
+
+def test_amp_list_names_match_invoke_funnel():
+    """Every AMP list entry must name a real invoke-funnel call site.
+    Scans the source for invoke_raw("<name>" occurrences; a drift like
+    round 1's CamelCase entries fails here immediately."""
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mxnet_tpu")
+    names = set()
+    pat = re.compile(r'invoke_raw\(\s*f?"([A-Za-z0-9_{}]+)"')
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.endswith(".py"):
+                with open(os.path.join(dirpath, f), encoding="utf8") as fh:
+                    names.update(pat.findall(fh.read()))
+    # f-string sites like "rnn_{mode}" register a prefix family
+    prefixes = tuple(n.split("{")[0] for n in names if "{" in n)
+    names = {n for n in names if "{" not in n}
+
+    def known(op):
+        return op in names or (prefixes and op.startswith(prefixes))
+
+    missing = [op for op in amp_mod.TARGET_DTYPE_OPS if not known(op)]
+    assert not missing, f"TARGET_DTYPE_OPS entries with no invoke site: " \
+                        f"{missing}"
+    missing = [op for op in amp_mod.FP32_OPS if not known(op)]
+    assert not missing, f"FP32_OPS entries with no invoke site: {missing}"
