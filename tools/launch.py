@@ -22,11 +22,49 @@ import subprocess
 import sys
 
 
+def _may_own_accelerator(env) -> bool:
+    """True when the child could hold the accelerator client. Killing a
+    process mid-TPU-dispatch can wedge a tunneled relay for HOURS (it
+    cost round 3 both driver artifacts) — such processes must exit on
+    SIGTERM, never SIGKILL."""
+    return env.get("JAX_PLATFORMS", "").lower() != "cpu"
+
+
+def _graceful_stop(procs, owns_accel, grace=None) -> None:
+    """Dead-rank cleanup protocol: SIGTERM -> grace window -> SIGKILL,
+    where the SIGKILL escalation is PER-PROCESS gated: CPU-pinned
+    stragglers are hard-killed, accelerator-owning stragglers only ever
+    receive repeated SIGTERM + a loud warning (kill-hygiene protocol,
+    docs/PERF_NOTES.md)."""
+    import time
+    if grace is None:
+        grace = float(os.environ.get("MXNET_LAUNCH_KILL_GRACE", "10"))
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace
+    while time.time() < deadline:
+        if all(p.poll() is not None for p in procs):
+            return
+        time.sleep(0.1)
+    for p, owns in zip(procs, owns_accel):
+        if p.poll() is None:
+            if owns:
+                print(f"launch: worker pid {p.pid} may own the "
+                      "accelerator; NOT hard-killing (a SIGKILL "
+                      "mid-dispatch can wedge the device relay). "
+                      "Re-sending SIGTERM.", file=sys.stderr)
+                p.terminate()
+            else:
+                p.kill()
+
+
 def launch_local(n: int, cmd, port: int) -> int:
     """Spawn n local worker processes sharing a coordinator (the analog of
     the reference's `--launcher local` multi-process rig used by
     tests/nightly/dist_sync_kvstore.py)."""
     procs = []
+    owns = []
     for i in range(n):
         env = dict(os.environ)
         env.update({
@@ -37,15 +75,15 @@ def launch_local(n: int, cmd, port: int) -> int:
             "DMLC_PS_ROOT_PORT": str(port),
         })
         procs.append(subprocess.Popen(cmd, env=env))
+        owns.append(_may_own_accelerator(env))
 
     def _kill(*_):
-        for p in procs:
-            p.terminate()
+        _graceful_stop(procs, owns)
         sys.exit(1)
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
-    return _wait_all(procs)
+    return _wait_all(procs, owns)
 
 
 def launch_ssh(n: int, cmd, hostfile: str, port: int) -> int:
@@ -70,19 +108,23 @@ def launch_ssh(n: int, cmd, hostfile: str, port: int) -> int:
                                        "StrictHostKeyChecking=no",
                                        hosts[i], remote]))
 
+    # the local ssh client processes never own this host's accelerator
+    owns = [False] * len(procs)
+
     def _kill(*_):
-        for p in procs:
-            p.terminate()
+        _graceful_stop(procs, owns)
         sys.exit(1)
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
-    return _wait_all(procs)
+    return _wait_all(procs, owns)
 
 
-def _wait_all(procs) -> int:
-    """Wait on all workers; when one fails, terminate the siblings (they
-    may be blocked in a collective waiting for the dead rank forever)."""
+def _wait_all(procs, owns_accel) -> int:
+    """Wait on all workers; when one fails, gracefully stop the siblings
+    (they may be blocked in a collective waiting for the dead rank
+    forever). Escalation is SIGTERM -> grace -> SIGKILL, never
+    hard-killing an accelerator-owning process (_graceful_stop)."""
     import time
     rc = 0
     alive = list(procs)
@@ -94,8 +136,7 @@ def _wait_all(procs) -> int:
             alive.remove(p)
             if r != 0:
                 rc = rc or r
-                for q in alive:
-                    q.terminate()
+                _graceful_stop(procs, owns_accel)
         time.sleep(0.05)
     return rc
 

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Parse training output logs into a markdown (or TSV) table.
+
+Reference analog: tools/parse_log.py — same CLI and the same
+``Epoch[N] Train-<metric>=V`` / ``Validation-<metric>=V`` /
+``Epoch[N] Time cost=V`` line grammar, extended to also match this
+framework's estimator LoggingHandler lines
+(``[Epoch N] train <metric>: V``, gluon/contrib/estimator).
+"""
+import argparse
+import re
+import sys
+
+
+def parse_lines(lines, metric_names):
+    """-> {epoch: [sum, count] * (2*len(metrics)+1)} accumulator rows:
+    train metrics, then val metrics, then epoch time."""
+    res = [re.compile(r".*Epoch\[(\d+)\] Train-" + s + r".*=([.\d]+)")
+           for s in metric_names]
+    res += [re.compile(r".*Epoch\[(\d+)\] Validation-" + s + r".*=([.\d]+)")
+            for s in metric_names]
+    res += [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+    # estimator LoggingHandler grammar
+    est = [re.compile(r".*\[Epoch (\d+)\].*[Tt]rain " + s + r": ([.\d]+)")
+           for s in metric_names]
+    est += [re.compile(r".*\[Epoch (\d+)\].*[Vv]al(?:idation)? " + s +
+                       r": ([.\d]+)") for s in metric_names]
+    est += [re.compile(r".*\[Epoch (\d+)\].*time.*?: ([.\d]+)")]
+
+    n_slots = 2 * len(metric_names) + 1
+    data = {}
+    for line in lines:
+        for table in (res, est):
+            for i, r in enumerate(table):
+                m = r.match(line)
+                if m is not None:
+                    epoch = int(m.group(1))
+                    val = float(m.group(2))
+                    row = data.setdefault(epoch, [0.0, 0] * n_slots)
+                    row[i * 2] += val
+                    row[i * 2 + 1] += 1
+                    break
+            else:
+                continue
+            break
+    return data
+
+
+def format_table(data, metric_names, fmt):
+    heads = (["train-" + s for s in metric_names] +
+             ["val-" + s for s in metric_names] + ["time"])
+    rows = []
+    for epoch in sorted(data):
+        v = data[epoch]
+        cells = []
+        for j in range(len(heads)):
+            cnt = v[2 * j + 1]
+            cells.append("%f" % (v[2 * j] / cnt) if cnt else "-")
+        rows.append((epoch + 1, cells))
+    out = []
+    if fmt == "markdown":
+        out.append("| epoch | " + " | ".join(heads) + " |")
+        out.append("| --- " * (len(heads) + 1) + "|")
+        for epoch, cells in rows:
+            out.append("| %2d | " % epoch + " | ".join(cells) + " |")
+    else:
+        out.append("\t".join(["epoch"] + heads))
+        for epoch, cells in rows:
+            out.append("\t".join(["%2d" % epoch] + cells))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Parse training output log")
+    parser.add_argument("logfile", nargs=1, type=str,
+                        help="the log file for parsing")
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"],
+                        help="the format of the parsed output")
+    parser.add_argument("--metric-names", type=str, nargs="+",
+                        default=["accuracy"],
+                        help="names of metrics in log to parse")
+    args = parser.parse_args(argv)
+    with open(args.logfile[0]) as f:
+        lines = f.readlines()
+    data = parse_lines(lines, args.metric_names)
+    print(format_table(data, args.metric_names, args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

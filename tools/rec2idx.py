@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Create a random-access index for an existing RecordIO file.
+
+Reference analog: tools/rec2idx.py (IndexCreator over MXRecordIO).
+Reads the .rec sequentially, records each record's byte offset, writes
+the text index ("key\\tpos" lines) that MXIndexedRecordIO consumes.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio
+
+
+class IndexCreator(recordio.MXRecordIO):
+    """Reads RecordIO data and creates the index file enabling random
+    access (reference rec2idx.py:26)."""
+
+    def __init__(self, uri, idx_path, key_type=int):
+        self.key_type = key_type
+        self.fidx = None
+        self.idx_path = idx_path
+        super().__init__(uri, "r")
+
+    def open(self):
+        super().open()
+        self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def tell(self):
+        return self._rec.tell()
+
+    def create_index(self, key=0):
+        self.reset()
+        counter = 0
+        pre_time = __import__("time").time()
+        while True:
+            now = __import__("time").time()
+            if now - pre_time > 1:
+                pre_time = now
+                print(f"time: {now}  count: {counter}", file=sys.stderr)
+            pos = self.tell()
+            cont = self.read()
+            if cont is None:
+                break
+            key = self.key_type(counter)
+            self.fidx.write(f"{key}\t{pos}\n")
+            counter += 1
+        self.fidx.flush()
+        return counter
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an index file from a RecordIO file")
+    parser.add_argument("record", help="path to the .rec file")
+    parser.add_argument("index", help="path of the index file to create")
+    args = parser.parse_args(argv)
+    creator = IndexCreator(args.record, args.index)
+    n = creator.create_index()
+    creator.close()
+    print(f"indexed {n} records -> {args.index}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
