@@ -26,14 +26,15 @@ from .util import is_np_array, set_np, reset_np, use_np
 # line below is enabled once the module exists and its tests pass.
 _OPTIONAL_MODULES = [
     ("initializer", None), ("init", None), ("optimizer", None),
-    ("lr_scheduler", None), ("kvstore", None), ("gluon", None),
+    ("lr_scheduler", None), ("kvstore", None), ("kvstore", "kv"),
+    ("gluon", None),
     ("metric", None), ("profiler", None), ("numpy", "np"),
     ("numpy_extension", "npx"), ("symbol", None), ("symbol", "sym"),
     ("image", None), ("io", None), ("runtime", None), ("parallel", None),
     ("test_utils", None), ("amp", None), ("recordio", None),
     ("operator", None), ("rtc", None), ("contrib", None),
     ("subgraph", None), ("checkpoint", None), ("library", None),
-    ("inspector", None),
+    ("inspector", None), ("visualization", None), ("visualization", "viz"),
 ]
 import importlib as _importlib
 
