@@ -125,7 +125,12 @@ def bench_resnet(dtype):
     from __graft_entry__ import make_train_step, _init_net
 
     on_accel = jax.default_backend() != "cpu"
-    bs = 128 if on_accel else 4
+    try:
+        bs = int(os.environ.get("MXNET_BENCH_BS") or 128) if on_accel \
+            else 4
+    except ValueError:
+        raise SystemExit("MXNET_BENCH_BS must be an integer, got "
+                         f"{os.environ['MXNET_BENCH_BS']!r}")
     size = 224 if on_accel else 32
     warmup = 3 if on_accel else 1
     steps = 20 if on_accel else 2

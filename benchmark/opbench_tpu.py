@@ -205,7 +205,8 @@ def _cases(rng):
                                   no_bias=True)._data
             # 128x1000 -> feed back as 128x2048 via renormalized tile
             y = _renorm(y)
-            return jnp.concatenate([y, y], axis=1)[:, :2048]
+            return jnp.concatenate([y, y, y], axis=1)[:, :2048] \
+                .astype(c.dtype)
 
         return (arr((128, 2048), "bfloat16"), body,
                 2 * 128 * 2048 * 1000, None)
@@ -220,7 +221,7 @@ def _cases(rng):
         def body(i, c):
             h = nd.dot(_nd(c), _nd(wf1))._data
             h = jnp.maximum(h, 0)
-            return _renorm(nd.dot(_nd(h), _nd(wf2))._data)
+            return _renorm(nd.dot(_nd(h), _nd(wf2))._data).astype(c.dtype)
 
         return xb, body, 2 * 16384 * 768 * 3072 * 2, None
 
