@@ -35,6 +35,8 @@ _OPTIONAL_MODULES = [
     ("operator", None), ("rtc", None), ("contrib", None),
     ("subgraph", None), ("checkpoint", None), ("library", None),
     ("inspector", None), ("visualization", None), ("visualization", "viz"),
+    ("name", None), ("attribute", None), ("error", None), ("log", None),
+    ("registry", None),
 ]
 import importlib as _importlib
 
@@ -47,6 +49,11 @@ for _mod, _alias in _OPTIONAL_MODULES:
 
 try:
     from .kvstore import KVStore  # noqa: F401
+except ImportError:
+    pass
+
+try:
+    from .attribute import AttrScope  # noqa: F401  (reference __init__:72)
 except ImportError:
     pass
 

@@ -1,0 +1,60 @@
+"""Symbol attribute scoping.
+
+Reference analog: python/mxnet/attribute.py:23 — ``with
+mx.AttrScope(ctx_group='stage1'):`` attaches string attributes to every
+symbol created inside the scope (used for context grouping, subgraph
+marking). Scopes nest by dict-merge, inner keys winning.
+"""
+import contextvars
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    """Attribute manager for scoping; all values must be strings
+    (they travel through the symbol's serialized attr dict)."""
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be string")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge the scope's attributes under the user-passed ``attr``
+        dict (user keys win). Always returns a fresh dict — the result
+        is stored on the symbol, so caller state must not alias in —
+        and enforces the strings-only rule on user attrs too."""
+        if attr:
+            for value in attr.values():
+                if not isinstance(value, str):
+                    raise ValueError("Attributes need to be string")
+        ret = self._attr.copy()
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        # merge for the scope's duration only; restored on exit so a
+        # reused AttrScope instance never leaks an old enclosing scope
+        self._saved_attr = self._attr
+        attr = _current.get()._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        self._old_scope = _current.get()
+        _current.set(self)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope
+        _current.set(self._old_scope)
+        self._attr = self._saved_attr
+
+
+_current = contextvars.ContextVar("attrscope", default=AttrScope())
+
+
+def current():
+    """The active attribute scope."""
+    return _current.get()

@@ -1,0 +1,46 @@
+"""Typed framework errors.
+
+Reference analog: python/mxnet/error.py — a registry mapping error-type
+names to Python exception classes (there used to decode C++ FFI error
+headers like ``ValueError: ...``; here used by native-boundary code and
+kept for API parity) plus ``InternalError``.
+"""
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "register"]
+
+_ERROR_REGISTRY = {}
+
+
+def register(cls_or_name, cls=None):
+    """Register an error class under a name. Usable as a decorator
+    (``@register`` on an MXNetError subclass) or as
+    ``register("ValueError", ValueError)``."""
+    if cls is not None:
+        _ERROR_REGISTRY[cls_or_name] = cls
+        return cls
+    _ERROR_REGISTRY[cls_or_name.__name__] = cls_or_name
+    return cls_or_name
+
+
+def get_error_class(name):
+    """Look up a registered error class; MXNetError when unknown."""
+    return _ERROR_REGISTRY.get(name, MXNetError)
+
+
+@register
+class InternalError(MXNetError):
+    """Internal error in the framework (reference error.py:31)."""
+
+    def __init__(self, msg):
+        if "hint:" not in msg:
+            msg += ("\nhint: you hit an internal error; please report it "
+                    "with the full traceback.")
+        super().__init__(msg)
+
+
+register("ValueError", ValueError)
+register("TypeError", TypeError)
+register("AttributeError", AttributeError)
+register("IndexError", IndexError)
+register("NotImplementedError", NotImplementedError)

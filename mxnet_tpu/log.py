@@ -1,0 +1,74 @@
+"""Logging utilities with the framework's glog-style line format.
+
+Reference analog: python/mxnet/log.py — ``get_logger`` returns a logger
+whose lines look like ``I0505 00:29:47 3525 file:func:1] message``
+(level letter, date, PID, location), colorized on TTYs.
+"""
+import logging
+import sys
+import warnings
+
+__all__ = ["get_logger", "getLogger", "CRITICAL", "ERROR", "WARNING",
+           "INFO", "DEBUG", "NOTSET"]
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+_LEVEL_CHAR = {logging.CRITICAL: "C", logging.ERROR: "E",
+               logging.WARNING: "W", logging.INFO: "I",
+               logging.DEBUG: "D"}
+
+
+class _Formatter(logging.Formatter):
+    """glog-style formatter (reference log.py:34)."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def _color(self, level):
+        if level >= logging.WARNING:
+            return "\x1b[31m"
+        if level >= logging.INFO:
+            return "\x1b[32m"
+        return "\x1b[34m"
+
+    def format(self, record):
+        label = _LEVEL_CHAR.get(record.levelno, "U")
+        loc = "%(asctime)s %(process)d %(pathname)s:%(funcName)s:%(lineno)d"
+        if self._colored:
+            fmt = self._color(record.levelno) + label + loc + "]\x1b[0m"
+        else:
+            fmt = label + loc + "]"
+        self._style._fmt = fmt + " %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A logger with the framework formatter installed once per name
+    (reference log.py:84). ``filename`` attaches a FileHandler
+    (mode ``filemode`` or 'a'); otherwise a stderr StreamHandler."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", None):
+        logger._init_done = True
+        if filename:
+            hdlr = logging.FileHandler(filename, filemode or "a")
+            hdlr.setFormatter(_Formatter(colored=False))
+        else:
+            hdlr = logging.StreamHandler(sys.stderr)
+            hdlr.setFormatter(_Formatter(
+                colored=getattr(sys.stderr, "isatty", lambda: False)()))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias of :func:`get_logger` (reference log.py:74)."""
+    warnings.warn("getLogger is deprecated, use get_logger instead.",
+                  DeprecationWarning, stacklevel=2)
+    return get_logger(name, filename, filemode, level)

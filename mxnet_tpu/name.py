@@ -1,0 +1,55 @@
+"""Automatic symbol naming scopes.
+
+Reference analog: python/mxnet/name.py (:21 NameManager, :71 Prefix) —
+same contract: a context-local manager turns (user name | None, hint)
+into a canonical name, counting per hint; ``Prefix`` prepends a string.
+Consumed by ``mx.sym`` op construction (symbol/__init__.py).
+"""
+import contextvars
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Automatic naming: user-specified names pass through; otherwise
+    ``<hint><n>`` with a per-hint counter. Use as a context manager to
+    install for the enclosed symbol constructions."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old_manager = _current.get()
+        _current.set(self)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        _current.set(self._old_manager)
+
+
+class Prefix(NameManager):
+    """Name manager that attaches a prefix to every generated or
+    user-given name (reference name.py:71)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+_current = contextvars.ContextVar("namemanager", default=NameManager())
+
+
+def current():
+    """The active name manager."""
+    return _current.get()

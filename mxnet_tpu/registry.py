@@ -1,0 +1,97 @@
+"""Generic class-factory registry.
+
+Reference analog: python/mxnet/registry.py — per-base-class registries
+with register / alias / create(name-or-config-JSON) factory functions.
+Used by optimizer/initializer/lr_scheduler-style plugin surfaces.
+"""
+import json
+import warnings
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_REGISTRY = {}
+
+
+def get_registry(base_class):
+    """A copy of the registry for ``base_class``."""
+    return dict(_REGISTRY.setdefault(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """A registrator: ``register(klass, name=None)`` files subclasses of
+    ``base_class`` under ``name.lower()`` (warning on override)."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        if not (isinstance(klass, type) and issubclass(klass, base_class)):
+            raise TypeError(
+                f"Can only register subclass of {base_class.__name__}")
+        key = (name or klass.__name__).lower()
+        if key in registry:
+            warnings.warn(
+                f"New {nickname} {klass.__module__}.{klass.__name__} "
+                f"registered with name {key} is overriding existing "
+                f"{nickname} {registry[key].__module__}."
+                f"{registry[key].__name__}", UserWarning, stacklevel=2)
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """A decorator factory registering one class under several names:
+    ``@alias('sgd', 'vanilla_sgd')``."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A factory: ``create(name, *args, **kwargs)`` instantiates the
+    registered class. ``name`` may also be an instance (returned
+    as-is), a config dict, or the JSON forms '["name", {...kwargs}]' /
+    '{...kwargs incl. nickname key}' (reference registry.py:114)."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise ValueError(
+                    f"{nickname} is already an instance. Additional "
+                    "arguments are invalid")
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        if not isinstance(name, str):
+            raise TypeError(f"{nickname} must be of string type")
+        if name.startswith("["):
+            if args or kwargs:
+                raise ValueError("JSON config takes no extra arguments")
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            if args or kwargs:
+                raise ValueError("JSON config takes no extra arguments")
+            return create(**json.loads(name))
+        key = name.lower()
+        if key not in registry:
+            raise KeyError(
+                f"{name} is not registered. Please register with "
+                f"{nickname}.register first")
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance from config."
+    return create

@@ -11,13 +11,18 @@ from . import symbol as _symbol_mod
 
 
 def _make_sym_op(opname):
-    def op(*args, name=None, **kwargs):
+    def op(*args, name=None, attr=None, **kwargs):
+        from .. import attribute as _attribute
+        from .. import name as _name
         sym_inputs = [a for a in args if isinstance(a, Symbol)]
         attrs = {k: v for k, v in kwargs.items()
                  if not isinstance(v, Symbol)}
         sym_inputs += [v for v in kwargs.values() if isinstance(v, Symbol)]
-        return Symbol(opname, name or f"{opname.lower()}_{len(sym_inputs)}",
-                      sym_inputs, attrs)
+        s = Symbol(opname,
+                   _name.current().get(name, opname.lower()),
+                   sym_inputs, attrs)
+        s._user_attrs = _attribute.current().get(attr)
+        return s
     op.__name__ = opname
     return op
 

@@ -38,6 +38,7 @@ class Symbol:
         self._name = name
         self._inputs = list(inputs)
         self._attrs = dict(attrs or {})
+        self._user_attrs: Dict[str, str] = {}  # AttrScope/attr= strings
         self._out_index = out_index
 
     # ---------------- introspection ----------------
@@ -45,10 +46,29 @@ class Symbol:
     def name(self) -> str:
         return self._name
 
+    def attr(self, key: str):
+        """The string attribute ``key`` attached to this node by
+        ``AttrScope`` / ``attr=`` (reference symbol.py attr()); None
+        when unset."""
+        return self._user_attrs.get(key)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        """{node name: its string attrs} over the whole graph
+        (reference symbol.py attr_dict())."""
+        out: Dict[str, Dict[str, str]] = {}
+        for node in self.get_internals():
+            if getattr(node, "_user_attrs", None):
+                out[node._name] = dict(node._user_attrs)
+        return out
+
     def list_arguments(self) -> List[str]:
         seen, order = set(), []
+        visited = set()
 
         def walk(s):
+            if id(s) in visited:  # memoize: shared inputs are common
+                return
+            visited.add(id(s))
             if s._op is None:
                 if s._name not in seen:
                     seen.add(s._name)
@@ -63,12 +83,15 @@ class Symbol:
 
     def get_internals(self) -> List["Symbol"]:
         nodes = []
+        visited = set()
 
         def walk(s):
+            if id(s) in visited:  # memoize: a diamond graph would
+                return            # otherwise traverse exponentially
+            visited.add(id(s))
             for i in s._inputs:
                 walk(i)
-            if s not in nodes:
-                nodes.append(s)
+            nodes.append(s)
         walk(self)
         return nodes
 
@@ -78,10 +101,15 @@ class Symbol:
                          "use operator functions")
 
     def _binary(self, other, opname):
+        from .. import attribute as _attribute
+        from .. import name as _name
+        nm = _name.current().get(None, f"_{opname}")
         if isinstance(other, (int, float)):
-            return Symbol(opname + "_scalar", f"{opname}_{id(self)}",
-                          [self], {"scalar": other})
-        return Symbol(opname, f"{opname}_{id(self)}", [self, other])
+            s = Symbol(opname + "_scalar", nm, [self], {"scalar": other})
+        else:
+            s = Symbol(opname, nm, [self, other])
+        s._user_attrs = _attribute.current().get(None)
+        return s
 
     def __add__(self, o):
         return self._binary(o, "add")
@@ -103,7 +131,12 @@ class Symbol:
         return self._binary(o, "pow")
 
     def __neg__(self):
-        return Symbol("negative", f"neg_{id(self)}", [self])
+        from .. import attribute as _attribute
+        from .. import name as _name
+        s = Symbol("negative", _name.current().get(None, "_negative"),
+                   [self])
+        s._user_attrs = _attribute.current().get(None)
+        return s
 
     # ---------------- evaluation ----------------
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
@@ -148,8 +181,11 @@ class Symbol:
                 return node_ids[id(s)]
             in_ids = [visit(i) for i in s._inputs]
             nid = len(nodes)
-            nodes.append({"op": s._op or "null", "name": s._name,
-                          "attrs": _jsonable(s._attrs), "inputs": in_ids})
+            node = {"op": s._op or "null", "name": s._name,
+                    "attrs": _jsonable(s._attrs), "inputs": in_ids}
+            if getattr(s, "_user_attrs", None):
+                node["attr"] = dict(s._user_attrs)
+            nodes.append(node)
             node_ids[id(s)] = nid
             return nid
         head = visit(self)
@@ -178,9 +214,12 @@ def _jsonable(attrs):
     return out
 
 
-def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
+def Variable(name: str, shape=None, dtype=None, attr=None,
+             **kwargs) -> Symbol:
+    from .. import attribute as _attribute
     s = Symbol(None, name)
     s._attrs.update({"shape": shape, "dtype": dtype})
+    s._user_attrs = _attribute.current().get(attr)
     return s
 
 
@@ -202,6 +241,7 @@ def load_json(json_str: str) -> Symbol:
             s = Symbol(node["op"], node["name"],
                        [built[i] for i in node["inputs"]],
                        node.get("attrs", {}))
+        s._user_attrs = dict(node.get("attr", {}))
         built.append(s)
     return built[spec["head"]]
 

@@ -1,0 +1,193 @@
+"""Base utility modules: name scopes, attribute scopes, error registry,
+logging, class-factory registry.
+
+Reference analogs: python/mxnet/{name,attribute,error,log,registry}.py
+— exercised through the same surfaces reference users hit (mx.name.
+Prefix around symbol construction, mx.AttrScope attaching string attrs,
+registry-driven create from JSON configs).
+"""
+import logging
+
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+
+# ---------------------------------------------------------------------------
+# name scopes
+# ---------------------------------------------------------------------------
+
+def test_name_manager_counts_per_hint():
+    nm = mx.name.NameManager()
+    assert nm.get(None, "fc") == "fc0"
+    assert nm.get(None, "fc") == "fc1"
+    assert nm.get(None, "conv") == "conv0"
+    assert nm.get("explicit", "fc") == "explicit"
+
+
+def test_prefix_applies_to_symbol_construction():
+    data = sym.Variable("data")
+    with mx.name.Prefix("mynet_"):
+        net = sym.FullyConnected(data, sym.Variable("w"), num_hidden=10,
+                                 name="fc1")
+        auto = sym.relu(net)
+    assert net.name == "mynet_fc1"
+    assert auto.name == "mynet_relu0"
+    # outside the scope the default manager resumes, no prefix
+    outside = sym.relu(net)
+    assert outside.name.startswith("relu") and \
+        not outside.name.startswith("mynet_")
+
+
+def test_name_managers_nest():
+    with mx.name.Prefix("a_"):
+        with mx.name.Prefix("b_"):
+            assert mx.name.current().get(None, "x") == "b_x0"
+        assert mx.name.current().get(None, "x") == "a_x0"
+
+
+# ---------------------------------------------------------------------------
+# attribute scopes
+# ---------------------------------------------------------------------------
+
+def test_attr_scope_attaches_and_nests():
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="stage1"):
+        a = sym.relu(data)
+        with mx.AttrScope(ctx_group="stage2", lr_mult="0.1"):
+            b = sym.relu(a)
+    c = sym.relu(b)
+    assert a.attr("ctx_group") == "stage1"
+    assert b.attr("ctx_group") == "stage2" and b.attr("lr_mult") == "0.1"
+    assert c.attr("ctx_group") is None
+    d = sym.attr_dict(c) if hasattr(sym, "attr_dict") else c.attr_dict()
+    assert d[b.name]["ctx_group"] == "stage2"
+    with pytest.raises(ValueError):
+        mx.AttrScope(bad=123)
+
+
+def test_attr_scope_covers_variables_and_operators():
+    """Reference contract: EVERY symbol created in the scope gets the
+    attrs — including Variable and operator-overload nodes (review
+    finding round 4)."""
+    with mx.AttrScope(ctx_group="g1"):
+        x = sym.Variable("x")
+        y = sym.Variable("y")
+        z = x + y
+        n = -z
+    assert x.attr("ctx_group") == "g1"
+    assert z.attr("ctx_group") == "g1" and n.attr("ctx_group") == "g1"
+    with mx.name.Prefix("p_"):
+        w = sym.Variable("a") + sym.Variable("b")
+    assert w.name.startswith("p_")
+
+
+def test_attr_scope_instance_reuse_does_not_leak():
+    s = mx.AttrScope(grp="a")
+    with mx.AttrScope(extra="x"):
+        with s:
+            pass
+    with s:
+        node = sym.Variable("v")
+    assert node.attr("grp") == "a"
+    assert node.attr("extra") is None  # stale enclosing scope must not leak
+
+
+def test_attr_kwarg_is_copied_and_validated():
+    d = {"lr_mult": "0.1"}
+    a = sym.relu(sym.Variable("x"), attr=d)
+    d["lr_mult"] = "10"
+    assert a.attr("lr_mult") == "0.1"  # no aliasing of caller state
+    with pytest.raises(ValueError):
+        sym.relu(sym.Variable("x"), attr={"lr_mult": 0.1})
+
+
+def test_shared_input_graphs_traverse_linearly():
+    """Diamond-heavy graphs (y = x*x chained) must not blow up
+    exponentially in the graph walks (review finding round 4)."""
+    y = sym.Variable("x")
+    for _ in range(60):
+        y = y * y
+    assert y.attr_dict() == {}
+    assert y.list_arguments() == ["x"]
+    assert len(y.get_internals()) == 61
+
+
+def test_attr_survives_json_roundtrip():
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="g0"):
+        y = sym.exp(data, name="e0")
+    y2 = sym.load_json(y.tojson())
+    assert y2.attr("ctx_group") == "g0"
+
+
+# ---------------------------------------------------------------------------
+# error registry
+# ---------------------------------------------------------------------------
+
+def test_error_registry():
+    from mxnet_tpu import error
+    assert issubclass(error.InternalError, mx.MXNetError)
+    with pytest.raises(error.InternalError, match="hint"):
+        raise error.InternalError("boom")
+    assert error.get_error_class("ValueError") is ValueError
+    assert error.get_error_class("InternalError") is error.InternalError
+    assert error.get_error_class("NoSuchThing") is mx.MXNetError
+
+
+# ---------------------------------------------------------------------------
+# log
+# ---------------------------------------------------------------------------
+
+def test_get_logger_format(tmp_path):
+    logf = tmp_path / "t.log"
+    logger = mx.log.get_logger("mxt_test_logger", filename=str(logf),
+                               level=logging.INFO)
+    logger.info("hello world")
+    logger.debug("invisible")  # below level
+    for h in logger.handlers:
+        h.flush()
+    text = logf.read_text()
+    assert "hello world" in text and "invisible" not in text
+    line = [l for l in text.splitlines() if "hello world" in l][0]
+    assert line.startswith("I")          # level letter prefix
+    assert "test_base_modules" in line   # pathname in the prefix
+    # idempotent: second call must not duplicate handlers
+    again = mx.log.get_logger("mxt_test_logger")
+    assert again is logger and len(logger.handlers) == 1
+    with pytest.warns(DeprecationWarning):
+        mx.log.getLogger("mxt_test_logger")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class _Base:
+    def __init__(self, v=0):
+        self.v = v
+
+
+def test_registry_register_alias_create():
+    reg = mx.registry.get_register_func(_Base, "thing")
+    alias = mx.registry.get_alias_func(_Base, "thing")
+    create = mx.registry.get_create_func(_Base, "thing")
+
+    @alias("myimpl", "impl2")
+    class Impl(_Base):
+        pass
+
+    assert mx.registry.get_registry(_Base)["myimpl"] is Impl
+    assert isinstance(create("MyImpl"), Impl)          # case-insensitive
+    assert isinstance(create("impl2", 5), Impl)
+    inst = Impl(3)
+    assert create(inst) is inst                         # instance passthru
+    assert create('["myimpl", {"v": 7}]').v == 7        # JSON list form
+    assert create('{"thing": "myimpl", "v": 9}').v == 9  # JSON dict form
+    with pytest.raises(KeyError):
+        create("unregistered")
+    with pytest.raises(TypeError):
+        reg(int)  # not a subclass
+    with pytest.warns(UserWarning):
+        reg(Impl, "myimpl")  # override warns
