@@ -33,6 +33,11 @@ from ..ndarray import utils as nd_utils
 from ..ndarray.ndarray import NDArray
 from ..ndarray.random import next_key, push_trace_key, pop_trace_key
 from ..ops.registry import invoke_raw
+
+
+def _wrap_nd(x):
+    """jax array (or NDArray) -> NDArray view for op-hook callbacks."""
+    return x if isinstance(x, NDArray) else NDArray(x)
 from .parameter import Parameter, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
@@ -121,6 +126,8 @@ class Block:
         self._reg_params: Dict[str, Parameter] = {}
         self._forward_hooks: List[Callable] = []
         self._forward_pre_hooks: List[Callable] = []
+        self._op_hooks: List[Callable] = []  # register_op_hook wrappers
+        self._op_hook_active = False
         self._prefix = prefix or ""
         self._name = type(self).__name__.lower()
 
@@ -233,6 +240,111 @@ class Block:
             if extra:
                 raise MXNetError(f"{filename} contains extra parameters {extra}")
 
+    def load_dict(self, param_dict, ctx=None, allow_missing=False,
+                  ignore_extra=False, cast_dtype=False,
+                  dtype_source="current"):
+        """Load parameter values from a dict of name -> NDArray
+        (reference block.py:430; 'arg:'/'aux:' key prefixes from 1.x
+        save_checkpoint files are stripped). With ``cast_dtype``,
+        ``dtype_source='current'`` casts incoming arrays to each
+        parameter's dtype and ``'saved'`` re-types the parameter to the
+        checkpoint's dtype."""
+        if dtype_source not in ("current", "saved"):
+            raise MXNetError("dtype_source must be 'current' or 'saved', "
+                             f"got {dtype_source!r}")
+        loaded = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                  for k, v in param_dict.items()}
+        params = self.collect_params()
+        for k, v in params.items():
+            if k in loaded:
+                arr = loaded[k]
+                if cast_dtype and dtype_source == "saved" and \
+                        v._data is not None:
+                    v.cast(arr._data.dtype)
+                v.set_data(arr)
+            elif not allow_missing:
+                raise MXNetError(
+                    f"Parameter '{k}' is missing in param_dict. Set "
+                    "allow_missing=True to ignore missing parameters.")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(
+                    f"param_dict contains extra parameters {extra}; set "
+                    "ignore_extra=True to ignore them.")
+
+    def setattr(self, name, value):
+        """Set an attribute on ALL Parameters, e.g.
+        ``model.setattr('grad_req', 'null')`` (reference block.py:630)."""
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    def share_parameters(self, shared):
+        """Tie this block's Parameters to those in ``shared`` (a dict
+        from another block's ``collect_params()``) by structured name:
+        the Parameter OBJECTS are shared, so later loads into either
+        block reflect in both (reference block.py:653)."""
+        if shared is None:
+            return self
+        if not isinstance(shared, dict):
+            raise ValueError("'shared' should be a dict of Parameters, "
+                             f"got {type(shared)}")
+
+        def walk(block, prefix):
+            for name in list(block._reg_params):
+                full = prefix + name
+                if full in shared:
+                    block._reg_params[name] = shared[full]
+                    setattr(block, name, shared[full])
+            for cname, child in block._children.items():
+                walk(child, f"{prefix}{cname}.")
+        walk(self, "")
+        return self
+
+    def register_op_hook(self, callback, monitor_all=False):
+        """Install a monitor over every operator executed inside this
+        block's forward: ``callback(tensor_name, op_name, NDArray)`` for
+        each output (and each input when ``monitor_all``) — reference
+        block.py:730, built here on the invoke-funnel wrapper stack the
+        profiler/AMP/inspector use."""
+        from ..ops import registry as _op_registry
+        owner = self
+
+        def wrapper(name, fn):
+            def monitored(*args, **kwargs):
+                if not getattr(owner, "_op_hook_active", False):
+                    return fn(*args, **kwargs)
+                if monitor_all:
+                    for i, a in enumerate(args):
+                        if hasattr(a, "shape"):
+                            callback(f"{name}_input{i}", name,
+                                     _wrap_nd(a))
+                out = fn(*args, **kwargs)
+                outs = out if isinstance(out, tuple) else (out,)
+                for i, o in enumerate(outs):
+                    if hasattr(o, "shape"):
+                        callback(f"{name}_output{i}" if len(outs) > 1
+                                 else f"{name}_output", name, _wrap_nd(o))
+                return out
+            return monitored
+
+        self._op_hooks.append(wrapper)
+        _op_registry.add_invoke_wrapper(wrapper)
+
+        class _OpHookHandle:
+            def detach(handle):
+                _op_registry.remove_invoke_wrapper(wrapper)
+                if wrapper in owner._op_hooks:
+                    owner._op_hooks.remove(wrapper)
+
+            def __enter__(handle):
+                return handle
+
+            def __exit__(handle, *exc):
+                handle.detach()
+
+        return _OpHookHandle()
+
     # ---------------- execution ----------------
     def forward(self, *args, **kwargs):
         raise NotImplementedError
@@ -240,7 +352,14 @@ class Block:
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self.forward(*args, **kwargs)
+        if self.__dict__.get("_op_hooks"):
+            self._op_hook_active = True
+            try:
+                out = self.forward(*args, **kwargs)
+            finally:
+                self._op_hook_active = False
+        else:
+            out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
@@ -670,7 +789,46 @@ class HybridBlock(Block):
     def infer_shape(self, *args):
         self._ensure_shapes(args)
 
+    def infer_type(self, *args):
+        """Infer Parameter dtypes from the inputs (reference
+        block.py:1292): floating-point params follow the widest
+        floating input dtype; integer params are untouched."""
+        import jax.numpy as jnp
+        in_dtypes = [a._data.dtype for a in args
+                     if isinstance(a, NDArray) and
+                     jnp.issubdtype(a._data.dtype, jnp.floating)]
+        if not in_dtypes:
+            return
+        target = in_dtypes[0]
+        for d in in_dtypes[1:]:
+            target = jnp.promote_types(target, d)
+        for p in self.collect_params().values():
+            if p._data is None:
+                p.dtype = target  # dtype for the deferred allocation
+            elif jnp.issubdtype(p._data._data.dtype, jnp.floating):
+                p.cast(target)
+            # initialized non-floating params keep their dtype
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """1.x-style override point (reference block.py:1448): when a
+        subclass defines it, the default ``forward`` calls it with
+        ``F = mx.nd`` and the block's materialized Parameters as
+        keyword arguments."""
+        raise NotImplementedError
+
     def forward(self, *args, **kwargs):
+        if type(self).hybrid_forward is not HybridBlock.hybrid_forward:
+            from .. import ndarray as F
+            pdata = {}
+            for name, p in self._reg_params.items():
+                if p._data is None:
+                    raise MXNetError(
+                        f"hybrid_forward compat path: parameter {name} "
+                        "is uninitialized; construct the layer with "
+                        "known input sizes (deferred shape inference "
+                        "needs a 2.0-style forward)")
+                pdata[name] = p.data()
+            return self.hybrid_forward(F, *args, **kwargs, **pdata)
         raise NotImplementedError
 
 

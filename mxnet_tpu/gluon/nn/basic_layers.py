@@ -23,7 +23,7 @@ from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-           "BatchNorm", "SyncBatchNorm", "LayerNorm", "GroupNorm",
+           "BatchNorm", "BatchNormReLU", "SyncBatchNorm", "LayerNorm", "GroupNorm",
            "InstanceNorm", "Flatten", "Activation", "LeakyReLU", "PReLU",
            "ELU", "SELU", "GELU", "Swish", "SiLU", "Lambda", "HybridLambda",
            "Identity", "Concatenate", "HybridConcatenate"]
@@ -234,6 +234,17 @@ class SyncBatchNorm(BatchNorm):
     def __init__(self, in_channels=0, num_devices=None, **kwargs):
         super().__init__(in_channels=in_channels, **kwargs)
         self._num_devices = num_devices
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm with a fused trailing ReLU (reference gluon/nn
+    basic_layers.py BatchNormReLU, backed by the _npx_batch_norm+relu
+    kernel there). Here the relu composes onto the BN output and XLA
+    fuses the pair into one kernel."""
+
+    def forward(self, x):
+        from ... import ndarray as F
+        return F.relu(super().forward(x))
 
 
 class LayerNorm(HybridBlock):

@@ -1,6 +1,7 @@
 """Gluon recurrent API (reference: python/mxnet/gluon/rnn/)."""
-from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell,
                        SequentialRNNCell, HybridSequentialRNNCell,
-                       DropoutCell, ZoneoutCell,
+                       DropoutCell, ModifierCell, ZoneoutCell,
                        ResidualCell, BidirectionalCell)
 from .rnn_layer import RNN, LSTM, GRU

@@ -17,9 +17,10 @@ from ...ndarray.ndarray import NDArray
 from ..block import HybridBlock
 from ..parameter import Parameter
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
-           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
 
 
 def _cells_state_info(cells, batch_size):
@@ -89,6 +90,11 @@ class RecurrentCell(HybridBlock):
         if merge_outputs:
             return F.stack(*outputs, axis=t_axis), states
         return outputs, states
+
+
+# Reference splits RecurrentCell/HybridRecurrentCell by hybridizability;
+# every cell here is traceable, so they are one class (rnn_cell.py:330).
+HybridRecurrentCell = RecurrentCell
 
 
 class _BaseRNNCell(RecurrentCell):
@@ -246,22 +252,34 @@ class DropoutCell(RecurrentCell):
         return inputs, states
 
 
-class ZoneoutCell(RecurrentCell):
-    """Zoneout regularization wrapper (reference ZoneoutCell)."""
+class ModifierCell(RecurrentCell):
+    """Base for cells that wrap another cell to modify its computation
+    (reference rnn_cell.py ModifierCell): parameters belong to the base
+    cell; state handling delegates to it."""
 
-    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
-                 **kwargs):
+    def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
         self.base_cell = base_cell
-        self._zoneout_outputs = zoneout_outputs
-        self._zoneout_states = zoneout_states
-        self._prev_output = None
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
     def begin_state(self, batch_size=0, **kwargs):
         return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.base_cell!r})"
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization wrapper (reference ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
 
     def reset(self):
         super().reset()
@@ -286,18 +304,8 @@ class ZoneoutCell(RecurrentCell):
         return out, next_states
 
 
-class ResidualCell(RecurrentCell):
+class ResidualCell(ModifierCell):
     """Add the input to the base cell's output (reference ResidualCell)."""
-
-    def __init__(self, base_cell, **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
-
-    def begin_state(self, batch_size=0, **kwargs):
-        return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
 
     def forward(self, inputs, states):
         out, next_states = self.base_cell(inputs, states)

@@ -18,7 +18,7 @@ from .base import MXNetError, jx_dtype
 from .ndarray import random as nd_random
 from .ndarray.ndarray import NDArray
 
-__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+__all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
            "Mixed", "Load", "registry", "create"]
 
@@ -30,6 +30,19 @@ def _register(name):
         registry[name.lower()] = cls
         return cls
     return deco
+
+
+class InitDesc(str):
+    """Descriptor for an initialization pattern (reference
+    initializer.py:36): a str (the variable name) carrying the
+    variable's attrs (from ``Symbol.attr_dict``) and a fallback
+    ``global_init``."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
 
 
 class Initializer:

@@ -619,6 +619,37 @@ class AdaGrad(Optimizer):
 
 
 @register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with one shared learning-rate history per ROW of the
+    parameter (reference optimizer/contrib.py:26): history accumulates
+    mean(grad^2) over the non-leading axes. Weight decay is not
+    supported, matching the reference."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if self.wd != 0.0:
+            raise MXNetError("GroupAdaGrad does not support weight decay")
+        self.epsilon = epsilon
+        self.lazy_update = True  # row-wise rule: safe on sparse rows
+
+    def create_state(self, index, weight):
+        d = weight._data
+        return (NDArray(jnp.zeros((d.shape[0],) + (1,) * (d.ndim - 1),
+                                  d.dtype)),)
+
+    def _rule(self):
+        eps = self.epsilon
+
+        def rule(w, g, lr, wd, t, states):
+            (h,) = states
+            axes = tuple(range(1, g.ndim))
+            h = h + (jnp.mean(g * g, axis=axes, keepdims=True)
+                     if axes else g * g)
+            return w - lr * g / (jnp.sqrt(h) + eps), (h,)
+        return rule
+
+
+@register
 class AdaDelta(Optimizer):
     def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

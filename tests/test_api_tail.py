@@ -1,0 +1,174 @@
+"""API-surface tail: BatchNormReLU, ModifierCell hierarchy,
+GroupAdaGrad, InitDesc.
+
+Reference analogs: gluon/nn/basic_layers.py BatchNormReLU,
+gluon/rnn/rnn_cell.py ModifierCell/HybridRecurrentCell,
+optimizer/contrib.py GroupAdaGrad, initializer.py InitDesc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn, rnn
+
+
+def test_batchnorm_relu_equals_bn_then_relu():
+    onp.random.seed(0)
+    x = nd.array(onp.random.randn(4, 8, 5, 5).astype("float32"))
+    a = nn.BatchNormReLU(in_channels=8)
+    b = nn.BatchNorm(in_channels=8)
+    a.initialize()
+    b.initialize()
+    got = a(x).asnumpy()
+    want = nd.relu(b(x)).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert (got >= 0).all()
+
+
+def test_modifier_cell_hierarchy_and_delegation():
+    base = rnn.LSTMCell(8, input_size=4)
+    z = rnn.ZoneoutCell(base, zoneout_outputs=0.1)
+    r = rnn.ResidualCell(rnn.RNNCell(4, input_size=4))
+    assert isinstance(z, rnn.ModifierCell)
+    assert isinstance(r, rnn.ModifierCell)
+    assert rnn.HybridRecurrentCell is rnn.RecurrentCell
+    assert z.state_info(2) == base.state_info(2)
+    base.initialize()
+    states = z.begin_state(batch_size=2)
+    assert len(states) == len(base.state_info())
+    assert "ZoneoutCell" in repr(z) and "LSTMCell" in repr(z)
+
+
+def test_residual_cell_runs():
+    c = rnn.ResidualCell(rnn.RNNCell(4, input_size=4))
+    c.base_cell.initialize()
+    x = nd.array(onp.random.randn(2, 4).astype("float32"))
+    out, states = c(x, c.begin_state(batch_size=2))
+    assert out.shape == (2, 4)
+
+
+def test_group_adagrad_row_wise_history():
+    opt = mx.optimizer.create("groupadagrad", learning_rate=0.1)
+    w = nd.array(onp.ones((3, 4), "float32"))
+    g = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    state = opt.create_state(0, w)
+    assert state[0].shape == (3, 1)  # one history entry per row
+    opt.update(0, w, g, state)
+    wn = w.asnumpy()
+    # every element in a row moved with the SAME effective lr
+    per_row_scale = (1.0 - wn) / (g.asnumpy() + 1e-30)
+    for r in range(3):
+        row = per_row_scale[r][g.asnumpy()[r] != 0]
+        assert onp.allclose(row, row[0], rtol=1e-5)
+    with pytest.raises(mx.MXNetError):
+        mx.optimizer.create("groupadagrad", wd=0.1)
+
+
+def test_block_setattr_and_load_dict():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.setattr("grad_req", "null")
+    assert all(p.grad_req == "null"
+               for p in net.collect_params().values())
+    w = nd.array(onp.ones((4, 3), "float32"))
+    b = nd.array(onp.full((4,), 2.0, "float32"))
+    net.load_dict({"arg:weight": w, "aux:bias": b})  # 1.x prefixes strip
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), 1.0)
+    onp.testing.assert_allclose(net.bias.data().asnumpy(), 2.0)
+    with pytest.raises(mx.MXNetError, match="missing"):
+        net.load_dict({"weight": w})
+    with pytest.raises(mx.MXNetError, match="extra"):
+        net.load_dict({"weight": w, "bias": b, "nope": w})
+    net.load_dict({"weight": w}, allow_missing=True)
+    net.load_dict({"weight": w, "bias": b, "nope": w}, ignore_extra=True,
+                  allow_missing=True)
+
+
+def test_share_parameters_ties_objects():
+    d0 = nn.Dense(8, in_units=4)
+    d1 = nn.Dense(8, in_units=4)
+    d0.initialize()
+    d1.initialize()
+    d1.share_parameters(d0.collect_params())
+    assert d1.weight is d0.weight and d1.bias is d0.bias
+    # a later load into d0 must reflect in d1 (object sharing, not copy)
+    d0.load_dict({"weight": nd.array(onp.full((8, 4), 3.0, "float32")),
+                  "bias": nd.array(onp.zeros((8,), "float32"))})
+    onp.testing.assert_allclose(d1.weight.data().asnumpy(), 3.0)
+    x = nd.array(onp.ones((2, 4), "float32"))
+    onp.testing.assert_allclose(d0(x).asnumpy(), d1(x).asnumpy())
+    with pytest.raises(ValueError):
+        d1.share_parameters([1, 2])
+
+
+def test_register_op_hook_monitors_ops():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Activation("relu"))
+    net.initialize()
+    seen = []
+    handle = net.register_op_hook(lambda tname, opname, arr:
+                                  seen.append((tname, opname, arr.shape)))
+    x = nd.array(onp.ones((2, 3), "float32"))
+    net(x)
+    ops = [o for _, o, _ in seen]
+    assert any("fully_connected" in o for o in ops), ops
+    assert any("relu" in o or "activation" in o for o in ops), ops
+    n = len(seen)
+    nd.relu(x)  # ops OUTSIDE the block's forward are not monitored
+    assert len(seen) == n
+    handle.detach()
+    net(x)
+    assert len(seen) == n and not net._op_hooks  # detached cleanly
+
+
+def test_load_dict_cast_dtype_saved():
+    import jax.numpy as jnp
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    wbf = nd.array(onp.ones((4, 3), "float32")).astype("bfloat16")
+    bbf = nd.array(onp.zeros((4,), "float32")).astype("bfloat16")
+    net.load_dict({"weight": wbf, "bias": bbf}, cast_dtype=True,
+                  dtype_source="saved")
+    assert net.weight.data().dtype == jnp.bfloat16  # re-typed to saved
+    net.load_dict({"weight": wbf, "bias": bbf})  # default: keep current
+    assert net.weight.data().dtype == jnp.bfloat16
+    with pytest.raises(mx.MXNetError, match="dtype_source"):
+        net.load_dict({"weight": wbf, "bias": bbf}, dtype_source="bogus")
+
+
+def test_infer_type_casts_float_params():
+    import jax.numpy as jnp
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.infer_type(nd.array(onp.ones((2, 3), "float32"))
+                   .astype("bfloat16"))
+    assert net.weight.data().dtype == jnp.bfloat16
+
+
+def test_hybrid_forward_compat_subclass():
+    from mxnet_tpu.gluon import HybridBlock, Parameter
+
+    class OldStyle(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.weight = Parameter("weight", shape=(4, 3))
+
+        def hybrid_forward(self, F, x, weight):
+            return F.FullyConnected(x, weight, num_hidden=4,
+                                    no_bias=True)
+
+    net = OldStyle()
+    net.initialize()
+    x = nd.array(onp.ones((2, 3), "float32"))
+    out = net(x)
+    assert out.shape == (2, 4)
+    want = nd.dot(x, net.weight.data(), transpose_b=True).asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_init_desc_carries_attrs():
+    from mxnet_tpu.initializer import InitDesc
+    d = InitDesc("fc1_weight", attrs={"lr_mult": "0.1"})
+    assert d == "fc1_weight" and isinstance(d, str)
+    assert d.attrs["lr_mult"] == "0.1" and d.global_init is None
