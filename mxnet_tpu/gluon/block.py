@@ -306,13 +306,29 @@ class Block:
         block's forward: ``callback(tensor_name, op_name, NDArray)`` for
         each output (and each input when ``monitor_all``) — reference
         block.py:730, built here on the invoke-funnel wrapper stack the
-        profiler/AMP/inspector use."""
+        profiler/AMP/inspector use.
+
+        Values are always CONCRETE: outside ``autograd.record()`` they
+        come from the invoke wrapper; under recording the kernel runs
+        inside a vjp trace (tracer values), so delivery moves to the
+        tape's post-vjp output check, which sees the evaluated outputs
+        (inputs are then not individually reported). Inside a
+        hybridized/jitted cache there is no imperative dispatch to
+        observe — hooks monitor eager execution, like the reference's
+        executor monitor."""
         from ..ops import registry as _op_registry
         owner = self
 
+        def deliver_outs(name, outs):
+            for i, o in enumerate(outs):
+                if hasattr(o, "shape"):
+                    callback(f"{name}_output{i}" if len(outs) > 1
+                             else f"{name}_output", name, _wrap_nd(o))
+
         def wrapper(name, fn):
             def monitored(*args, **kwargs):
-                if not getattr(owner, "_op_hook_active", False):
+                if not getattr(owner, "_op_hook_active", False) or \
+                        _in_trace(args):
                     return fn(*args, **kwargs)
                 if monitor_all:
                     for i, a in enumerate(args):
@@ -320,22 +336,20 @@ class Block:
                             callback(f"{name}_input{i}", name,
                                      _wrap_nd(a))
                 out = fn(*args, **kwargs)
-                outs = out if isinstance(out, tuple) else (out,)
-                for i, o in enumerate(outs):
-                    if hasattr(o, "shape"):
-                        callback(f"{name}_output{i}" if len(outs) > 1
-                                 else f"{name}_output", name, _wrap_nd(o))
+                deliver_outs(name,
+                             out if isinstance(out, tuple) else (out,))
                 return out
             return monitored
 
-        self._op_hooks.append(wrapper)
+        hook = {"wrapper": wrapper, "deliver": deliver_outs}
+        self._op_hooks.append(hook)
         _op_registry.add_invoke_wrapper(wrapper)
 
         class _OpHookHandle:
             def detach(handle):
                 _op_registry.remove_invoke_wrapper(wrapper)
-                if wrapper in owner._op_hooks:
-                    owner._op_hooks.remove(wrapper)
+                if hook in owner._op_hooks:
+                    owner._op_hooks.remove(hook)
 
             def __enter__(handle):
                 return handle
@@ -353,11 +367,23 @@ class Block:
         for hook in self._forward_pre_hooks:
             hook(self, args)
         if self.__dict__.get("_op_hooks"):
+            # under autograd.record the kernel runs inside a vjp trace,
+            # so concrete outputs are only visible at the tape's
+            # post-vjp check — chain delivery there for the duration
             self._op_hook_active = True
+
+            def tape_check(name, outs, _hooks=self._op_hooks):
+                for h in _hooks:
+                    h["deliver"](name, outs)
+                if old_check is not None:
+                    old_check(name, outs)
+
+            old_check = _tape.set_output_check(tape_check)
             try:
                 out = self.forward(*args, **kwargs)
             finally:
                 self._op_hook_active = False
+                _tape.set_output_check(old_check)
         else:
             out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:

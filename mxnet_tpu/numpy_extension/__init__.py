@@ -145,3 +145,20 @@ def index_add(data, indices, values):
     idx = indices._data if isinstance(indices, NDArray) else indices
     return _invoke("npx_index_add",
                    lambda x: x.at[idx].add(v), [_arr(data)])
+
+
+# npx.image: image-op namespace (reference numpy_extension/__init__.py:23
+# re-exports mxnet.ndarray.image) — the framework's image ops already
+# propagate the mx.np array class through the invoke funnel.
+from .. import image as image  # noqa: E402,F401
+
+
+def get_cuda_compute_capability(ctx=None):
+    """CUDA introspection has no TPU analog (reference
+    numpy_extension re-export of util.get_cuda_compute_capability);
+    raises with the TPU-native alternative."""
+    from ..base import MXNetError
+    raise MXNetError(
+        "get_cuda_compute_capability is CUDA-specific; on this "
+        "framework query mx.runtime.Features() / jax.devices()[0]"
+        ".device_kind instead")

@@ -122,6 +122,29 @@ def test_register_op_hook_monitors_ops():
     assert len(seen) == n and not net._op_hooks  # detached cleanly
 
 
+def test_register_op_hook_concrete_under_record():
+    """Callbacks must receive CONCRETE values even inside
+    autograd.record() (review finding round 4: the kernel runs in a vjp
+    trace there, so delivery rides the tape's post-vjp output check)."""
+    from mxnet_tpu import autograd
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    sums = []
+    handle = net.register_op_hook(
+        lambda tname, opname, arr: sums.append(
+            float(arr.asnumpy().sum())))
+    try:
+        x = nd.array(onp.ones((2, 3), "float32"))
+        with autograd.record():
+            loss = net(x).sum()
+        assert sums and all(onp.isfinite(s) for s in sums)
+        # gradient path is unaffected by monitoring
+        loss.backward()
+        assert onp.isfinite(net.weight.grad().asnumpy()).all()
+    finally:
+        handle.detach()
+
+
 def test_load_dict_cast_dtype_saved():
     import jax.numpy as jnp
     net = nn.Dense(4, in_units=3)

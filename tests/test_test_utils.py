@@ -1,0 +1,197 @@
+"""test_utils reference-tail helpers.
+
+Reference analog: the helpers of python/mxnet/test_utils.py that the
+reference's own unit tests consume (tolerances, random builders,
+assertion variants, statistical generator checks, optimizer
+comparison, data fixtures).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, test_utils as tu
+
+
+def test_tolerance_helpers():
+    assert tu.get_rtol(None, onp.float32) == tu.default_rtols()[
+        onp.dtype(onp.float32)]
+    assert tu.get_rtol(0.5) == 0.5
+    assert tu.get_atol(None, onp.float16) == 1e-1
+    x16 = onp.ones(3, onp.float16)
+    x64 = onp.ones(3, onp.float64)
+    rtol, atol = tu.get_tols(x16, x64, None, None)
+    assert rtol == 1e-2 and atol == 1e-1  # the looser of the two
+    assert tu.get_etol(None) == 0 and tu.get_etol(0.1) == 0.1
+
+
+def test_random_builders():
+    a = tu.random_arrays((3, 4))
+    assert a.shape == (3, 4) and a.dtype == onp.float32
+    l = tu.random_arrays((2,), (3,))
+    assert len(l) == 2
+    s = tu.random_sample(list(range(10)), 4)
+    assert len(s) == 4 and len(set(s)) == 4
+    assert tu.create_2d_tensor(3, 4).shape == (3, 4)
+    assert tu.create_vector(5).tolist() == [0, 1, 2, 3, 4]
+    x, y = tu.rand_coord_2d(0, 5, 10, 15)
+    assert 0 <= x < 5 and 10 <= y < 15
+
+
+def test_sparse_builders():
+    arr, (data, indices) = tu.rand_sparse_ndarray((8, 4), "row_sparse",
+                                                  density=0.5)
+    assert arr.shape == (8, 4)
+    arr2 = tu.create_sparse_array((6, 3), "row_sparse",
+                                  rsp_indices=[1, 4], data_init=2.0)
+    d = arr2.asnumpy()
+    assert (d[1] == 2.0).all() and (d[0] == 0).all()
+    z = tu.create_sparse_array_zd((4, 2), "row_sparse", density=0)
+    assert (z.asnumpy() == 0).all()
+
+
+def test_assertion_variants():
+    a = onp.array([1.0, 2.0, 3.0, 4.0])
+    b = a.copy()
+    b[0] = 99.0
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal_with_err(a, b, etol=0.1)
+    tu.assert_almost_equal_with_err(a, b, etol=0.3)  # 25% mismatch ok
+    an = a.copy()
+    bn = a.copy()
+    an[1] = onp.nan
+    bn[1] = onp.nan
+    tu.assert_almost_equal_ignore_nan(an, bn)
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    with pytest.raises(AssertionError):
+        tu.assert_exception(lambda: None, ValueError)
+    tu.assert_allclose(nd.array(a), a)
+
+
+def test_np_reduce_and_collapse():
+    d = onp.arange(24.0).reshape(2, 3, 4)
+    r = tu.np_reduce(d, axis=(0, 2), keepdims=True,
+                     numpy_reduce_func=onp.sum)
+    onp.testing.assert_allclose(r, d.sum(axis=(0, 2), keepdims=True))
+    c = tu.collapse_sum_like(onp.ones((2, 3, 4)), (3, 1))
+    assert c.shape == (3, 1)
+    onp.testing.assert_allclose(c, 8.0)
+
+
+def test_statistical_checks():
+    onp.random.seed(0)
+    gen = lambda n: onp.random.normal(0, 1.0, size=n)
+    assert tu.mean_check(gen, 0, 1.0, nsamples=200000)
+    assert tu.var_check(gen, 1.0, nsamples=200000)
+    import scipy.stats as ss
+    buckets, probs = tu.gen_buckets_probs_with_ppf(
+        lambda x: ss.norm.ppf(x, 0, 1), 5)
+    assert len(buckets) == 5 and abs(sum(probs) - 1.0) < 1e-9
+    tu.verify_generator(gen, buckets, probs, nsamples=50000, nrepeat=3)
+    bad = lambda n: onp.random.normal(3.0, 1.0, size=n)  # wrong mean
+    with pytest.raises(AssertionError):
+        tu.verify_generator(bad, buckets, probs, nsamples=50000,
+                            nrepeat=3)
+    # discrete buckets
+    dgen = lambda n: onp.random.randint(0, 4, size=n)
+    p, obs, exp = tu.chi_square_check(dgen, [0, 1, 2, 3], [0.25] * 4,
+                                      nsamples=50000)
+    assert p > 0.01
+
+
+def test_compare_optimizer():
+    onp.random.seed(0)
+    o1 = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    o2 = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    tu.compare_optimizer(o1, o2, [(4, 3), (5,)], "float32")
+    o3 = mx.optimizer.create("sgd", learning_rate=0.2)
+    with pytest.raises(AssertionError):
+        tu.compare_optimizer(o1, o3, [(4, 3)], "float32")
+
+
+def test_check_gluon_hybridize_consistency():
+    from mxnet_tpu.gluon import nn
+
+    def builder():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu", in_units=4), nn.Dense(2))
+        return net
+
+    tu.check_gluon_hybridize_consistency(
+        builder, [nd.array(onp.random.rand(3, 4).astype("float32"))])
+
+
+def test_matrix_generators():
+    q = tu.new_orthonormal_matrix_2d(4, 4)
+    onp.testing.assert_allclose(q @ q.T, onp.eye(4), atol=1e-8)
+    m = tu.new_matrix_with_real_eigvals_2d(5)
+    assert onp.abs(onp.linalg.eigvals(m).imag).max() < 1e-9
+    mn = tu.new_matrix_with_real_eigvals_nd((2, 3, 3))
+    assert mn.shape == (2, 3, 3)
+    s = tu.new_sym_matrix_with_real_eigvals_2d(4)
+    onp.testing.assert_allclose(s, s.T)
+
+
+def test_mnist_fixtures(tmp_path):
+    m = tu.get_mnist(path=str(tmp_path))  # no files -> synthetic
+    assert m["train_data"].shape[1:] == (1, 28, 28)
+    assert m["train_data"].dtype == onp.float32
+    assert set(onp.unique(m["train_label"])) <= set(range(10))
+    # ubyte writer round-trips through the real IDX reader
+    tu.get_mnist_ubyte(path=str(tmp_path))
+    m2 = tu.get_mnist(path=str(tmp_path))
+    assert m2["train_data"].shape == m["train_data"].shape
+    tr, val = tu.get_mnist_iterator(batch_size=32, input_shape=(784,),
+                                    path=str(tmp_path))
+    batch = next(iter(tr))
+    assert batch.data[0].shape == (32, 784)
+    # sharded parts are disjoint and cover the whole train set
+    sizes = []
+    for i in range(3):
+        tri, _ = tu.get_mnist_iterator(batch_size=1, input_shape=(784,),
+                                       num_parts=3, part_index=i)
+        sizes.append(sum(1 for _ in tri))
+    assert sum(sizes) == 600 and max(sizes) - min(sizes) <= 1
+    with pytest.raises(mx.MXNetError):
+        tu.get_mnist_iterator(1, (784,), num_parts=3, part_index=5)
+    with pytest.raises(mx.MXNetError, match="cifar"):
+        tu.get_cifar10(path=str(tmp_path))
+    assert tu.get_im2rec_path().endswith("im2rec.py")
+
+
+def test_misc_helpers():
+    assert tu.list_gpus() == []
+    assert tu.has_tvm_ops() is False and tu.is_op_runnable() is True
+    a = nd.array(onp.ones(3, "float32"))
+    assert tu.same_array(a, a)
+    assert not tu.same_array(a, nd.array(onp.ones(3, "float32")))
+    out = tu.assign_each(onp.array([1.0, -2.0]), lambda x: x * 2)
+    onp.testing.assert_allclose(out, [2.0, -4.0])
+    out2 = tu.assign_each2(onp.array([1.0]), onp.array([3.0]),
+                           lambda x, y: x + y)
+    onp.testing.assert_allclose(out2, [4.0])
+    import sys
+    with tu.discard_stderr():
+        print("hidden", file=sys.stderr)
+    sec = tu.check_speed(lambda: nd.array(onp.ones(4)), n=3, warmup=1)
+    assert sec > 0
+    assert tu.check_speed(lambda: 1, n=2, warmup=0) >= 0  # warmup=0 ok
+    it = tu.DummyIter(tu.get_mnist_iterator(8, (784,))[0])
+    it.reset()  # epoch-loop compatible no-op
+    assert next(it) is next(it)
+
+
+def test_symbolic_helpers():
+    import mxnet_tpu.symbol as sym
+    x1 = sym.Variable("a")
+    y1 = sym.relu(sym.exp(x1))
+    x2 = sym.Variable("b")
+    y2 = sym.relu(sym.exp(x2))
+    y3 = sym.exp(sym.relu(x2))
+    assert tu.same_symbol_structure(y1, y2)
+    assert not tu.same_symbol_structure(y1, y3)
+
+    tu.check_symbolic_backward(
+        lambda a: (a * a).sum(),
+        [onp.array([1.0, 2.0], "float32")],
+        [onp.array(1.0, "float32")],
+        [onp.array([2.0, 4.0], "float32")])
