@@ -153,12 +153,7 @@ def index_add(data, indices, values):
 from .. import image as image  # noqa: E402,F401
 
 
-def get_cuda_compute_capability(ctx=None):
-    """CUDA introspection has no TPU analog (reference
-    numpy_extension re-export of util.get_cuda_compute_capability);
-    raises with the TPU-native alternative."""
-    from ..base import MXNetError
-    raise MXNetError(
-        "get_cuda_compute_capability is CUDA-specific; on this "
-        "framework query mx.runtime.Features() / jax.devices()[0]"
-        ".device_kind instead")
+# reference npx re-exports util.get_cuda_compute_capability; keep ONE
+# behavior for the symbol everywhere (the util compat shim: None on
+# non-CUDA builds, so defensive `if cap and cap >= 70:` probes skip)
+from ..util import get_cuda_compute_capability  # noqa: E402,F401

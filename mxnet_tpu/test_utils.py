@@ -367,14 +367,19 @@ def random_sample(population, k):
 
 def shuffle_csr_column_indices(csr):
     """Shuffle column indices per row (makes them unsorted) for CSR
-    robustness tests (reference :199)."""
-    row_count = len(csr.indptr) - 1
-    for i in range(row_count):
-        start = csr.indptr[i]
-        end = csr.indptr[i + 1]
-        sublist = onp.array(csr.indices[start:end])
-        onp.random.shuffle(sublist)
-        csr.indices[start:end] = sublist
+    robustness tests (reference :199). Accepts this framework's
+    CSRNDArray or any object with numpy-able indptr/indices."""
+    indptr = onp.asarray(_as_numpy(csr.indptr), dtype=onp.int64)
+    indices = onp.array(_as_numpy(csr.indices))
+    for i in range(len(indptr) - 1):
+        sub = indices[indptr[i]:indptr[i + 1]]
+        onp.random.shuffle(sub)
+        indices[indptr[i]:indptr[i + 1]] = sub
+    if isinstance(csr.indices, NDArray):
+        csr._aux["indices"] = array(indices, dtype=indices.dtype)
+    else:
+        csr.indices[:] = indices
+    return csr
 
 
 def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
@@ -1037,7 +1042,7 @@ def get_mnist_iterator(batch_size, input_shape, num_parts=1, part_index=0,
     """(train_iter, val_iter) of NDArrayIter over get_mnist()
     (reference :1680 uses MNISTIter over the ubyte files)."""
     from .io import NDArrayIter
-    m = get_mnist()
+    m = get_mnist(path=path)
     flat = len(input_shape) == 1
 
     def shape_of(x):

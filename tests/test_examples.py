@@ -108,3 +108,7 @@ def test_example_pipeline_trainer():
 @pytest.mark.slow
 def test_example_convlstm():
     _run("convlstm_video.py", ("x", "--steps", "200"))
+
+
+def test_example_wikitext_lm_pretrained_embedding():
+    _run("wikitext_lm_pretrained_embedding.py", argv=("x", "--steps", "25"))

@@ -145,20 +145,37 @@ def test_mnist_fixtures(tmp_path):
     batch = next(iter(tr))
     assert batch.data[0].shape == (32, 784)
     # sharded parts are disjoint and cover the whole train set
+    n_total = len(tu.get_mnist(path=str(tmp_path))["train_label"])
     sizes = []
     for i in range(3):
         tri, _ = tu.get_mnist_iterator(batch_size=1, input_shape=(784,),
-                                       num_parts=3, part_index=i)
+                                       num_parts=3, part_index=i,
+                                       path=str(tmp_path))
         sizes.append(sum(1 for _ in tri))
-    assert sum(sizes) == 600 and max(sizes) - min(sizes) <= 1
+    assert sum(sizes) == n_total and max(sizes) - min(sizes) <= 1
     with pytest.raises(mx.MXNetError):
-        tu.get_mnist_iterator(1, (784,), num_parts=3, part_index=5)
+        tu.get_mnist_iterator(1, (784,), num_parts=3, part_index=5,
+                              path=str(tmp_path))
     with pytest.raises(mx.MXNetError, match="cifar"):
         tu.get_cifar10(path=str(tmp_path))
     assert tu.get_im2rec_path().endswith("im2rec.py")
 
 
-def test_misc_helpers():
+def test_shuffle_csr_column_indices():
+    arr, _ = tu.rand_sparse_ndarray((6, 4), "csr", density=0.7)
+    indptr = arr.indptr.asnumpy()
+    before = arr.indices.asnumpy().copy()
+    out = tu.shuffle_csr_column_indices(arr)
+    after = out.indices.asnumpy()
+    assert after.shape == before.shape
+    # per-row membership preserved even though order may change
+    for i in range(len(indptr) - 1):
+        onp.testing.assert_array_equal(
+            onp.sort(after[indptr[i]:indptr[i + 1]]),
+            onp.sort(before[indptr[i]:indptr[i + 1]]))
+
+
+def test_misc_helpers(tmp_path):
     assert tu.list_gpus() == []
     assert tu.has_tvm_ops() is False and tu.is_op_runnable() is True
     a = nd.array(onp.ones(3, "float32"))
@@ -175,7 +192,8 @@ def test_misc_helpers():
     sec = tu.check_speed(lambda: nd.array(onp.ones(4)), n=3, warmup=1)
     assert sec > 0
     assert tu.check_speed(lambda: 1, n=2, warmup=0) >= 0  # warmup=0 ok
-    it = tu.DummyIter(tu.get_mnist_iterator(8, (784,))[0])
+    it = tu.DummyIter(tu.get_mnist_iterator(
+        8, (784,), path=str(tmp_path))[0])
     it.reset()  # epoch-loop compatible no-op
     assert next(it) is next(it)
 
