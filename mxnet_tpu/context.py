@@ -17,7 +17,7 @@ from .base import MXNetError
 
 __all__ = [
     "Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context",
-    "num_tpus", "num_gpus", "device",
+    "num_tpus", "num_gpus", "device", "gpu_memory_info",
 ]
 
 _DEVTYPE_CPU = 1
@@ -148,3 +148,12 @@ def num_tpus() -> int:
 
 def num_gpus() -> int:  # compat alias used by reference scripts
     return num_tpus()
+
+
+def gpu_memory_info(device_id=0):
+    """CUDA memory query (reference context.py:249) — no analog on TPU
+    builds; raises with the TPU-native alternative."""
+    from .base import MXNetError
+    raise MXNetError(
+        "gpu_memory_info is CUDA-specific; use "
+        "mx.profiler.memory_summary() for accelerator memory here")

@@ -29,7 +29,10 @@ from .base import MXNetError
 from .ops import registry as _registry
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
-           "resume", "scope", "Profiler", "dump_memory", "memory_summary"]
+           "resume", "scope", "Profiler", "dump_memory", "memory_summary",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker",
+           "profiler_set_config", "profiler_set_state", "dump_profile",
+           "set_kvstore_handle"]
 
 
 class Profiler:
@@ -240,3 +243,186 @@ def scope(name: str):
             yield
     finally:
         prof._scope = old
+
+
+# ---------------------------------------------------------------------------
+# instrumentation object API (reference profiler.py:228-520: Domain,
+# Task, Frame, Event, Counter, Marker over the MXProfile* C API). Here
+# each object writes straight into the profiler's Chrome-trace event
+# stream: durations as 'X' slices categorized by domain, counters as
+# 'C' samples, markers as 'i' instants — visible in chrome://tracing
+# next to the per-op events.
+# ---------------------------------------------------------------------------
+
+class Domain:
+    """Category grouping for instrumentation objects (reference :228)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _DurationObject:
+    """start()/stop() pair recording one Chrome-trace slice."""
+
+    _cat_suffix = ""
+
+    def __init__(self, domain, name):
+        self.name = name
+        self._domain = domain
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is None:
+            raise MXNetError(f"{type(self).__name__} {self.name!r}: "
+                             "stop() before start()")
+        Profiler.get().record(self.name, self._t0, time.perf_counter(),
+                              cat=str(self._domain) + self._cat_suffix)
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_DurationObject):
+    """Accumulated logical unit of work (reference :287)."""
+
+
+class Frame(_DurationObject):
+    """Per-pass discrete duration, e.g. one video frame
+    (reference :329)."""
+
+    _cat_suffix = ":frame"
+
+
+class Event(_DurationObject):
+    """Per-thread demarcated event without a domain (reference :371)."""
+
+    def __init__(self, name):
+        super().__init__(_EVENT_DOMAIN, name)
+
+
+_EVENT_DOMAIN = Domain("event")
+
+
+class Counter:
+    """Numeric counter sampled into the trace (reference :420):
+    set_value/increment/decrement emit Chrome 'C' events."""
+
+    def __init__(self, domain, name, value=None):
+        self.name = name
+        self._domain = domain
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def _emit(self):
+        prof = Profiler.get()
+        if not prof.running or prof.paused:
+            return
+        with prof._ev_lock:
+            prof._events.append({
+                "name": self.name, "cat": str(self._domain), "ph": "C",
+                "ts": time.perf_counter() * 1e6, "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+                "args": {"value": self._value},
+            })
+
+    def set_value(self, value):
+        self._value = value
+        self._emit()
+
+    def increment(self, delta=1):
+        self._value += delta
+        self._emit()
+
+    def decrement(self, delta=1):
+        self._value -= delta
+        self._emit()
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+    def __str__(self):
+        return self.name
+
+
+class Marker:
+    """Instant marker (reference :470): mark(scope) emits a Chrome 'i'
+    event with the given scope ('process'|'thread'|'global')."""
+
+    _SCOPES = {"process": "p", "thread": "t", "global": "g"}
+
+    def __init__(self, domain, name):
+        self.name = name
+        self._domain = domain
+
+    def mark(self, scope="process"):
+        prof = Profiler.get()
+        if not prof.running or prof.paused:
+            return
+        with prof._ev_lock:
+            prof._events.append({
+                "name": self.name, "cat": str(self._domain), "ph": "i",
+                "ts": time.perf_counter() * 1e6, "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+                "s": self._SCOPES.get(scope, "p"),
+            })
+
+
+# deprecated 1.x aliases (reference profiler.py keeps them with warnings)
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    import warnings
+    warnings.warn("profiler.profiler_set_config is deprecated; use "
+                  "profiler.set_config", DeprecationWarning, stacklevel=2)
+    set_config(filename=filename)
+
+
+def profiler_set_state(state="stop"):
+    import warnings
+    warnings.warn("profiler.profiler_set_state is deprecated; use "
+                  "profiler.set_state", DeprecationWarning, stacklevel=2)
+    set_state(state)
+
+
+def dump_profile():
+    import warnings
+    warnings.warn("profiler.dump_profile is deprecated; use "
+                  "profiler.dump", DeprecationWarning, stacklevel=2)
+    dump(True)
+
+
+def set_kvstore_handle(handle=None):
+    """No-op shim (reference wires the kvstore's server-side profiler
+    over the C API; kvstore here is in-process, so its ops already land
+    in this profiler's stream)."""
+    return None

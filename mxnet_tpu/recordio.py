@@ -213,6 +213,32 @@ def unpack(s: bytes):
     return header, s
 
 
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an image array and pack it into one record (reference
+    recordio.py:469; PIL replaces cv2.imencode in this environment —
+    JPEG ``quality`` 1-100 or PNG ``quality`` as compress level 0-9).
+    Round-trips through :func:`unpack_img`."""
+    import io as _io
+    from PIL import Image
+
+    from .base import MXNetError
+    arr = onp.asarray(img)
+    if arr.dtype != onp.uint8:
+        arr = onp.clip(arr, 0, 255).astype(onp.uint8)
+    im = Image.fromarray(arr)
+    buf = _io.BytesIO()
+    fmt = img_fmt.lower()
+    if fmt in (".jpg", ".jpeg"):
+        im.save(buf, format="JPEG", quality=int(quality))
+    elif fmt == ".png":
+        im.save(buf, format="PNG",
+                compress_level=min(max(int(quality), 0), 9))
+    else:
+        raise MXNetError(f"unsupported image format {img_fmt!r}; "
+                         "use .jpg or .png")
+    return pack(header, buf.getvalue())
+
+
 def unpack_img(s: bytes, iscolor=1):
     """unpack + image decode (reference recordio.py unpack_img). Uses
     PIL/raw numpy fallback since OpenCV isn't in this environment."""

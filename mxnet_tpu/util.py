@@ -11,6 +11,10 @@ import functools
 import threading
 
 __all__ = ["is_np_array", "is_np_shape", "set_np", "set_np_shape", "reset_np",
+           "use_np_shape", "set_module", "np_ufunc_legal_option",
+           "default_array", "is_np_default_dtype", "set_np_default_dtype",
+           "np_default_dtype", "use_np_default_dtype", "getenv", "setenv",
+           "get_gpu_count", "get_gpu_memory", "numpy_fallback",
            "use_np", "use_np_array", "np_array", "np_shape", "wrap_np_unary_func",
            "wrap_np_binary_func", "get_cuda_compute_capability"]
 
@@ -98,3 +102,150 @@ def wrap_np_binary_func(func):
 
 def get_cuda_compute_capability(ctx):  # compat shim; no CUDA on TPU builds
     return None
+
+
+def use_np_shape(func_or_cls):
+    """Decorator scoping NumPy-shape semantics (reference util.py:231).
+    Scalar/zero-size shapes are always legal here (XLA-native), so the
+    scope flag is informational; the decorator still flips it for code
+    that inspects is_np_shape()."""
+    if isinstance(func_or_cls, type):
+        return func_or_cls
+
+    @functools.wraps(func_or_cls)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func_or_cls(*args, **kwargs)
+    return wrapper
+
+
+def set_module(module):
+    """Decorator overriding __module__ for doc rendering
+    (reference util.py:312)."""
+    def decorator(func):
+        if module is not None:
+            func.__module__ = module
+        return func
+    return decorator
+
+
+def np_ufunc_legal_option(key, value):
+    """Whether a ufunc kwarg is supported by the np dispatch layer
+    (reference util.py:552)."""
+    if key == "where":
+        return True
+    if key == "casting":
+        return value in ("no", "equiv", "safe", "same_kind", "unsafe")
+    if key == "order":
+        return isinstance(value, str)
+    if key == "dtype":
+        import numpy as _onp
+        try:
+            _onp.dtype(value)
+            return True
+        except TypeError:
+            return False
+    if key == "subok":
+        return isinstance(value, bool)
+    return False
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Create an array in the ACTIVE frontend: mx.np.ndarray under
+    np-array semantics, classic NDArray otherwise
+    (reference util.py:917)."""
+    if is_np_array():
+        from . import numpy as _mx_np
+        return _mx_np.array(source_array, ctx=ctx, dtype=dtype)
+    from .ndarray.ndarray import array as _nd_array
+    return _nd_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def is_np_default_dtype() -> bool:
+    """True when the NumPy default dtype (float64) scope is active
+    (reference util.py:930)."""
+    return bool(getattr(_flags(), "np_dtype", False))
+
+
+def set_np_default_dtype(is_np_default_dtype=True):  # noqa: A002
+    """Flip the default-dtype semantics flag; returns the previous
+    value (reference util.py:940). Note: TPU arrays default to float32
+    regardless (x64 is disabled for performance; documented deviation,
+    docs/ENV_VARS.md)."""
+    f = _flags()
+    old = bool(getattr(f, "np_dtype", False))
+    f.np_dtype = bool(is_np_default_dtype)
+    return old
+
+
+class _NumpyDtypeScope:
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._old = set_np_default_dtype(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_default_dtype(self._old)
+
+
+def np_default_dtype(active=True):
+    """'with' scope for NumPy default-dtype semantics
+    (reference util.py:971)."""
+    return _NumpyDtypeScope(active)
+
+
+def use_np_default_dtype(func_or_cls):
+    """Decorator form of np_default_dtype (reference util.py:1005)."""
+    if isinstance(func_or_cls, type):
+        return func_or_cls
+
+    @functools.wraps(func_or_cls)
+    def wrapper(*args, **kwargs):
+        with np_default_dtype(True):
+            return func_or_cls(*args, **kwargs)
+    return wrapper
+
+
+def getenv(name):
+    """Read an env var the way the runtime does (reference util.py
+    getenv via MXGetEnv)."""
+    import os
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    """Set an env var for the runtime (reference util.py setenv via
+    MXSetEnv). Config vars read at import time (docs/ENV_VARS.md) need
+    a restart to take effect — same caveat as the reference."""
+    import os
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+
+
+def get_gpu_count():
+    """Number of CUDA GPUs — always 0 on TPU builds (reference
+    util.py:40)."""
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """CUDA memory introspection has no TPU analog; raises with the
+    TPU-native alternative (reference util.py:46)."""
+    from .base import MXNetError
+    raise MXNetError(
+        "get_gpu_memory is CUDA-specific; use "
+        "mx.profiler.memory_summary() / jax device memory stats for "
+        "accelerator memory on this framework")
+
+
+def numpy_fallback(func):
+    """Decorator marking a host-numpy fallback implementation
+    (reference numpy_op_fallback.register flavor): refuses under
+    autograd recording and warns once, like mx.np's fallback ops."""
+    from .numpy.fallback import make_fallback
+    return make_fallback(getattr(func, "__name__", "fallback"), func)

@@ -1,0 +1,150 @@
+"""API-surface tail, part 2: profiler instrumentation objects,
+recordio.pack_img, util/context shims.
+
+Reference analogs: profiler.py:228-520 (Domain/Task/Frame/Event/
+Counter/Marker), recordio.py:469 pack_img, util.py tail, context.py
+gpu_memory_info.
+"""
+import json
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler, recordio, util
+
+
+def test_profiler_instrumentation_objects(tmp_path):
+    out = tmp_path / "trace.json"
+    profiler.set_config(filename=str(out))
+    profiler.set_state("run")
+    dom = profiler.Domain("mydomain")
+    task = dom.new_task("loadtask")
+    with task:
+        nd.array(onp.ones(4)).asnumpy()
+    frame = dom.new_frame("frame0")
+    frame.start()
+    frame.stop()
+    ev = profiler.Event("standalone")
+    with ev:
+        pass
+    ctr = dom.new_counter("examples", 10)
+    ctr.increment(5)
+    ctr -= 3
+    marker = dom.new_marker("epoch-end")
+    marker.mark("process")
+    profiler.set_state("stop")
+    profiler.dump()
+    events = json.load(open(out))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"loadtask", "frame0", "standalone", "examples",
+            "epoch-end"} <= names
+    cat = {e["name"]: e.get("cat") for e in events}
+    assert cat["loadtask"] == "mydomain"
+    assert cat["frame0"] == "mydomain:frame"
+    counter_vals = [e["args"]["value"] for e in events
+                    if e["name"] == "examples"]
+    assert counter_vals == [10, 15, 12]
+    inst = [e for e in events if e["name"] == "epoch-end"]
+    assert inst and inst[0]["ph"] == "i" and inst[0]["s"] == "p"
+    with pytest.raises(mx.MXNetError):
+        dom.new_task("bad").stop()  # stop before start
+
+
+def test_profiler_deprecated_aliases(tmp_path):
+    with pytest.warns(DeprecationWarning):
+        profiler.profiler_set_config(
+            filename=str(tmp_path / "p.json"))
+    with pytest.warns(DeprecationWarning):
+        profiler.profiler_set_state("stop")
+    assert profiler.set_kvstore_handle(None) is None
+
+
+def test_pack_img_roundtrip(tmp_path):
+    # smooth gradient: JPEG-friendly (random noise is destroyed by DCT)
+    gy, gx = onp.mgrid[0:16, 0:16]
+    img = onp.stack([gy * 16, gx * 16, (gy + gx) * 8],
+                    axis=-1).astype("uint8")
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    for fmt, tol in ((".png", 0), (".jpg", 40)):
+        s = recordio.pack_img(header, img, quality=(9 if fmt == ".png"
+                                                    else 95),
+                              img_fmt=fmt)
+        h2, img2 = recordio.unpack_img(s)
+        assert h2.label == 3.0 and h2.id == 7
+        assert img2.shape == img.shape
+        assert onp.abs(img2.astype(int) - img.astype(int)).max() <= tol
+    with pytest.raises(mx.MXNetError):
+        recordio.pack_img(header, img, img_fmt=".webp")
+    # full file round trip through the indexed writer
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                   str(tmp_path / "d.rec"), "w")
+    w.write_idx(0, recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+    r = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                   str(tmp_path / "d.rec"), "r")
+    h3, img3 = recordio.unpack_img(r.read_idx(0))
+    onp.testing.assert_array_equal(img3, img)
+
+
+def test_util_tail():
+    @util.set_module("mxnet_tpu.numpy")
+    def f():
+        pass
+    assert f.__module__ == "mxnet_tpu.numpy"
+
+    assert util.np_ufunc_legal_option("casting", "safe")
+    assert not util.np_ufunc_legal_option("casting", "bogus")
+    assert util.np_ufunc_legal_option("dtype", "float32")
+    assert not util.np_ufunc_legal_option("nope", 1)
+
+    with util.np_array(True):
+        arr = util.default_array([1.0, 2.0])
+        import mxnet_tpu.numpy as mnp
+        assert isinstance(arr, mnp.ndarray)
+    arr2 = util.default_array([1.0, 2.0])
+    assert type(arr2).__name__ == "NDArray"
+
+    assert util.is_np_default_dtype() is False
+    with util.np_default_dtype(True):
+        assert util.is_np_default_dtype() is True
+    assert util.is_np_default_dtype() is False
+
+    @util.use_np_default_dtype
+    def inside():
+        return util.is_np_default_dtype()
+    assert inside() is True
+
+    @util.use_np_shape
+    def shaped():
+        return util.is_np_shape()
+    assert shaped() is True
+
+    util.setenv("MXT_TEST_ENV_VAR", "42")
+    assert util.getenv("MXT_TEST_ENV_VAR") == "42"
+    util.setenv("MXT_TEST_ENV_VAR", None)
+    assert util.getenv("MXT_TEST_ENV_VAR") is None
+
+    assert util.get_gpu_count() == 0
+    with pytest.raises(mx.MXNetError):
+        util.get_gpu_memory(0)
+    with pytest.raises(mx.MXNetError):
+        mx.context.gpu_memory_info(0)
+
+
+def test_numpy_fallback_decorator():
+    import numpy as real_np
+
+    @util.numpy_fallback
+    def my_median(x):
+        return real_np.median(x)
+
+    out = my_median(nd.array(onp.array([1.0, 3.0, 2.0])))
+    # scalar results pass through as numpy scalars (arrays wrap to mx)
+    assert float(out) == 2.0
+    a = nd.array(onp.ones(3))
+    a.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        with pytest.raises(mx.MXNetError, match="fallback"):
+            my_median(a)
