@@ -22,7 +22,8 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Signum", "SGLD", "DCASGD", "Adam",
-           "AdamW", "AdaBelief", "Adamax", "Nadam", "AdaGrad", "AdaDelta",
+           "AdamW", "AdaBelief", "Adamax", "Nadam", "AdaGrad", "GroupAdaGrad",
+           "AdaDelta",
            "RMSProp", "Ftrl", "FTML", "LARS", "LAMB", "LANS", "Updater",
            "get_updater", "create", "register"]
 
