@@ -315,7 +315,16 @@ def _dispatch_to_mx(name, onp_func, args, kwargs):
     import mxnet_tpu.numpy as mx_np
     mx_fn = getattr(mx_np, name, None)
     if callable(mx_fn) and not getattr(mx_fn, "_is_np_fallback", False):
-        return mx_fn(*_fb._to_mx(args), **_fb._to_mx(kwargs))
+        try:
+            return mx_fn(*_fb._to_mx(args), **_fb._to_mx(kwargs))
+        except TypeError as e:
+            # a legal ufunc option (np_ufunc_legal_option: where=, …) the
+            # mx implementation doesn't take — keep protocol semantics by
+            # falling back to host (refused under autograd recording by
+            # the fallback wrapper) instead of surfacing the TypeError
+            if builtins.any(k in str(e) for k in kwargs):
+                return _fb.make_fallback(name, onp_func)(*args, **kwargs)
+            raise
     if getattr(mx_fn, "_is_np_fallback", False):
         return mx_fn(*args, **kwargs)  # installed wrapper converts itself
     return _fb.make_fallback(name, onp_func)(*args, **kwargs)

@@ -3,12 +3,13 @@
 Reference analog: python/mxnet/visualization.py (:46 print_summary,
 :210 plot_network), importable as ``mx.viz`` exactly like the reference.
 
-TPU-native differences: per-node output shapes come from ONE abstract
-evaluation of the whole DAG under ``jax.eval_shape`` (XLA shape
-inference — zero FLOPs, no device contact) instead of the reference's
-nnvm infer-shape pass over a JSON round-trip; and parameter counts are
-derived from real inferred input shapes rather than string-parsed attr
-dicts. ``plot_network`` degrades gracefully: it prefers the ``graphviz``
+TPU-native differences: per-node output shapes come from an abstract
+per-node walk under ``jax.eval_shape`` with ``ShapeDtypeStruct``
+arguments (XLA shape inference — zero FLOPs, no device contact; only
+the data shape is required, parameter shapes are inferred) instead of
+the reference's nnvm infer-shape pass over a JSON round-trip; and
+parameter counts are derived from real inferred input shapes rather
+than string-parsed attr dicts. ``plot_network`` degrades gracefully: it prefers the ``graphviz``
 package but falls back to a minimal DOT builder with the same
 ``.source`` surface when the package is absent (this environment has no
 ``dot`` binary, so rendering is the caller's concern either way).
@@ -24,25 +25,100 @@ __all__ = ["print_summary", "plot_network"]
 
 
 def _node_shapes(symbol: Symbol, shapes: Dict) -> Dict[int, tuple]:
-    """id(node) -> inferred output shape, via one jax.eval_shape pass."""
+    """id(node) -> inferred output shape, via an abstract per-node
+    ``jax.eval_shape`` walk: every feed enters the trace as a
+    ``ShapeDtypeStruct`` argument, so no array is ever materialized and
+    no device is touched. Parameter-variable shapes absent from
+    ``shapes`` are inferred from op attrs + the data input's (already
+    inferred) shape, so reference-style calls
+    ``print_summary(sym, shape={'data': ...})`` work the way the
+    reference's interior infer-shape pass makes them work
+    (reference visualization.py:75)."""
     import jax
-    from .ndarray import zeros
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
     from .symbol.executor import _eval_node
 
     internals = symbol.get_internals()
-    missing = [n for n in symbol.list_arguments() if n not in shapes]
-    if missing:
-        raise MXNetError(f"Input shape is incomplete: missing {missing}")
+    shapes = {k: tuple(int(x) for x in v) for k, v in shapes.items()}
+    out_shape: Dict[int, tuple] = {}
+    unresolved: List[str] = []
 
-    def f():
-        feeds = {n: zeros(shapes[n]) for n in symbol.list_arguments()}
-        cache: Dict[int, object] = {}
-        return tuple(_eval_node(node, feeds, cache)._data
-                     for node in internals)
+    def _apply(node):
+        def f(arrs):
+            feeds: Dict[str, NDArray] = {}
+            cache: Dict[int, NDArray] = {}
+            for inp, a in zip(node._inputs, arrs):
+                v = NDArray(a)
+                cache[id(inp)] = v
+                feeds[inp._name] = v
+            return _eval_node(node, feeds, cache)._data
+        return f
 
-    outs = jax.eval_shape(f)
-    return {id(node): tuple(o.shape)
-            for node, o in zip(internals, outs)}
+    for node in internals:
+        if node._op is None:
+            continue
+        _infer_param_shapes(node, shapes, out_shape)
+        in_structs = []
+        for inp in node._inputs:
+            s = out_shape.get(id(inp)) or shapes.get(inp._name)
+            if s is None:
+                unresolved.append(inp._name)
+            else:
+                in_structs.append(jax.ShapeDtypeStruct(s, jnp.float32))
+        if unresolved:
+            raise MXNetError(
+                f"Input shape is incomplete: missing {sorted(set(unresolved))}")
+        out = jax.eval_shape(_apply(node), in_structs)
+        out_shape[id(node)] = tuple(out.shape)
+    for node in internals:
+        if node._op is None and node._name in shapes:
+            out_shape[id(node)] = shapes[node._name]
+    return out_shape
+
+
+def _infer_param_shapes(node: Symbol, shapes: Dict, out_shape: Dict) -> None:
+    """Complete missing parameter-variable shapes for ``node`` in place,
+    from its op attrs + the data input's inferred shape — the job the
+    reference delegates to nnvm's infer-shape pass so users only supply
+    the data shape."""
+    var_inputs = [i for i in node._inputs
+                  if i._op is None and i._name not in shapes]
+    if not var_inputs:
+        return
+    op, attrs = node._op, node._attrs
+    data = node._inputs[0]
+    in_shape = out_shape.get(id(data)) or shapes.get(data._name) or ()
+    guesses: Dict[str, tuple] = {}
+    if op in _CONV_OPS and len(in_shape) > 1:
+        nf = int(attrs.get("num_filter", 0) or 0)
+        ng = max(int(attrs.get("num_group", 1) or 1), 1)
+        guesses["weight"] = (nf, int(in_shape[1]) // ng) + _as_int_tuple(
+            attrs.get("kernel"))
+        guesses["bias"] = (nf,)
+    elif op in _FC_OPS and in_shape:
+        nh = int(attrs.get("num_hidden", 0) or 0)
+        if attrs.get("flatten", True) in (False, "False", 0):
+            in_feat = int(in_shape[-1])
+        else:
+            in_feat = 1
+            for x in in_shape[1:]:
+                in_feat *= int(x)
+        guesses["weight"] = (nh, in_feat)
+        guesses["bias"] = (nh,)
+    elif op in _BN_OPS and len(in_shape) > 1:
+        ch = (int(in_shape[int(attrs.get("axis", 1) or 1)]),)
+        for suffix in ("gamma", "beta", "moving_mean", "moving_var",
+                       "running_mean", "running_var"):
+            guesses[suffix] = ch
+    elif op in _EMBED_OPS:
+        guesses["weight"] = (int(attrs.get("input_dim", 0)),
+                             int(attrs.get("output_dim", 0)))
+    for v in var_inputs:
+        for suffix, g in guesses.items():
+            if v._name.endswith(suffix):
+                shapes[v._name] = g
+                break
 
 
 def _as_int_tuple(v) -> tuple:

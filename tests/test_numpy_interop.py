@@ -139,3 +139,22 @@ def test_unravel_index():
     onp.testing.assert_array_equal(scalar.asnumpy(), [3, 1, 4, 1])
     with pytest.raises(mx.MXNetError):
         np.unravel_index(5, (3, 3), order="F")
+
+
+def test_ufunc_unsupported_kwarg_falls_back_to_host():
+    """where= is a legal ufunc option (util.np_ufunc_legal_option) that
+    the mx implementations don't take; the protocol must fall back to
+    host instead of raising TypeError (advisor round-4 low)."""
+    a = np.array([1.0, 2.0, 3.0])
+    got = onp.add(a, a, where=onp.array([True, False, True]))
+    assert isinstance(got, np.ndarray)
+    vals = got.asnumpy()
+    assert vals[0] == 2.0 and vals[2] == 6.0
+
+
+def test_ufunc_unsupported_kwarg_refused_under_recording():
+    a = np.array([1.0, 2.0, 3.0])
+    a.attach_grad()
+    with pytest.raises(mx.MXNetError):
+        with autograd.record():
+            onp.add(a, a, where=onp.array([True, False, True]))

@@ -51,9 +51,37 @@ def test_print_summary_requires_symbol_and_complete_shape():
     with pytest.raises(TypeError):
         mx.viz.print_summary("not a symbol")
     fc, shapes = _conv_bn_fc()
-    del shapes["conv1_weight"]
+    del shapes["data"]
     with pytest.raises(mx.MXNetError, match="incomplete"):
         mx.viz.print_summary(fc, shape=shapes)
+
+
+def test_print_summary_infers_param_shapes_from_data_alone():
+    """Reference-style call: only the data shape supplied; parameter
+    shapes (conv weight, BN stats, FC weight/bias) are inferred from op
+    attrs like the reference's nnvm infer-shape pass does."""
+    fc, _ = _conv_bn_fc()
+    total = mx.viz.print_summary(fc, shape={"data": (1, 3, 8, 8)})
+    assert total == 432 + 32 + 10250
+
+
+def test_node_shapes_is_abstract_no_device_arrays(monkeypatch):
+    """The shape walk must never materialize arrays: creating a concrete
+    jnp array during it would defeat eval_shape (advisor round-4 low)."""
+    import jax.numpy as jnp
+    fc, shapes = _conv_bn_fc()
+    real_zeros = jnp.zeros
+
+    def boom(*a, **k):
+        raise AssertionError("concrete array materialized during shape walk")
+
+    monkeypatch.setattr(jnp, "zeros", boom)
+    try:
+        from mxnet_tpu.visualization import _node_shapes
+        out = _node_shapes(fc, shapes)
+    finally:
+        monkeypatch.setattr(jnp, "zeros", real_zeros)
+    assert out[id(fc)] == (1, 10)
 
 
 def test_plot_network_source_and_hide_weights():

@@ -47,6 +47,7 @@ def _graceful_stop(procs, owns_accel, grace=None) -> None:
         if all(p.poll() is not None for p in procs):
             return
         time.sleep(0.1)
+    stragglers = []
     for p, owns in zip(procs, owns_accel):
         if p.poll() is None:
             if owns:
@@ -55,8 +56,29 @@ def _graceful_stop(procs, owns_accel, grace=None) -> None:
                       "mid-dispatch can wedge the device relay). "
                       "Re-sending SIGTERM.", file=sys.stderr)
                 p.terminate()
+                stragglers.append(p)
             else:
                 p.kill()
+    # bounded supervision of accelerator-owning stragglers: keep
+    # re-sending SIGTERM once per grace window rather than orphaning
+    # them after a single resend
+    for attempt in range(5):
+        stragglers = [p for p in stragglers if p.poll() is None]
+        if not stragglers:
+            return
+        time.sleep(grace)
+        for p in stragglers:
+            if p.poll() is None:
+                print(f"launch: pid {p.pid} still alive after "
+                      f"{attempt + 2} SIGTERMs; re-sending.",
+                      file=sys.stderr)
+                p.terminate()
+    stragglers = [p for p in stragglers if p.poll() is None]
+    if stragglers:
+        print("launch: giving up on accelerator-owning stragglers "
+              f"{[p.pid for p in stragglers]}; they keep SIGTERM "
+              "semantics (never SIGKILLed) — clean up manually if the "
+              "device relay stays held.", file=sys.stderr)
 
 
 def launch_local(n: int, cmd, port: int) -> int:
