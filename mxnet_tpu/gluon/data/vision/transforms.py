@@ -11,14 +11,15 @@ from ....base import MXNetError
 from ....ndarray.ndarray import NDArray
 from ....ndarray import ops as F
 from ...block import Block, HybridBlock
-from ...nn.basic_layers import Sequential
+from ...nn.basic_layers import Sequential, HybridSequential
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
-           "CenterCrop", "RandomResizedCrop", "RandomCrop",
+__all__ = ["Compose", "HybridCompose", "Cast", "ToTensor", "Normalize",
+           "Resize", "CenterCrop", "CropResize", "RandomResizedCrop",
+           "RandomCrop", "RandomApply", "HybridRandomApply",
            "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
            "RandomHue", "RandomColorJitter", "RandomLighting",
-           "RandomGray"]
+           "RandomGray", "Rotate", "RandomRotation"]
 
 
 class Compose(Sequential):
@@ -28,6 +29,60 @@ class Compose(Sequential):
         super().__init__()
         for t in transforms:
             self.add(t)
+
+
+class HybridCompose(HybridSequential):
+    """Hybrid version of Compose: every member must be a HybridBlock so
+    the whole chain fuses into one compiled program (reference
+    transforms/__init__.py:80)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            if not isinstance(t, HybridBlock):
+                raise ValueError(f"{t} is not a HybridBlock, try use "
+                                 "`Compose` instead")
+            self.add(t)
+        self.hybridize()
+
+
+class RandomApply(Sequential):
+    """Apply ``transforms`` (a Block or composed chain) with probability
+    ``p``, decided on host per call (reference
+    transforms/__init__.py:138)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self.transforms = transforms
+        self.p = p
+
+    def forward(self, x):
+        if self.p < onp.random.random():
+            return x
+        return self.transforms(x)
+
+
+class HybridRandomApply(HybridSequential):
+    """Hybrid RandomApply: the coin flip is a device-side uniform draw
+    and the branch is a compiled ``lax.cond`` — only the taken branch
+    executes (reference transforms/__init__.py:168, which lowers to
+    F.contrib.cond the same way)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        assert isinstance(transforms, HybridBlock), \
+            "transforms must be a HybridBlock"
+        self.transforms = transforms
+        self.p = p
+
+    def forward(self, x):
+        from ....ndarray import random as ndrandom
+        from ....ndarray import contrib as ndcontrib
+        coin = ndrandom.uniform(low=0, high=1, shape=(1,))
+        pred = (coin > self.p).reshape(())
+        return ndcontrib.cond(pred,
+                              lambda v: self.transforms(v),
+                              lambda v: v, [x])
 
 
 class Cast(Block):
@@ -258,3 +313,101 @@ class RandomGray(Block):
             gray = (arr * self._COEF).sum(-1, keepdims=True)
             return NDArray(onp.broadcast_to(gray, arr.shape).copy())
         return x
+
+
+class Rotate(Block):
+    """Rotate a CHW float32 image (or NCHW batch) by a fixed angle,
+    keeping the shape (reference transforms/image.py:144; kernel =
+    image.imrotate, one fused XLA program)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._args = (rotation_degrees, zoom_in, zoom_out)
+
+    def forward(self, x):
+        if str(x.dtype) != "float32":
+            raise TypeError("This transformation only supports float32. "
+                            "Consider calling it after ToTensor, "
+                            f"given: {x.dtype}")
+        from ....image.image import imrotate
+        deg, zin, zout = self._args
+        return imrotate(x, deg, zoom_in=zin, zoom_out=zout)
+
+
+class RandomRotation(Block):
+    """Rotate by an angle drawn uniformly from ``angle_limits``, with
+    probability ``rotate_with_proba`` (reference
+    transforms/image.py:174)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        lower, upper = angle_limits
+        if lower >= upper:
+            raise ValueError("`angle_limits` must be an ordered tuple")
+        if rotate_with_proba < 0 or rotate_with_proba > 1:
+            raise ValueError("Probability of rotating the image should "
+                             "be between 0 and 1")
+        self._args = (angle_limits, zoom_in, zoom_out)
+        self._rotate_with_proba = rotate_with_proba
+
+    def forward(self, x):
+        if onp.random.random() > self._rotate_with_proba:
+            return x
+        if str(x.dtype) != "float32":
+            raise TypeError("This transformation only supports float32. "
+                            "Consider calling it after ToTensor, "
+                            f"given: {x.dtype}")
+        from ....image.image import random_rotate
+        limits, zin, zout = self._args
+        return random_rotate(x, limits, zoom_in=zin, zoom_out=zout)
+
+
+class CropResize(HybridBlock):
+    """Crop a fixed region of an HWC image (or NHWC batch), optionally
+    resizing the crop (reference transforms/image.py:259). Static crop
+    coordinates keep the whole op traceable: the slice + resize fuse
+    into the surrounding compiled program."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x = int(x)
+        self._y = int(y)
+        self._width = int(width)
+        self._height = int(height)
+        if size is not None and not isinstance(size, (tuple, list)):
+            size = (size, size)
+        self._size = tuple(size) if size is not None else None
+        self._interpolation = interpolation
+
+    def forward(self, data):
+        from ....ops.registry import invoke_raw
+        import jax.numpy as _jnp
+
+        if data.ndim not in (3, 4):
+            raise ValueError("CropResize expects (H, W, C) or "
+                             f"(N, H, W, C) input, got {data.shape}")
+        x0, y0, w, h = self._x, self._y, self._width, self._height
+        size, interp = self._size, self._interpolation
+
+        def fn(d):
+            import jax
+            if d.ndim == 3:
+                crop = d[y0:y0 + h, x0:x0 + w, :]
+                if size is None:
+                    return crop
+                method = "nearest" if interp == 0 else "linear"
+                return jax.image.resize(
+                    crop.astype(_jnp.float32),
+                    (size[1], size[0], d.shape[-1]),
+                    method=method).astype(d.dtype)
+            crop = d[:, y0:y0 + h, x0:x0 + w, :]
+            if size is None:
+                return crop
+            method = "nearest" if interp == 0 else "linear"
+            return jax.image.resize(
+                crop.astype(_jnp.float32),
+                (d.shape[0], size[1], size[0], d.shape[-1]),
+                method=method).astype(d.dtype)
+
+        return invoke_raw("crop_resize", fn, [data])

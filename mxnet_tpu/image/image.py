@@ -24,6 +24,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array as nd_array
 
 __all__ = ["imdecode", "imresize", "imresize_np", "imdecode_or_raw",
+           "imrotate", "random_rotate",
            "resize_short", "fixed_crop", "center_crop", "random_crop",
            "color_normalize", "random_size_crop", "Augmenter",
            "SequentialAug", "ResizeAug", "ForceResizeAug", "CastAug",
@@ -150,6 +151,102 @@ def imresize_np(src: onp.ndarray, w: int, h: int,
 def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
     """Resize HWC image (reference imresize; lowers to jax.image.resize)."""
     return nd_array(imresize_np(_as_np(src).astype("float32"), w, h, interp))
+
+
+def imrotate(src, rotation_degrees, zoom_in: bool = False,
+             zoom_out: bool = False) -> NDArray:
+    """Rotate CHW image(s) (or NCHW batch) by ``rotation_degrees``
+    (reference image/image.py:618 imrotate — grid rotation around the
+    image center + bilinear sampling, zero padding outside).
+
+    TPU-native: the rotated sampling grid is built in jnp and sampled
+    through the shared bilinear-grid kernel
+    (``ndarray.vision_ops._grid_sample``), so the whole rotation is one
+    fused, differentiable XLA program — no host round-trip, usable
+    inside hybridized pipelines. ``zoom_in`` scales so no padding shows;
+    ``zoom_out`` so the whole source stays visible (mutually exclusive).
+    Batch inputs accept one angle per image.
+    """
+    import math
+
+    from ..ops.registry import invoke_raw
+    from ..ndarray.vision_ops import _grid_sample
+
+    if zoom_in and zoom_out:
+        raise ValueError("`zoom_in` and `zoom_out` cannot be both True")
+    if not isinstance(src, NDArray):
+        src = nd_array(src)
+    if str(src.dtype) != "float32":
+        raise TypeError("Only `float32` images are supported by this "
+                        f"function, got {src.dtype}")
+    expanded = src.ndim == 3
+    if expanded:
+        if isinstance(rotation_degrees, NDArray) or (
+                hasattr(rotation_degrees, "ndim")
+                and getattr(rotation_degrees, "ndim", 0) > 0):
+            raise TypeError("When a single image is passed the rotation "
+                            "angle is required to be a scalar.")
+        src = src.reshape((1,) + tuple(src.shape))
+    elif src.ndim != 4:
+        raise ValueError("Only 3D and 4D are supported by this function")
+    n = src.shape[0]
+    if not isinstance(rotation_degrees, NDArray):
+        deg = onp.asarray(rotation_degrees, dtype="float32").reshape(-1)
+        if deg.size == 1:
+            deg = onp.repeat(deg, n)
+        rotation_degrees = nd_array(deg)
+    if rotation_degrees.shape[0] != n:
+        raise ValueError("The number of images must be equal to the "
+                         "number of rotation angles")
+
+    def fn(data, deg):
+        B, C, H, W = data.shape
+        rad = (jnp.pi / 180.0) * deg.astype(data.dtype)
+        hs, ws = (H - 1) / 2.0, (W - 1) / 2.0
+        hm = jnp.broadcast_to(
+            (jnp.arange(H, dtype=data.dtype) - hs)[:, None], (H, W))
+        wm = jnp.broadcast_to(
+            (jnp.arange(W, dtype=data.dtype) - ws)[None, :], (H, W))
+        c = jnp.cos(rad)[:, None, None]
+        s = jnp.sin(rad)[:, None, None]
+        # rotate, THEN normalize (keeps aspect ratio, reference :687)
+        wrot = (wm * c - hm * s) / ws                       # (B, H, W)
+        hrot = (wm * s + hm * c) / hs
+        if zoom_in or zoom_out:
+            rho = math.hypot(H, W)
+            ang = math.atan2(H, W)                          # arctan(h/w)
+            ar = jnp.abs(rad)                               # (B,)
+            c1x = jnp.abs(rho * jnp.cos(ang + ar))
+            c1y = jnp.abs(rho * jnp.sin(ang + ar))
+            c2x = jnp.abs(rho * jnp.cos(ang - ar))
+            c2y = jnp.abs(rho * jnp.sin(ang - ar))
+            max_x = jnp.maximum(c1x, c2x)
+            max_y = jnp.maximum(c1y, c2y)
+            if zoom_out:
+                scale = jnp.maximum(max_x / W, max_y / H)
+            else:
+                scale = jnp.minimum(W / max_x, H / max_y)
+            scale = scale[:, None, None]
+            wrot = wrot * scale
+            hrot = hrot * scale
+        # denormalize [-1, 1] -> fractional pixel coords
+        return _grid_sample(data, (hrot + 1.0) * hs, (wrot + 1.0) * ws)
+
+    out = invoke_raw("imrotate", fn, [src, rotation_degrees])
+    return out[0] if expanded else out
+
+
+def random_rotate(src, angle_limits, zoom_in: bool = False,
+                  zoom_out: bool = False) -> NDArray:
+    """Rotate by an angle drawn uniformly from ``angle_limits`` — per
+    image for batches (reference image/image.py:727)."""
+    if getattr(src, "ndim", 3) == 3:
+        rotation_degrees = float(onp.random.uniform(*angle_limits))
+    else:
+        rotation_degrees = nd_array(onp.random.uniform(
+            *angle_limits, size=src.shape[0]).astype("float32"))
+    return imrotate(src, rotation_degrees, zoom_in=zoom_in,
+                    zoom_out=zoom_out)
 
 
 def resize_short(src, size: int, interp: int = 2) -> NDArray:

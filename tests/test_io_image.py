@@ -166,3 +166,125 @@ def test_bilinear_resize_2d_op():
     import pytest as _pytest
     with _pytest.raises(_E):
         _nd.BilinearResize2D(x)  # size mode without height/width
+
+
+def test_transforms_rotate_matches_scipy_interior():
+    """Rotate kernel golden vs scipy.ndimage.rotate (bilinear,
+    reshape=False): interior must agree to float tolerance; only the
+    zero-padding boundary convention may differ
+    (reference transforms/image.py:144 + image/image.py:618)."""
+    from scipy import ndimage
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    onp.random.seed(3)
+    img = onp.random.uniform(0, 1, size=(1, 33, 37)).astype("float32")
+    got = T.Rotate(30.0)(mx.nd.array(img)).asnumpy()[0]
+    want = ndimage.rotate(img[0], 30.0, reshape=False, order=1,
+                          mode="constant", cval=0.0)
+    assert got.shape == want.shape
+    onp.testing.assert_allclose(got[8:-8, 8:-8], want[8:-8, 8:-8],
+                                atol=1e-4)
+    with pytest.raises(TypeError):
+        T.Rotate(30.0)(mx.nd.array(img.astype("int32")))
+
+
+def test_transforms_rotate_zoom_flags_and_batch():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    from mxnet_tpu.image import imrotate
+
+    onp.random.seed(4)
+    batch = mx.nd.array(onp.random.uniform(
+        0, 1, size=(3, 2, 16, 16)).astype("float32"))
+    out = imrotate(batch, mx.nd.array(onp.array([10., 20., 30.],
+                                                "float32")))
+    assert out.shape == batch.shape
+    # zoom_in crops away padding: at 45 deg every output pixel of a
+    # constant image stays 1.0 (no zero padding visible)
+    ones = mx.nd.array(onp.ones((1, 17, 17), "float32"))
+    zin = imrotate(ones, 45.0, zoom_in=True).asnumpy()
+    assert zin.min() > 0.9
+    # plain rotation of the same image shows zero padding at corners
+    plain = imrotate(ones, 45.0).asnumpy()
+    assert plain.min() < 0.1
+    with pytest.raises(ValueError):
+        imrotate(ones, 45.0, zoom_in=True, zoom_out=True)
+    with pytest.raises(ValueError):
+        T.RandomRotation((10, -10))
+    with pytest.raises(ValueError):
+        T.RandomRotation((-10, 10), rotate_with_proba=1.5)
+
+
+def test_transforms_random_rotation_applies_within_limits():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    onp.random.seed(5)
+    img = mx.nd.array(onp.random.uniform(
+        0, 1, size=(1, 15, 15)).astype("float32"))
+    t = T.RandomRotation((-5, 5))
+    out = t(img)
+    assert out.shape == img.shape
+    # proba=0 is identity
+    t0 = T.RandomRotation((-5, 5), rotate_with_proba=0.0)
+    onp.testing.assert_array_equal(t0(img).asnumpy(), img.asnumpy())
+
+
+def test_transforms_crop_resize():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    onp.random.seed(6)
+    img = mx.nd.array(onp.random.uniform(
+        0, 255, size=(64, 48, 3)).astype("float32"))
+    out = T.CropResize(x=4, y=8, width=32, height=16)(img)
+    assert out.shape == (16, 32, 3)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                img.asnumpy()[8:24, 4:36], rtol=1e-6)
+    # with resize
+    out2 = T.CropResize(x=4, y=8, width=32, height=16, size=(8, 8),
+                        interpolation=1)(img)
+    assert out2.shape == (8, 8, 3)
+    # batch
+    b = mx.nd.array(onp.random.uniform(
+        0, 255, size=(2, 64, 48, 3)).astype("float32"))
+    out3 = T.CropResize(x=0, y=0, width=10, height=12, size=(5, 6))(b)
+    assert out3.shape == (2, 6, 5, 3)
+
+
+def test_transforms_compose_hybrid_and_random_apply():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    onp.random.seed(7)
+    img = mx.nd.array(onp.random.uniform(
+        0, 255, size=(32, 32, 3)).astype("float32"))
+    hc = T.HybridCompose([T.CropResize(0, 0, 16, 16),
+                          T.CropResize(2, 2, 8, 8)])
+    out = hc(img)
+    assert out.shape == (8, 8, 3)
+    # non-hybrid member rejected
+    with pytest.raises(ValueError):
+        T.HybridCompose([T.CropResize(0, 0, 16, 16), T.ToTensor()])
+
+    # RandomApply: p=1 always applies, p=0 never
+    always = T.RandomApply(T.CropResize(0, 0, 16, 16), p=1.0)
+    assert always(img).shape == (16, 16, 3)
+    never = T.RandomApply(T.CropResize(0, 0, 16, 16), p=0.0)
+    assert never(img).shape == (32, 32, 3)
+
+
+def test_transforms_hybrid_random_apply_cond():
+    """HybridRandomApply: device-side coin + lax.cond branch — shapes
+    must match between branches (the reference F.contrib.cond contract),
+    so use a shape-preserving hybrid transform."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    class Scale(mx.gluon.HybridBlock):
+        def forward(self, x):
+            return x * 2.0
+
+    img = mx.nd.array(onp.ones((4, 4, 3), "float32"))
+    seen = set()
+    for i in range(20):
+        out = T.HybridRandomApply(Scale(), p=0.5)(img).asnumpy()
+        seen.add(float(out.ravel()[0]))
+    assert seen <= {1.0, 2.0} and len(seen) == 2
+    with pytest.raises(AssertionError):
+        T.HybridRandomApply(T.ToTensor(), p=0.5)
