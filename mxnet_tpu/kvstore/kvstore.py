@@ -383,17 +383,25 @@ class KVStoreDist(KVStoreTPU):
         # size — the reference slices because ps-lite messages cannot)
         thresh = int(get_env("MXNET_KVSTORE_SLICE_THRESHOLD", 4 << 20,
                              int))
-        buckets, cur, cur_n, cur_dt = [], [], 0, None
+        # group by dtype FIRST (not by adjacency: an interleaved
+        # f32/i32/f32 list must still form one bucket per dtype), then
+        # split oversize groups at the threshold. Deterministic across
+        # workers: dict insertion order follows the shared key order.
+        by_dtype: Dict[str, list] = {}
         for item in dense:
-            arr = item[3]
-            if cur and (arr.dtype != cur_dt or cur_n + arr.size > thresh):
+            by_dtype.setdefault(str(item[3].dtype), []).append(item)
+        buckets = []
+        for items in by_dtype.values():
+            cur, cur_n = [], 0
+            for item in items:
+                arr = item[3]
+                if cur and cur_n + arr.size > thresh:
+                    buckets.append(cur)
+                    cur, cur_n = [], 0
+                cur.append(item)
+                cur_n += arr.size
+            if cur:
                 buckets.append(cur)
-                cur, cur_n = [], 0
-            cur.append(item)
-            cur_n += arr.size
-            cur_dt = arr.dtype
-        if cur:
-            buckets.append(cur)
 
         pending = []
         for b in buckets:

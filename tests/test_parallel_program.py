@@ -1,0 +1,216 @@
+"""Program-level (HLO) regression guards for the parallel paths.
+
+The round-3 AMP episode proved value-level tests cannot catch a silent
+efficiency regression: numerics stay right while the compiled program
+quietly does the wrong thing (f32-width activations then; per-parameter
+collectives or unsharded matmuls next). These tests pin the COMPILED
+PROGRAM structure the way tests/test_amp_program.py pins dtype flow:
+
+1. the 8-device DP train step's gradient reduction compiles to a small
+   number of *combined* all-reduces — one tuple all-reduce carrying the
+   whole gradient set — not one collective per parameter (the contract
+   the reference's kvstore comm layer exists for,
+   include/mxnet/kvstore.h:129-141 ordering + ps-lite batching);
+2. the TP leg actually shards the matmul: per-device dot shapes are the
+   tp-fraction of the logical shapes and the backward contraction over
+   the sharded axis emits a collective;
+3. the dist-kvstore cross-worker reduction program is exactly ONE
+   all-reduce over the bucketed 1-D buffer (the program
+   KVStoreDist._dispatch_sum jits), and pushpull_list dispatches exactly
+   one such buffer per dtype bucket.
+
+All run on the conftest's virtual 8-device CPU mesh; GSPMD emits the
+same collective structure XLA would emit on an ICI-connected TPU slice.
+"""
+import re
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def _hlo_lines(txt, op):
+    return [l for l in txt.splitlines() if f" {op}(" in l or f"{op}(" in l
+            and "=" in l]
+
+
+def _all_reduce_lines(txt):
+    return [l for l in txt.splitlines() if re.search(r"all-reduce(\.\d+)?\(", l)
+            and "=" in l]
+
+
+def _tuple_arity(line):
+    """Number of tensors in an all-reduce's result tuple (1 if untupled)."""
+    m = re.search(r"=\s+\((.*?)\)\s+all-reduce", line)
+    if not m:
+        return 1
+    return m.group(1).count("[")
+
+
+def _compile_dp_step(net, in_shape, n_dp=8, bs=16, classes=8):
+    from __graft_entry__ import make_train_step, _init_net
+
+    onp.random.seed(0)
+    params = _init_net(net, (1,) + in_shape)
+    mesh = Mesh(onp.array(jax.devices()[:n_dp]), ("dp",))
+    step_fn = make_train_step(net, params, lr=0.1)
+    repl = NamedSharding(mesh, P())
+    p_shard = tuple(repl for _ in params)
+    step = jax.jit(step_fn,
+                   in_shardings=(p_shard, p_shard,
+                                 NamedSharding(mesh, P("dp")),
+                                 NamedSharding(mesh, P("dp")), repl),
+                   donate_argnums=(0, 1))
+    pd = tuple(jax.device_put(p._data._data, s)
+               for p, s in zip(params, p_shard))
+    mom = tuple(jax.device_put(jnp.zeros_like(d), s)
+                for d, s in zip(pd, p_shard))
+    x = jax.device_put(
+        jnp.asarray(onp.random.uniform(size=(bs,) + in_shape)
+                    .astype("float32")), NamedSharding(mesh, P("dp")))
+    y = jax.device_put(
+        jnp.asarray(onp.random.randint(0, classes, size=(bs,))
+                    .astype("int32")), NamedSharding(mesh, P("dp")))
+    key = jax.random.PRNGKey(0)
+    txt = step.lower(pd, mom, x, y, key).compile().as_text()
+    return txt, len(params)
+
+
+def test_dp_gradient_allreduces_are_combined_mlp():
+    """26-parameter MLP, dp=8: the gradient reduction must compile to a
+    SINGLE combined tuple all-reduce (plus at most a couple of scalar
+    reductions for the loss), never one collective per parameter."""
+    net = nn.HybridSequential()
+    for _ in range(12):
+        net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(8))
+    txt, n_params = _compile_dp_step(net, (32,))
+    assert n_params >= 20
+    ars = _all_reduce_lines(txt)
+    assert len(ars) <= 4, (
+        f"{len(ars)} all-reduces for {n_params} params — gradient "
+        "bucketing regressed to (near-)per-parameter collectives:\n"
+        + "\n".join(l[:120] for l in ars))
+    # the combined bucket: one tuple all-reduce carrying >= 20 tensors
+    assert max(_tuple_arity(l) for l in ars) >= 20, (
+        "no combined gradient all-reduce found:\n"
+        + "\n".join(l[:120] for l in ars))
+
+
+@pytest.mark.slow
+def test_dp_gradient_allreduces_are_combined_resnet18():
+    """ResNet-18, dp=8 (the dryrun's DP leg at model scale): BatchNorm
+    emits inherent per-layer statistics all-reduces, but the parameter-
+    gradient reduction must still combine — total collective count stays
+    well under one-per-parameter, and one tuple all-reduce carries the
+    bulk of the weight gradients."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=16)
+    txt, n_params = _compile_dp_step(net, (3, 32, 32), classes=16)
+    ars = _all_reduce_lines(txt)
+    assert n_params >= 100
+    assert len(ars) < n_params, (
+        f"{len(ars)} all-reduces >= {n_params} params: per-parameter "
+        "collectives are back")
+    assert max(_tuple_arity(l) for l in ars) >= 15, \
+        "combined weight-gradient all-reduce is gone"
+
+
+def test_tp_dense_matmul_is_sharded():
+    """Dense(1024) with weight P('tp', None) over tp=8: every dot in the
+    compiled step must run on the 1/8 weight shard (f32[128,512]), the
+    full-size dot must be absent, and the backward contraction over the
+    sharded axis must emit a collective."""
+    from __graft_entry__ import make_train_step, _init_net
+
+    onp.random.seed(0)
+    net = nn.Dense(1024, in_units=512)
+    params = _init_net(net, (1, 512))
+    mesh = Mesh(onp.array(jax.devices()), ("tp",))
+    step_fn = make_train_step(net, params, lr=0.1)
+    shards = tuple(
+        NamedSharding(mesh, P("tp") if len(p._data.shape) == 1
+                      else P("tp", None)) for p in params)
+    repl = NamedSharding(mesh, P())
+    step = jax.jit(step_fn, in_shardings=(shards, shards, repl, repl, repl),
+                   donate_argnums=(0, 1))
+    pd = tuple(jax.device_put(p._data._data, s)
+               for p, s in zip(params, shards))
+    mom = tuple(jax.device_put(jnp.zeros_like(d), s)
+                for d, s in zip(pd, shards))
+    x = jax.device_put(jnp.asarray(
+        onp.random.uniform(size=(4, 512)).astype("float32")), repl)
+    y = jax.device_put(jnp.zeros((4,), jnp.int32), repl)
+    txt = step.lower(pd, mom, x, y, jax.random.PRNGKey(0)).compile().as_text()
+
+    dots = [l for l in txt.splitlines() if re.search(r"=.* dot\(", l)]
+    assert dots, "no dot ops in compiled TP step"
+    assert not any("f32[1024,512]" in l for l in dots), (
+        "full-size weight matmul present — TP sharding silently "
+        "regressed to replicated compute")
+    assert any("f32[4,128]" in l or "f32[128,512]" in l for l in dots), (
+        "no tp-fraction dot shapes found:\n"
+        + "\n".join(l[:120] for l in dots))
+    n_coll = sum(len(_hlo_lines(txt, op)) for op in
+                 ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute"))
+    assert n_coll >= 1, "sharded-contraction collective missing"
+
+
+def test_kvstore_dispatch_sum_program_is_one_allreduce():
+    """The program KVStoreDist._dispatch_sum jits — sum over the worker
+    axis of a (num_workers, N) bucketed buffer, replicated output — must
+    compile to exactly ONE all-reduce (simulated here with 8 local
+    devices standing in for 8 workers; same GSPMD partitioning)."""
+    mesh = Mesh(onp.array(jax.devices()), ("worker",))
+    fn = jax.jit(lambda a: jnp.sum(a, axis=0),
+                 out_shardings=NamedSharding(mesh, P()))
+    gshape = (8, 4096)
+    arg = jax.ShapeDtypeStruct(
+        gshape, jnp.float32,
+        sharding=NamedSharding(mesh, P("worker")))
+    txt = fn.lower(arg).compile().as_text()
+    ars = _all_reduce_lines(txt)
+    assert len(ars) == 1, (
+        f"expected exactly 1 all-reduce, got {len(ars)}:\n"
+        + "\n".join(l[:120] for l in ars))
+    assert "4096" in ars[0], ars[0]
+
+
+def test_pushpull_list_one_dispatch_per_dtype_bucket():
+    """pushpull_list must hand _dispatch_sum exactly one flattened 1-D
+    buffer per dtype bucket — the program-dispatch contract behind the
+    wall-clock numbers test_dist_kvstore checks."""
+    kv = mx.kvstore.create("dist_sync")
+    kv._force_fuse = True
+    seen = []
+    orig = kv._dispatch_sum
+
+    def spy(buf):
+        seen.append((buf.ndim, str(buf.dtype), buf.size))
+        return orig(buf)
+
+    kv._dispatch_sum = spy
+    vals = [mx.nd.array(onp.ones((4, 3), "float32")),
+            mx.nd.array(onp.full((7,), 2, "int32")),
+            mx.nd.array(onp.ones((2, 5), "float32")),
+            mx.nd.array(onp.full((3,), 4, "int32"))]
+    kv.pushpull_list([0, 1, 2, 3], vals)
+    assert len(seen) == 2, seen  # one bucket per dtype
+    by_dtype = {d: n for nd_, d, n in seen}
+    assert all(nd_ == 1 for nd_, _, _ in seen), seen  # flattened buffers
+    assert by_dtype["float32"] == 4 * 3 + 2 * 5
+    assert by_dtype["int32"] == 7 + 3
+    # values still correct through the spied path
+    onp.testing.assert_allclose(vals[0].asnumpy(), onp.ones((4, 3)))
+    onp.testing.assert_array_equal(vals[1].asnumpy(), onp.full((7,), 2))
