@@ -1001,6 +1001,8 @@ def delete(arr, obj, axis=None):
     a = arr if isinstance(arr, NDArray) else array(arr)
     if isinstance(obj, NDArray):
         obj = onp.asarray(obj.asnumpy(), dtype=onp.int32)
+    elif isinstance(obj, (list, tuple)):  # numpy accepts index lists
+        obj = onp.asarray(obj, dtype=onp.int32)
     return _invoke("delete", lambda x: jnp.delete(x, obj, axis=axis), [a])
 
 

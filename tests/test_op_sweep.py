@@ -1,0 +1,711 @@
+"""Registry-wide op numerics sweep (VERDICT r4 item 5).
+
+Auto-parametrized golden sweep over EVERY ``mx.np`` function that has an
+official-NumPy analog: each op runs on synthetic inputs — including
+0-size and broadcast edge shapes — and must match ``numpy`` bit-for-bit
+modulo float tolerance; differentiable elementwise ops additionally get
+a central-finite-difference gradient check against the autograd vjp.
+
+Self-auditing: ``test_sweep_covers_namespace`` fails when a new np
+function appears that is neither covered here nor in the documented
+``EXCLUDED`` ledger, and asserts the exclusion list stays shorter than
+the covered list.
+
+Reference analog: the breadth intent of
+tests/python/unittest/test_operator.py + test_numpy_op.py (19.5k LoC,
+SURVEY §4) — matched by generation rather than enumeration.
+"""
+import builtins
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.numpy as np
+from mxnet_tpu import autograd
+
+# ---------------------------------------------------------------------------
+# input pools (deterministic per shape+seed)
+# ---------------------------------------------------------------------------
+
+
+def _rs(shape, seed=0):
+    return onp.random.RandomState(abs(hash((shape, seed))) % (2 ** 31))
+
+
+def real(shape, seed=0):
+    return onp.asarray(_rs(shape, seed).randn(*shape)).astype("float32")
+
+
+def pos(shape, seed=0):
+    return onp.asarray(_rs(shape, seed).uniform(0.5, 2.0, shape)).astype(
+        "float32")
+
+
+def unit(shape, seed=0):
+    return onp.asarray(_rs(shape, seed).uniform(-0.9, 0.9, shape)).astype(
+        "float32")
+
+
+def gt1(shape, seed=0):
+    return onp.asarray(_rs(shape, seed).uniform(1.1, 3.0, shape)).astype(
+        "float32")
+
+
+def away0(shape, seed=0):
+    r = _rs(shape, seed)
+    return onp.asarray(r.choice([-1.0, 1.0], shape)
+                       * r.uniform(0.25, 2.0, shape)).astype("float32")
+
+
+def awayint(shape, seed=0):
+    """Reals away from integers and half-integers (safe for floor/round)."""
+    r = _rs(shape, seed)
+    return onp.asarray(r.randint(-3, 3, shape)
+                       + r.uniform(0.1, 0.4, shape)).astype("float32")
+
+
+def ints(shape, seed=0, lo=0, hi=5):
+    return onp.asarray(_rs(shape, seed).randint(lo, hi, shape)).astype(
+        "int32")
+
+
+def posints(shape, seed=0):
+    return onp.asarray(_rs(shape, seed).randint(1, 7, shape)).astype(
+        "int32")
+
+
+def bools(shape, seed=0):
+    return onp.asarray(_rs(shape, seed).rand(*shape)) > 0.5
+
+
+def with_nans(shape, seed=0):
+    a = real(shape, seed)
+    if a.size:
+        a.flat[:: max(a.size // 3, 1)] = onp.nan
+    return a
+
+
+SHAPES_U = [(3, 4), (6,), (0,), (2, 0, 3)]
+SHAPES_B = [((3, 4), (3, 4)), ((3, 1), (1, 4)), ((6,), ()),
+            ((0, 4), (1, 4))]
+
+# ---------------------------------------------------------------------------
+# category tables
+# ---------------------------------------------------------------------------
+
+# name -> (input pool, grad-checkable)
+UNARY = {
+    "abs": (away0, True), "absolute": (away0, True), "fabs": (away0, False),
+    "negative": (real, True), "positive": (real, True),
+    "sign": (away0, False), "signbit": (away0, False),
+    "sqrt": (pos, True), "cbrt": (pos, True), "square": (real, True),
+    "reciprocal": (away0, True),
+    "exp": (unit, True), "exp2": (unit, True), "expm1": (unit, True),
+    "log": (pos, True), "log10": (pos, True), "log1p": (pos, True),
+    "log2": (pos, True),
+    "sin": (real, True), "cos": (real, True), "tan": (unit, True),
+    "sinh": (unit, True), "cosh": (unit, True), "tanh": (unit, True),
+    "arcsin": (unit, True), "arccos": (unit, True), "arctan": (real, True),
+    "arcsinh": (real, True), "arccosh": (gt1, True), "arctanh": (unit, True),
+    "degrees": (real, True), "radians": (real, True),
+    "deg2rad": (real, True), "rad2deg": (real, True),
+    "rint": (awayint, False), "fix": (awayint, False),
+    "floor": (awayint, False), "ceil": (awayint, False),
+    "trunc": (awayint, False),
+    "conj": (real, False), "conjugate": (real, False),
+    "real": (real, False), "imag": (real, False), "angle": (pos, False),
+    "i0": (unit, False), "sinc": (away0, True), "spacing": (away0, False),
+    "isfinite": (real, False), "isinf": (real, False),
+    "isnan": (with_nans, False),
+    "isneginf": (real, False), "isposinf": (real, False),
+    "logical_not": (bools, False),
+    "nan_to_num": (with_nans, False), "copy": (real, False),
+    "cumsum": (real, True), "cumprod": (pos, True),
+    "nancumsum": (with_nans, False), "nancumprod": (with_nans, False),
+    "flatnonzero": (away0, False),
+    "unwrap": (real, False),
+}
+
+UNARY_INT = {"invert": ints, "bitwise_not": ints}
+
+# name -> (pool_a, pool_b, grad-checkable)
+BINARY = {
+    "add": (real, real, True), "subtract": (real, real, True),
+    "multiply": (real, real, True),
+    "divide": (real, away0, True), "true_divide": (real, away0, True),
+    "floor_divide": (awayint, away0, False),
+    "mod": (awayint, away0, False), "remainder": (awayint, away0, False),
+    "fmod": (awayint, away0, False),
+    "power": (pos, real, True), "float_power": (pos, real, False),
+    "arctan2": (away0, away0, True), "hypot": (away0, away0, True),
+    "maximum": (real, real, True), "minimum": (real, real, True),
+    "fmax": (real, real, False), "fmin": (real, real, False),
+    "copysign": (away0, away0, False),
+    "nextafter": (real, real, False),
+    "logaddexp": (unit, unit, True), "logaddexp2": (unit, unit, False),
+    "heaviside": (away0, pos, False),
+    "logical_and": (bools, bools, False),
+    "logical_or": (bools, bools, False),
+    "logical_xor": (bools, bools, False),
+    "equal": (ints, ints, False), "not_equal": (ints, ints, False),
+    "greater": (real, real, False), "greater_equal": (real, real, False),
+    "less": (real, real, False), "less_equal": (real, real, False),
+}
+
+BINARY_INT = {
+    "gcd": posints, "lcm": posints,
+    "bitwise_and": ints, "bitwise_or": ints, "bitwise_xor": ints,
+}
+
+# reductions: name -> (pool, kwargs variants, supports 0-size)
+_AX = [{}, {"axis": 0}, {"axis": -1, "keepdims": True}]
+REDUCTIONS = {
+    "sum": (real, _AX, True), "prod": (pos, _AX, True),
+    "mean": (real, _AX, False), "std": (real, _AX, False),
+    "var": (real, _AX, False),
+    "amax": (real, _AX, False), "amin": (real, _AX, False),
+    "max": (real, _AX, False), "min": (real, _AX, False),
+    "ptp": (real, [{}, {"axis": 0}], False),
+    "median": (real, [{}, {"axis": 0}], False),
+    "average": (real, [{}, {"axis": 0}], False),
+    "argmax": (real, [{}, {"axis": 0}], False),
+    "argmin": (real, [{}, {"axis": 0}], False),
+    "all": (bools, _AX, True), "any": (bools, _AX, True),
+    "count_nonzero": (away0, [{}, {"axis": 0}], True),
+    "nanmax": (with_nans, [{}], False), "nanmin": (with_nans, [{}], False),
+    "nansum": (with_nans, _AX, True), "nanprod": (with_nans, _AX, True),
+    "nanmean": (with_nans, [{}], False),
+    "nanmedian": (with_nans, [{}], False),
+    "nanargmax": (with_nans, [{}], False),
+    "nanargmin": (with_nans, [{}], False),
+    "trace": (real, [{}], False),
+}
+
+# literal cases: name -> list of thunks returning (args, kwargs);
+# onp.ndarray args are converted for the mx call automatically
+LITERAL = {
+    # creation
+    "arange": [lambda: ((5,), {}), lambda: ((2, 11, 3), {}),
+               lambda: ((0,), {}), lambda: ((0.5, 2.5, 0.5), {})],
+    "eye": [lambda: ((4,), {}), lambda: ((3, 5), {}),
+            lambda: ((3, 3), {"k": 1})],
+    "identity": [lambda: ((4,), {})],
+    "full": [lambda: (((2, 3), 7.0), {}), lambda: (((0,), 1.0), {})],
+    "full_like": [lambda: ((real((2, 3)), 7.0), {})],
+    "ones": [lambda: (((2, 3),), {}), lambda: (((0, 2),), {})],
+    "zeros": [lambda: (((2, 3),), {}), lambda: (((0,),), {})],
+    "ones_like": [lambda: ((real((2, 3)),), {})],
+    "zeros_like": [lambda: ((real((2, 3)),), {})],
+    "linspace": [lambda: ((0.0, 1.0, 7), {}),
+                 lambda: ((0.0, 1.0, 5), {"endpoint": False})],
+    "logspace": [lambda: ((0.0, 2.0, 5), {})],
+    "geomspace": [lambda: ((1.0, 8.0, 4), {})],
+    "meshgrid": [lambda: ((real((3,)), real((4,))), {}),
+                 lambda: ((real((3,)), real((4,))), {"indexing": "ij"})],
+    "indices": [lambda: (((2, 3),), {})],
+    "tri": [lambda: ((4,), {}), lambda: ((3, 5), {"k": -1})],
+    "vander": [lambda: ((real((4,)),), {}),
+               lambda: ((real((4,)), 3), {})],
+    # windows
+    "bartlett": [lambda: ((7,), {})],
+    "blackman": [lambda: ((7,), {})],
+    "hamming": [lambda: ((7,), {})],
+    "hanning": [lambda: ((7,), {})],
+    "kaiser": [lambda: ((7, 8.6), {})],
+    # round with decimals
+    "round": [lambda: ((awayint((3, 4)),), {}),
+              lambda: ((real((3, 4)) * 10, 1), {})],
+    "around": [lambda: ((awayint((3, 4)),), {})],
+    "clip": [lambda: ((real((3, 4)), -0.5, 0.5), {}),
+             lambda: ((real((0,)), -0.5, 0.5), {})],
+}
+
+# ---- shape / manipulation ----
+def _taa_case():
+    x = real((3, 4))
+    return ((x, onp.argsort(x, axis=1), 1), {})
+
+
+def _piecewise_case():
+    x = real((6,))
+    return ((x, [x < 0, x >= 0], [-1.0, 1.0]), {})
+
+
+def _select_case():
+    x = real((6,))
+    return (([x < -0.5, x > 0.5], [x * 2, x * 3], 0.0), {})
+
+
+LITERAL.update({
+    "reshape": [lambda: ((real((3, 4)), (2, 6)), {}),
+                lambda: ((real((3, 4)), (-1,)), {}),
+                lambda: ((real((0, 4)), (4, 0)), {})],
+    "ravel": [lambda: ((real((3, 4)),), {}), lambda: ((real((0,)),), {})],
+    "transpose": [lambda: ((real((3, 4)),), {}),
+                  lambda: ((real((2, 3, 4)), (2, 0, 1)), {})],
+    "swapaxes": [lambda: ((real((2, 3, 4)), 0, 2), {})],
+    "moveaxis": [lambda: ((real((2, 3, 4)), 0, -1), {})],
+    "rollaxis": [lambda: ((real((2, 3, 4)), 2), {})],
+    "expand_dims": [lambda: ((real((2, 3)), 1), {}),
+                    lambda: ((real((0, 3)), 0), {})],
+    "squeeze": [lambda: ((real((2, 1, 3)),), {}),
+                lambda: ((real((2, 1, 3)), 1), {})],
+    "broadcast_to": [lambda: ((real((3, 1)), (3, 4)), {})],
+    "broadcast_arrays": [lambda: ((real((3, 1)), real((1, 4), 1)), {})],
+    "atleast_1d": [lambda: ((real((2, 3)),), {}),
+                   lambda: ((onp.float32(3.0),), {})],
+    "atleast_2d": [lambda: ((real((3,)),), {})],
+    "atleast_3d": [lambda: ((real((3, 4)),), {})],
+    "concatenate": [lambda: (([real((2, 3)), real((3, 3), 1)],),
+                             {"axis": 0}),
+                    lambda: (([real((2, 0)), real((2, 3), 1)],),
+                             {"axis": 1})],
+    "concat": [lambda: (([real((2, 3)), real((3, 3), 1)],), {"axis": 0})],
+    "stack": [lambda: (([real((2, 3)), real((2, 3), 1)],), {}),
+              lambda: (([real((2, 3)), real((2, 3), 1)],), {"axis": -1})],
+    "vstack": [lambda: (([real((2, 3)), real((1, 3), 1)],), {})],
+    "hstack": [lambda: (([real((2, 3)), real((2, 1), 1)],), {})],
+    "dstack": [lambda: (([real((2, 3)), real((2, 3), 1)],), {})],
+    "column_stack": [lambda: (([real((4,)), real((4,), 1)],), {})],
+    "row_stack": [lambda: (([real((2, 3)), real((1, 3), 1)],), {})],
+    "split": [lambda: ((real((6, 2)), 3), {}),
+              lambda: ((real((6, 2)), [2, 4]), {})],
+    "array_split": [lambda: ((real((7, 2)), 3), {})],
+    "hsplit": [lambda: ((real((2, 6)), 2), {})],
+    "vsplit": [lambda: ((real((6, 2)), 3), {})],
+    "dsplit": [lambda: ((real((2, 3, 4)), 2), {})],
+    "tile": [lambda: ((real((2, 3)), (2, 2)), {}),
+             lambda: ((real((3,)), 2), {})],
+    "repeat": [lambda: ((real((3, 4)), 2), {}),
+               lambda: ((real((3, 4)), 3), {"axis": 1})],
+    "flip": [lambda: ((real((3, 4)),), {}),
+             lambda: ((real((3, 4)), 1), {})],
+    "fliplr": [lambda: ((real((3, 4)),), {})],
+    "flipud": [lambda: ((real((3, 4)),), {})],
+    "roll": [lambda: ((real((3, 4)), 2), {}),
+             lambda: ((real((3, 4)), 1, 0), {})],
+    "rot90": [lambda: ((real((3, 4)),), {}),
+              lambda: ((real((3, 4)), 2), {})],
+    "append": [lambda: ((real((3,)), real((2,), 1)), {}),
+               lambda: ((real((2, 3)), real((1, 3), 1)), {"axis": 0})],
+    "delete": [lambda: ((real((5,)), 1), {}),
+               lambda: ((real((5, 3)), [0, 2]), {"axis": 0})],
+    "insert": [lambda: ((real((5,)), 2, 9.0), {}),
+               lambda: ((real((3, 4)), 1, 5.0), {"axis": 1})],
+    "resize": [lambda: ((real((3, 4)), (2, 6)), {}),
+               lambda: ((real((2,)), (5,)), {})],
+    "pad": [lambda: ((real((3, 4)), 1), {}),
+            lambda: ((real((4,)), (1, 2)), {"mode": "edge"})],
+    "trim_zeros": [lambda: ((onp.array([0., 0., 1., 2., 0.], "float32"),),
+                            {})],
+    "diag": [lambda: ((real((4,)),), {}),
+             lambda: ((real((3, 4)),), {"k": 1})],
+    "diagflat": [lambda: ((real((2, 2)),), {})],
+    "diagonal": [lambda: ((real((3, 4)),), {}),
+                 lambda: ((real((3, 4)),), {"offset": 1})],
+    "diag_indices_from": [lambda: ((real((4, 4)),), {})],
+    "tril": [lambda: ((real((4, 4)),), {}),
+             lambda: ((real((3, 5)),), {"k": -1})],
+    "triu": [lambda: ((real((4, 4)),), {}),
+             lambda: ((real((3, 5)),), {"k": 1})],
+    "tril_indices": [lambda: ((4,), {}), lambda: ((3,), {"k": 1})],
+    "triu_indices": [lambda: ((4,), {})],
+    "tril_indices_from": [lambda: ((real((4, 4)),), {})],
+    "triu_indices_from": [lambda: ((real((4, 4)),), {})],
+    "diff": [lambda: ((real((6,)),), {}),
+             lambda: ((real((3, 4)),), {"n": 2, "axis": 1})],
+    "ediff1d": [lambda: ((real((5,)),), {})],
+    "gradient": [lambda: ((real((6,)),), {}),
+                 lambda: ((real((3, 4)),), {})],
+    "unravel_index": [],  # CUSTOM: deliberate stacked-rows deviation
+    "ix_": [lambda: ((ints((3,)), ints((2,), 1)), {})],
+})
+
+# ---- indexing / search / sort / sets ----
+LITERAL.update({
+    "sort": [lambda: ((real((6,)),), {}),
+             lambda: ((real((3, 4)),), {"axis": 0})],
+    "argsort": [lambda: ((real((6,)),), {}),
+                lambda: ((real((3, 4)),), {"axis": 1})],
+    "lexsort": [lambda: (((real((8,)),),), {})],
+    "searchsorted": [lambda: ((onp.sort(real((8,))), real((5,), 1)), {}),
+                     lambda: ((onp.sort(real((8,))), real((5,), 1)),
+                              {"side": "right"})],
+    "nonzero": [lambda: ((away0((3, 4)) * bools((3, 4), 2),), {})],
+    "argwhere": [lambda: ((bools((3, 4)),), {})],
+    "where": [lambda: ((bools((3, 4)), real((3, 4)), real((3, 4), 1)), {}),
+              lambda: ((bools((3, 4)),), {})],
+    "take": [lambda: ((real((5,)), ints((3,), 0, 0, 5)), {}),
+             lambda: ((real((3, 4)), ints((2,), 1, 0, 3)), {"axis": 0})],
+    "take_along_axis": [_taa_case],
+    "choose": [lambda: ((ints((4,), 0, 0, 3),
+                         [real((4,)), real((4,), 1), real((4,), 2)]), {})],
+    "compress": [lambda: ((bools((5,)), real((5, 2)), 0), {})],
+    "extract": [lambda: ((bools((4, 3)), real((4, 3))), {})],
+    "select": [_select_case],
+    "piecewise": [_piecewise_case],
+    "digitize": [lambda: ((real((6,)),
+                           onp.array([-1., 0., 1.], "float32")), {})],
+    "bincount": [lambda: ((ints((10,)),), {}),
+                 lambda: ((ints((10,)),),
+                          {"weights": real((10,)), "minlength": 8})],
+    "unique": [lambda: ((ints((10,)),), {}),
+               lambda: ((ints((10,)),), {"return_counts": True})],
+    "in1d": [lambda: ((ints((6,)), ints((3,), 1)), {})],
+    "isin": [lambda: ((ints((2, 3)), ints((3,), 1)), {})],
+    "intersect1d": [lambda: ((ints((6,)), ints((6,), 1)), {})],
+    "union1d": [lambda: ((ints((5,)), ints((5,), 1)), {})],
+    "setdiff1d": [lambda: ((ints((6,)), ints((4,), 1)), {})],
+    "setxor1d": [lambda: ((ints((6,)), ints((6,), 1)), {})],
+    "count_nonzero": [lambda: ((away0((3, 4)) * bools((3, 4), 2),), {})],
+    "histogram": [lambda: ((real((20,)),), {}),
+                  lambda: ((real((20,)),),
+                           {"bins": 5, "range": (-2.0, 2.0)})],
+    "histogram_bin_edges": [lambda: ((real((20,)),), {"bins": 5})],
+    "histogram2d": [lambda: ((real((20,)), real((20,), 1)),
+                             {"bins": 4})],
+    "histogramdd": [lambda: ((real((20, 3)),), {"bins": 3})],
+    "percentile": [lambda: ((real((10,)), 30.0), {}),
+                   lambda: ((real((3, 4)), [25.0, 75.0]), {"axis": 1})],
+    "quantile": [lambda: ((real((10,)), 0.3), {})],
+    "nanpercentile": [lambda: ((with_nans((10,)), 30.0), {})],
+    "nanquantile": [lambda: ((with_nans((10,)), 0.3), {})],
+})
+
+# ---- linalg-adjacent / signal / poly / misc ----
+LITERAL.update({
+    "dot": [lambda: ((real((3, 4)), real((4, 5), 1)), {}),
+            lambda: ((real((4,)), real((4,), 1)), {})],
+    "vdot": [lambda: ((real((3, 4)), real((3, 4), 1)), {})],
+    "inner": [lambda: ((real((3, 4)), real((5, 4), 1)), {})],
+    "outer": [lambda: ((real((3,)), real((4,), 1)), {})],
+    "matmul": [lambda: ((real((2, 3)), real((3, 4), 1)), {}),
+               lambda: ((real((2, 3, 4)), real((2, 4, 5), 1)), {})],
+    "tensordot": [lambda: ((real((2, 3, 4)), real((4, 3, 5), 1)),
+                           {"axes": ([1, 2], [1, 0])})],
+    "einsum": [lambda: (("ij,jk->ik", real((2, 3)), real((3, 4), 1)), {}),
+               lambda: (("bij->bji", real((2, 3, 4))), {})],
+    "kron": [lambda: ((real((2, 2)), real((2, 3), 1)), {})],
+    "cross": [lambda: ((real((4, 3)), real((4, 3), 1)), {})],
+    "convolve": [lambda: ((real((5,)), real((3,), 1)), {"mode": "same"}),
+                 lambda: ((real((5,)), real((3,), 1)), {"mode": "full"})],
+    "correlate": [lambda: ((real((5,)), real((3,), 1)), {"mode": "same"})],
+    "interp": [lambda: ((real((5,)), onp.sort(real((8,), 1)),
+                         real((8,), 2)), {})],
+    "trapz": [lambda: ((real((6,)),), {}),
+              lambda: ((real((6,)),), {"dx": 0.5})],
+    "corrcoef": [lambda: ((real((3, 8)),), {})],
+    "cov": [lambda: ((real((3, 8)),), {})],
+    "poly": [lambda: ((real((4,)),), {})],
+    "polyadd": [lambda: ((real((3,)), real((4,), 1)), {})],
+    "polysub": [lambda: ((real((3,)), real((4,), 1)), {})],
+    "polymul": [lambda: ((real((3,)), real((4,), 1)), {})],
+    "polydiv": [lambda: ((real((4,)), away0((2,), 1)), {})],
+    "polyval": [lambda: ((real((3,)), real((5,), 1)), {})],
+    "polyint": [lambda: ((real((4,)),), {})],
+    "polyfit": [lambda: ((onp.linspace(0, 1, 8, dtype="float32"),
+                          real((8,), 1), 2), {})],
+    "divmod": [lambda: ((awayint((3, 4)), away0((3, 4), 1)), {})],
+    "modf": [lambda: ((awayint((3, 4)),), {})],
+    "frexp": [lambda: ((away0((3, 4)),), {})],
+    "ldexp": [lambda: ((real((3, 4)), ints((3, 4), 1, -2, 3)), {})],
+    "left_shift": [lambda: ((ints((3, 4)), ints((3, 4), 1, 0, 3)), {})],
+    "right_shift": [lambda: ((ints((3, 4), 0, 0, 64),
+                              ints((3, 4), 1, 0, 3)), {})],
+    "packbits": [lambda: ((bools((12,)),), {})],
+    "unpackbits": [lambda: ((onp.array([7, 200], "uint8"),), {})],
+    "apply_along_axis": [lambda: ((lambda v: v.sum(), 0, real((3, 4))),
+                                  {})],
+    "apply_over_axes": [lambda: ((onp.sum, real((2, 3, 4)), [0, 2]), {})],
+    "fill_diagonal": [],  # covered by the CUSTOM validator below
+    "partition": [],      # CUSTOM (layout within partitions unspecified)
+    "argpartition": [],   # CUSTOM
+    "roots": [],          # CUSTOM (root ordering unspecified)
+})
+
+
+# custom validators for ops whose exact output layout numpy leaves
+# unspecified (partition order, root order) or that mutate in place
+def _check_partition():
+    a = real((8,))
+    k = 3
+    got = _to_host(np.partition(np.array(a), k))
+    want_sorted = onp.sort(a)
+    assert got[k] == want_sorted[k]
+    assert onp.all(onp.sort(got[:k]) <= got[k])
+    assert onp.all(onp.sort(got[k + 1:]) >= got[k])
+    onp.testing.assert_allclose(onp.sort(got), want_sorted, rtol=1e-6)
+
+
+def _check_argpartition():
+    a = real((8,))
+    k = 3
+    idx = _to_host(np.argpartition(np.array(a), k)).astype(int)
+    assert sorted(idx.tolist()) == list(range(8))
+    got = a[idx]
+    want_sorted = onp.sort(a)
+    assert got[k] == want_sorted[k]
+    assert onp.all(got[:k] <= got[k]) and onp.all(got[k + 1:] >= got[k])
+
+
+def _check_roots():
+    coeffs = onp.array([1.0, -3.0, 2.0], "float32")
+    got = onp.sort(onp.real(_to_host(np.roots(np.array(coeffs)))))
+    want = onp.sort(onp.real(onp.roots(coeffs)))
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def _check_fill_diagonal():
+    a = real((4, 4))
+    ma = np.array(a)
+    np.fill_diagonal(ma, 9.0)
+    onp.fill_diagonal(a, 9.0)
+    onp.testing.assert_allclose(_to_host(ma), a, rtol=1e-6)
+
+
+def _check_unravel_index():
+    """mx returns the coordinate rows STACKED into one array — the
+    reference's own deviation from numpy's tuple
+    (reference numpy/multiarray.py:7876); values must still match."""
+    idx = ints((4,), 0, 0, 11)
+    got = _to_host(np.unravel_index(np.array(idx), (3, 4)))
+    want = onp.stack(onp.unravel_index(idx, (3, 4)))
+    onp.testing.assert_array_equal(onp.asarray(got), want)
+
+
+CUSTOM = {
+    "partition": _check_partition,
+    "argpartition": _check_argpartition,
+    "roots": _check_roots,
+    "fill_diagonal": _check_fill_diagonal,
+    "unravel_index": _check_unravel_index,
+}
+
+# queries
+LITERAL.update({
+    "ndim": [lambda: ((real((2, 3)),), {})],
+    "shape": [lambda: ((real((2, 3)),), {})],
+    "size": [lambda: ((real((2, 3)),), {})],
+    "isscalar": [lambda: ((3.0,), {}), lambda: ((real((2,)),), {})],
+    "allclose": [lambda: ((real((3,)), real((3,)) + 1e-9), {}),
+                 lambda: ((real((3,)), real((3,), 1)), {})],
+    "isclose": [lambda: ((real((3,)), real((3,)) + 1e-9), {})],
+    "array_equal": [lambda: ((ints((3,)), ints((3,))), {}),
+                    lambda: ((ints((3,)), ints((3,), 1)), {})],
+    "array_equiv": [lambda: ((ints((3,)), ints((3,))), {})],
+})
+
+# ---------------------------------------------------------------------------
+# the documented exclusion ledger: name -> reason
+# ---------------------------------------------------------------------------
+EXCLUDED = {
+    # dtype/class objects and casting-table queries, not array ops
+    "bool": "dtype alias", "bool_": "dtype alias",
+    "complex64": "dtype alias", "complex128": "dtype alias",
+    "float16": "dtype alias", "float32": "dtype alias",
+    "float64": "dtype alias",
+    "int8": "dtype alias", "int16": "dtype alias", "int32": "dtype alias",
+    "int64": "dtype alias", "intc": "dtype alias",
+    "uint16": "dtype alias", "uint32": "dtype alias",
+    "uint64": "dtype alias", "uint8": "dtype alias",
+    "dtype": "dtype constructor", "finfo": "dtype query",
+    "iinfo": "dtype query",
+    "can_cast": "casting-table query, covered by test_dtype_parity",
+    "min_scalar_type": "casting-table query",
+    "promote_types": "casting-table query",
+    "result_type": "casting-table query",
+    "ndarray": "the array class itself",
+    "array": "constructor, exercised by every other case here",
+    "asarray": "constructor, exercised by every other case here",
+    "empty": "values uninitialized by contract — nothing to golden-check",
+    "empty_like": "values uninitialized by contract",
+    "may_share_memory": "host-memory introspection; mx arrays live on device",
+    "shares_memory": "host-memory introspection; mx arrays live on device",
+}
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+def _to_mx(x):
+    if isinstance(x, onp.ndarray):
+        return np.array(x)
+    if isinstance(x, tuple):
+        return tuple(_to_mx(v) for v in x)
+    if isinstance(x, list):
+        return [_to_mx(v) for v in x]
+    return x
+
+
+def _to_host(x):
+    if isinstance(x, np.ndarray):
+        return x.asnumpy()
+    if isinstance(x, (list, tuple)):
+        return [_to_host(v) for v in x]
+    return x
+
+
+def _compare(got, want, name):
+    got, want = _to_host(got), _to_host(want)
+    if isinstance(want, (list, tuple)):
+        assert isinstance(got, (list, tuple)) and len(got) == len(want), \
+            f"{name}: structure mismatch {got!r} vs {want!r}"
+        for g, w in zip(got, want):
+            _compare(g, w, name)
+        return
+    garr = onp.asarray(got)
+    warr = onp.asarray(want)
+    assert garr.shape == warr.shape, \
+        f"{name}: shape {garr.shape} != numpy {warr.shape}"
+    if warr.dtype == onp.bool_ or warr.dtype.kind in "iu":
+        onp.testing.assert_array_equal(garr, warr, err_msg=name)
+    else:
+        onp.testing.assert_allclose(
+            garr.astype("float64"), warr.astype("float64"),
+            rtol=2e-4, atol=1e-5, equal_nan=True, err_msg=name)
+
+
+def _run_cases(name, cases):
+    onp_fn = getattr(onp, name)
+    mx_fn = getattr(np, name)
+    for i, thunk in enumerate(cases):
+        args, kwargs = thunk()
+        want = onp_fn(*args, **kwargs)
+        got = mx_fn(*[_to_mx(a) for a in args],
+                    **{k: _to_mx(v) for k, v in kwargs.items()})
+        try:
+            _compare(got, want, f"{name} case {i}")
+        except AssertionError as e:
+            raise AssertionError(
+                f"{name} case {i}: args={args!r} kwargs={kwargs!r}\n{e}")
+
+
+def _case_table():
+    table = {}
+    for name, (pool, _) in UNARY.items():
+        table[name] = [
+            (lambda pool=pool, s=s: ((pool(s),), {})) for s in SHAPES_U]
+    for name, pool in UNARY_INT.items():
+        table[name] = [
+            (lambda pool=pool, s=s: ((pool(s),), {})) for s in SHAPES_U]
+    for name, (pa, pb, _) in BINARY.items():
+        table[name] = [
+            (lambda pa=pa, pb=pb, sa=sa, sb=sb:
+             ((pa(sa), pb(sb, 1)), {})) for sa, sb in SHAPES_B]
+    for name, pool in BINARY_INT.items():
+        table[name] = [
+            (lambda pool=pool, sa=sa, sb=sb:
+             ((pool(sa), pool(sb, 1)), {})) for sa, sb in SHAPES_B]
+    for name, (pool, variants, zero_ok) in REDUCTIONS.items():
+        shapes = [(3, 4), (2, 3, 4)] + ([(0, 4)] if zero_ok else [])
+        table[name] = [
+            (lambda pool=pool, s=s, kw=kw: ((pool(s),), dict(kw)))
+            for s in shapes for kw in variants]
+    for name, cases in LITERAL.items():
+        table.setdefault(name, []).extend(cases)
+    return table
+
+
+CASE_TABLE = _case_table()
+
+
+@pytest.mark.parametrize("name", sorted(CASE_TABLE), ids=str)
+def test_op_matches_numpy(name):
+    if name in CUSTOM:
+        CUSTOM[name]()
+    _run_cases(name, CASE_TABLE[name])
+
+
+# ---------------------------------------------------------------------------
+# gradient sweep: autograd vjp vs central finite differences
+# ---------------------------------------------------------------------------
+
+GRAD_UNARY = sorted(n for n, (_, g) in UNARY.items() if g)
+GRAD_BINARY = sorted(n for n, (_, _, g) in BINARY.items() if g)
+
+
+def _fd_check(name, pools, shapes):
+    mx_fn = getattr(np, name)
+    arrs = [pool(s, seed=7 + i) for i, (pool, s) in
+            enumerate(zip(pools, shapes))]
+    out_shape = onp.asarray(getattr(onp, name)(*arrs)).shape
+    w = onp.random.RandomState(11).randn(*out_shape).astype("float32")
+    weights = np.array(w)
+
+    xs = [np.array(a) for a in arrs]
+    for x in xs:
+        x.attach_grad()
+    with autograd.record():
+        out = mx_fn(*xs)
+        loss = (out * weights).sum()
+    loss.backward()
+
+    def f(hosts):
+        return float((mx_fn(*[np.array(h) for h in hosts])
+                      * weights).sum().asnumpy())
+
+    eps = 1e-2
+    rs = onp.random.RandomState(13)
+    for k, (a, x) in enumerate(zip(arrs, xs)):
+        grad = x.grad.asnumpy()
+        assert grad.shape == a.shape
+        n_probe = min(4, a.size)
+        idxs = rs.choice(a.size, size=n_probe, replace=False)
+        for flat in idxs:
+            ap = [v.copy() for v in arrs]
+            am = [v.copy() for v in arrs]
+            ap[k].flat[flat] += eps
+            am[k].flat[flat] -= eps
+            fd = (f(ap) - f(am)) / (2 * eps)
+            got = grad.flat[flat]
+            assert abs(got - fd) <= 5e-2 * max(abs(fd), abs(got), 1.0), (
+                f"{name}: d/dx[{k}].flat[{flat}] autograd={got} "
+                f"finite-diff={fd}")
+
+
+@pytest.mark.parametrize("name", GRAD_UNARY, ids=str)
+def test_unary_gradient_matches_finite_difference(name):
+    pool = UNARY[name][0]
+    _fd_check(name, [pool], [(2, 3)])
+
+
+@pytest.mark.parametrize("name", GRAD_BINARY, ids=str)
+def test_binary_gradient_matches_finite_difference(name):
+    pa, pb, _ = BINARY[name]
+    _fd_check(name, [pa, pb], [(2, 3), (2, 3)])
+    _fd_check(name, [pa, pb], [(2, 1), (1, 3)])  # broadcast grads
+
+
+# ---------------------------------------------------------------------------
+# completeness audit
+# ---------------------------------------------------------------------------
+
+
+def _namespace_universe():
+    out = set()
+    for n in dir(np):
+        if n.startswith("_"):
+            continue
+        f = getattr(np, n)
+        if callable(f) and hasattr(onp, n):
+            out.add(n)
+    return out
+
+
+def test_sweep_covers_namespace():
+    """Every np function with a numpy analog is either swept above or in
+    the documented EXCLUDED ledger — and the ledger stays shorter than
+    the covered list (VERDICT r4 'done' criterion)."""
+    universe = _namespace_universe()
+    covered = set(CASE_TABLE)
+    unaccounted = universe - covered - set(EXCLUDED)
+    assert not unaccounted, (
+        f"{len(unaccounted)} np functions neither swept nor excluded: "
+        f"{sorted(unaccounted)}")
+    stale = set(EXCLUDED) - universe
+    assert not stale, f"EXCLUDED entries no longer in namespace: {stale}"
+    assert len(EXCLUDED) < len(covered & universe), (
+        f"exclusion list ({len(EXCLUDED)}) must stay shorter than the "
+        f"covered list ({len(covered & universe)})")
