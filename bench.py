@@ -102,29 +102,33 @@ def compile_step(step_fn, *args):
 
 
 def matmul_roofline():
-    """Achieved bf16 GEMM TFLOP/s: best over several large matmul shapes
-    (8192³ underreports the chip by ~40% — round-3 data showed 12288³
-    sustaining 157 TFLOP/s, so the MFU denominator must probe for the
-    max). Skipped on CPU (meaningless there)."""
+    """Achieved bf16 GEMM TFLOP/s: best over several large matmul shapes.
+    8192³ underreports the chip by ~40%; the max lives at big-K
+    rectangular shapes where the output write is amortized (r5 measured:
+    8192x65536x8192 at 163 TFLOP/s = 83% of v5e peak vs 113 for 8192³).
+    Skipped on CPU (meaningless there)."""
     if jax.default_backend() == "cpu":
         return None
     best = None
-    for n in (8192, 12288, 16384):
-        # ~30 TFLOP of work per shape so each probe times comparably
-        iters = max(4, int(round(30 * (8192.0 / n) ** 3)))
-        a = jnp.asarray(onp.random.randn(n, n), jnp.bfloat16)
-        f = jax.jit(lambda a, c: a @ c)
-        c = f(a, a)
+    for m, k, n in ((8192, 8192, 8192), (12288, 12288, 12288),
+                    (8192, 65536, 8192), (16384, 32768, 16384)):
+        # ~35 TFLOP of work per shape so each probe times comparably
+        iters = max(3, int(round(35e12 / (2 * m * k * n))))
+        a = jnp.asarray(onp.random.randn(m, k), jnp.bfloat16)
+        b = jnp.asarray(onp.random.randn(k, n), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        c = f(a, b)
         _flush(c)
         t0 = time.perf_counter()
         for _ in range(iters):
-            c = f(a, c)
+            c = f(a, b)
         _flush(c)
         dt = time.perf_counter() - t0
-        tfs = 2 * n ** 3 * iters / dt / 1e12
-        log(f"bench: roofline probe n={n} iters={iters}: {tfs:.1f} TFLOP/s")
+        tfs = 2 * m * k * n * iters / dt / 1e12
+        log(f"bench: roofline probe {m}x{k}x{n} iters={iters}: "
+            f"{tfs:.1f} TFLOP/s")
         best = tfs if best is None or tfs > best else best
-        del a, c
+        del a, b, c
     return best
 
 

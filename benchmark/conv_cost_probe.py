@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Per-conv-shape XLA cost probe: for every distinct convolution in the
+ResNet-50 forward, compile THAT conv alone and compare XLA's counted
+flops against the algebraic 2*N*C_in*K_h*K_w per output element — the
+microscope for the program-level executed-vs-analytic multiplier
+(benchmark/flops_attrib.py).
+
+Usage: python benchmark/conv_cost_probe.py [bs]
+Appends results to benchmark/flops_attrib.json under "conv_probe".
+"""
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def parse_stablehlo_convs(txt):
+    """(lhs, rhs, out, strides, padding) for every stablehlo.convolution."""
+    convs = []
+    # stablehlo.convolution(%a, %b) ... {stride = [2, 2], pad = [[3, 3], [3, 3]]} ...
+    #   : (tensor<128x3x224x224xbf16>, tensor<64x3x7x7xbf16>) -> tensor<...>
+    pat = re.compile(
+        r"stablehlo\.convolution.*?window = \{([^}]*)\}.*?"
+        r":\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)\s*->\s*tensor<([^>]+)>")
+    for m in pat.finditer(txt):
+        win, lhs, rhs, out = m.groups()
+        sm = re.search(r"stride = \[([\d, ]+)\]", win)
+        stride = tuple(int(x) for x in sm.group(1).split(",")) if sm \
+            else (1, 1)
+        pm = re.search(r"pad = \[\[(\d+), (\d+)\], \[(\d+), (\d+)\]\]", win)
+        pad = tuple(int(x) for x in pm.groups()) if pm else (0, 0, 0, 0)
+
+        def dims(s):
+            parts = s.split("x")
+            return tuple(int(p) for p in parts[:-1]), parts[-1]
+        convs.append({"lhs": dims(lhs), "rhs": dims(rhs),
+                      "out": dims(out), "stride": stride, "pad": pad})
+    return convs
+
+
+def algebra_gflops(c):
+    (n, ci, h, w), _ = c["lhs"]
+    (co, cig, kh, kw), _ = c["rhs"]
+    (no, coo, ho, wo), _ = c["out"]
+    return 2.0 * no * coo * ho * wo * cig * kh * kw / 1e9
+
+
+def probe_xla_flops(c):
+    (n, ci, h, w), ldt = c["lhs"]
+    (co, cig, kh, kw), rdt = c["rhs"]
+    dt = jnp.bfloat16 if "bf16" in ldt else jnp.float32
+    a = jnp.zeros((n, ci, h, w), dt)
+    b = jnp.zeros((co, cig, kh, kw), dt)
+    pad = c["pad"]
+
+    def f(a, b):
+        return lax.conv_general_dilated(
+            a, b, window_strides=c["stride"],
+            padding=((pad[0], pad[1]), (pad[2], pad[3])),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=ci // cig)
+
+    comp = jax.jit(f).lower(a, b).compile()
+    ca = comp.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca.get("flops", 0.0)) / 1e9
+
+
+def main():
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from __graft_entry__ import _init_net, _functional_apply
+
+    onp.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    params = _init_net(net, (1, 3, 224, 224))
+    mx.amp.init()
+    try:
+        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
+        x = jnp.asarray(onp.random.uniform(
+            size=(bs, 3, 224, 224)).astype("float32"))
+        key = jax.random.PRNGKey(0)
+        fwd = _functional_apply(net, params, train=False)
+        txt = jax.jit(fwd).lower(pd, x, key).as_text()
+    finally:
+        mx.amp.uninit()
+
+    convs = parse_stablehlo_convs(txt)
+    print(f"{len(convs)} convolution sites in the forward", flush=True)
+    # dedup by full config
+    seen = {}
+    for c in convs:
+        k = json.dumps({k2: v for k2, v in c.items()}, sort_keys=True)
+        seen.setdefault(k, {"cfg": c, "n": 0})
+        seen[k]["n"] += 1
+
+    rows = []
+    tot_alg = tot_xla = 0.0
+    for e in seen.values():
+        c, n = e["cfg"], e["n"]
+        alg = algebra_gflops(c)
+        xla = probe_xla_flops(c)
+        rows.append({"lhs": c["lhs"][0], "rhs": c["rhs"][0],
+                     "out": c["out"][0], "stride": c["stride"], "n": n,
+                     "algebra_gflops": alg, "xla_gflops": xla,
+                     "ratio": xla / alg if alg else None})
+        tot_alg += n * alg
+        tot_xla += n * xla
+        print(f"n={n:2d} lhs={str(c['lhs'][0]):22s} rhs={str(c['rhs'][0]):20s}"
+              f" alg={alg:7.2f}G xla={xla:8.2f}G ratio={xla/alg:5.2f}",
+              flush=True)
+    print(f"TOTAL fwd conv: algebra={tot_alg:.1f}G xla_single_op_sum="
+          f"{tot_xla:.1f}G ratio={tot_xla/tot_alg:.3f}")
+
+    path = "benchmark/flops_attrib.json"
+    data = json.load(open(path)) if os.path.exists(path) else {}
+    data["conv_probe"] = {"bs": bs, "rows": rows,
+                          "total_algebra_gflops": tot_alg,
+                          "total_xla_gflops": tot_xla,
+                          "ratio": tot_xla / tot_alg}
+    json.dump(data, open(path, "w"), indent=1)
+    print("updated", path)
+
+
+if __name__ == "__main__":
+    main()
