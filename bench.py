@@ -248,6 +248,250 @@ def bench_bert(dtype):
     return {"tok_s": tok_s, "tflops": tfs}
 
 
+def bench_lstm(dtype):
+    """LSTM LM training throughput (BASELINE.md row 4: reference
+    example/rnn word_lm on the cuDNN RNN path; here gluon.rnn.LSTM
+    lowers to one lax.scan). Medium config: vocab 33278 (wikitext-2),
+    650-d embed/hidden, 2 layers, bs=64, bptt=35."""
+    import importlib.util
+    import mxnet_tpu as mx
+    from __graft_entry__ import make_train_step
+
+    spec = importlib.util.spec_from_file_location(
+        "train_lstm_lm",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "examples", "train_lstm_lm.py"))
+    ex = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ex)
+
+    on_accel = jax.default_backend() != "cpu"
+    vocab, embed, hidden, layers = (33278, 650, 650, 2) if on_accel \
+        else (128, 16, 32, 1)
+    bs, seq = (64, 35) if on_accel else (4, 8)
+    warmup, steps = (3, 20) if on_accel else (1, 2)
+    log(f"bench[lstm]: vocab={vocab} hidden={hidden} bs={bs} bptt={seq}")
+
+    onp.random.seed(0)
+    net = ex.WordLM(vocab, embed, hidden, layers)
+    net.initialize()
+    tokens = onp.random.randint(0, vocab, size=(1, seq)).astype("int32")
+    net(mx.nd.array(tokens))  # eager init pre-AMP (see bench_resnet note)
+    if dtype == "bf16":
+        mx.amp.init()
+    try:
+        params = [p for p in net.collect_params().values()
+                  if p._data is not None]
+        train_step = make_train_step(net, params, lr=0.5)
+
+        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
+        mom = tuple(jnp.zeros_like(d) for d in pd)
+        x = jnp.asarray(onp.random.randint(
+            0, vocab, size=(bs, seq)).astype("int32"))
+        y = jnp.asarray(onp.random.randint(
+            0, vocab, size=(bs, seq)).astype("int32"))
+        key = jax.random.PRNGKey(0)
+
+        step, flops = compile_step(train_step, pd, mom, x, y, key)
+        t0 = time.perf_counter()
+        for _ in range(warmup):
+            pd, mom, loss = step(pd, mom, x, y, key)
+        _flush(loss)
+        log(f"bench[lstm]: warmup {time.perf_counter() - t0:.1f}s, "
+            f"loss={float(loss):.3f}")
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pd, mom, loss = step(pd, mom, x, y, key)
+        _flush(loss)
+        dt = time.perf_counter() - t0
+    finally:
+        if dtype == "bf16":
+            mx.amp.uninit()
+    tok_s = bs * seq * steps / dt
+    tfs = flops * steps / dt / 1e12 if flops and on_accel else None
+    return {"tok_s": tok_s, "tflops": tfs}
+
+
+class _SSDResNet50:
+    """Builder for the SSD-ResNet50 bench model (BASELINE.md row 5):
+    resnet50_v1 features (minus global pool) + two extra downsample
+    scales, 3x3 cls/loc heads per scale, anchors via MultiBoxPrior —
+    the reference example/ssd architecture re-expressed in this Gluon."""
+
+    @staticmethod
+    def build(num_classes=20):
+        from mxnet_tpu import gluon, nd
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        SIZES = [(0.2, 0.272), (0.37, 0.447), (0.54, 0.619)]
+        RATIOS = (1.0, 2.0, 0.5)
+        A = len(SIZES[0]) + len(RATIOS) - 1
+
+        class SSD(gluon.Block):
+            def __init__(self):
+                super().__init__()
+                base = vision.resnet50_v1()
+                self.backbone = nn.Sequential()
+                feats = list(base.features._children.values())[:-1]
+                for blk in feats:
+                    self.backbone.add(blk)
+                self.extra1 = nn.Sequential()
+                self.extra1.add(nn.Conv2D(512, 3, strides=2, padding=1,
+                                          activation="relu"))
+                self.extra2 = nn.Sequential()
+                self.extra2.add(nn.Conv2D(256, 3, strides=2, padding=1,
+                                          activation="relu"))
+                self.cls_heads = []
+                self.loc_heads = []
+                for i in range(3):
+                    ch = nn.Conv2D(A * (num_classes + 1), 3, padding=1)
+                    lh = nn.Conv2D(A * 4, 3, padding=1)
+                    setattr(self, f"cls{i}", ch)
+                    setattr(self, f"loc{i}", lh)
+                    self.cls_heads.append(ch)
+                    self.loc_heads.append(lh)
+                self._nc = num_classes
+
+            def forward(self, x):
+                feats = [self.backbone(x)]
+                feats.append(self.extra1(feats[-1]))
+                feats.append(self.extra2(feats[-1]))
+                anchors, clses, locs = [], [], []
+                for i, f in enumerate(feats):
+                    anchors.append(nd.contrib.MultiBoxPrior(
+                        f, sizes=SIZES[i], ratios=RATIOS))
+                    c = self.cls_heads[i](f)
+                    b, _, h, w = c.shape
+                    clses.append(c.transpose((0, 2, 3, 1)).reshape(
+                        (b, h * w * A, self._nc + 1)))
+                    locs.append(self.loc_heads[i](f).transpose(
+                        (0, 2, 3, 1)).reshape((b, -1)))
+                return (nd.concat(*anchors, dim=1),
+                        nd.concat(*clses, dim=1),
+                        nd.concat(*locs, dim=1))
+
+        return SSD()
+
+
+def bench_ssd(dtype):
+    """SSD-ResNet50 training throughput, MultiBoxTarget matching inside
+    the compiled step and one on-device-NMS eval (MultiBoxDetection)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import _tape, nd
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from __graft_entry__ import _functional_apply
+
+    on_accel = jax.default_backend() != "cpu"
+    bs, size = (32, 300) if on_accel else (2, 64)
+    warmup, steps = (3, 10) if on_accel else (1, 2)
+    log(f"bench[ssd]: bs={bs} size={size}")
+
+    onp.random.seed(0)
+    net = _SSDResNet50.build()
+    net.initialize()
+    net(mx.nd.array(onp.random.uniform(
+        size=(1, 3, size, size)).astype("float32")))  # eager init pre-AMP
+    if dtype == "bf16":
+        mx.amp.init()
+    try:
+        params = [p for p in net.collect_params().values()
+                  if p._data is not None]
+        trainable = tuple(p.grad_req != "null" for p in params)
+        apply_fn = _functional_apply(net, params, train=True,
+                                     with_state=True)
+        lr, momentum = 1e-3, 0.9
+
+        def loss_fn(pd, x, labels):
+            (anchors, cls, loc), state = apply_fn(pd, x,
+                                                  jax.random.PRNGKey(0))
+            prev = _tape.set_recording(False)
+            try:
+                loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+                    NDArray(jax.lax.stop_gradient(anchors)),
+                    NDArray(labels),
+                    NDArray(jax.lax.stop_gradient(cls)
+                            .transpose((0, 2, 1))))
+                ce = nd.softmax_cross_entropy(
+                    NDArray(cls.reshape((-1, cls.shape[-1]))),
+                    NDArray(cls_t._data.reshape((-1,))))
+                l1 = nd.abs(NDArray(loc) * loc_mask - loc_t * loc_mask)
+            finally:
+                _tape.set_recording(prev)
+            l = ce._data / cls.shape[0] / cls.shape[1] \
+                + jnp.mean(l1._data)
+            return l, state
+
+        def train_step(pd, mom, x, labels):
+            (loss, state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(pd, x, labels)
+            new_mom = tuple(momentum * m + g for m, g in zip(mom, grads))
+            new_pd = tuple(d - lr * m if t else s
+                           for d, m, s, t in zip(pd, new_mom, state,
+                                                 trainable))
+            return new_pd, new_mom, loss
+
+        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
+        mom = tuple(jnp.zeros_like(d) for d in pd)
+        x = jnp.asarray(onp.random.uniform(
+            size=(bs, 3, size, size)).astype("float32"))
+        # one random ground-truth box per image: (B, 1, 5) [cls x0 y0 x1 y1]
+        lab = onp.zeros((bs, 1, 5), "float32")
+        lab[:, 0, 0] = onp.random.randint(0, 20, size=bs)
+        x0 = onp.random.uniform(0, 0.6, size=(bs, 2)).astype("float32")
+        lab[:, 0, 1:3] = x0
+        lab[:, 0, 3:5] = x0 + 0.3
+        labels = jnp.asarray(lab)
+
+        step, flops = compile_step(train_step, pd, mom, x, labels)
+        t0 = time.perf_counter()
+        for _ in range(warmup):
+            pd, mom, loss = step(pd, mom, x, labels)
+        _flush(loss)
+        log(f"bench[ssd]: warmup {time.perf_counter() - t0:.1f}s, "
+            f"loss={float(loss):.3f}")
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pd, mom, loss = step(pd, mom, x, labels)
+        _flush(loss)
+        dt = time.perf_counter() - t0
+
+        # on-device NMS eval pass (the reference's custom CUDA NMS; here
+        # MultiBoxDetection's lax loop) — ONE jitted program: eager
+        # per-op dispatch through the tunnel would cost minutes
+        eval_apply = _functional_apply(net, params, train=False)
+
+        def eval_prog(pd, xe):
+            anchors, cls, loc = eval_apply(pd, xe, jax.random.PRNGKey(0))
+            prev = _tape.set_recording(False)
+            try:
+                probs = nd.softmax(NDArray(cls).transpose((0, 2, 1)),
+                                   axis=1)
+                det = nd.contrib.MultiBoxDetection(
+                    probs, NDArray(loc), NDArray(anchors),
+                    nms_threshold=0.45, threshold=0.01)
+            finally:
+                _tape.set_recording(prev)
+            return det._data
+
+        xe = jnp.asarray(onp.random.uniform(
+            size=(4, 3, size, size)).astype("float32"))
+        t0 = time.perf_counter()
+        det = jax.jit(eval_prog)(pd, xe)
+        onp.asarray(det)
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        onp.asarray(jax.jit(eval_prog)(pd, xe))
+        nms_s = time.perf_counter() - t0
+        log(f"bench[ssd]: on-device NMS eval (bs=4): {nms_s*1e3:.0f} ms "
+            f"(+{t_compile:.1f}s compile)")
+    finally:
+        if dtype == "bf16":
+            mx.amp.uninit()
+    img_s = bs * steps / dt
+    tfs = flops * steps / dt / 1e12 if flops and on_accel else None
+    return {"img_s": img_s, "tflops": tfs}
+
+
 def main():
     model = os.environ.get("MXNET_BENCH_MODEL", "all")
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
@@ -320,6 +564,35 @@ def main():
                 "bert_mfu": round(b["tflops"] / peak, 4)
                 if b["tflops"] and peak else None,
             })
+    for name, fn, tok_field in (("lstm", bench_lstm, "lstm_tokens_per_sec"),
+                                ("ssd", bench_ssd, "ssd_img_per_sec")):
+        if model not in ("all", name):
+            continue
+        try:
+            r = fn(dtype)
+        except Exception as e:
+            if model == name:
+                raise
+            log(f"bench[{name}]: FAILED ({type(e).__name__}: {e}); "
+                "continuing without it")
+            continue
+        val = r.get("tok_s") or r.get("img_s")
+        if model == name:
+            out.update({
+                "metric": f"{name}_train_"
+                          + ("tokens_per_sec" if "tok_s" in r
+                             else "img_per_sec"),
+                "value": round(val, 1),
+                "unit": "tokens/s" if "tok_s" in r else "img/s",
+                "vs_baseline": None,  # BASELINE rows 4-5: no in-tree number
+                "dtype": dtype,
+            })
+        out.update({
+            tok_field: round(val, 1),
+            f"{name}_tflops": round(r["tflops"], 2) if r["tflops"] else None,
+            f"{name}_mfu": round(r["tflops"] / peak, 4)
+            if r["tflops"] and peak else None,
+        })
     try:
         roof = matmul_roofline()
     except Exception as e:

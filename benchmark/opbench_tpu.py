@@ -227,6 +227,120 @@ def _cases(rng):
 
     cases.append(("bert_ffn_16384_768_3072_bf16", build_ffn))
 
+    # ---- BERT-base bs=32 seq=512 attention internals (VERDICT r4 #2:
+    # measure the asserted "attention tail" instead of guessing).
+    # Shapes: (B, H, S, D) = (32, 12, 512, 64); tokens = B*S = 16384.
+    BH, S, D = 32 * 12, 512, 64
+    attn_flops = 2 * 2 * BH * S * S * D  # QK^T + PV, 2-FLOP convention
+
+    def build_flash_fwd(use_pallas):
+        def build():
+            from mxnet_tpu.ops import attention as ATT
+            q = arr((32, 12, S, D), "bfloat16")
+            k = arr((32, 12, S, D), "bfloat16")
+            v = arr((32, 12, S, D), "bfloat16")
+
+            def body(i, c):
+                o = ATT.flash_attention(c, k, v, use_pallas=use_pallas)
+                return _renorm(o).astype(c.dtype)
+
+            return q, body, attn_flops, None
+        return build
+
+    cases.append(("bert_flash_attn_fwd_pallas_bf16", build_flash_fwd(True)))
+    cases.append(("bert_flash_attn_fwd_xlascan_bf16",
+                  build_flash_fwd(False)))
+
+    def build_flash_fwdbwd(use_pallas):
+        def build():
+            from mxnet_tpu.ops import attention as ATT
+            q = arr((32, 12, S, D), "bfloat16")
+            k = arr((32, 12, S, D), "bfloat16")
+            v = arr((32, 12, S, D), "bfloat16")
+
+            def loss(q_, k_, v_):
+                o = ATT.flash_attention(q_, k_, v_, use_pallas=use_pallas)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            gfn = jax.grad(loss, argnums=(0, 1, 2))
+
+            def body(i, c):
+                dq, dk, dv = gfn(c, k, v)
+                return _renorm(dq).astype(c.dtype)
+
+            # fwd (2 matmuls) + bwd (5 matmuls: dq, dk, dv, 2 recompute)
+            return q, body, attn_flops * 7 // 2, None
+        return build
+
+    cases.append(("bert_flash_attn_fwdbwd_pallas_bf16",
+                  build_flash_fwdbwd(True)))
+    cases.append(("bert_flash_attn_fwdbwd_xlascan_bf16",
+                  build_flash_fwdbwd(False)))
+
+    def build_softmax():
+        x0 = arr((BH, S, S), "bfloat16")
+
+        def body(i, c):
+            y = nd.softmax(_nd(c), axis=-1)._data
+            return (y * jnp.bfloat16(2.0) - jnp.bfloat16(0.5)).astype(
+                c.dtype)
+
+        # unfused S^2 softmax: what the flash kernel avoids materializing
+        return x0, body, None, x0.size * 2 * 2
+
+    cases.append(("bert_softmax_384x512x512_bf16", build_softmax))
+
+    def build_layernorm():
+        x0 = arr((16384, 768), "bfloat16")
+        g = arr((768,), "float32")
+        b2 = arr((768,), "float32")
+
+        def body(i, c):
+            y = nd.LayerNorm(_nd(c), _nd(g), _nd(b2))._data
+            return (y + jnp.bfloat16(0.01)).astype(c.dtype)
+
+        return x0, body, None, x0.size * 2 * 2
+
+    cases.append(("bert_layernorm_16384x768_bf16", build_layernorm))
+
+    def build_bias_gelu():
+        x0 = arr((16384, 3072), "bfloat16")
+        b3 = arr((3072,), "float32")
+
+        def body(i, c):
+            y = nd.Activation(_nd(c + b3.astype(c.dtype)),
+                              act_type="gelu")._data
+            return _renorm(y).astype(c.dtype)
+
+        return x0, body, None, x0.size * 2 * 2
+
+    cases.append(("bert_bias_gelu_16384x3072_bf16", build_bias_gelu))
+
+    def build_dropout():
+        x0 = arr((16384, 768), "bfloat16")
+        key = jax.random.PRNGKey(7)
+
+        def body(i, c):
+            k = jax.random.fold_in(key, i)
+            keep = jax.random.bernoulli(k, 0.9, c.shape)
+            return jnp.where(keep, c / jnp.bfloat16(0.9),
+                             jnp.bfloat16(0.0))
+
+        return x0, body, None, x0.size * 2 * 2
+
+    cases.append(("bert_dropout_16384x768_bf16", build_dropout))
+
+    def build_qkv_proj():
+        w = arr((768, 768), "bfloat16")
+
+        def body(i, c):
+            return _renorm(nd.dot(_nd(c), _nd(w))._data).astype(c.dtype)
+
+        return (arr((16384, 768), "bfloat16"), body,
+                2 * 16384 * 768 * 768, None)
+
+    cases.append(("bert_proj_16384x768x768_bf16", build_qkv_proj))
+
     return cases
 
 

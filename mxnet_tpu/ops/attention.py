@@ -142,8 +142,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        # dots take the INPUT dtype (bf16 under AMP) with f32
+        # accumulation — an astype(f32) here would push the MXU onto its
+        # ~6x slower f32 passes (r5: 23.5 -> ~90 TFLOP/s on BERT shapes)
+        q = q_ref[0]                              # (block_q, d)
+        k = k_ref[0]                              # (block_k, d)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
@@ -164,7 +167,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
         acc_s[...] = acc_s[...] * alpha + lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -296,22 +299,26 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # (bq, d)
-        k = k_ref[0].astype(jnp.float32)            # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)          # (bq, d)
+        # operands keep the input dtype (bf16 under AMP), f32 accumulate
+        # — see the forward kernel's MXU-pass note
+        q = q_ref[0]                                # (bq, d)
+        k = k_ref[0]                                # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]                              # (bq, d)
         lse = lse_ref[0][:, :1]                     # (bq, 1)
         delta = delta_ref[0][:, :1]                 # (bq, 1)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         valid = _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # (bq, bk)
-        dv_s[...] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_s[...] += lax.dot_general(p.astype(do.dtype), do,
+                                     (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
-        dk_s[...] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        ds = (p * (dp - delta) * sm_scale)
+        dk_s[...] += lax.dot_general(ds.astype(q.dtype), q,
+                                     (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
@@ -337,10 +344,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # operands keep the input dtype (bf16 under AMP), f32 accumulate
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -350,7 +358,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dq_s[...] += lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        dq_s[...] += lax.dot_general(ds.astype(k.dtype), k,
+                                     (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
