@@ -40,8 +40,14 @@ class BERTModel(HybridBlock):
         self.position_embed = Embedding(max_length, units)
         self.embed_ln = LayerNorm(in_channels=units)
         self.embed_dropout = Dropout(dropout)
+        # gelu_tanh: the tanh-polynomial GELU of the original BERT code
+        # (google-research/bert modeling.py gelu). Also the faster form on
+        # TPU: its backward reuses the forward tanh (1 - t^2) where exact
+        # erf-GELU's backward needs a fresh exp(-x^2/2) — measured 12
+        # ms/step on bs=32x512 BERT-base (docs/PERF_NOTES.md r5).
         self.encoder = TransformerEncoder(num_layers, units, hidden_size,
-                                          num_heads, dropout=dropout)
+                                          num_heads, dropout=dropout,
+                                          activation="gelu_tanh")
         self.pooler = Dense(units, activation="tanh", flatten=False,
                             in_units=units) if use_pooler else None
         if use_decoder:

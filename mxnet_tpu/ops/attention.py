@@ -122,8 +122,22 @@ def _attention_xla(q, k, v, causal: bool, sm_scale: float,
 # Pallas TPU forward kernel
 # ---------------------------------------------------------------------------
 
+def _head_group(bh: int, block_q: int, block_k: int) -> int:
+    """Heads per Pallas program. Per-program fixed overhead (~2-3 µs:
+    launch + DMA setup) dominates short-seq attention when the grid has
+    one program per (batch, head) — 384 programs for BERT-base bs=32.
+    Batch G heads per program, bounded by the (G, bq, bk) f32 score
+    tile's VMEM footprint (~16 MiB/core on v5e, keep the tile ≤ 4 MiB)."""
+    g = 1
+    while (g * 2 <= 8 and bh % (g * 2) == 0
+           and g * 2 * block_q * block_k * 4 <= 4 * 1024 * 1024):
+        g *= 2
+    return g
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                  sm_scale, causal, block_q, block_k, nk, seq_q, seq_k):
+                  sm_scale, causal, block_q, block_k, nk, seq_q, seq_k,
+                  need_mask):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -144,46 +158,49 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     def _compute():
         # dots take the INPUT dtype (bf16 under AMP) with f32
         # accumulation — an astype(f32) here would push the MXU onto its
-        # ~6x slower f32 passes (r5: 23.5 -> ~90 TFLOP/s on BERT shapes)
-        q = q_ref[0]                              # (block_q, d)
-        k = k_ref[0]                              # (block_k, d)
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # ~6x slower f32 passes
+        q = q_ref[...]                            # (G, block_q, d)
+        k = k_ref[...]                            # (G, block_k, d)
+        s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * sm_scale
-        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
-                                                    (block_q, block_k), 1)
-        valid = k_pos < seq_k
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + diag_off
-            valid = valid & (k_pos <= q_pos)
-        s = jnp.where(valid, s, _NEG_INF)
+        if need_mask or causal:
+            # masking is real VPU work on a (bq, bk) tile — emitted only
+            # when there is padding to hide or a causal wedge to cut
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = k_pos < seq_k
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0) + diag_off
+                valid = valid & (k_pos <= q_pos)
+            s = jnp.where(valid[None], s, _NEG_INF)
 
-        m_prev = m_s[:, :1]                       # (block_q, 1)
-        m_cur = s.max(axis=1, keepdims=True)
+        m_prev = m_s[:, :, :1]                    # (G, block_q, 1)
+        m_cur = s.max(axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l_s[:, :1] * alpha + p.sum(axis=1, keepdims=True)
+        l_new = l_s[:, :, :1] * alpha + p.sum(axis=2, keepdims=True)
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
         acc_s[...] = acc_s[...] * alpha + lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[...], (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_s[:, :1], 1e-30)
+        l = jnp.maximum(l_s[:, :, :1], 1e-30)
         out = acc_s[...] / l
         # rows that never saw a valid key (m still at init) output zero —
         # the shared convention across every path in this module
-        out = jnp.where(m_s[:, :1] > _NEG_INF / 2, out, 0.0)
-        o_ref[0] = out.astype(o_ref.dtype)
+        out = jnp.where(m_s[:, :, :1] > _NEG_INF / 2, out, 0.0)
+        o_ref[...] = out.astype(o_ref.dtype)
         # log-sum-exp per row: the residual the backward kernels need
         # (p = exp(s - lse) reconstructs softmax without the S×S matrix)
-        lse = jnp.where(m_s[:, :1] > _NEG_INF / 2,
-                        m_s[:, :1] + jnp.log(l), _NEG_INF)
+        lse = jnp.where(m_s[:, :, :1] > _NEG_INF / 2,
+                        m_s[:, :, :1] + jnp.log(l), _NEG_INF)
         # 8-lane replication: narrowest layout the TPU tiling rules allow
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def _pad_for_blocks(q, k, v, block_q, block_k):
@@ -225,30 +242,32 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
     sk = k.shape[2]
     (qp, kp, vp, _, block_q, block_k, dp, sqp, skp, nq, nk) = \
         _pad_for_blocks(q, k, v, block_q, block_k)
+    g = _head_group(b * h, block_q, block_k)
 
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, nk=nk, seq_q=sq, seq_k=sk)
+        block_k=block_k, nk=nk, seq_q=sq, seq_k=sk,
+        need_mask=(skp != sk))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, nq, nk),
+        grid=(b * h // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((g, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((g, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((g, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((g, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((g, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
             jax.ShapeDtypeStruct((b * h, sqp, 8), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, dp), jnp.float32),
+            pltpu.VMEM((g, block_q, 128), jnp.float32),
+            pltpu.VMEM((g, block_q, 128), jnp.float32),
+            pltpu.VMEM((g, block_q, dp), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -283,7 +302,7 @@ def _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k):
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal,
-                          block_q, block_k, nq, seq_q, seq_k):
+                          block_q, block_k, nq, seq_q, seq_k, need_mask):
     from jax.experimental import pallas as pl
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -301,35 +320,72 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         # operands keep the input dtype (bf16 under AMP), f32 accumulate
         # — see the forward kernel's MXU-pass note
-        q = q_ref[0]                                # (bq, d)
-        k = k_ref[0]                                # (bk, d)
-        v = v_ref[0]
-        do = do_ref[0]                              # (bq, d)
-        lse = lse_ref[0][:, :1]                     # (bq, 1)
-        delta = delta_ref[0][:, :1]                 # (bq, 1)
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        q = q_ref[...]                              # (G, bq, d)
+        k = k_ref[...]                              # (G, bk, d)
+        v = v_ref[...]
+        do = do_ref[...]                            # (G, bq, d)
+        lse = lse_ref[...][:, :, :1]                # (G, bq, 1)
+        delta = delta_ref[...][:, :, :1]            # (G, bq, 1)
+        s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * sm_scale
-        valid = _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        p = jnp.exp(s - lse)                        # (G, bq, bk)
+        if need_mask or causal:
+            valid = _bwd_mask(qi, ki, block_q, block_k, causal,
+                              seq_q, seq_k)
+            p = jnp.where(valid[None], p, 0.0)
         dv_s[...] += lax.dot_general(p.astype(do.dtype), do,
-                                     (((0,), (0,)), ((), ())),
+                                     (((1,), (1,)), ((0,), (0,))),
                                      preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale)
         dk_s[...] += lax.dot_general(ds.astype(q.dtype), q,
-                                     (((0,), (0,)), ((), ())),
+                                     (((1,), (1,)), ((0,), (0,))),
                                      preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+        dk_ref[...] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dk_ref, dv_ref, *, sm_scale, causal,
+                            block_q, block_k, seq_q, seq_k, need_mask):
+    """Single-block backward (nq == nk == 1, the short-seq fast path):
+    one program computes dq, dk AND dv, reconstructing the softmax block
+    ONCE — the two-kernel general path pays the s = qk^T + exp recompute
+    twice, and that VPU work dominates short-seq attention (r5)."""
+    q = q_ref[...]                                  # (G, bq, d)
+    k = k_ref[...]                                  # (G, bk, d)
+    v = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...][:, :, :1]
+    delta = delta_ref[...][:, :, :1]
+    s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32) * sm_scale
+    p = jnp.exp(s - lse)                            # (G, bq, bk)
+    if need_mask or causal:
+        valid = _bwd_mask(0, 0, block_q, block_k, causal, seq_q, seq_k)
+        p = jnp.where(valid[None], p, 0.0)
+    pb = p.astype(do.dtype)
+    dv_ref[...] = lax.dot_general(
+        pb, do, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+    dq_ref[...] = lax.dot_general(
+        ds, k, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_ref[...] = lax.dot_general(
+        ds, q, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_s, *, sm_scale, causal, block_q,
-                         block_k, nk, seq_q, seq_k):
+                         block_k, nk, seq_q, seq_k, need_mask):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -345,26 +401,29 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(run)
     def _compute():
         # operands keep the input dtype (bf16 under AMP), f32 accumulate
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        q = q_ref[...]                              # (G, bq, d)
+        k = k_ref[...]                              # (G, bk, d)
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...][:, :, :1]
+        delta = delta_ref[...][:, :, :1]
+        s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * sm_scale
-        valid = _bwd_mask(qi, ki, block_q, block_k, causal, seq_q, seq_k)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        p = jnp.exp(s - lse)
+        if need_mask or causal:
+            valid = _bwd_mask(qi, ki, block_q, block_k, causal,
+                              seq_q, seq_k)
+            p = jnp.where(valid[None], p, 0.0)
+        dp = lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dq_s[...] += lax.dot_general(ds.astype(k.dtype), k,
-                                     (((1,), (0,)), ((), ())),
+                                     (((2,), (1,)), ((0,), (0,))),
                                      preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+        dq_ref[...] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
@@ -387,43 +446,69 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
     # 8-lane replication (TPU block tiling minimum for a row vector)
     dl = jnp.broadcast_to(dl[..., None], dl.shape + (8,))
     lsep = jnp.broadcast_to(lsep[..., None], lsep.shape + (8,))
+    g = _head_group(b * h, block_q, block_k)
+    need_mask = (skp != sk) or (sqp != sq)
 
-    q_spec = pl.BlockSpec((1, block_q, dp), lambda bh, a, c: (bh, a, 0))
-    row_spec = pl.BlockSpec((1, block_q, 8), lambda bh, a, c: (bh, a, 0))
+    if nq == 1 and nk == 1:
+        bspec = lambda blk: pl.BlockSpec((g, blk, dp),
+                                         lambda bh: (bh, 0, 0))
+        rspec = pl.BlockSpec((g, block_q, 8), lambda bh: (bh, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_k=block_k, seq_q=sq, seq_k=sk,
+                need_mask=need_mask),
+            grid=(b * h // g,),
+            in_specs=[bspec(block_q), bspec(block_k), bspec(block_k),
+                      bspec(block_q), rspec, rspec],
+            out_specs=[bspec(block_q), bspec(block_k), bspec(block_k)],
+            out_shape=[jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
+                       jax.ShapeDtypeStruct((b * h, skp, dp), k.dtype),
+                       jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=interpret,
+        )(qp, kp, vp, dop, lsep, dl)
+        return (dq.reshape(b, h, sqp, dp)[:, :, :sq, :d],
+                dk.reshape(b, h, skp, dp)[:, :, :sk, :d],
+                dv.reshape(b, h, skp, dp)[:, :, :sk, :d])
+
+    q_spec = pl.BlockSpec((g, block_q, dp), lambda bh, a, c: (bh, a, 0))
+    row_spec = pl.BlockSpec((g, block_q, 8), lambda bh, a, c: (bh, a, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          nk=nk, seq_q=sq, seq_k=sk),
-        grid=(b * h, nq, nk),
+                          nk=nk, seq_q=sq, seq_k=sk, need_mask=need_mask),
+        grid=(b * h // g, nq, nk),
         in_specs=[
             q_spec,
-            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((g, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((g, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
             q_spec, row_spec, row_spec,
         ],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, block_q, dp), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, dl)
 
-    k_spec = pl.BlockSpec((1, block_k, dp), lambda bh, ki, qi: (bh, ki, 0))
-    qrow = pl.BlockSpec((1, block_q, dp), lambda bh, ki, qi: (bh, qi, 0))
-    rrow = pl.BlockSpec((1, block_q, 8), lambda bh, ki, qi: (bh, qi, 0))
+    k_spec = pl.BlockSpec((g, block_k, dp), lambda bh, ki, qi: (bh, ki, 0))
+    qrow = pl.BlockSpec((g, block_q, dp), lambda bh, ki, qi: (bh, qi, 0))
+    rrow = pl.BlockSpec((g, block_q, 8), lambda bh, ki, qi: (bh, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          nq=nq, seq_q=sq, seq_k=sk),
-        grid=(b * h, nk, nq),
+                          nq=nq, seq_q=sq, seq_k=sk, need_mask=need_mask),
+        grid=(b * h // g, nk, nq),
         in_specs=[qrow, k_spec, k_spec, qrow, rrow, rrow],
         out_specs=[k_spec, k_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, skp, dp), k.dtype),
                    jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
-                        pltpu.VMEM((block_k, dp), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, block_k, dp), jnp.float32),
+                        pltpu.VMEM((g, block_k, dp), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
