@@ -25,7 +25,10 @@ Prints ONE JSON line with the primary metric plus efficiency fields:
 
 The whole training step (forward, loss, backward, SGD-momentum update) is one
 donated-buffer XLA computation — the TPU-native answer to the reference's
-CachedOp static_alloc + bulking + fused multi_sgd (SURVEY §3.2/§3.4).
+CachedOp static_alloc + bulking + fused multi_sgd (SURVEY §3.2/§3.4). Since
+PR 1 the resnet/bert/lstm legs build that program through the FRAMEWORK
+(gluon.TrainLoop over Trainer.compile_step, gluon/fused_step.py) rather than
+the bespoke make_train_step sidecar — the bench measures the product path.
 
 AMP note: ``mx.amp.init()`` is enabled AFTER the eager shape-materializing
 forward and applies inside the jitted step (one compile). bf16 then FLOWS
@@ -101,6 +104,46 @@ def compile_step(step_fn, *args):
     return comp, flops
 
 
+def framework_loop(net, lr, momentum=0.9):
+    """The PRODUCT train-step path: gluon.TrainLoop over
+    Trainer.compile_step — forward+backward+update as ONE donated-buffer
+    XLA program built by the framework itself. The resnet/bert/lstm legs
+    run through this (previously a bespoke make_train_step sidecar in
+    __graft_entry__ — the bench now measures what users get)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    trainer = mx.gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": lr, "momentum": momentum}, kvstore=None)
+    return mx.gluon.TrainLoop(net, trainer, SoftmaxCrossEntropyLoss())
+
+
+def run_framework_bench(tag, loop, x, y, warmup, steps):
+    """AOT-compile the framework step for this shape bucket, then run
+    warmup + the timed loop. Returns (dt_seconds, flops, final_loss)."""
+    import mxnet_tpu as mx
+    x_nd, y_nd = mx.nd.from_jax(x), mx.nd.from_jax(y)
+    flops = loop.compiled_step.aot_compile(x_nd, y_nd)
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        loss = loop.step(x_nd, y_nd)
+    _flush(loss._data)
+    fused = loop.compiled_step.mode == "fused"
+    log(f"bench[{tag}]: warmup (incl. compile) "
+        f"{time.perf_counter() - t0:.1f}s, "
+        f"loss={float(loss._data.mean()):.3f}, mode="
+        f"{loop.compiled_step.mode}, traces={loop.compiled_step.n_traces}")
+    if not fused:  # pragma: no cover - diagnostic
+        log(f"bench[{tag}]: WARNING framework step fell back to eager")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = loop.step(x_nd, y_nd)
+    _flush(loss._data)
+    dt = time.perf_counter() - t0
+    log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f}")
+    return dt, flops, loss
+
+
 def matmul_roofline():
     """Achieved bf16 GEMM TFLOP/s: best over several large matmul shapes.
     8192³ underreports the chip by ~40%; the max lives at big-K
@@ -135,7 +178,7 @@ def matmul_roofline():
 def bench_resnet(dtype):
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
-    from __graft_entry__ import make_train_step, _init_net
+    from __graft_entry__ import _init_net
 
     on_accel = jax.default_backend() != "cpu"
     try:
@@ -155,35 +198,17 @@ def bench_resnet(dtype):
     # eager init runs BEFORE amp.init(): the fp32 eager path is
     # compile-cached across runs, while flowing-bf16 eager would trigger
     # ~100 fresh remote compiles on tunneled platforms
-    params = _init_net(net, (1, 3, size, size))
+    _init_net(net, (1, 3, size, size))
     if dtype == "bf16":
         mx.amp.init()
     try:
-        train_step = make_train_step(net, params, lr=0.1)
-
-        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
-        mom = tuple(jnp.zeros_like(d) for d in pd)
+        loop = framework_loop(net, lr=0.1)
         x = jnp.asarray(onp.random.uniform(size=(bs, 3, size, size))
                         .astype("float32"))
         y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,))
                         .astype("int32"))
-        key = jax.random.PRNGKey(0)
-
-        step, flops = compile_step(train_step, pd, mom, x, y, key)
-
-        t0 = time.perf_counter()
-        for _ in range(warmup):
-            pd, mom, loss = step(pd, mom, x, y, key)
-        _flush(loss)
-        log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
-            f"loss={float(loss):.3f}")
-
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            pd, mom, loss = step(pd, mom, x, y, key)
-        _flush(loss)
-        dt = time.perf_counter() - t0
-        log(f"bench: final loss={float(loss):.3f}")
+        dt, flops, _ = run_framework_bench("resnet", loop, x, y, warmup,
+                                           steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
@@ -195,7 +220,6 @@ def bench_resnet(dtype):
 def bench_bert(dtype):
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import bert
-    from __graft_entry__ import make_train_step
 
     on_accel = jax.default_backend() != "cpu"
     bs, seqlen = (32, 512) if on_accel else (2, 32)
@@ -213,33 +237,14 @@ def bench_bert(dtype):
     if dtype == "bf16":
         mx.amp.init()
     try:
-        params = [p for p in net.collect_params().values()
-                  if p._data is not None]
         # lr small enough that random-label steps stay finite on every
         # config (throughput is lr-independent)
-        train_step = make_train_step(net, params, lr=1e-3)
-
-        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
-        mom = tuple(jnp.zeros_like(d) for d in pd)
+        loop = framework_loop(net, lr=1e-3)
         x = jnp.asarray(onp.random.randint(0, vocab, size=(bs, seqlen))
                         .astype("int32"))
         y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
-        key = jax.random.PRNGKey(0)
-
-        step, flops = compile_step(train_step, pd, mom, x, y, key)
-
-        t0 = time.perf_counter()
-        for _ in range(warmup):
-            pd, mom, loss = step(pd, mom, x, y, key)
-        _flush(loss)
-        log(f"bench[bert]: warmup {time.perf_counter() - t0:.1f}s, "
-            f"loss={float(loss):.3f}")
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            pd, mom, loss = step(pd, mom, x, y, key)
-        _flush(loss)
-        dt = time.perf_counter() - t0
-        log(f"bench[bert]: final loss={float(loss):.3f}")
+        dt, flops, _ = run_framework_bench("bert", loop, x, y, warmup,
+                                           steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
@@ -255,7 +260,6 @@ def bench_lstm(dtype):
     650-d embed/hidden, 2 layers, bs=64, bptt=35."""
     import importlib.util
     import mxnet_tpu as mx
-    from __graft_entry__ import make_train_step
 
     spec = importlib.util.spec_from_file_location(
         "train_lstm_lm",
@@ -279,30 +283,13 @@ def bench_lstm(dtype):
     if dtype == "bf16":
         mx.amp.init()
     try:
-        params = [p for p in net.collect_params().values()
-                  if p._data is not None]
-        train_step = make_train_step(net, params, lr=0.5)
-
-        pd = tuple(jnp.array(p._data._data, copy=True) for p in params)
-        mom = tuple(jnp.zeros_like(d) for d in pd)
+        loop = framework_loop(net, lr=0.5)
         x = jnp.asarray(onp.random.randint(
             0, vocab, size=(bs, seq)).astype("int32"))
         y = jnp.asarray(onp.random.randint(
             0, vocab, size=(bs, seq)).astype("int32"))
-        key = jax.random.PRNGKey(0)
-
-        step, flops = compile_step(train_step, pd, mom, x, y, key)
-        t0 = time.perf_counter()
-        for _ in range(warmup):
-            pd, mom, loss = step(pd, mom, x, y, key)
-        _flush(loss)
-        log(f"bench[lstm]: warmup {time.perf_counter() - t0:.1f}s, "
-            f"loss={float(loss):.3f}")
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            pd, mom, loss = step(pd, mom, x, y, key)
-        _flush(loss)
-        dt = time.perf_counter() - t0
+        dt, flops, _ = run_framework_bench("lstm", loop, x, y, warmup,
+                                           steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()

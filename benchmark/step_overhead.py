@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Per-step host-overhead microbench: eager tape loop vs fused compile_step.
+
+Non-gating. Quantifies what the fused whole-train-step buys on the HOST
+side: the eager loop walks the Python tape (one vjp closure per op) and
+crosses a host boundary between backward and the jitted optimizer update
+every iteration; ``Trainer.compile_step`` dispatches ONE compiled
+program per step plus a thin writeback. On a tiny MLP the device work is
+negligible, so wall time ~= host overhead — the quantity that caps LSTM/
+small-batch MFU (ISSUE 1, BENCH_r05: 0.17 LSTM MFU vs 148 TFLOP/s
+roofline).
+
+    JAX_PLATFORMS=cpu python benchmark/step_overhead.py
+
+Prints one JSON line:
+  {"metric": "train_step_host_overhead", "eager_ms": .., "fused_ms": ..,
+   "speedup": .., "steps": N, "device": "..."}
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import Trainer, TrainLoop, nn  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss  # noqa: E402
+
+
+def build_net(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, in_units=32, activation="relu"),
+            nn.Dense(64, in_units=64, activation="relu"),
+            nn.Dense(8, in_units=64))
+    net.initialize()
+    return net
+
+
+def main():
+    steps = int(os.environ.get("MXNET_STEP_OVERHEAD_STEPS", "200"))
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.randn(16, 32).astype("float32"))
+    y = nd.array(rng.randint(0, 8, size=(16,)).astype("int32"))
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+
+    # ---- eager record/backward/step loop ----
+    net_e = build_net()
+    tr_e = Trainer(net_e.collect_params(), "sgd",
+                   {"learning_rate": 0.05, "momentum": 0.9})
+    for _ in range(10):  # warmup: compile per-op kernels + fused update
+        with autograd.record():
+            l = loss_blk(net_e(x), y)
+        l.backward()
+        tr_e.step(16)
+    jax.block_until_ready(l._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        with autograd.record():
+            l = loss_blk(net_e(x), y)
+        l.backward()
+        tr_e.step(16)
+    jax.block_until_ready(l._data)
+    eager_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    # ---- fused whole-step program ----
+    net_f = build_net()
+    tr_f = Trainer(net_f.collect_params(), "sgd",
+                   {"learning_rate": 0.05, "momentum": 0.9})
+    loop = TrainLoop(net_f, tr_f, loss_blk)
+    loop.compiled_step.aot_compile(x, y)
+    for _ in range(10):
+        l = loop.step(x, y)
+    jax.block_until_ready(l._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l = loop.step(x, y)
+    jax.block_until_ready(l._data)
+    fused_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    assert loop.compiled_step.mode == "fused", loop.compiled_step.mode
+    print(json.dumps({
+        "metric": "train_step_host_overhead",
+        "eager_ms": round(eager_ms, 3),
+        "fused_ms": round(fused_ms, 3),
+        "speedup": round(eager_ms / fused_ms, 2) if fused_ms else None,
+        "steps": steps,
+        "n_traces": loop.compiled_step.n_traces,
+        "device": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

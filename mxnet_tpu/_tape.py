@@ -26,6 +26,7 @@ from .base import MXNetError
 
 __all__ = [
     "is_recording", "is_training", "set_recording", "set_training",
+    "is_taping_suspended", "set_taping_suspended", "suspend_taping",
     "TapeNode", "record_op", "backward", "grad", "mark_variables",
 ]
 
@@ -34,6 +35,11 @@ class _State(threading.local):
     def __init__(self):
         self.recording = False
         self.training = False
+        # Hard override used by whole-graph functionalization (cached ops,
+        # Trainer.compile_step): while suspended, is_recording() reports
+        # False even if user code inside the traced region enters
+        # autograd.record() — tape nodes must never be attached to tracers.
+        self.suspended = False
 
 
 _state = _State()
@@ -41,7 +47,7 @@ _node_counter = [0]
 
 
 def is_recording() -> bool:
-    return _state.recording
+    return _state.recording and not _state.suspended
 
 
 def is_training() -> bool:
@@ -56,6 +62,30 @@ def set_recording(flag: bool) -> bool:
 def set_training(flag: bool) -> bool:
     old, _state.training = _state.training, flag
     return old
+
+
+def is_taping_suspended() -> bool:
+    return _state.suspended
+
+
+def set_taping_suspended(flag: bool) -> bool:
+    old, _state.suspended = _state.suspended, flag
+    return old
+
+
+class suspend_taping:
+    """Context manager: force is_recording() False for the duration, even
+    across user calls to set_recording(True)/autograd.record() inside the
+    scope. The functionalized-trace analog of the reference's
+    Imperative::DCInfo scope (deferred compute forbids nested recording)."""
+
+    def __enter__(self):
+        self._prev = set_taping_suspended(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_taping_suspended(self._prev)
+        return False
 
 
 class TapeNode:

@@ -13,13 +13,17 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "set_recording", "set_training", "mark_variables",
-           "backward", "grad", "get_symbol", "Function"]
+           "suspend_taping", "backward", "grad", "get_symbol", "Function"]
 
 is_recording = _tape.is_recording
 is_training = _tape.is_training
 set_recording = _tape.set_recording
 set_training = _tape.set_training
 mark_variables = _tape.mark_variables
+# Whole-graph functionalization guard (cached ops, Trainer.compile_step):
+# inside the scope is_recording() is forced False even if traced user code
+# re-enters record() — jax differentiates the program; the tape must not.
+suspend_taping = _tape.suspend_taping
 
 
 class _RecordingStateScope:

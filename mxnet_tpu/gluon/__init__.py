@@ -15,6 +15,7 @@ for _mod in ["trainer", "data", "rnn", "model_zoo", "contrib", "probability"]:
 
 try:
     from .trainer import Trainer  # noqa: F401
+    from .fused_step import TrainLoop, CompiledTrainStep  # noqa: F401
     from .pipeline import PipelineTrainer  # noqa: F401
 except ImportError:
     pass

@@ -71,6 +71,55 @@ class _TracedSentinel:
 _TRACED = _TracedSentinel()
 
 
+class ParamBinding:
+    """Functional parameter binding for whole-graph traces.
+
+    Shared by the CachedOp trace (``_build_cache``) and the fused train
+    step (``gluon.fused_step``): binds raw jax arrays into Parameters for
+    the duration of an imperative forward running under trace, then on
+    exit captures functional rebinds (BatchNorm running stats replace
+    ``Parameter._data`` with a new handle) and restores the original
+    handles. ``grad_req='null'`` params are bound behind
+    ``lax.stop_gradient`` so reverse-mode prunes their dead gradients.
+
+    After ``__exit__``:
+      - ``state``     tuple over params of the raw (possibly updated) array
+      - ``state_idx`` indices of params whose handle was rebound in forward
+    """
+
+    __slots__ = ("params", "datas", "state", "state_idx", "_orig",
+                 "_bound_ids")
+
+    def __init__(self, params, datas):
+        self.params = list(params)
+        self.datas = list(datas)
+        self.state = None
+        self.state_idx = None
+
+    def __enter__(self):
+        self._orig = [p._data for p in self.params]
+        self._bound_ids = []
+        for p, d in zip(self.params, self.datas):
+            nd = NDArray(jax.lax.stop_gradient(d)
+                         if p.grad_req == "null" else d)
+            p._data = nd
+            self._bound_ids.append(id(nd))
+        return self
+
+    def __exit__(self, *exc):
+        state, idx = [], []
+        for i, p in enumerate(self.params):
+            cur = p._data
+            state.append(cur._data if isinstance(cur, NDArray) else cur)
+            if id(cur) != self._bound_ids[i]:
+                idx.append(i)
+        self.state = tuple(state)
+        self.state_idx = idx
+        for p, o in zip(self.params, self._orig):
+            p._data = o
+        return False
+
+
 def _in_trace(args) -> bool:
     """True when any input is a jax tracer — i.e. we are already inside an
     enclosing jit trace and must inline rather than nest cached ops."""
@@ -530,32 +579,22 @@ class HybridBlock(Block):
             leaves = [next(it) if s is _TRACED else s for s in static_spec]
             args_nd, kwargs_nd = jax.tree_util.tree_unflatten(
                 arg_treedef, leaves)
-            orig = [p._data for p in params]
-            bound_ids = []
-            for p, d in zip(params, param_datas):
-                nd = NDArray(jax.lax.stop_gradient(d)
-                             if p.grad_req == "null" else d)
-                p._data = nd
-                bound_ids.append(id(nd))
+            binding = ParamBinding(params, param_datas)
             push_trace_key(rng_key)
             prev = _tape.set_recording(False)
+            prev_s = _tape.set_taping_suspended(True)
             prev_t = _tape.set_training(train_mode)
             try:
-                out = block.forward(*args_nd, **kwargs_nd)
+                with binding:
+                    out = block.forward(*args_nd, **kwargs_nd)
             finally:
                 _tape.set_recording(prev)
+                _tape.set_taping_suspended(prev_s)
                 _tape.set_training(prev_t)
                 pop_trace_key()
-            # capture functional state updates (BN running stats etc.)
-            state_leaves, state_idx = [], []
-            for i, p in enumerate(params):
-                if id(p._data) != bound_ids[i]:
-                    state_idx.append(i)
-                    state_leaves.append(
-                        p._data._data if isinstance(p._data, NDArray)
-                        else p._data)
-            for p, o in zip(params, orig):
-                p._data = o
+            # functional state updates (BN running stats etc.)
+            state_idx = binding.state_idx
+            state_leaves = [binding.state[i] for i in state_idx]
             # flatten outputs with NDArray as LEAF (not pytree node) so the
             # call path can rebuild the structure around the tape-carrying
             # output handles

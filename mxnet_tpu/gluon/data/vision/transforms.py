@@ -79,7 +79,9 @@ class HybridRandomApply(HybridSequential):
         from ....ndarray import random as ndrandom
         from ....ndarray import contrib as ndcontrib
         coin = ndrandom.uniform(low=0, high=1, shape=(1,))
-        pred = (coin > self.p).reshape(())
+        # apply WITH probability p: P(coin <= p) = p (the previous
+        # `coin > p` applied with probability 1-p — inverted)
+        pred = (coin <= self.p).reshape(())
         return ndcontrib.cond(pred,
                               lambda v: self.transforms(v),
                               lambda v: v, [x])

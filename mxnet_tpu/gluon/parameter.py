@@ -184,6 +184,14 @@ class Parameter:
                 f"{self._data.shape}")
         self._data._data = data._data.astype(self._data._data.dtype)
 
+    def _write_fused(self, new_data):
+        """Write a fused-train-step result buffer into this parameter
+        IN PLACE: the NDArray handle (and its attached grad / any user
+        reference from ``data()``) stays stable, only the backing jax
+        array is swapped — the writeback half of the donation contract
+        (``Trainer.compile_step``; docs/PERF_NOTES.md)."""
+        self._data._data = new_data
+
     def zero_grad(self):
         d = self._data
         if d is not None and d.grad is not None:

@@ -32,6 +32,9 @@ class Trainer:
             self._param_names = [p.name for p in params]
         else:
             raise MXNetError("params must be a dict or list of Parameters")
+        # full set incl. grad_req='null' (running stats): the fused whole-
+        # step program (compile_step) must bind these as traced state too
+        self._all_params = list(self._params)
         self._params = [p for p in self._params if p.grad_req != "null"]
         self._param2idx = {id(p): i for i, p in enumerate(self._params)}
 
@@ -63,6 +66,30 @@ class Trainer:
     @property
     def optimizer(self):
         return self._optimizer
+
+    # ---------------- fused whole-step compilation ----------------
+    def compile_step(self, loss_fn, donate: bool = True,
+                     train_mode: bool = True):
+        """Compile the ENTIRE training step — forward, backward, gradient
+        reduction, optimizer update — into one donated-buffer XLA program
+        per input-shape bucket (gluon/fused_step.py)::
+
+            step = trainer.compile_step(lambda x, y: loss_blk(net(x), y))
+            for x, y in batches:
+                loss = step(x, y)          # == record/backward/step(bs)
+
+        Gradient semantics match ``loss.backward()`` (seed ones) followed
+        by ``trainer.step(batch_size)`` with ``batch_size`` inferred from
+        the leading batch axis (override per call:
+        ``step(x, y, batch_size=n)``). lr/wd/update-count/rescale are
+        traced arguments — mutating ``trainer.learning_rate`` or varying
+        the batch size never recompiles. Sparse-grad/multi-precision
+        parameters, ``update_on_kvstore`` stores, and non-traceable
+        forwards fall back transparently to the eager tape path.
+        """
+        from .fused_step import CompiledTrainStep
+        return CompiledTrainStep(self, loss_fn, donate=donate,
+                                 train_mode=train_mode)
 
     # ---------------- kvstore setup (reference trainer.py:188) -------------
     def _init_kvstore(self):

@@ -215,6 +215,19 @@ class KVStoreTPU(KVStoreBase):
         from ..parallel.compression import GradientCompression
         self._compression = GradientCompression(**compression_params)
 
+    # ---------------- fused-step integration ----------------
+    @property
+    def in_program_reduce(self) -> bool:
+        """True when the gradient reduction this store performs can live
+        INSIDE one compiled train step (``Trainer.compile_step``): a
+        single-process store holds ONE logical array per parameter, so
+        the reduce is the identity (mesh-sharded arrays get their psum
+        inserted by XLA under jit). Stores that must cross a process
+        boundary (KVStoreDist with >1 worker) return False and the fused
+        step falls back to a host-side ``pushpull_list`` between its
+        gradient and update programs."""
+        return True
+
     # ---------------- topology ----------------
     @property
     def rank(self) -> int:
@@ -284,6 +297,16 @@ class KVStoreDist(KVStoreTPU):
         # observability: collective dispatches and host syncs per store —
         # the quantities the batched path exists to shrink
         self.stats = {"collectives": 0, "blocks": 0}
+
+    @property
+    def in_program_reduce(self) -> bool:
+        """Cross-process reduction cannot be traced into a single-process
+        jit program (it rides make_array_from_single_device_arrays over a
+        worker mesh); with >1 worker — or when tests force the fused
+        bucketed path via ``_force_fuse`` — the compiled train step must
+        route gradients through host-side ``pushpull_list``."""
+        return jax.process_count() == 1 and not getattr(
+            self, "_force_fuse", False)
 
     # -------- cross-process collective machinery --------
     def _worker_mesh(self):

@@ -306,6 +306,29 @@ set_np_ndarray_cls(ndarray)
 # ------------------------------------------------------------------
 # helpers
 # ------------------------------------------------------------------
+def _rejected_kwargs(fn, kwargs):
+    """Kwargs ``fn`` STRUCTURALLY cannot accept, via inspect.signature —
+    not exception-message sniffing, so a genuine TypeError raised inside
+    an mx op (bad dtype/shape arg) is never mistaken for an unsupported
+    ufunc option. Un-introspectable callables and **kwargs-takers accept
+    everything by construction."""
+    import inspect
+    if not kwargs:
+        return ()
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return ()
+    params = sig.parameters.values()
+    if builtins.any(p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params):
+        return ()
+    accepted = {p.name for p in params
+                if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                              inspect.Parameter.KEYWORD_ONLY)}
+    return tuple(k for k in kwargs if k not in accepted)
+
+
 def _dispatch_to_mx(name, onp_func, args, kwargs):
     """Route an official-NumPy function/ufunc call whose arguments
     include mx arrays: prefer the mx.np implementation (device compute,
@@ -315,16 +338,14 @@ def _dispatch_to_mx(name, onp_func, args, kwargs):
     import mxnet_tpu.numpy as mx_np
     mx_fn = getattr(mx_np, name, None)
     if callable(mx_fn) and not getattr(mx_fn, "_is_np_fallback", False):
-        try:
-            return mx_fn(*_fb._to_mx(args), **_fb._to_mx(kwargs))
-        except TypeError as e:
+        if _rejected_kwargs(mx_fn, kwargs):
             # a legal ufunc option (np_ufunc_legal_option: where=, …) the
-            # mx implementation doesn't take — keep protocol semantics by
-            # falling back to host (refused under autograd recording by
-            # the fallback wrapper) instead of surfacing the TypeError
-            if builtins.any(k in str(e) for k in kwargs):
-                return _fb.make_fallback(name, onp_func)(*args, **kwargs)
-            raise
+            # mx implementation doesn't declare — keep protocol semantics
+            # by falling back to host (refused under autograd recording
+            # by the fallback wrapper). Detected BEFORE the call, so
+            # TypeErrors raised inside the mx op propagate unchanged.
+            return _fb.make_fallback(name, onp_func)(*args, **kwargs)
+        return mx_fn(*_fb._to_mx(args), **_fb._to_mx(kwargs))
     if getattr(mx_fn, "_is_np_fallback", False):
         return mx_fn(*args, **kwargs)  # installed wrapper converts itself
     return _fb.make_fallback(name, onp_func)(*args, **kwargs)

@@ -122,15 +122,21 @@ def _attention_xla(q, k, v, causal: bool, sm_scale: float,
 # Pallas TPU forward kernel
 # ---------------------------------------------------------------------------
 
-def _head_group(bh: int, block_q: int, block_k: int) -> int:
+def _head_group(bh: int, block_q: int, block_k: int,
+                n_tiles: int = 1) -> int:
     """Heads per Pallas program. Per-program fixed overhead (~2-3 µs:
     launch + DMA setup) dominates short-seq attention when the grid has
     one program per (batch, head) — 384 programs for BERT-base bs=32.
-    Batch G heads per program, bounded by the (G, bq, bk) f32 score
-    tile's VMEM footprint (~16 MiB/core on v5e, keep the tile ≤ 4 MiB)."""
+    Batch G heads per program, bounded by the CONCURRENT (G, bq, bk) f32
+    tiles' VMEM footprint (~16 MiB/core on v5e, keep them ≤ 4 MiB
+    total). ``n_tiles`` is how many such score-shaped tiles the kernel
+    holds live at once: 1 for the forward (s; p overwrites it), 4 for
+    the fused backward (s, p, dp, ds) — budgeting the backward as a
+    single tile oversizes G and fails Mosaic lowering at large blocks."""
     g = 1
     while (g * 2 <= 8 and bh % (g * 2) == 0
-           and g * 2 * block_q * block_k * 4 <= 4 * 1024 * 1024):
+           and g * 2 * block_q * block_k * 4 * n_tiles
+           <= 4 * 1024 * 1024):
         g *= 2
     return g
 
@@ -446,7 +452,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
     # 8-lane replication (TPU block tiling minimum for a row vector)
     dl = jnp.broadcast_to(dl[..., None], dl.shape + (8,))
     lsep = jnp.broadcast_to(lsep[..., None], lsep.shape + (8,))
-    g = _head_group(b * h, block_q, block_k)
+    g = _head_group(b * h, block_q, block_k, n_tiles=4)
     need_mask = (skp != sk) or (sqp != sq)
 
     if nq == 1 and nk == 1:

@@ -222,28 +222,54 @@ class Optimizer:
         for s, ns in zip(state, new_state):
             s._data = ns
 
+    def fused_step_fn(self):
+        """Pure TRACEABLE multi-tensor update: the whole-step fusion
+        surface ``Trainer.compile_step`` folds into its one program, and
+        the body ``_jitted_multi`` compiles standalone for the eager path.
+
+        Signature: ``(ws, gs, lrs, wds, ts, rescale, clip, states) ->
+        (new_ws, new_states)`` where ws/gs/states are tuples over params
+        and lrs/wds/ts index per-param hyperparameters (list of scalars
+        OR traced 1-d arrays — both support ``[i]``). rescale/clip are
+        traced scalars so ``trainer.learning_rate = x`` / per-step batch
+        size never force a retrace."""
+        rule = self._rule()
+        has_clip = self.clip_gradient is not None
+
+        def stepfn(ws, gs, lrs, wds, ts, rescale, clip, states):
+            new_ws, new_ss = [], []
+            for i, (w, g, st) in enumerate(zip(ws, gs, states)):
+                g = g * rescale
+                if has_clip:
+                    g = jnp.clip(g, -clip, clip)
+                nw, ns = rule(w, g, lrs[i], wds[i], ts[i], st)
+                new_ws.append(nw)
+                new_ss.append(ns)
+            return tuple(new_ws), tuple(new_ss)
+
+        return stepfn
+
+    def begin_fused_step(self, indices):
+        """Host-side half of a fused whole-train-step: advance the
+        per-index update counts (same bookkeeping the eager
+        ``_update_multi`` does) and return ``(lrs, wds, ts)`` as small
+        host arrays to be passed as TRACED arguments — changing the
+        learning rate, a scheduler tick, or weight decay never
+        recompiles the step program."""
+        ts = [self._update_count(i) for i in indices]
+        lrs = [self._get_lr(i) for i in indices]
+        wds = [self._get_wd(i) for i in indices]
+        return (onp.asarray(lrs, onp.float32), onp.asarray(wds, onp.float32),
+                onp.asarray(ts, onp.int32))
+
     def _jitted_multi(self):
         """Multi-tensor fused step (reference multi_sgd_mom_update,
         src/operator/optimizer_op.cc): ALL parameter updates compile into
         ONE XLA program — one dispatch per optimizer step instead of one
         per parameter."""
         if getattr(self, "_jit_multi", None) is None:
-            rule = self._rule()
-            has_clip = self.clip_gradient is not None
-
-            def stepfn(ws, gs, lrs, wds, ts, rescale, clip, states):
-                new_ws, new_ss = [], []
-                for w, g, lr, wd, t, st in zip(ws, gs, lrs, wds, ts,
-                                               states):
-                    g = g * rescale
-                    if has_clip:
-                        g = jnp.clip(g, -clip, clip)
-                    nw, ns = rule(w, g, lr, wd, t, st)
-                    new_ws.append(nw)
-                    new_ss.append(ns)
-                return tuple(new_ws), tuple(new_ss)
-
-            self._jit_multi = jax.jit(stepfn, donate_argnums=(7,))
+            self._jit_multi = jax.jit(self.fused_step_fn(),
+                                      donate_argnums=(7,))
         return self._jit_multi
 
     def _update_multi(self, indices, weights, grads, states):

@@ -61,7 +61,11 @@ def _node_shapes(symbol: Symbol, shapes: Dict) -> Dict[int, tuple]:
         _infer_param_shapes(node, shapes, out_shape)
         in_structs = []
         for inp in node._inputs:
-            s = out_shape.get(id(inp)) or shapes.get(inp._name)
+            # explicit None checks: a 0-d shape () is falsy but RESOLVED —
+            # `or`-chaining would misreport it as missing
+            s = out_shape.get(id(inp))
+            if s is None:
+                s = shapes.get(inp._name)
             if s is None:
                 unresolved.append(inp._name)
             else:
@@ -88,7 +92,11 @@ def _infer_param_shapes(node: Symbol, shapes: Dict, out_shape: Dict) -> None:
         return
     op, attrs = node._op, node._attrs
     data = node._inputs[0]
-    in_shape = out_shape.get(id(data)) or shapes.get(data._name) or ()
+    in_shape = out_shape.get(id(data))
+    if in_shape is None:
+        in_shape = shapes.get(data._name)
+    if in_shape is None:
+        in_shape = ()
     guesses: Dict[str, tuple] = {}
     if op in _CONV_OPS and len(in_shape) > 1:
         nf = int(attrs.get("num_filter", 0) or 0)

@@ -288,3 +288,26 @@ def test_transforms_hybrid_random_apply_cond():
     assert seen <= {1.0, 2.0} and len(seen) == 2
     with pytest.raises(AssertionError):
         T.HybridRandomApply(T.ToTensor(), p=0.5)
+
+
+def test_hybrid_random_apply_probability_direction():
+    """``p`` is the probability of APPLYING the transform (the seed had
+    it inverted: applied with 1-p). Directional check with p near 0 and
+    1: at p=0.05 the transform must fire rarely, at p=0.95 almost
+    always."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    class Scale(mx.gluon.HybridBlock):
+        def forward(self, x):
+            return x * 2.0
+
+    img = mx.nd.array(onp.ones((2, 2, 3), "float32"))
+    n = 200
+    for p, lo, hi in ((0.05, 0.0, 0.3), (0.95, 0.7, 1.0)):
+        mx.random.seed(42)
+        tf = T.HybridRandomApply(Scale(), p=p)
+        applied = sum(
+            float(tf(img).asnumpy().ravel()[0]) == 2.0 for _ in range(n))
+        frac = applied / n
+        assert lo <= frac <= hi, \
+            f"p={p}: applied fraction {frac} outside [{lo}, {hi}]"

@@ -213,3 +213,17 @@ def test_resnet18_summary_matches_gluon_param_count(capsys):
 
     dot = mx.viz.plot_network(fc, shape=shapes)
     assert "s3b1_conv2" in dot.source
+
+
+def test_node_shapes_scalar_interior_output_not_missing():
+    """A 0-d interior output (shape ()) is falsy: `or`-chained lookups
+    misreported it as a missing input shape. Explicit `is None` checks
+    must resolve it (ISSUE 1 satellite)."""
+    data = sym.Variable("data")
+    total = sym.sum(data)          # interior node, output shape ()
+    out = data * total
+    from mxnet_tpu.visualization import _node_shapes
+    shp = _node_shapes(out, {"data": (2, 3)})
+    assert sorted(shp.values()) == [(), (2, 3), (2, 3)]
+    # and the user-facing surface runs end to end over it
+    assert mx.viz.print_summary(out, shape={"data": (2, 3)}) == 0
