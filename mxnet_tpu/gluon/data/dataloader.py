@@ -11,8 +11,7 @@ double-buffering.
 """
 from __future__ import annotations
 
-import queue
-import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
@@ -94,19 +93,34 @@ class DataLoader:
                 yield self._load_batch(indices)
             return
         # threaded pipeline with bounded in-flight futures
-        # (reference prefetcher double-buffering, src/io/iter_prefetcher.h)
-        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+        # (reference prefetcher double-buffering, src/io/iter_prefetcher.h).
+        # Cleanup contract: on a worker exception, a timeout, or the
+        # consumer abandoning the iterator (break/close), every remaining
+        # in-flight future is cancelled and the pool shut down WITHOUT
+        # waiting — a failing dataset must not block behind (or silently
+        # run) the rest of the prefetch window.
+        from concurrent.futures import TimeoutError as _FutTimeout
+        pool = ThreadPoolExecutor(max_workers=self._num_workers)
+        inflight = deque()
+        try:
             batches = iter(self._batch_sampler)
-            inflight = queue.Queue()
-            submitted = 0
             for indices in batches:
-                inflight.put(pool.submit(self._load_batch, indices))
-                submitted += 1
-                if submitted >= self._prefetch:
+                inflight.append(pool.submit(self._load_batch, indices))
+                if len(inflight) >= self._prefetch:
                     break
-            while not inflight.empty():
-                fut = inflight.get()
+            while inflight:
+                fut = inflight.popleft()
+                try:
+                    batch = fut.result(timeout=self._timeout)
+                except _FutTimeout:
+                    raise MXNetError(
+                        f"DataLoader worker produced no batch within "
+                        f"timeout={self._timeout}s") from None
                 nxt = next(batches, None)
                 if nxt is not None:
-                    inflight.put(pool.submit(self._load_batch, nxt))
-                yield fut.result(timeout=self._timeout)
+                    inflight.append(pool.submit(self._load_batch, nxt))
+                yield batch
+        finally:
+            while inflight:
+                inflight.popleft().cancel()
+            pool.shutdown(wait=False, cancel_futures=True)

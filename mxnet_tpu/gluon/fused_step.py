@@ -39,6 +39,18 @@ Contracts:
   ``update_on_kvstore`` stores, and blocks whose forward cannot trace
   (host-side numpy, data-dependent Python control flow) fall back to the
   eager record/backward/step loop with identical numerics.
+- **ZeRO-1 sharded update.** When a ``DeviceMesh`` with a data-parallel
+  axis is active (``parallel.make_mesh``), the redundant replicated
+  weight update is cross-replica sharded (arXiv:2004.13336): gradients
+  are constrained to a flat 1/N-per-replica layout (XLA's weight-update
+  sharding pass turns the gradient all-reduce into a reduce-scatter),
+  the optimizer rule runs on each replica's shard, and the new weights
+  all-gather back to replicated. Optimizer state (momenta, Adam moments,
+  fp32 master copies of multi-precision params) lives permanently
+  sharded via ``NamedSharding`` — per-replica state memory drops ~N×.
+  Parameters smaller than ``MXNET_ZERO_SHARD_MIN_SIZE`` elements bucket
+  into one fused shard per dtype so tiny tensors don't pay a collective
+  each. See ``_ZeroShardPlan``.
 """
 from __future__ import annotations
 
@@ -65,6 +77,185 @@ _LOG = logging.getLogger("mxnet_tpu.fused_step")
 _ARRAY_TYPES = (NDArray, onp.ndarray, jax.Array)
 
 
+def _place_on_mesh(mesh, axis: str, d):
+    """Lay a step input out on the mesh: batch-shard dim0 over ``axis``
+    when divisible (``shard_batch`` semantics), else replicate; arrays
+    already resident on this mesh pass through."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if not hasattr(d, "shape"):
+        return d
+    sh = getattr(d, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh.mesh:
+        return d
+    d = jnp.asarray(d)
+    n = int(mesh.shape[axis])
+    if d.ndim >= 1 and d.shape[0] and d.shape[0] % n == 0:
+        spec = PartitionSpec(axis, *([None] * (d.ndim - 1)))
+        return jax.device_put(d, NamedSharding(mesh.mesh, spec))
+    return jax.device_put(d, NamedSharding(mesh.mesh, PartitionSpec()))
+
+
+def _zero_min_size() -> int:
+    try:
+        return int(os.environ.get("MXNET_ZERO_SHARD_MIN_SIZE", "2048"))
+    except ValueError:
+        return 2048
+
+
+class _ZeroShardPlan:
+    """Host-side layout of the ZeRO-1 sharded weight update
+    (arXiv:2004.13336 "Automatic Cross-Replica Sharding of Weight Update
+    in Data-Parallel Training").
+
+    Trainable parameters map to UNITS:
+
+    - every parameter with flat size >= ``MXNET_ZERO_SHARD_MIN_SIZE``
+      (and every multi-precision parameter) is its own unit;
+    - smaller parameters concatenate into one bucket unit per dtype, so
+      tiny tensors share a single reduce-scatter/all-gather instead of
+      paying one collective each (their hyperparameters pack into
+      per-element vectors — ``Optimizer.pack_shard_hparams``).
+
+    Each unit is a flat buffer zero-padded to a multiple of the dp-axis
+    size; its optimizer state (and the fp32 master copy of a
+    multi-precision unit) lives as ``NamedSharding``-partitioned arrays,
+    1/N per replica. Weights stay replicated for the forward; inside the
+    compiled step the flat gradient is constrained to the sharded layout
+    (XLA's weight-update-sharding pass converts the gradient all-reduce
+    into a reduce-scatter feeding it), the elementwise optimizer rule
+    runs shard-locally, and the new weights are constrained back to
+    replicated (an all-gather).
+    """
+
+    def __init__(self, trainer, mesh, axis: str):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.mesh import zero_shard_pad
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = int(mesh.shape[axis])
+        self.shard = NamedSharding(mesh.mesh, PartitionSpec(axis))
+        self.repl = NamedSharding(mesh.mesh, PartitionSpec())
+        opt = trainer._optimizer
+        params = trainer._params
+        min_size = _zero_min_size()
+
+        raw_units = []
+        small: "dict[str, list]" = {}
+        for j, p in enumerate(params):
+            d = p._data._data
+            mp = opt.multi_precision and d.dtype in (jnp.float16,
+                                                     jnp.bfloat16)
+            if mp or int(d.size) >= min_size:
+                raw_units.append((tuple([j]), mp))
+            else:
+                small.setdefault(str(d.dtype), []).append(j)
+        for js in small.values():
+            raw_units.append((tuple(js), False))
+
+        self.units = []
+        self.states = []       # per unit: tuple of flat sharded NDArrays
+        self.masters = []      # flat sharded fp32 masters (mp units only)
+        self.master_slot = {}  # unit index -> slot in self.masters
+        for members, mp in raw_units:
+            shapes = tuple(tuple(params[j]._data._data.shape)
+                           for j in members)
+            dtypes = tuple(params[j]._data._data.dtype for j in members)
+            sizes = tuple(int(onp.prod(s)) if s else 1 for s in shapes)
+            total = int(sum(sizes))
+            self.units.append(dict(
+                members=members, shapes=shapes, dtypes=dtypes, sizes=sizes,
+                total=total, padded=zero_shard_pad(total, self.n_shards),
+                mp=mp, upd_dtype=jnp.float32 if mp else dtypes[0]))
+        for k, unit in enumerate(self.units):
+            if unit["mp"]:
+                j = unit["members"][0]
+                master = params[j]._data._data.astype(jnp.float32)
+                self.master_slot[k] = len(self.masters)
+                self.masters.append(NDArray(self._flat_shard(
+                    master.reshape(-1), unit["padded"])))
+            self.states.append(tuple(
+                NDArray(x) for x in self._unit_state_leaves(trainer, unit)))
+
+    # ---------------- layout helpers ----------------
+    def _flat_shard(self, flat, padded: int):
+        n = int(flat.shape[0])
+        if n != padded:
+            flat = jnp.pad(flat, (0, padded - n))
+        return jax.device_put(flat, self.shard)
+
+    def _unit_state_leaves(self, trainer, unit):
+        """Create (or adopt from the Updater) each member's optimizer
+        state, then concatenate + pad + shard per state slot."""
+        opt = trainer._optimizer
+        params = trainer._params
+        per_member = []
+        for j, shape in zip(unit["members"], unit["shapes"]):
+            p = params[j]
+            src = NDArray(jnp.asarray(p._data._data, jnp.float32)) \
+                if unit["mp"] else p.data()
+            st = trainer._updater.states.get(j)
+            if not (isinstance(st, tuple)
+                    and all(isinstance(s, NDArray)
+                            and tuple(s.shape) == shape for s in st)):
+                st = opt.create_state(j, src)
+            per_member.append(tuple(s._data.reshape(-1) for s in st))
+        counts = {len(m) for m in per_member}
+        if len(counts) != 1:
+            raise MXNetError(
+                "zero-shard: optimizer state leaf count differs across "
+                f"bucket members ({sorted(counts)})")
+        leaves = []
+        for li in range(counts.pop()):
+            flats = [m[li] for m in per_member]
+            flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+            leaves.append(self._flat_shard(flat, unit["padded"]))
+        return leaves
+
+    # ---------------- per-step host work ----------------
+    def pack_hparams(self, opt, lrs, wds, ts):
+        """Per-unit hyperparameters: scalars for single-param units,
+        per-element packed vectors for buckets."""
+        ulrs, uwds, uts = [], [], []
+        for unit in self.units:
+            m = unit["members"]
+            if len(m) == 1:
+                ulrs.append(onp.float32(lrs[m[0]]))
+                uwds.append(onp.float32(wds[m[0]]))
+                uts.append(onp.int32(ts[m[0]]))
+            else:
+                lv, wv, tv = opt.pack_shard_hparams(
+                    lrs, wds, ts, list(m), list(unit["sizes"]),
+                    unit["padded"])
+                ulrs.append(lv)
+                uwds.append(wv)
+                uts.append(tv)
+        return tuple(ulrs), tuple(uwds), tuple(uts)
+
+    def place_leaf(self, d):
+        return _place_on_mesh(self.mesh, self.axis, d)
+
+    # ---------------- observability ----------------
+    @staticmethod
+    def _per_replica_bytes(a) -> int:
+        sh = getattr(a, "sharding", None)
+        if sh is not None:
+            try:
+                shp = sh.shard_shape(a.shape)
+                return int(onp.prod(shp)) * a.dtype.itemsize
+            except Exception:   # pragma: no cover - exotic shardings
+                pass
+        return int(a.size) * a.dtype.itemsize
+
+    def state_bytes_per_replica(self) -> int:
+        total = 0
+        for st in self.states:
+            for s in st:
+                total += self._per_replica_bytes(s._data)
+        for m in self.masters:
+            total += self._per_replica_bytes(m._data)
+        return total
+
+
 def _infer_batch_size(traced) -> int:
     for leaf in traced:
         d = leaf._data if isinstance(leaf, NDArray) else leaf
@@ -84,7 +275,8 @@ class CompiledTrainStep:
     """
 
     def __init__(self, trainer, loss_fn: Callable, donate: bool = True,
-                 train_mode: bool = True):
+                 train_mode: bool = True, zero_shard: Optional[bool] = None,
+                 zero_axis: str = "dp", mesh=None):
         self._trainer = trainer
         self._loss_fn = loss_fn
         self._donate = donate
@@ -94,6 +286,15 @@ class CompiledTrainStep:
         self._trace_signatures: set = set()
         self._n_traces = 0
         self._steps_done = 0
+        # ZeRO-1 sharded update: None = auto (on when a mesh with a
+        # `zero_axis` axis is active), True = required, False = off
+        self._zero_requested = zero_shard
+        self._zero_axis = zero_axis
+        self._zero_mesh = mesh
+        self._zero_ok: Optional[tuple] = None   # (mesh, axis) once decided
+        self._zero: Optional[_ZeroShardPlan] = None
+        self._plain_mesh: Optional[tuple] = None  # mesh-aware plain mode
+        self._mesh_prepared = False
 
         # dedup while preserving order: tied params may appear twice in a
         # collected dict; bind each object once
@@ -119,6 +320,27 @@ class CompiledTrainStep:
     def mode(self) -> Optional[str]:
         return self._mode
 
+    @property
+    def zero_sharded(self) -> bool:
+        """True when the ZeRO-1 sharded weight update is active."""
+        return self._zero is not None or self._zero_ok is not None
+
+    def optimizer_state_bytes(self) -> int:
+        """PER-REPLICA bytes of optimizer state (momenta/moments + fp32
+        master copies). Under the ZeRO-1 sharded mode each replica holds
+        1/N of every state buffer; in the plain fused and eager modes
+        state is fully replicated — the ratio between the two is the
+        memory the sharded update frees (~N× for Adam)."""
+        if self._zero is not None:
+            return self._zero.state_bytes_per_replica()
+        total = 0
+        for st in self._trainer._updater.states.values():
+            for s in jax.tree_util.tree_leaves(
+                    st, is_leaf=lambda x: isinstance(x, NDArray)):
+                if isinstance(s, NDArray):
+                    total += _ZeroShardPlan._per_replica_bytes(s._data)
+        return total
+
     # ---------------- mode decision ----------------
     def _decide_mode(self) -> str:
         tr = self._trainer
@@ -140,12 +362,53 @@ class CompiledTrainStep:
                 return "eager"   # deferred shapes: eager forward infers
             if p.stype != "default" or p._grad_stype != "default":
                 return "eager"   # sparse storage/grad: lazy row path
+        zero = self._resolve_zero()
         opt = self._trainer._optimizer
-        if opt.multi_precision and any(
+        if not zero and opt.multi_precision and any(
                 p._data._data.dtype in (jnp.float16, jnp.bfloat16)
                 for p in self._trainer._params):
-            return "eager"       # master-weight states: not fused yet
+            # master-weight states fuse only via the sharded update
+            # (the zero plan owns flat fp32 masters); plain mode: eager
+            return "eager"
         return "fused"
+
+    def _resolve_zero(self) -> bool:
+        """Decide whether the ZeRO-1 sharded update applies: a mesh with
+        the dp axis must be active, the optimizer rule elementwise, and
+        the kvstore's reduction must both live in-program AND advertise
+        the reduce-scatter decomposition. A valid mesh whose update is
+        gated off (opt-out, non-elementwise optimizer) still runs the
+        PLAIN fused mode mesh-aware — params replicated, batch sharded,
+        psum in-program."""
+        from ..parallel.mesh import current_mesh
+        mesh = self._zero_mesh or current_mesh()
+        axis = self._zero_axis
+        mesh_ok = (mesh is not None and axis in mesh.axis_names
+                   and mesh.shape[axis] >= 2)
+        if mesh_ok:
+            self._plain_mesh = (mesh, axis)
+        reason = None
+        if self._zero_requested is False:
+            return False
+        if not mesh_ok:
+            reason = f"no active mesh with a {axis!r} axis of size >= 2"
+        else:
+            opt = self._trainer._optimizer
+            kv = self._trainer._kvstore
+            if not getattr(opt, "elementwise_update", False):
+                reason = (f"{type(opt).__name__} update is not elementwise "
+                          "(cannot run on flat shards)")
+            elif self._host_allreduce():
+                reason = "kvstore reduction cannot live in-program"
+            elif kv is not None and not getattr(
+                    kv, "in_program_reduce_scatter", True):
+                reason = "kvstore does not advertise the reduce-scatter path"
+        if reason is not None:
+            if self._zero_requested:
+                raise MXNetError(f"compile_step(zero_shard=True): {reason}")
+            return False
+        self._zero_ok = (mesh, axis)
+        return True
 
     def _host_allreduce(self) -> bool:
         kv = self._trainer._kvstore
@@ -274,6 +537,70 @@ class CompiledTrainStep:
             gs = tuple(grads[i] for i in t_pos)
             return l, state, gs
 
+        if self._zero is not None:
+            # ZeRO-1 sharded update: grads constrained to the flat
+            # 1/N-per-replica layout (XLA converts the allreduce into a
+            # reduce-scatter feeding it), elementwise rule on each
+            # replica's shard against permanently-sharded state, new
+            # weights constrained back to replicated (all-gather).
+            plan = self._zero
+            shard, repl = plan.shard, plan.repl
+            units = plan.units
+            mslot = plan.master_slot
+            wsc = jax.lax.with_sharding_constraint
+
+            def _flat_cat(arrs):
+                flats = [a.reshape(-1) for a in arrs]
+                return flats[0] if len(flats) == 1 \
+                    else jnp.concatenate(flats)
+
+            def _padded(v, padded):
+                n = v.shape[0]
+                return v if n == padded else jnp.pad(v, (0, padded - n))
+
+            def zero_fused(pds, sts, masters, traced_leaves, ulrs, uwds,
+                           uts, rescale, clip, key):
+                step_self._n_traces += 1
+                l, state, gs = grad_part(pds, traced_leaves, key)
+                ws_u, gs_u = [], []
+                for k, u in enumerate(units):
+                    if u["mp"]:
+                        wflat = masters[mslot[k]]   # persistent fp32 shard
+                    else:
+                        wflat = wsc(_padded(_flat_cat(
+                            [pds[t_pos[j]] for j in u["members"]]),
+                            u["padded"]), shard)
+                    gflat = _padded(_flat_cat(
+                        [gs[j] for j in u["members"]]), u["padded"])
+                    gflat = wsc(gflat.astype(u["upd_dtype"]), shard)
+                    ws_u.append(wflat)
+                    gs_u.append(gflat)
+                new_ws, new_sts = opt_fn(tuple(ws_u), tuple(gs_u), ulrs,
+                                         uwds, uts, rescale, clip, sts)
+                new_pds = list(state)
+                new_masters = [None] * len(mslot)
+                for k, u in enumerate(units):
+                    full = wsc(new_ws[k], repl)     # the all-gather
+                    off = 0
+                    for j, shp, n, dt in zip(u["members"], u["shapes"],
+                                             u["sizes"], u["dtypes"]):
+                        new_pds[t_pos[j]] = \
+                            full[off:off + n].reshape(shp).astype(dt)
+                        off += n
+                    if u["mp"]:
+                        new_masters[mslot[k]] = wsc(new_ws[k], shard)
+                # pin the state outputs to the sharded layout: the
+                # replicated all-gather consumer above must not make
+                # GSPMD replicate the persistent buffers on the way out
+                new_sts = tuple(tuple(wsc(s, shard) for s in st)
+                                for st in new_sts)
+                return (tuple(new_pds), new_sts, tuple(new_masters), l)
+
+            donate_z = (0, 1, 2) if self._donate else ()
+            return {"kind": "zero",
+                    "fn": jax.jit(zero_fused, donate_argnums=donate_z),
+                    "exe": None, "flops": None}
+
         if self._host_allreduce():
             # split mode (dist stores): program A computes loss+grads+
             # functional state; the kvstore's bucketed pushpull_list runs
@@ -325,10 +652,56 @@ class CompiledTrainStep:
                            if opt.clip_gradient is not None else 0.0)
         return lrs, wds, ts, rescale, clip
 
+    def _prepare_zero(self):
+        """Materialize the zero plan: replicate weights on the mesh and
+        build the flat sharded state/master buffers."""
+        mesh, axis = self._zero_ok
+        repl_sharding = mesh.sharding()
+        for p in self._all_params:
+            p._write_fused(jax.device_put(p._data._data, repl_sharding))
+        self._zero = _ZeroShardPlan(self._trainer, mesh, axis)
+
+    def _zero_call(self, entry, traced, batch_size):
+        plan = self._zero
+        pds = tuple(p._data._data for p in self._all_params)
+        sts = tuple(tuple(s._data for s in st) for st in plan.states)
+        masters = tuple(m._data for m in plan.masters)
+        leaf_datas = tuple(plan.place_leaf(
+            l._data if isinstance(l, NDArray) else l) for l in traced)
+        lrs, wds, ts, rescale, clip = self._scalars(batch_size)
+        ulrs, uwds, uts = plan.pack_hparams(self._trainer._optimizer,
+                                            lrs, wds, ts)
+        key = next_key()
+        new_pds, new_sts, new_masters, l = entry["fn"](
+            pds, sts, masters, leaf_datas, ulrs, uwds, uts, rescale, clip,
+            key)
+        # writeback: same handles, new buffers (donation contract); the
+        # state/master handles stay sharded across steps
+        for p, nw in zip(self._all_params, new_pds):
+            p._write_fused(nw)
+        for st, ns in zip(plan.states, new_sts):
+            for s, n in zip(st, ns):
+                s._data = n
+        for m, nm in zip(plan.masters, new_masters):
+            m._data = nm
+        return NDArray(l)
+
     def _fused_call(self, args, kwargs, batch_size):
+        if self._zero_ok is not None and self._zero is None:
+            self._prepare_zero()
+        elif self._plain_mesh is not None and not self._mesh_prepared:
+            # mesh-aware PLAIN mode (zero gated off): params replicate on
+            # the mesh so dp-sharded batches psum in-program
+            mesh, _ = self._plain_mesh
+            repl_sharding = mesh.sharding()
+            for p in self._all_params:
+                p._write_fused(jax.device_put(p._data._data, repl_sharding))
+            self._mesh_prepared = True
         entry, traced = self._entry_for(args, kwargs)
         if batch_size is None:
             batch_size = _infer_batch_size(traced)
+        if entry["kind"] == "zero":
+            return self._zero_call(entry, traced, batch_size)
         states = self._ensure_states()
         for st in states:
             if not (isinstance(st, tuple) and all(
@@ -340,6 +713,10 @@ class CompiledTrainStep:
         sts = tuple(tuple(s._data for s in st) for st in states)
         leaf_datas = tuple(l._data if isinstance(l, NDArray) else l
                            for l in traced)
+        if self._mesh_prepared:
+            mesh, axis = self._plain_mesh
+            leaf_datas = tuple(_place_on_mesh(mesh, axis, d)
+                               for d in leaf_datas)
         lrs, wds, ts, rescale, clip = self._scalars(batch_size)
         key = next_key()
 
@@ -381,7 +758,10 @@ class CompiledTrainStep:
         unavailable). Does not advance optimizer counts."""
         if self._mode is None:
             self._mode = self._decide_mode()
-        if self._mode != "fused" or self._host_allreduce():
+        if self._mode != "fused" or self._host_allreduce() \
+                or self._zero_ok is not None:
+            # zero mode: jit-compiles on first step; AOT flop pinning is
+            # not wired for the sharded signature yet
             return None
         entry, traced = self._entry_for(args, kwargs)
         if entry["exe"] is not None:

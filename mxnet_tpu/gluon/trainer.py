@@ -69,7 +69,9 @@ class Trainer:
 
     # ---------------- fused whole-step compilation ----------------
     def compile_step(self, loss_fn, donate: bool = True,
-                     train_mode: bool = True):
+                     train_mode: bool = True,
+                     zero_shard: Optional[bool] = None,
+                     zero_axis: str = "dp", mesh=None):
         """Compile the ENTIRE training step — forward, backward, gradient
         reduction, optimizer update — into one donated-buffer XLA program
         per input-shape bucket (gluon/fused_step.py)::
@@ -83,13 +85,28 @@ class Trainer:
         the leading batch axis (override per call:
         ``step(x, y, batch_size=n)``). lr/wd/update-count/rescale are
         traced arguments — mutating ``trainer.learning_rate`` or varying
-        the batch size never recompiles. Sparse-grad/multi-precision
-        parameters, ``update_on_kvstore`` stores, and non-traceable
-        forwards fall back transparently to the eager tape path.
+        the batch size never recompiles. Sparse-grad parameters,
+        ``update_on_kvstore`` stores, and non-traceable forwards fall
+        back transparently to the eager tape path.
+
+        **ZeRO-1 sharded update** (arXiv:2004.13336): when a
+        ``parallel.DeviceMesh`` with a ``zero_axis`` ('dp') axis of size
+        N >= 2 is active — or passed via ``mesh=`` — the redundant
+        replicated weight update is cross-replica sharded: gradients
+        reduce-scatter, each replica updates its 1/N flat shard against
+        permanently-NamedSharding-sharded optimizer state (momenta, Adam
+        moments, fp32 masters of multi-precision params), and the new
+        weights all-gather back. Per-replica optimizer-state memory
+        drops ~N×. ``zero_shard``: None = auto-detect, True = require
+        (raises if no mesh), False = keep the plain in-program psum.
+        Parameters below ``MXNET_ZERO_SHARD_MIN_SIZE`` elements bucket
+        into one fused shard per dtype (docs/PERF_NOTES.md).
         """
         from .fused_step import CompiledTrainStep
         return CompiledTrainStep(self, loss_fn, donate=donate,
-                                 train_mode=train_mode)
+                                 train_mode=train_mode,
+                                 zero_shard=zero_shard,
+                                 zero_axis=zero_axis, mesh=mesh)
 
     # ---------------- kvstore setup (reference trainer.py:188) -------------
     def _init_kvstore(self):

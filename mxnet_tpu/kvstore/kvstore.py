@@ -228,6 +228,18 @@ class KVStoreTPU(KVStoreBase):
         gradient and update programs."""
         return True
 
+    @property
+    def in_program_reduce_scatter(self) -> bool:
+        """True when the in-program reduction may additionally lower to
+        the ZeRO-1 decomposition (reduce-scatter → shard-local optimizer
+        update → all-gather, arXiv:2004.13336) instead of a plain psum —
+        the path ``Trainer.compile_step`` takes on a dp mesh. Single-
+        process stores hold one logical array per parameter, so XLA is
+        free to re-associate the reduction; stores that cannot reduce
+        in-program (``in_program_reduce`` False) cannot reduce-scatter
+        in-program either."""
+        return self.in_program_reduce
+
     # ---------------- topology ----------------
     @property
     def rank(self) -> int:

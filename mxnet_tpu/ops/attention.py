@@ -39,6 +39,15 @@ __all__ = ["flash_attention", "ring_attention", "ring_attention_sharded",
 _NEG_INF = -1e30  # finite mask value: keeps exp() NaN-free for masked rows
 
 
+def _PLTPU_COMPILER_PARAMS(**kwargs):
+    """pallas-tpu CompilerParams across jax versions (older releases spell
+    it TPUCompilerParams)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def attention_reference(q, k, v, causal: bool = False,
                         sm_scale: Optional[float] = None, mask=None):
     """Unfused softmax(QK^T)V — the numeric oracle for tests and the
@@ -275,7 +284,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             pltpu.VMEM((g, block_q, 128), jnp.float32),
             pltpu.VMEM((g, block_q, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_PLTPU_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
@@ -471,7 +480,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
             out_shape=[jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
                        jax.ShapeDtypeStruct((b * h, skp, dp), k.dtype),
                        jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_PLTPU_COMPILER_PARAMS(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
         )(qp, kp, vp, dop, lsep, dl)
@@ -496,7 +505,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((g, block_q, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_PLTPU_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, dl)
@@ -515,7 +524,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
                    jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype)],
         scratch_shapes=[pltpu.VMEM((g, block_k, dp), jnp.float32),
                         pltpu.VMEM((g, block_k, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_PLTPU_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, dl)
@@ -692,6 +701,6 @@ def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
     spec = P(None, None, axis, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
                            sm_scale=sm_scale)
-    return jax.shard_map(lambda a, b_, c: fn(a, b_, c), mesh=m,
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    from ..parallel.collectives import shard_map as _shard_map
+    return _shard_map(lambda a, b_, c: fn(a, b_, c), m,
+                      (spec, spec, spec), spec)(q, k, v)

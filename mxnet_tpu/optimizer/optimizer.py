@@ -54,6 +54,13 @@ class Optimizer:
     The rule is jitted once with donated buffers.
     """
 
+    # True when the update rule is purely elementwise over the weight (no
+    # cross-element reductions like LARS/LAMB trust ratios or GroupAdaGrad
+    # row means) AND tolerates vector-valued lr/wd/t. Elementwise rules can
+    # run on arbitrary flat 1/N shards of the weight — the property the
+    # ZeRO-1 sharded update (gluon/fused_step.py) keys on.
+    elementwise_update = True
+
     def __init__(self, rescale_grad: float = 1.0, param_idx2name=None,
                  wd: float = 0.0, clip_gradient: Optional[float] = None,
                  learning_rate: Optional[float] = None, lr_scheduler=None,
@@ -99,18 +106,24 @@ class Optimizer:
 
     def _get_lr(self, index) -> float:
         lr = self.learning_rate
-        name = self.idx2name.get(index, index)
         if index in self.param_dict:
             lr *= self.param_dict[index].lr_mult
-        lr *= self._lr_mult.get(name, self._lr_mult.get(index, 1.0))
+        # reference optimizer.py precedence: an index-keyed mult wins over
+        # a name-keyed one for the same parameter
+        if index in self._lr_mult:
+            lr *= self._lr_mult[index]
+        else:
+            lr *= self._lr_mult.get(self.idx2name.get(index, index), 1.0)
         return lr
 
     def _get_wd(self, index) -> float:
         wd = self.wd
-        name = self.idx2name.get(index, index)
         if index in self.param_dict:
             wd *= self.param_dict[index].wd_mult
-        wd *= self._wd_mult.get(name, self._wd_mult.get(index, 1.0))
+        if index in self._wd_mult:
+            wd *= self._wd_mult[index]
+        else:
+            wd *= self._wd_mult.get(self.idx2name.get(index, index), 1.0)
         return wd
 
     def _update_count(self, index):
@@ -232,7 +245,14 @@ class Optimizer:
         and lrs/wds/ts index per-param hyperparameters (list of scalars
         OR traced 1-d arrays — both support ``[i]``). rescale/clip are
         traced scalars so ``trainer.learning_rate = x`` / per-step batch
-        size never force a retrace."""
+        size never force a retrace.
+
+        Under the ZeRO-1 sharded update (gluon/fused_step.py) each ``ws``
+        entry is a flat padded 1/N *shard* of one parameter — or of a
+        whole bucket of small parameters — and the matching lrs/wds/ts
+        entry may be a per-ELEMENT vector built by
+        ``pack_shard_hparams``; elementwise rules
+        (``elementwise_update``) apply unchanged either way."""
         rule = self._rule()
         has_clip = self.clip_gradient is not None
 
@@ -248,6 +268,27 @@ class Optimizer:
             return tuple(new_ws), tuple(new_ss)
 
         return stepfn
+
+    @staticmethod
+    def pack_shard_hparams(lrs, wds, ts, member_idx, sizes, padded):
+        """Per-shard lr/wd packing for a ZeRO bucket: several small
+        parameters concatenated into ONE flat sharded buffer need
+        per-ELEMENT hyperparameters. Repeats each member's scalar over its
+        flat segment; the pad tail gets lr=wd=0 and t=1 so bias-corrected
+        rules (Adam's ``1/(1-beta**t)``) stay finite on the padding.
+        Returns (lr_vec f32[padded], wd_vec f32[padded], t_vec i32[padded])
+        as plain host arrays — traced jit arguments, never retrace keys."""
+        lr_vec = onp.zeros(padded, onp.float32)
+        wd_vec = onp.zeros(padded, onp.float32)
+        t_vec = onp.ones(padded, onp.int32)
+        total = int(onp.sum(sizes))
+        lr_vec[:total] = onp.repeat(
+            onp.asarray(lrs, onp.float32)[member_idx], sizes)
+        wd_vec[:total] = onp.repeat(
+            onp.asarray(wds, onp.float32)[member_idx], sizes)
+        t_vec[:total] = onp.repeat(
+            onp.asarray(ts, onp.int32)[member_idx], sizes)
+        return lr_vec, wd_vec, t_vec
 
     def begin_fused_step(self, indices):
         """Host-side half of a fused whole-train-step: advance the
@@ -439,6 +480,10 @@ class Signum(Optimizer):
 @register
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py)."""
+
+    # jax.random.fold_in needs a SCALAR step count; vector ts from a
+    # bucketed shard would break the noise key derivation
+    elementwise_update = False
 
     def __init__(self, learning_rate=0.01, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -652,6 +697,8 @@ class GroupAdaGrad(Optimizer):
     mean(grad^2) over the non-leading axes. Weight decay is not
     supported, matching the reference."""
 
+    elementwise_update = False  # row-mean reduction needs the full shape
+
     def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         if self.wd != 0.0:
@@ -794,6 +841,8 @@ class FTML(Optimizer):
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (reference optimizer/lars.py)."""
 
+    elementwise_update = False  # trust ratio needs the full-layer norms
+
     def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -821,6 +870,8 @@ class LARS(Optimizer):
 @register
 class LAMB(Optimizer):
     """Layer-wise Adam for large-batch (reference optimizer/lamb.py)."""
+
+    elementwise_update = False  # trust ratio needs the full-layer norms
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
@@ -861,6 +912,8 @@ class LAMB(Optimizer):
 @register
 class LANS(Optimizer):
     """LAMB with normalized gradients (reference optimizer/lans.py)."""
+
+    elementwise_update = False  # trust ratio needs the full-layer norms
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, **kwargs):

@@ -7,7 +7,8 @@ parallelism flavor (dp/tp/pp/sp/ep); annotate shardings, let XLA insert the
 ICI/DCN collectives.
 """
 from .mesh import (DeviceMesh, make_mesh, current_mesh, data_parallel_mesh,
-                   shard_batch, replicate, shard_params)
+                   shard_batch, replicate, shard_params, zero_shard_pad,
+                   zero_shard_sharding)
 from .compression import GradientCompression
 from . import mesh, compression, dist, collectives, pipeline
 from .collectives import (allreduce, allgather, reduce_scatter,

@@ -30,18 +30,24 @@ def _get_mesh(mesh):
 
 
 def shard_map(fn, mesh, in_spec, out_spec):
-    """Version-compat ``jax.shard_map`` with value-based replication checks
-    off (check_vma: e.g. a tiled all_gather's output IS replicated over the
-    axis but the varying-axis inference can't prove it; numerics are
-    asserted in tests/test_parallel.py instead). Accepts a DeviceMesh or a
-    raw jax Mesh — the supported entry point for user/example code."""
+    """Version-compat ``shard_map`` with value-based replication checks
+    off (check_vma/check_rep: e.g. a tiled all_gather's output IS
+    replicated over the axis but the varying-axis inference can't prove
+    it; numerics are asserted in tests/test_parallel.py instead). Resolves
+    ``jax.shard_map`` (new jax) or ``jax.experimental.shard_map`` (<=0.4.x)
+    and whichever check kwarg that version spells. Accepts a DeviceMesh or
+    a raw jax Mesh — the supported entry point for user/example code."""
     raw = mesh.mesh if isinstance(mesh, DeviceMesh) else mesh
-    try:
-        return jax.shard_map(fn, mesh=raw, in_specs=in_spec,
-                             out_specs=out_spec, check_vma=False)
-    except TypeError:  # older jax without check_vma
-        return jax.shard_map(fn, mesh=raw, in_specs=in_spec,
-                             out_specs=out_spec)
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+    for check_kwarg in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return impl(fn, mesh=raw, in_specs=in_spec,
+                        out_specs=out_spec, **check_kwarg)
+        except TypeError:  # this jax spells the check kwarg differently
+            continue
+    raise MXNetError("no usable shard_map in this jax version")
 
 
 _shard_map = shard_map  # internal alias (pre-existing call sites)
@@ -87,12 +93,29 @@ def allgather(x: NDArray, axis: str = "dp",
 
 def reduce_scatter(x: NDArray, axis: str = "dp",
                    mesh: Optional[DeviceMesh] = None) -> NDArray:
+    """psum_scatter over a mesh axis: each shard receives the reduced
+    1/N tile of the leading dim — the first leg of the ZeRO-1 sharded
+    weight update (reduce-scatter → shard-local update → all-gather,
+    arXiv:2004.13336). A leading dim not divisible by the axis size is
+    zero-padded before the scatter and sliced back after, so arbitrary
+    parameter shapes ride the same collective."""
     mesh = _get_mesh(mesh)
+    n = mesh.shape[axis]
+    lead = int(x.shape[0]) if x.ndim >= 1 else 1
+    if x.ndim == 0:
+        raise MXNetError("reduce_scatter needs a >=1-d operand")
+    pad = (-lead) % n
+    data = x._data
+    if pad:
+        data = jnp.pad(data, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
 
     def f(v):
         return jax.lax.psum_scatter(v, axis, tiled=True)
     out = _shard_map(f, mesh, (P(),),
-                     _batch_spec_ndim(x.ndim, axis))(_on_mesh(x, mesh, P()))
+                     _batch_spec_ndim(x.ndim, axis))(
+                         _on_mesh(NDArray(data), mesh, P()))
+    if pad:
+        out = out[:lead]
     return NDArray(out)
 
 

@@ -20,7 +20,8 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DeviceMesh", "make_mesh", "current_mesh", "data_parallel_mesh",
-           "shard_batch", "replicate", "shard_params", "P"]
+           "shard_batch", "replicate", "shard_params", "zero_shard_pad",
+           "zero_shard_sharding", "P"]
 
 _state = threading.local()
 
@@ -45,6 +46,12 @@ class DeviceMesh:
 
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
+
+    def axis_size(self, axis: str) -> int:
+        if axis not in self.mesh.shape:
+            raise MXNetError(f"mesh has no axis {axis!r}; axes: "
+                             f"{self.axis_names}")
+        return int(self.mesh.shape[axis])
 
     def __enter__(self):
         stack = getattr(_state, "stack", None)
@@ -112,6 +119,23 @@ def replicate(data: NDArray, mesh: Optional[DeviceMesh] = None) -> NDArray:
     if mesh is None:
         return data
     return NDArray(jax.device_put(data._data, mesh.sharding()))
+
+
+def zero_shard_pad(n: int, num_shards: int) -> int:
+    """Smallest multiple of ``num_shards`` >= ``n`` — the padded flat length
+    a ZeRO-sharded buffer needs so every replica owns an equal 1/N tile
+    (arXiv:2004.13336 pads the weight-update buffers the same way)."""
+    if num_shards <= 0:
+        raise MXNetError(f"num_shards must be positive, got {num_shards}")
+    return -(-n // num_shards) * num_shards
+
+
+def zero_shard_sharding(mesh: DeviceMesh, axis: str = "dp") -> NamedSharding:
+    """NamedSharding that partitions a flat (1-D) buffer's leading dim over
+    ``axis`` — the layout optimizer state lives in under the ZeRO-1 sharded
+    weight update (gluon/fused_step.py)."""
+    mesh.axis_size(axis)  # validates the axis exists
+    return mesh.sharding(axis)
 
 
 def shard_params(params, rules: Sequence[Tuple[str, Tuple]],

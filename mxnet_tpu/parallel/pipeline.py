@@ -29,7 +29,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
     device's stage weights; ``microbatches`` (M, mb, ...) the full
     replicated stream. Returns (M, mb, ...) outputs, replicated (last
     stage's results psum-broadcast)."""
-    pp = lax.axis_size(axis_name)
+    pp = lax.psum(1, axis_name)  # axis size (lax.axis_size needs newer jax)
     idx = lax.axis_index(axis_name)
     m_count = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
@@ -52,11 +52,17 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
 
     def _varying(a):
         # the ring carry differs per device; mark the initial zeros as
-        # pp-varying so scan's carry types line up (JAX VMA tracking)
-        try:
-            return lax.pcast(a, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return lax.pvary(a, (axis_name,))
+        # pp-varying so scan's carry types line up (JAX VMA tracking).
+        # jax versions without pcast/pvary have no VMA tracking (we run
+        # shard_map with the replication check off) — identity is correct.
+        for name, kw in (("pcast", {"to": "varying"}), ("pvary", {})):
+            fn = getattr(lax, name, None)
+            if fn is not None:
+                try:
+                    return fn(a, (axis_name,), **kw)
+                except TypeError:
+                    continue
+        return a
 
     init = (_varying(jnp.zeros(mb_shape, microbatches.dtype)),
             _varying(jnp.zeros((m_count,) + mb_shape, microbatches.dtype)))
@@ -90,7 +96,8 @@ def run_pipeline(stage_fn, stacked_params, x, num_microbatches, mesh,
         params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
         return pipeline_apply(stage_fn, params_local, micro_all, axis_name)
 
-    out = jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(axis_name), P()), out_specs=P())(stacked_params, micro)
+    from .collectives import shard_map as _compat_shard_map
+    out = _compat_shard_map(
+        shard_fn, mesh,
+        (P(axis_name), P()), P())(stacked_params, micro)
     return out.reshape(b, *out.shape[2:])

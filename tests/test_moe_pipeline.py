@@ -85,10 +85,11 @@ def test_moe_expert_parallel_matches_dense():
 
     # every shard computes identical token outputs, but the all-to-alls
     # make that unprovable statically -> check_vma off
-    f = jax.jit(jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(), P(), P("ep"), P("ep")),
-        out_specs=(P(), P()), check_vma=False))
+    from mxnet_tpu.parallel import shard_map as _shard_map
+    f = jax.jit(_shard_map(
+        shard_fn, mesh,
+        (P(), P(), P("ep"), P("ep")),
+        (P(), P())))
     ep_out, ep_aux = f(jnp.asarray(x), gate, w1, w2)
     onp.testing.assert_allclose(onp.asarray(ep_out),
                                 onp.asarray(dense_out),
@@ -113,10 +114,10 @@ def test_moe_expert_parallel_gradients_flow():
                                        capacity_factor=4.0, axis_name="ep")
             return jnp.sum(out ** 2) + 0.01 * aux
 
-        return jax.shard_map(shard, mesh=mesh,
-                             in_specs=(P(), P(), P("ep"), P("ep")),
-                             out_specs=P(), check_vma=False)(xs, gw, w1s,
-                                                             w2s)
+        from mxnet_tpu.parallel import shard_map as _shard_map
+        return _shard_map(shard, mesh,
+                          (P(), P(), P("ep"), P("ep")),
+                          P())(xs, gw, w1s, w2s)
 
     loss, grads = jax.jit(jax.value_and_grad(loss_fn))((gate, w1, w2), x)
     assert onp.isfinite(float(loss))

@@ -1,0 +1,75 @@
+"""Optimizer lr/wd multiplier resolution (ISSUE 2 satellite).
+
+Reference precedence (python/mxnet/optimizer/optimizer.py _get_lr/_get_wd):
+an INDEX-keyed entry in set_lr_mult/set_wd_mult wins over a NAME-keyed one
+for the same parameter; a name-keyed entry applies only when no index key
+exists. Also pins the ZeRO eligibility flags and the per-shard
+hyperparameter packing helper the sharded fused step uses.
+"""
+import numpy as onp
+
+from mxnet_tpu import optimizer as opt_mod
+
+
+def _sgd_with_names():
+    opt = opt_mod.SGD(learning_rate=1.0, wd=1.0,
+                      param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    return opt
+
+
+def test_lr_mult_index_beats_name():
+    opt = _sgd_with_names()
+    opt.set_lr_mult({"fc_weight": 0.5, 0: 0.25})
+    # both key kinds present for index 0: the index key wins
+    assert opt._get_lr(0) == 0.25
+    # only a name key for index 1
+    opt.set_lr_mult({"fc_bias": 2.0})
+    assert opt._get_lr(1) == 2.0
+    # neither -> unity
+    assert opt._get_lr(0) == 1.0
+
+
+def test_wd_mult_index_beats_name():
+    opt = _sgd_with_names()
+    opt.set_wd_mult({"fc_weight": 0.5, 0: 4.0, "fc_bias": 0.0})
+    assert opt._get_wd(0) == 4.0     # index key shadows the name key
+    assert opt._get_wd(1) == 0.0     # name key applies
+
+
+def test_mults_without_idx2name():
+    """With no idx2name the index doubles as the name; both spellings
+    resolve and index still takes precedence."""
+    opt = opt_mod.SGD(learning_rate=1.0, wd=1.0)
+    opt.set_lr_mult({0: 0.1})
+    assert opt._get_lr(0) == onp.float32(0.1)
+    assert opt._get_lr(1) == 1.0
+
+
+def test_elementwise_update_flags():
+    """The ZeRO-1 sharded fused step may engage only for elementwise
+    rules; norm-based and row-reducing rules must opt out."""
+    assert opt_mod.SGD().elementwise_update
+    assert opt_mod.Adam().elementwise_update
+    assert opt_mod.AdamW().elementwise_update
+    assert opt_mod.RMSProp().elementwise_update
+    assert not opt_mod.LARS().elementwise_update
+    assert not opt_mod.LAMB().elementwise_update
+    assert not opt_mod.LANS().elementwise_update
+    assert not opt_mod.GroupAdaGrad().elementwise_update
+    assert not opt_mod.SGLD().elementwise_update
+
+
+def test_pack_shard_hparams_layout():
+    """Per-element packing: each member's scalar repeats over its flat
+    segment; the pad tail is lr=wd=0, t=1 (finite bias corrections)."""
+    lrs = onp.asarray([0.1, 0.2, 0.3], onp.float32)
+    wds = onp.asarray([1.0, 2.0, 3.0], onp.float32)
+    ts = onp.asarray([5, 6, 7], onp.int32)
+    # bucket holds params 2 and 0 (sizes 3 and 2), padded to 8
+    lv, wv, tv = opt_mod.Optimizer.pack_shard_hparams(
+        lrs, wds, ts, [2, 0], [3, 2], 8)
+    onp.testing.assert_allclose(
+        lv, [0.3, 0.3, 0.3, 0.1, 0.1, 0.0, 0.0, 0.0], rtol=1e-6)
+    onp.testing.assert_allclose(
+        wv, [3.0, 3.0, 3.0, 1.0, 1.0, 0.0, 0.0, 0.0])
+    onp.testing.assert_array_equal(tv, [7, 7, 7, 5, 5, 1, 1, 1])

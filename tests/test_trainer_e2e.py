@@ -178,6 +178,76 @@ def test_dataloader_basics():
     assert sum(b[1].shape[0] for b in loader3) == 50
 
 
+def test_dataloader_failing_dataset_cancels_inflight():
+    """ISSUE 2 satellite regression: when a worker raises, the threaded
+    __iter__ must surface the error WITHOUT draining the remaining
+    in-flight futures. Item 0 raises immediately; every other item
+    blocks on a gate the test only opens AFTER the error arrives — the
+    old implementation's pool shutdown waited on the blocked future and
+    deadlocked here."""
+    import threading
+    import time
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import Dataset
+
+    gate = threading.Event()
+
+    class Failing(Dataset):
+        def __len__(self):
+            return 40
+
+        def __getitem__(self, i):
+            if i == 0:
+                raise ValueError("poisoned sample")
+            gate.wait(timeout=30)
+            return onp.float32(i)
+
+    loader = DataLoader(Failing(), batch_size=4, num_workers=1)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ValueError, match="poisoned"):
+            for _ in loader:
+                pass
+        elapsed = time.monotonic() - t0
+        # the error must not wait behind the gated in-flight batch
+        assert elapsed < 10, f"error was blocked for {elapsed:.1f}s"
+    finally:
+        gate.set()   # release any worker thread still in __getitem__
+
+
+def test_dataloader_timeout_raises():
+    """timeout is honored per batch with a clear framework error."""
+    import time
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import Dataset
+
+    class Slow(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            time.sleep(2.0)
+            return onp.float32(i)
+
+    loader = DataLoader(Slow(), batch_size=2, num_workers=1, timeout=0.2)
+    with pytest.raises(MXNetError, match="timeout"):
+        next(iter(loader))
+
+
+def test_dataloader_early_break_no_leak():
+    """Abandoning the iterator (break) shuts the pool down without
+    waiting on queued work; a fresh iteration still works."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = ArrayDataset(onp.arange(64).astype("float32"))
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    it = iter(loader)
+    next(it)
+    it.close()   # GeneratorExit path: finally must cancel + shutdown
+    total = sum(b.shape[0] for b in loader)
+    assert total == 64
+
+
 def test_dataloader_sampler_api():
     from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
                                       RandomSampler, SequentialSampler)

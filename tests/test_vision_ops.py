@@ -373,9 +373,10 @@ def test_sync_batch_norm_axis_name_updates_moving_stats():
 
     prev = _tape.set_training(True)
     try:
-        out, new_mm, new_mv = jax.jit(jax.shard_map(
-            per_shard, mesh=mesh, in_specs=P("dp"),
-            out_specs=(P("dp"), P(), P())))(jnp.asarray(x))
+        from mxnet_tpu.parallel import shard_map as _shard_map
+        out, new_mm, new_mv = jax.jit(_shard_map(
+            per_shard, mesh, P("dp"),
+            (P("dp"), P(), P())))(jnp.asarray(x))
     finally:
         _tape.set_training(prev)
     bm = x.mean(axis=(0, 2, 3))
@@ -419,8 +420,8 @@ def test_sync_batch_norm_axis_name_psum():
                   fix_gamma=False, axis_name="dp")
         return out._data
 
-    f = jax.jit(jax.shard_map(per_shard, mesh=mesh,
-                              in_specs=P("dp"), out_specs=P("dp")))
+    from mxnet_tpu.parallel import shard_map as _shard_map
+    f = jax.jit(_shard_map(per_shard, mesh, P("dp"), P("dp")))
     # batch-moment normalization is the TRAINING path (inference uses the
     # moving averages, reference sync_batch_norm.cc)
     from mxnet_tpu import _tape
