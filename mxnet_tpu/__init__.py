@@ -33,7 +33,8 @@ _OPTIONAL_MODULES = [
     ("image", None), ("io", None), ("runtime", None), ("parallel", None),
     ("test_utils", None), ("amp", None), ("recordio", None),
     ("operator", None), ("rtc", None), ("contrib", None),
-    ("subgraph", None), ("checkpoint", None), ("library", None),
+    ("subgraph", None), ("checkpoint", None), ("testing", None),
+    ("library", None),
     ("inspector", None), ("visualization", None), ("visualization", "viz"),
     ("name", None), ("attribute", None), ("error", None), ("log", None),
     ("registry", None),

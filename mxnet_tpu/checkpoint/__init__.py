@@ -1,0 +1,36 @@
+"""Checkpoint / resume subsystem.
+
+Two generations live here:
+
+- **Atomic native checkpoints** (this PR, preemption-safe): staged +
+  checksummed + committed by a single ``os.replace`` (atomic.py),
+  capturing the COMPLETE train state including fused/ZeRO-sharded
+  optimizer buffers (state.py), with retention + async writes +
+  auto-resume (manager.py). ``gluon.TrainLoop(checkpoint_dir=...)`` is
+  the high-level entry; fault-injection points prove crash consistency
+  (mxnet_tpu/testing/faults.py, docs/ROBUSTNESS.md).
+- **orbax-backed checkpoints** (orbax_backend.py, kept for
+  compatibility): ``save_checkpoint``/``load_checkpoint``/
+  ``CheckpointManager`` over ``orbax.checkpoint``.
+"""
+from .atomic import (CheckpointCorruptError, atomic_write_bytes,  # noqa: F401
+                     latest_valid, list_checkpoints, load_latest,
+                     prune_checkpoints, read_checkpoint,
+                     validate_checkpoint, write_checkpoint)
+from .state import (TrainState, apply_train_state,  # noqa: F401
+                    assemble_segments, capture_train_state)
+from .manager import TrainCheckpointManager  # noqa: F401
+from .orbax_backend import (CheckpointManager, load_checkpoint,  # noqa: F401
+                            save_checkpoint)
+from . import atomic, manager, orbax_backend, state  # noqa: F401
+
+__all__ = [
+    # native atomic stack
+    "TrainCheckpointManager", "TrainState", "capture_train_state",
+    "apply_train_state", "assemble_segments", "write_checkpoint",
+    "read_checkpoint", "validate_checkpoint", "load_latest",
+    "latest_valid", "list_checkpoints", "prune_checkpoints",
+    "atomic_write_bytes", "CheckpointCorruptError",
+    # orbax compatibility layer
+    "save_checkpoint", "load_checkpoint", "CheckpointManager",
+]

@@ -1,0 +1,336 @@
+"""Atomic, checksummed checkpoint directories.
+
+The reference's persistence is a bare file write (``NDArray::Save``,
+include/mxnet/ndarray.h:399) — a kill mid-write clobbers the previous
+good file. Preemptible TPU pods need the database discipline instead
+(arXiv:1605.08695 §4.3 periodic checkpoint/restore): every checkpoint is
+
+1. **staged** into a hidden temp dir (``.tmp-*``) next to its final
+   location — one ``.npy`` file per array, fsynced, with a CRC32 per
+   array recorded in a JSON ``manifest.json`` (also fsynced);
+2. **committed** with a single ``os.replace(tmp, step-N)`` — the only
+   visibility point, atomic on POSIX — followed by an fsync of the
+   parent directory;
+3. **published** by atomically rewriting a ``latest`` pointer file.
+
+A reader therefore never observes a partial checkpoint: either the
+``step-N`` directory exists with a complete, checksummed payload, or it
+does not exist at all. ``load_latest`` additionally *verifies* every
+CRC and falls back to the newest older checkpoint that validates,
+warning about (and skipping) corrupt ones — so even post-commit disk
+corruption degrades a resume by K steps instead of killing it.
+
+bfloat16 arrays are stored as a uint16 view with the logical dtype in
+the manifest (numpy cannot serialize bf16 natively); everything else is
+a plain ``.npy``. The format is self-contained — no pickle — so it is
+robust to class renames across versions.
+
+Fault points (``mxnet_tpu.testing.faults``): ``checkpoint.stage``,
+``checkpoint.manifest``, ``checkpoint.commit``, ``checkpoint.publish``,
+``checkpoint.prune`` — each bracketed before/after, so kill-9 tests can
+die at every boundary and prove the invariant above.
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import shutil
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..testing.faults import fault_point
+
+__all__ = ["CheckpointCorruptError", "write_checkpoint", "read_checkpoint",
+           "validate_checkpoint", "list_checkpoints", "latest_valid",
+           "load_latest", "prune_checkpoints", "atomic_write_bytes",
+           "step_dir_name", "MANIFEST", "FORMAT_VERSION"]
+
+_LOG = logging.getLogger("mxnet_tpu.checkpoint")
+
+MANIFEST = "manifest.json"
+LATEST = "latest"
+FORMAT_VERSION = 1
+_STEP_PREFIX = "step-"
+
+
+class CheckpointCorruptError(MXNetError):
+    """Manifest unreadable or a payload failed its checksum."""
+
+
+# ---------------------------------------------------------------- helpers
+def _fsync_path(path: str):
+    """fsync a file or directory by path (directory fsync persists the
+    entries created/renamed inside it)."""
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0) \
+        if os.path.isdir(path) else os.O_RDONLY
+    try:
+        fd = os.open(path, flags)
+    except OSError:        # pragma: no cover - platform without dir-open
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _npy_bytes(arr: onp.ndarray) -> Tuple[bytes, str]:
+    """Serialize to .npy bytes; bf16 goes as a uint16 view with the
+    logical dtype recorded separately (returned)."""
+    logical = str(arr.dtype)
+    if logical == "bfloat16":
+        arr = arr.view(onp.uint16)
+    buf = io.BytesIO()
+    onp.save(buf, onp.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue(), logical
+
+
+def _from_npy(raw: bytes, logical_dtype: str) -> onp.ndarray:
+    arr = onp.load(io.BytesIO(raw), allow_pickle=False)
+    if logical_dtype == "bfloat16":
+        import jax.numpy as jnp
+        arr = onp.asarray(jnp.asarray(arr).view(jnp.bfloat16))
+    return arr
+
+
+def step_dir_name(step: int) -> str:
+    return f"{_STEP_PREFIX}{int(step):010d}"
+
+
+def _parse_step(name: str) -> Optional[int]:
+    if not name.startswith(_STEP_PREFIX):
+        return None
+    try:
+        return int(name[len(_STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def atomic_write_bytes(fname: str, data: bytes, fault: str = "ndarray.save"):
+    """Crash-safe single-file write: stage to ``fname.tmp-<pid>``, fsync,
+    ``os.replace`` over the destination, fsync the directory. A kill at
+    any point leaves either the old complete file or the new complete
+    file — never a torn mix."""
+    tmp = f"{fname}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        fault_point(fault, "before")
+        os.replace(tmp, fname)
+        fault_point(fault, "after")
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    parent = os.path.dirname(os.path.abspath(fname))
+    _fsync_path(parent)
+
+
+# ---------------------------------------------------------------- write
+def write_checkpoint(root: str, step: int,
+                     arrays: Dict[str, onp.ndarray],
+                     array_meta: Optional[Dict[str, dict]] = None,
+                     meta: Optional[dict] = None) -> str:
+    """Write one atomic checkpoint ``<root>/step-<N>``; returns its path.
+
+    ``arrays``: name -> host numpy array. ``array_meta``: optional extra
+    JSON per array (merged into its manifest entry). ``meta``: free-form
+    JSON for the whole checkpoint (step counters, optimizer class, ...).
+    """
+    root = os.path.abspath(root)
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, step_dir_name(step))
+    tmp = os.path.join(root, f".tmp-{step_dir_name(step)}-{os.getpid()}-"
+                             f"{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(tmp, "arrays"))
+    manifest: Dict[str, Any] = {
+        "format": FORMAT_VERSION, "step": int(step),
+        "meta": meta or {}, "arrays": {}}
+    try:
+        fault_point("checkpoint.stage", "before")
+        for i, (name, arr) in enumerate(arrays.items()):
+            arr = onp.asarray(arr)
+            raw, logical = _npy_bytes(arr)
+            rel = os.path.join("arrays", f"{i}.npy")
+            entry = {"file": rel, "crc32": zlib.crc32(raw),
+                     "shape": [int(s) for s in arr.shape],
+                     "dtype": logical, "nbytes": len(raw)}
+            if array_meta and name in array_meta:
+                entry.update(array_meta[name])
+            manifest["arrays"][name] = entry
+            path = os.path.join(tmp, rel)
+            with open(path, "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+        fault_point("checkpoint.stage", "after")
+        fault_point("checkpoint.manifest", "before")
+        mpath = os.path.join(tmp, MANIFEST)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        fault_point("checkpoint.manifest", "after")
+        _fsync_path(os.path.join(tmp, "arrays"))
+        _fsync_path(tmp)
+        # the ONE visibility point: before this replace the checkpoint
+        # does not exist; after it, it is complete and checksummed
+        fault_point("checkpoint.commit", "before")
+        if os.path.isdir(final):      # re-saving the same step: replace
+            _replace_dir(tmp, final)
+        else:
+            os.replace(tmp, final)
+        fault_point("checkpoint.commit", "after")
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _fsync_path(root)
+    _publish_latest(root, step)
+    return final
+
+
+def _replace_dir(tmp: str, final: str):
+    """os.replace cannot overwrite a non-empty dir: move the old one
+    aside first so the final name never points at a partial payload."""
+    aside = final + f".old-{uuid.uuid4().hex[:8]}"
+    os.replace(final, aside)
+    os.replace(tmp, final)
+    shutil.rmtree(aside, ignore_errors=True)
+
+
+def _publish_latest(root: str, step: int):
+    fault_point("checkpoint.publish", "before")
+    atomic_write_bytes(os.path.join(root, LATEST),
+                       (step_dir_name(step) + "\n").encode(),
+                       fault="checkpoint.publish.replace")
+    fault_point("checkpoint.publish", "after")
+
+
+# ---------------------------------------------------------------- read
+def validate_checkpoint(path: str) -> dict:
+    """Parse the manifest and verify every array file's CRC; returns the
+    manifest. Raises CheckpointCorruptError on any mismatch."""
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: unreadable manifest ({e})") from e
+    if manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: unsupported format "
+            f"{manifest.get('format')!r}")
+    for name, entry in manifest.get("arrays", {}).items():
+        fpath = os.path.join(path, entry["file"])
+        try:
+            with open(fpath, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: missing payload for {name!r}") from e
+        if len(raw) != entry["nbytes"] or \
+                zlib.crc32(raw) != entry["crc32"]:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: checksum mismatch for {name!r} "
+                f"({entry['file']})")
+    return manifest
+
+
+def read_checkpoint(path: str) \
+        -> Tuple[Dict[str, onp.ndarray], dict]:
+    """Load a validated checkpoint: returns (arrays, manifest)."""
+    manifest = validate_checkpoint(path)
+    arrays: Dict[str, onp.ndarray] = {}
+    for name, entry in manifest["arrays"].items():
+        with open(os.path.join(path, entry["file"]), "rb") as f:
+            arr = _from_npy(f.read(), entry["dtype"])
+        arrays[name] = arr.reshape(tuple(entry["shape"]))
+    return arrays, manifest
+
+
+def list_checkpoints(root: str) -> List[int]:
+    """Committed step numbers under ``root``, ascending (no validation)."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        s = _parse_step(name)
+        if s is not None and os.path.isdir(os.path.join(root, name)):
+            steps.append(s)
+    return sorted(steps)
+
+
+def _latest_pointer(root: str) -> Optional[int]:
+    try:
+        with open(os.path.join(root, LATEST)) as f:
+            return _parse_step(f.read().strip())
+    except OSError:
+        return None
+
+
+def latest_valid(root: str) -> Optional[Tuple[int, str]]:
+    """Newest checkpoint that passes validation: the ``latest`` pointer
+    is tried first, then every committed step newest-first. Corrupt
+    candidates are skipped with a warning. Returns (step, path) or
+    None."""
+    root = os.path.abspath(root)
+    candidates: List[int] = []
+    ptr = _latest_pointer(root)
+    if ptr is not None:
+        candidates.append(ptr)
+    for s in reversed(list_checkpoints(root)):
+        if s not in candidates:
+            candidates.append(s)
+    candidates.sort(reverse=True)
+    for s in candidates:
+        path = os.path.join(root, step_dir_name(s))
+        try:
+            validate_checkpoint(path)
+            return s, path
+        except CheckpointCorruptError as e:
+            _LOG.warning("skipping corrupt checkpoint: %s", e)
+    return None
+
+
+def load_latest(root: str) \
+        -> Optional[Tuple[int, Dict[str, onp.ndarray], dict]]:
+    """Load the newest VALID checkpoint; (step, arrays, manifest) or
+    None when no valid checkpoint exists."""
+    found = latest_valid(root)
+    if found is None:
+        return None
+    step, path = found
+    arrays, manifest = read_checkpoint(path)
+    return step, arrays, manifest
+
+
+def prune_checkpoints(root: str, keep_last: int,
+                      protect: Tuple[int, ...] = ()):
+    """Delete all but the newest ``keep_last`` committed checkpoints
+    (never the ones in ``protect``). Pruning happens strictly after
+    commit+publish, so a crash mid-prune still leaves >= keep_last valid
+    checkpoints behind."""
+    if keep_last <= 0:
+        return
+    steps = list_checkpoints(root)
+    doomed = [s for s in steps[:-keep_last] if s not in protect]
+    for s in doomed:
+        fault_point("checkpoint.prune", "before")
+        shutil.rmtree(os.path.join(root, step_dir_name(s)),
+                      ignore_errors=True)
+        fault_point("checkpoint.prune", "after")
+    # stale staging dirs from crashed writers are garbage, not state
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if name.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)

@@ -19,8 +19,8 @@ from typing import Any, Dict, Optional
 
 import numpy as onp
 
-from .base import MXNetError
-from .ndarray.ndarray import NDArray
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
 
 __all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager"]
 

@@ -1,0 +1,371 @@
+"""Complete training-state capture/restore (``TrainState``).
+
+PR 2's ZeRO-1 sharded fused step moved the optimizer state out of the
+eager ``Updater`` into ``_ZeroShardPlan`` buffers that live permanently
+``NamedSharding``-partitioned — ``Trainer.save_states`` (a pickle of the
+eager updater) silently misses all of it. This module extracts the
+WHOLE state of a training run into a flat ``{name: host-numpy}`` dict
+plus JSON meta, in a *logical* (layout-free) format:
+
+- ``param/<name>``   — every Parameter (incl. grad_req='null' stats);
+- ``opt/<idx>/<slot>`` — optimizer state per trainable param, in the
+  PARAM's shape: zero-sharded flat buffers are unpadded, split out of
+  their buckets, and reshaped on capture, so the on-disk format is
+  independent of the dp size — a dp=N checkpoint resumes on a dp=M mesh
+  (or in plain fused / eager mode, for plain-tuple states);
+- ``master/<idx>``   — fp32 master copies of multi-precision params;
+- ``rng/key``        — the process PRNG key chain;
+- meta: step, update counters (Adam's bias correction), lr-scheduler
+  state, optimizer class.
+
+Arrays whose shards are not all host-local (multi-host ``parallel.dist``
+runs) are captured as per-host dim0 segments (``name#seg<start>``) and
+reassembled on restore via :func:`assemble_segments`.
+
+Restore is *adoption-based*: parameters are written back preserving the
+live array's sharding; optimizer state lands in ``Updater.states`` as
+plain NDArray tuples, which the eager path uses directly and which
+``_ZeroShardPlan`` adopts (re-flattening, re-padding and re-sharding to
+the CURRENT mesh) when the next zero-sharded step materializes. A live
+zero plan is updated in place.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["TrainState", "capture_train_state", "apply_train_state",
+           "assemble_segments"]
+
+_LOG = logging.getLogger("mxnet_tpu.checkpoint")
+
+
+class TrainState:
+    """A captured snapshot: ``arrays`` (host numpy), per-array JSON
+    ``array_meta``, and whole-state JSON ``meta``."""
+
+    def __init__(self, arrays: Dict[str, onp.ndarray],
+                 meta: Dict[str, Any],
+                 array_meta: Optional[Dict[str, dict]] = None):
+        self.arrays = arrays
+        self.meta = meta
+        self.array_meta = array_meta or {}
+
+    @property
+    def step(self) -> int:
+        return int(self.meta.get("step", 0))
+
+    def __repr__(self):
+        return (f"TrainState(step={self.step}, "
+                f"{len(self.arrays)} arrays)")
+
+
+# ---------------------------------------------------------------- host copy
+def _host_copy(data, name: str, arrays: dict, array_meta: dict):
+    """Device->host. Fully-addressable arrays (every single-process run)
+    copy whole; multi-host shardings emit one dim0 segment per LOCAL
+    shard so each host persists only what it owns."""
+    if isinstance(data, NDArray):
+        data = data._data
+    if getattr(data, "is_fully_addressable", True):
+        arrays[name] = onp.asarray(data)
+        return
+    seen = set()
+    for shard in data.addressable_shards:        # pragma: no cover - multihost
+        idx = shard.index[0] if shard.index else slice(None)
+        start = idx.start or 0
+        if start in seen:
+            continue
+        seen.add(start)
+        key = f"{name}#seg{start}"
+        arrays[key] = onp.asarray(shard.data)
+        array_meta[key] = {"seg_of": name, "dim0_start": int(start),
+                           "global_shape": [int(s) for s in data.shape]}
+
+
+def assemble_segments(arrays: Dict[str, onp.ndarray],
+                      array_meta: Dict[str, dict]) -> Dict[str, onp.ndarray]:
+    """Merge ``name#seg<start>`` per-host segments back into full arrays
+    (inverse of the multi-host capture). Raises if a region is missing."""
+    segs: Dict[str, List[Tuple[int, onp.ndarray]]] = {}
+    out: Dict[str, onp.ndarray] = {}
+    for name, arr in arrays.items():
+        am = array_meta.get(name) or {}
+        if "seg_of" in am:
+            segs.setdefault(am["seg_of"], []).append(
+                (int(am["dim0_start"]), arr))
+        else:
+            out[name] = arr
+    for name, parts in segs.items():
+        parts.sort(key=lambda t: t[0])
+        gshape = array_meta[f"{name}#seg{parts[0][0]}"]["global_shape"]
+        full = onp.zeros(tuple(gshape), dtype=parts[0][1].dtype)
+        pos = 0
+        for start, arr in parts:
+            if start != pos:
+                raise MXNetError(
+                    f"checkpoint segment gap in {name!r} at row {pos}: "
+                    "not all hosts' shard files are present")
+            full[start:start + arr.shape[0]] = arr
+            pos = start + arr.shape[0]
+        if pos != gshape[0]:
+            raise MXNetError(
+                f"checkpoint segments for {name!r} cover {pos} of "
+                f"{gshape[0]} rows: incomplete multi-host restore")
+        out[name] = full
+    return out
+
+
+# ---------------------------------------------------------------- capture
+def _param_items(trainer, net):
+    if net is not None:
+        return list(net.collect_params().items())
+    if trainer is not None:
+        return list(zip(trainer._param_names, trainer._all_params))
+    return []
+
+
+def _live_zero_plan(trainer):
+    """The _ZeroShardPlan of a live CompiledTrainStep, if one owns the
+    optimizer state (Trainer._register_compiled tracks them)."""
+    if trainer is None:
+        return None
+    for step in trainer._live_compiled_steps():
+        if getattr(step, "_zero", None) is not None:
+            return step._zero
+    return None
+
+
+def _sched_state(sch) -> Optional[dict]:
+    if sch is None:
+        return None
+    state = {}
+    for k, v in vars(sch).items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            nested = _sched_state(v) if hasattr(v, "base_lr") else None
+            if nested is not None:
+                state[k] = {"__sched__": nested}
+            continue
+        state[k] = v
+    return state
+
+
+def _sched_restore(sch, state: Optional[dict]):
+    if sch is None or not state:
+        return
+    for k, v in state.items():
+        if isinstance(v, dict) and "__sched__" in v:
+            _sched_restore(getattr(sch, k, None), v["__sched__"])
+        elif hasattr(sch, k):
+            setattr(sch, k, type(getattr(sch, k))(v)
+                    if getattr(sch, k) is not None else v)
+
+
+def _capture_zero_states(plan, arrays, array_meta):
+    """Unpack the flat padded NamedSharding-sharded unit buffers into
+    per-param, param-shaped logical states (dp-size independent)."""
+    for unit, st in zip(plan.units, plan.states):
+        for li, leaf in enumerate(st):
+            _host_copy(leaf, f"__zu/{li}", arrays, array_meta)
+            flat = arrays.pop(f"__zu/{li}", None)
+            if flat is None:      # pragma: no cover - multihost segments
+                # segments stay flat+padded per unit; record membership
+                # so a same-layout multihost restore can reassemble
+                for key in list(arrays):
+                    if key.startswith(f"__zu/{li}#seg"):
+                        new = key.replace(
+                            f"__zu/{li}",
+                            f"zunit/{unit['members'][0]}/{li}")
+                        arrays[new] = arrays.pop(key)
+                        array_meta[new] = array_meta.pop(key)
+                continue
+            off = 0
+            for j, shp, n in zip(unit["members"], unit["shapes"],
+                                 unit["sizes"]):
+                arrays[f"opt/{j}/{li}"] = \
+                    flat[off:off + n].reshape(shp)
+                off += n
+    for k, slot in plan.master_slot.items():
+        unit = plan.units[k]
+        j = unit["members"][0]
+        _host_copy(plan.masters[slot], "__zm", arrays, array_meta)
+        flat = arrays.pop("__zm", None)
+        if flat is not None:
+            arrays[f"master/{j}"] = \
+                flat[:unit["sizes"][0]].reshape(unit["shapes"][0])
+
+
+def _capture_updater_states(trainer, arrays):
+    import jax
+    for idx, st in trainer._updater.states.items():
+        leaves = jax.tree_util.tree_leaves(
+            st, is_leaf=lambda t: isinstance(t, NDArray))
+        for li, leaf in enumerate(leaves):
+            arrays[f"opt/{idx}/{li}"] = onp.asarray(
+                leaf._data if isinstance(leaf, NDArray) else leaf)
+
+
+def capture_train_state(trainer=None, net=None, step: int = 0,
+                        extra: Optional[Dict[str, Any]] = None) -> TrainState:
+    """Snapshot params + optimizer state (fused/zero-sharded included) +
+    counters + RNG into host memory. The device->host copies happen HERE,
+    synchronously — serialization of the returned TrainState can then
+    overlap with further training steps (manager.py)."""
+    from ..ndarray import random as _random
+    arrays: Dict[str, onp.ndarray] = {}
+    array_meta: Dict[str, dict] = {}
+    meta: Dict[str, Any] = {"step": int(step)}
+
+    names = []
+    for name, p in _param_items(trainer, net):
+        if p._data is not None:
+            _host_copy(p._data, f"param/{name}", arrays, array_meta)
+            names.append(name)
+    meta["param_names"] = names
+
+    if trainer is not None:
+        opt = trainer._optimizer
+        plan = _live_zero_plan(trainer)
+        meta["opt_mode"] = "zero" if plan is not None else "updater"
+        meta["optimizer"] = type(opt).__name__
+        meta["num_update"] = int(opt.num_update)
+        meta["index_update_count"] = {
+            str(k): int(v) for k, v in opt._index_update_count.items()}
+        meta["trainable_names"] = [p.name for p in trainer._params]
+        meta["lr_scheduler"] = _sched_state(
+            getattr(opt, "lr_scheduler", None))
+        if plan is not None:
+            _capture_zero_states(plan, arrays, array_meta)
+        else:
+            _capture_updater_states(trainer, arrays)
+
+    arrays["rng/key"] = onp.asarray(_random.get_key_state())
+    if extra:
+        for k, v in extra.items():
+            arrays[f"extra/{k}"] = onp.asarray(
+                v._data if isinstance(v, NDArray) else v)
+    return TrainState(arrays, meta, array_meta)
+
+
+# ---------------------------------------------------------------- apply
+def _put_like(arr: onp.ndarray, live):
+    """Host array -> device, preserving the live array's sharding (a
+    sharded param must come back sharded, not silently replicated)."""
+    import jax
+    import jax.numpy as jnp
+    out = jnp.asarray(arr)
+    if live is not None and hasattr(live, "sharding"):
+        out = jax.device_put(out, live.sharding)
+    return out
+
+
+def _apply_params(arrays, trainer, net, strict):
+    applied = 0
+    for name, p in _param_items(trainer, net):
+        key = f"param/{name}"
+        if key not in arrays:
+            if strict and p._data is not None:
+                raise MXNetError(
+                    f"checkpoint has no data for parameter {name!r} "
+                    "(pass strict=False to keep its current value)")
+            continue
+        arr = arrays[key]
+        cur = p._data._data if p._data is not None else None
+        if cur is not None and tuple(cur.shape) != tuple(arr.shape):
+            raise MXNetError(
+                f"checkpoint shape {tuple(arr.shape)} does not match "
+                f"parameter {name!r} shape {tuple(cur.shape)}")
+        p.set_data(NDArray(_put_like(arr, cur)))
+        applied += 1
+    return applied
+
+
+def _apply_opt_states(arrays, meta, trainer):
+    """Land per-param logical states in Updater.states as plain NDArray
+    tuples (param-shaped) — directly usable by the eager/fused paths and
+    adopted by _ZeroShardPlan when the next sharded step builds."""
+    import jax
+    by_idx: Dict[int, Dict[int, onp.ndarray]] = {}
+    for key, arr in arrays.items():
+        if key.startswith("opt/"):
+            _, idx, li = key.split("/")
+            by_idx.setdefault(int(idx), {})[int(li)] = arr
+    upd = trainer._updater
+    for idx, slots in by_idx.items():
+        leaves = [NDArray(_put_like(slots[li], None))
+                  for li in sorted(slots)]
+        if idx >= len(trainer._params):
+            raise MXNetError(
+                f"checkpoint optimizer state index {idx} out of range "
+                f"({len(trainer._params)} trainable params)")
+        cur = upd.states.get(idx)
+        if cur is not None and meta.get("opt_mode") != "zero":
+            # typed restore: preserve the live structure (e.g. nested
+            # multi-precision (master, state) tuples)
+            flat, treedef = jax.tree_util.tree_flatten(
+                cur, is_leaf=lambda t: isinstance(t, NDArray))
+            if len(flat) == len(leaves):
+                upd.states[idx] = jax.tree_util.tree_unflatten(
+                    treedef, leaves)
+                continue
+        upd.states[idx] = tuple(leaves)
+
+    masters = {}
+    for key, arr in arrays.items():
+        if key.startswith("master/"):
+            masters[int(key.split("/")[1])] = onp.asarray(
+                arr, dtype=onp.float32)
+    # consumed by _ZeroShardPlan.__init__ (and a live plan below): the
+    # fp32 master of a multi-precision param must survive bit-exactly —
+    # recasting from the fp16 weight would lose the low-order bits
+    trainer._restored_masters = masters
+
+    opt = trainer._optimizer
+    if "num_update" in meta:
+        opt.num_update = int(meta["num_update"])
+    if "index_update_count" in meta:
+        opt._index_update_count = {
+            int(k): int(v) for k, v in meta["index_update_count"].items()}
+    _sched_restore(getattr(opt, "lr_scheduler", None),
+                   meta.get("lr_scheduler"))
+
+
+def _reload_live_plan(trainer):
+    """A zero plan already materialized (mid-run restore): rebuild its
+    flat padded sharded buffers from the freshly restored Updater states
+    and masters, in place."""
+    import jax.numpy as jnp
+    for step in trainer._live_compiled_steps():
+        plan = getattr(step, "_zero", None)
+        if plan is None:
+            continue
+        fresh = plan.__class__(trainer, plan.mesh, plan.axis)
+        for st, new_st in zip(plan.states, fresh.states):
+            for s, n in zip(st, new_st):
+                s._data = n._data
+        for m, nm in zip(plan.masters, fresh.masters):
+            m._data = nm._data
+        _LOG.info("restored state into live zero-shard plan (%d units)",
+                  len(plan.units))
+
+
+def apply_train_state(state: TrainState, trainer=None, net=None,
+                      strict: bool = True) -> Dict[str, Any]:
+    """Restore a captured/loaded TrainState into (net, trainer); returns
+    the state's meta (incl. 'step'). Works before the first step (states
+    are adopted when the fused/zero program builds) and mid-run (a live
+    zero plan is refreshed in place)."""
+    from ..ndarray import random as _random
+    arrays = assemble_segments(state.arrays, state.array_meta)
+    _apply_params(arrays, trainer, net, strict)
+    if trainer is not None:
+        _apply_opt_states(arrays, state.meta, trainer)
+        _reload_live_plan(trainer)
+    if "rng/key" in arrays:
+        _random.set_key_state(arrays["rng/key"])
+    return state.meta

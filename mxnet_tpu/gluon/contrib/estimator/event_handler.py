@@ -151,11 +151,21 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     """Saves model (and best-model) checkpoints (reference
-    CheckpointHandler: model_dir/model_prefix, monitor + mode)."""
+    CheckpointHandler: model_dir/model_prefix, monitor + mode).
+
+    The ``.params`` files keep the reference naming but now land via the
+    crash-safe staged write (``nd.save`` is atomic). With
+    ``save_trainer_states=True`` the FULL train state (params + fused/
+    ZeRO optimizer state + counters + RNG) additionally goes through
+    ``mx.checkpoint.TrainCheckpointManager`` under
+    ``<model_dir>/<prefix>-ckpt/`` — atomic, checksummed, pruned to
+    ``keep_last`` — and ``resume_from_checkpoint=True`` restores the
+    newest valid one at ``train_begin``."""
 
     def __init__(self, model_dir: str, model_prefix: str = "model",
                  monitor=None, mode: str = "min", save_best: bool = False,
-                 epoch_period: int = 1):
+                 epoch_period: int = 1, save_trainer_states: bool = True,
+                 keep_last: int = 3, resume_from_checkpoint: bool = False):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.monitor = monitor
@@ -166,9 +176,28 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             raise ValueError("mode must be min/max")
         self.mode = mode
         self.best = float("inf") if mode == "min" else -float("inf")
+        self.save_trainer_states = save_trainer_states
+        self.keep_last = keep_last
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self._manager = None
+
+    def _get_manager(self):
+        if self._manager is None:
+            from ....checkpoint.manager import TrainCheckpointManager
+            self._manager = TrainCheckpointManager(
+                os.path.join(self.model_dir,
+                             f"{self.model_prefix}-ckpt"),
+                keep_last=self.keep_last)
+        return self._manager
 
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
+        if self.resume_from_checkpoint and self.save_trainer_states:
+            meta = self._get_manager().restore_latest(
+                trainer=getattr(estimator, "trainer", None),
+                net=getattr(estimator, "net", None), strict=False)
+            if meta is not None:
+                self.current_epoch = int(meta.get("step", 0))
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
@@ -177,6 +206,12 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         prefix = os.path.join(self.model_dir, self.model_prefix)
         estimator.net.save_parameters(
             f"{prefix}-epoch{self.current_epoch}.params")
+        trainer = getattr(estimator, "trainer", None)
+        if self.save_trainer_states and trainer is not None:
+            # the atomic path handles fused/ZeRO state that the old
+            # Trainer.save_states pickle cannot see
+            self._get_manager().save(self.current_epoch, trainer=trainer,
+                                     net=estimator.net, block=True)
         if self.save_best and self.monitor is not None:
             _, val = self.monitor.get()
             better = val < self.best if self.mode == "min" else val > self.best

@@ -166,10 +166,17 @@ class _ZeroShardPlan:
                 members=members, shapes=shapes, dtypes=dtypes, sizes=sizes,
                 total=total, padded=zero_shard_pad(total, self.n_shards),
                 mp=mp, upd_dtype=jnp.float32 if mp else dtypes[0]))
+        restored = getattr(trainer, "_restored_masters", {})
         for k, unit in enumerate(self.units):
             if unit["mp"]:
                 j = unit["members"][0]
-                master = params[j]._data._data.astype(jnp.float32)
+                if j in restored:
+                    # checkpoint resume: the saved fp32 master carries
+                    # low-order bits the fp16 weight lost — recasting
+                    # would break bit-exact resume (checkpoint/state.py)
+                    master = jnp.asarray(restored.pop(j), jnp.float32)
+                else:
+                    master = params[j]._data._data.astype(jnp.float32)
                 self.master_slot[k] = len(self.masters)
                 self.masters.append(NDArray(self._flat_shard(
                     master.reshape(-1), unit["padded"])))
@@ -307,6 +314,8 @@ class CompiledTrainStep:
         pos = {id(p): i for i, p in enumerate(self._all_params)}
         # trainer._params (grad_req != null) carry the optimizer indices
         self._trainable_pos = [pos[id(p)] for p in trainer._params]
+        # the checkpoint stack finds zero-sharded state through this
+        trainer._register_compiled(self)
 
     # ---------------- introspection ----------------
     @property
@@ -808,13 +817,44 @@ class TrainLoop:
     ``step(*inputs, label)`` feeds all but the last array to ``net`` and
     the last to the loss block, through ``Trainer.compile_step`` — the
     framework-level replacement for hand-rolled jitted train steps.
+
+    **Preemption safety** (``checkpoint_dir=...``): the loop owns a
+    ``mx.checkpoint.TrainCheckpointManager`` — on construction it
+    auto-resumes from the newest VALID checkpoint (params, fused/ZeRO
+    optimizer state, update counters, RNG; corrupt ones are skipped
+    with a warning), every ``checkpoint_every`` steps it snapshots
+    device state synchronously and commits the write atomically on a
+    background thread (serialization overlaps the next steps), and it
+    keeps the newest ``keep_last`` checkpoints. A run killed at ANY
+    instant — including mid-commit — restarts from the last published
+    checkpoint and replays forward bit-exactly (docs/ROBUSTNESS.md).
+    A failed background write surfaces on the next ``step()``/``wait()``.
     """
 
-    def __init__(self, net, trainer, loss, donate: bool = True):
+    def __init__(self, net, trainer, loss, donate: bool = True,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 keep_last: int = 3, async_checkpoint: bool = True,
+                 resume: bool = True):
         self._net = net
         self._loss = loss
         self._trainer = trainer
         self._step = trainer.compile_step(self._loss_fn, donate=donate)
+        self._global_step = 0
+        self._every = checkpoint_every
+        self._manager = None
+        if checkpoint_dir is not None:
+            from ..checkpoint.manager import TrainCheckpointManager
+            self._manager = TrainCheckpointManager(
+                checkpoint_dir, keep_last=keep_last,
+                async_save=async_checkpoint)
+            if resume:
+                meta = self._manager.restore_latest(
+                    trainer=trainer, net=net, strict=False)
+                if meta is not None:
+                    self._global_step = int(meta.get("step", 0))
+                    _LOG.info("TrainLoop resumed at step %d from %s",
+                              self._global_step, checkpoint_dir)
 
     def _loss_fn(self, *batch):
         *inputs, label = batch
@@ -822,9 +862,38 @@ class TrainLoop:
         return self._loss(out, label)
 
     def step(self, *batch, batch_size: Optional[int] = None):
-        return self._step(*batch, batch_size=batch_size)
+        loss = self._step(*batch, batch_size=batch_size)
+        self._global_step += 1
+        if self._manager is not None and self._every and \
+                self._global_step % self._every == 0:
+            self.save_checkpoint()
+        return loss
 
     __call__ = step
+
+    # ---------------- checkpointing ----------------
+    def save_checkpoint(self, block: Optional[bool] = None):
+        """Snapshot now (at ``global_step``); async unless
+        ``block=True``. No-op without ``checkpoint_dir``."""
+        if self._manager is None:
+            raise MXNetError(
+                "TrainLoop was built without checkpoint_dir=")
+        self._manager.save(self._global_step, trainer=self._trainer,
+                           net=self._net, block=block)
+
+    def wait(self):
+        """Drain the in-flight checkpoint write (re-raising its error);
+        call before exiting so the newest snapshot is durable."""
+        if self._manager is not None:
+            self._manager.wait()
+
+    @property
+    def global_step(self) -> int:
+        return self._global_step
+
+    @property
+    def checkpoint_manager(self):
+        return self._manager
 
     @property
     def compiled_step(self) -> CompiledTrainStep:

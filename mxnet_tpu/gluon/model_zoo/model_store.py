@@ -1,29 +1,54 @@
 """Pretrained-weight store (reference gluon/model_zoo/model_store.py).
 
 Weights resolve in order: an existing local file under ``root`` (default
-``$MXNET_HOME/models``), then the repo at ``MXNET_GLUON_REPO`` via
-``gluon.utils.download`` — which in this zero-egress build serves ``file://``
-mirrors and existing paths only (utils.py download). Point
-``MXNET_GLUON_REPO`` at a local mirror (``file:///data/mirror/``) to use
-pretrained weights offline.
+``$MXNET_HOME/models``) whose sha1 (when known) verifies, then the repo
+at ``MXNET_GLUON_REPO`` via ``gluon.utils.download`` — transient fetch
+failures retry with backoff, the payload is sha1-verified against
+``_model_sha1`` BEFORE being ``os.replace``d into the cache, and a
+corrupt transfer is deleted rather than cached. In this zero-egress
+build only ``file://`` mirrors and existing paths are served; point
+``MXNET_GLUON_REPO`` at a local mirror (``file:///data/mirror/``) to
+use pretrained weights offline.
 """
 from __future__ import annotations
 
+import logging
 import os
 
-from ...base import data_dir
-from ..utils import download, _get_repo_url
+from ...base import data_dir, get_env
+from ..utils import check_sha1, download, _get_repo_url
 
-__all__ = ["get_model_file"]
+__all__ = ["get_model_file", "register_model_sha1"]
+
+_LOG = logging.getLogger("mxnet_tpu.model_zoo")
+
+# name -> sha1 of <name>.params. The reference ships a large literal
+# table; here mirrors register theirs (offline mirrors are user-built,
+# so the table is an extension point rather than a constant).
+_model_sha1 = {}
 
 
-def get_model_file(name: str, root: str | None = None) -> str:
+def register_model_sha1(name: str, sha1: str):
+    """Register/override the expected sha1 for ``<name>.params`` so
+    cache hits and downloads are integrity-checked."""
+    _model_sha1[name] = sha1
+
+
+def get_model_file(name: str, root: str | None = None,
+                   sha1_hash: str | None = None) -> str:
     """Return a local path to ``<name>.params``, fetching from the repo
-    mirror if absent (reference model_store.get_model_file)."""
+    mirror if absent (reference model_store.get_model_file). A cached
+    file with a known-bad sha1 is re-fetched; the fetch itself is
+    retried, verified, and committed atomically."""
     root = os.path.expanduser(root or os.path.join(data_dir(), "models"))
     path = os.path.join(root, f"{name}.params")
+    sha1_hash = sha1_hash or _model_sha1.get(name)
     if os.path.exists(path):
-        return path
+        if sha1_hash is None or check_sha1(path, sha1_hash):
+            return path
+        _LOG.warning("cached %s fails sha1 verification; re-fetching",
+                     path)
     os.makedirs(root, exist_ok=True)
     url = f"{_get_repo_url()}gluon/models/{name}.params"
-    return download(url, path=path)
+    return download(url, path=path, overwrite=True, sha1_hash=sha1_hash,
+                    retries=get_env("MXNET_MODEL_FETCH_RETRIES", 5, int))

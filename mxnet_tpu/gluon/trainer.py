@@ -9,6 +9,7 @@ for reference-style per-device replica lists.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Dict, List, Optional
 
 from .. import optimizer as opt_mod
@@ -50,6 +51,13 @@ class Trainer:
         self._kv_initialized = False
         self._scale = 1.0
         self._contains_sparse = False
+        # live CompiledTrainStep programs built from this trainer: the
+        # checkpoint stack asks them whether a ZeRO plan owns the
+        # optimizer state (weakrefs — a dropped step must not leak)
+        self._compiled_refs: List[weakref.ref] = []
+        # fp32 masters restored from a checkpoint, consumed when the
+        # next _ZeroShardPlan materializes (checkpoint/state.py)
+        self._restored_masters: Dict[int, object] = {}
 
     # ---------------- properties ----------------
     @property
@@ -107,6 +115,29 @@ class Trainer:
                                  train_mode=train_mode,
                                  zero_shard=zero_shard,
                                  zero_axis=zero_axis, mesh=mesh)
+
+    # ---------------- compiled-step registry ----------------
+    def _register_compiled(self, step):
+        self._compiled_refs.append(weakref.ref(step))
+
+    def _live_compiled_steps(self):
+        alive, out = [], []
+        for ref in self._compiled_refs:
+            s = ref()
+            if s is not None:
+                alive.append(ref)
+                out.append(s)
+        self._compiled_refs = alive
+        return out
+
+    def _zero_state_owner(self):
+        """The CompiledTrainStep whose ZeRO plan owns (or will own) the
+        sharded optimizer state, if any."""
+        for s in self._live_compiled_steps():
+            if getattr(s, "_zero", None) is not None or \
+                    getattr(s, "_zero_ok", None) is not None:
+                return s
+        return None
 
     # ---------------- kvstore setup (reference trainer.py:188) -------------
     def _init_kvstore(self):
@@ -213,16 +244,65 @@ class Trainer:
             d.fresh_grad = False
 
     # ---------------- persistence (reference trainer.py:477,506) -----------
+    def train_state(self, step: int = 0, net=None, extra=None):
+        """Snapshot the COMPLETE training state — params, optimizer state
+        (including fused and ZeRO-sharded buffers that live inside a
+        ``compile_step`` program), update counters, lr-scheduler state,
+        RNG key — as a ``mx.checkpoint.TrainState`` of host arrays. Pair
+        with ``mx.checkpoint.write_checkpoint``/``TrainCheckpointManager``
+        for atomic on-disk persistence."""
+        from ..checkpoint.state import capture_train_state
+        return capture_train_state(trainer=self, net=net, step=step,
+                                   extra=extra)
+
+    def load_train_state(self, state, net=None, strict: bool = True):
+        """Restore a ``TrainState`` (inverse of :meth:`train_state`);
+        returns its meta dict (incl. ``'step'``)."""
+        from ..checkpoint.state import apply_train_state
+        return apply_train_state(state, trainer=self, net=net,
+                                 strict=strict)
+
     def save_states(self, fname: str):
+        """Reference single-file optimizer-state dump. The write is
+        crash-safe (staged + fsync + ``os.replace``) but the FORMAT only
+        covers the eager updater: when a ZeRO-sharded ``compile_step``
+        owns NamedSharding-sharded moments/masters this raises instead
+        of silently writing stale state — use :meth:`train_state` /
+        ``mx.checkpoint.TrainCheckpointManager`` there."""
+        owner = self._zero_state_owner()
+        if owner is not None:
+            raise MXNetError(
+                "Trainer.save_states cannot serialize the ZeRO-sharded "
+                "optimizer state owned by a compile_step program (the "
+                "eager updater it pickles no longer holds the live "
+                "momenta/moments/fp32 masters). Use trainer.train_state()"
+                " with mx.checkpoint.write_checkpoint, or "
+                "mx.checkpoint.TrainCheckpointManager / "
+                "gluon.TrainLoop(checkpoint_dir=...).")
+        from ..checkpoint.atomic import atomic_write_bytes
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as f:
-                f.write(self._updater.get_states(dump_optimizer=True))
+            atomic_write_bytes(
+                fname, self._updater.get_states(dump_optimizer=True),
+                fault="trainer.save_states")
 
     def load_states(self, fname: str):
+        """Reads both the single-file updater pickle (reference format,
+        still what :meth:`save_states` writes) and — shim for the new
+        world — an atomic checkpoint directory produced by
+        ``mx.checkpoint`` (its optimizer state + counters are applied)."""
+        import os
         if not self._kv_initialized:
             self._init_kvstore()
+        if os.path.isdir(fname):
+            from ..checkpoint.atomic import read_checkpoint
+            from ..checkpoint.state import TrainState, apply_train_state
+            arrays, manifest = read_checkpoint(fname)
+            state = TrainState(arrays, manifest.get("meta", {}),
+                               array_meta=manifest["arrays"])
+            apply_train_state(state, trainer=self, strict=False)
+            return
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.load_optimizer_states(fname)
         else:

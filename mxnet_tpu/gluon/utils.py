@@ -75,22 +75,78 @@ def _get_repo_url():
     return url
 
 
-def download(url, path=None, overwrite=False, sha1_hash=None,
-             retries=5, verify_ssl=True):
-    """Reference gluon.utils.download. This build runs zero-egress; only
-    file:// and existing local paths are served."""
+def check_sha1(filename, sha1_hash) -> bool:
+    """True when the file's sha1 matches (reference gluon.utils.check_sha1;
+    prefix matches are accepted like the reference's short hashes)."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha1.update(chunk)
+    digest = sha1.hexdigest()
+    return digest == sha1_hash or digest.startswith(sha1_hash)
+
+
+def _fetch_once(url, tmp_path):
+    """One transfer attempt into ``tmp_path``. file:// and existing local
+    paths are served directly (zero-egress builds); http(s) goes through
+    urllib and surfaces transient failures as exceptions for the retry
+    loop."""
     import os
     import shutil
     if url.startswith("file://"):
-        src = url[7:]
-        dst = path or os.path.basename(src)
-        if os.path.isdir(dst):
-            dst = os.path.join(dst, os.path.basename(src))
-        if not os.path.exists(dst) or overwrite:
-            shutil.copyfile(src, dst)
-        return dst
+        shutil.copyfile(url[7:], tmp_path)
+        return
     if os.path.exists(url):
-        return url
+        shutil.copyfile(url, tmp_path)
+        return
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=30) as r, \
+            open(tmp_path, "wb") as f:
+        shutil.copyfileobj(r, f)
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference gluon.utils.download, hardened: transient failures are
+    retried with exponential backoff + jitter, the payload is staged to
+    a temp file and sha1-verified BEFORE an atomic ``os.replace`` into
+    place (a corrupt or torn transfer never lands at the destination,
+    and the corrupt temp is deleted), and an existing destination that
+    already matches ``sha1_hash`` short-circuits."""
+    import os
+    import random
+    import time
+    dst = path or url.split("/")[-1]
+    if os.path.isdir(dst):
+        dst = os.path.join(dst, url.split("/")[-1])
+    if os.path.exists(dst) and not overwrite and \
+            (sha1_hash is None or check_sha1(dst, sha1_hash)):
+        return dst
+    retries = max(1, int(retries))
+    tmp = f"{dst}.tmp-{os.getpid()}"
+    last_err = None
+    for attempt in range(retries):
+        try:
+            _fetch_once(url, tmp)
+            if sha1_hash and not check_sha1(tmp, sha1_hash):
+                raise MXNetError(
+                    f"downloaded file {url} failed sha1 verification "
+                    f"(expected {sha1_hash})")
+            os.replace(tmp, dst)
+            return dst
+        except Exception as e:
+            try:
+                os.unlink(tmp)   # never leave a corrupt partial behind
+            except OSError:
+                pass
+            last_err = e
+            if attempt + 1 < retries:
+                delay = min(10.0, 0.5 * (2 ** attempt)) \
+                    * (1.0 + 0.5 * random.random())
+                time.sleep(delay)
     raise MXNetError(
-        "network downloads unavailable (zero-egress environment); "
-        f"cannot fetch {url}")
+        f"cannot fetch {url} after {retries} attempts "
+        f"({type(last_err).__name__}: {last_err}); note this build runs "
+        "zero-egress — point MXNET_GLUON_REPO at a file:// mirror") \
+        from last_err

@@ -19,7 +19,8 @@ from ..base import jx_dtype
 from ..ops.registry import invoke_raw
 from .ndarray import NDArray, _put
 
-__all__ = ["seed", "next_key", "uniform", "normal", "randn", "randint",
+__all__ = ["seed", "next_key", "get_key_state", "set_key_state",
+           "uniform", "normal", "randn", "randint",
            "exponential", "gamma", "poisson", "negative_binomial",
            "generalized_negative_binomial", "multinomial", "shuffle",
            "bernoulli", "laplace"]
@@ -54,6 +55,18 @@ def next_key():
     k, sub = jax.random.split(k)
     _state.key = k
     return sub
+
+
+def get_key_state():
+    """The current PRNG key chain head as a host array — checkpointing
+    this (mx.checkpoint) makes a resumed run draw the SAME random stream
+    (dropout masks, samplers) the uninterrupted run would have."""
+    return onp.asarray(_key_state())
+
+
+def set_key_state(key):
+    """Restore a key captured by :func:`get_key_state`."""
+    _state.key = jnp.asarray(onp.asarray(key), dtype=jnp.uint32)
 
 
 def push_trace_key(key):

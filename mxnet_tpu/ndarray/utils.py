@@ -8,7 +8,7 @@ container — portable, mmap-able, and holds bfloat16 via a view trick.
 """
 from __future__ import annotations
 
-import os
+import io
 import zipfile
 from typing import Dict, List, Union
 
@@ -50,10 +50,14 @@ def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]):
             raise MXNetError(f"value for key {k!r} is not an NDArray")
         a, is_bf16 = _to_numpy(v)
         payload[k + (_BF16_SUFFIX if is_bf16 else "")] = a
-    onp.savez(fname, **payload)
-    # numpy appends .npz; keep the exact requested path like the reference does
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    # crash-safe write: serialize fully in memory, stage to a temp file,
+    # fsync, then os.replace — a kill mid-save can never clobber an
+    # existing good file with a torn archive (savez to a file object
+    # also keeps numpy from appending '.npz' to the requested path)
+    from ..checkpoint.atomic import atomic_write_bytes
+    buf = io.BytesIO()
+    onp.savez(buf, **payload)
+    atomic_write_bytes(fname, buf.getvalue(), fault="ndarray.save")
 
 
 def load(fname: str):

@@ -8,7 +8,10 @@ DMLC_* env names are honored so reference launch scripts keep working.
 """
 from __future__ import annotations
 
+import logging
 import os
+import random as _pyrandom
+import time
 from typing import Optional
 
 import jax
@@ -16,6 +19,8 @@ import jax
 from ..base import MXNetError, get_env
 
 __all__ = ["initialize", "is_initialized", "rank", "size", "global_mesh"]
+
+_LOG = logging.getLogger("mxnet_tpu.dist")
 
 _initialized = [False]
 
@@ -25,7 +30,14 @@ def initialize(coordinator_address: Optional[str] = None,
                process_id: Optional[int] = None):
     """Join the multi-host job. Maps reference env vars:
     DMLC_PS_ROOT_URI/PORT -> coordinator, DMLC_NUM_WORKER -> num_processes,
-    DMLC_WORKER_ID -> process_id. (reference: launch via tools/launch.py)."""
+    DMLC_WORKER_ID -> process_id. (reference: launch via tools/launch.py).
+
+    Joining races the coordinator's startup on real pods, so the
+    connection is retried with exponential backoff + jitter:
+    ``MXNET_DIST_INIT_RETRIES`` attempts (default 3),
+    ``MXNET_DIST_INIT_TIMEOUT`` seconds per attempt (default: jax's).
+    Exhausting the budget raises an ``MXNetError`` naming the
+    coordinator instead of leaking a raw RPC error."""
     if _initialized[0]:
         return
     coordinator_address = coordinator_address or _coord_from_env()
@@ -36,10 +48,38 @@ def initialize(coordinator_address: Optional[str] = None,
         # single-process: nothing to join
         _initialized[0] = True
         return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
-    _initialized[0] = True
+    retries = max(1, get_env("MXNET_DIST_INIT_RETRIES", 3, int))
+    timeout = get_env("MXNET_DIST_INIT_TIMEOUT", None, float)
+    kwargs = {}
+    if timeout is not None:
+        kwargs["initialization_timeout"] = timeout
+    last_err = None
+    for attempt in range(retries):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                **kwargs)
+            _initialized[0] = True
+            return
+        except Exception as e:   # jax surfaces RPC failures untyped
+            last_err = e
+            if attempt + 1 < retries:
+                delay = min(30.0, 0.5 * (2 ** attempt)) \
+                    * (1.0 + 0.25 * _pyrandom.random())
+                _LOG.warning(
+                    "dist.initialize attempt %d/%d against %s failed "
+                    "(%s: %s); retrying in %.1fs", attempt + 1, retries,
+                    coordinator_address, type(e).__name__, e, delay)
+                time.sleep(delay)
+    raise MXNetError(
+        f"could not join the distributed job: coordinator "
+        f"{coordinator_address} (process_id={process_id}, "
+        f"num_processes={num_processes}) unreachable after {retries} "
+        f"attempts; last error: {type(last_err).__name__}: {last_err}. "
+        "Check DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT and that the "
+        "coordinator process is up; tune MXNET_DIST_INIT_RETRIES/"
+        "MXNET_DIST_INIT_TIMEOUT.") from last_err
 
 
 def _coord_from_env() -> Optional[str]:

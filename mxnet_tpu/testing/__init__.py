@@ -1,0 +1,12 @@
+"""Testing support: fault injection for crash-consistency proofs.
+
+Reference analog: the reference's test fault tooling is ad-hoc
+(tests/python/unittest/common.py retry decorators); here fault points
+are first-class so the checkpoint stack's atomicity claims are enforced
+by kill-9 tests instead of asserted in comments.
+"""
+from . import faults                              # noqa: F401
+from .faults import (fault_point, FaultInjectedError,  # noqa: F401
+                     FaultRule)
+
+__all__ = ["faults", "fault_point", "FaultInjectedError", "FaultRule"]

@@ -1,0 +1,168 @@
+"""Fault-injection harness for crash-consistency testing.
+
+TPU pods are preempted, hosts are killed mid-write, and NFS hiccups —
+the TensorFlow paper (arXiv:1605.08695 §4.3) makes periodic
+checkpoint/restore the canonical answer, which is only trustworthy if
+the checkpoint path itself survives being killed at its worst moment.
+This module plants named *fault points* inside the persistence stack
+(``checkpoint.stage``, ``checkpoint.commit``, ``checkpoint.prune``,
+``ndarray.save``, ...) that are inert by default and armed through one
+env var::
+
+    MXNET_FAULT_INJECT="checkpoint.commit:after=1"          # SIGKILL
+    MXNET_FAULT_INJECT="checkpoint.stage:before=2:error"    # raise IO error
+    MXNET_FAULT_INJECT="ndarray.save:before=1:delay:250"    # sleep 250ms
+
+Grammar (``;``-separated rules)::
+
+    rule   := point ':' phase '=' nth [':' action]
+    phase  := 'before' | 'after'     # relative to the guarded operation
+    nth    := 1-based hit count at which the rule fires (once)
+    action := 'kill'                 # os.kill(SIGKILL) — hard preemption
+            | 'error'                # raise FaultInjectedError (an OSError)
+            | 'delay' ':' millis     # sleep, for overlap/race windows
+
+Subprocess kill-9 tests (tests/test_checkpoint.py) set the env var,
+run a real training loop, get SIGKILLed mid-commit, and then prove the
+checkpoint directory still resumes bit-exactly.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["fault_point", "FaultInjectedError", "FaultRule", "configure",
+           "reset", "hit_counts"]
+
+_LOG = logging.getLogger("mxnet_tpu.faults")
+
+ENV_VAR = "MXNET_FAULT_INJECT"
+
+
+class FaultInjectedError(OSError):
+    """The injected IO failure (an ``OSError`` so generic ``except OSError``
+    recovery paths are exercised exactly like a real disk error)."""
+
+
+class FaultRule:
+    __slots__ = ("point", "phase", "nth", "action", "delay_ms", "fired")
+
+    def __init__(self, point: str, phase: str, nth: int, action: str,
+                 delay_ms: int = 0):
+        if phase not in ("before", "after"):
+            raise ValueError(f"fault phase must be before/after, got {phase!r}")
+        if action not in ("kill", "error", "delay"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.point = point
+        self.phase = phase
+        self.nth = int(nth)
+        self.action = action
+        self.delay_ms = int(delay_ms)
+        self.fired = False
+
+    def __repr__(self):
+        return (f"FaultRule({self.point}:{self.phase}={self.nth}"
+                f":{self.action})")
+
+
+def _parse(spec: str) -> List[FaultRule]:
+    rules: List[FaultRule] = []
+    for chunk in spec.replace(",", ";").split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) < 2 or "=" not in parts[1]:
+            raise ValueError(
+                f"bad {ENV_VAR} rule {chunk!r}; expected "
+                "'point:before|after=N[:kill|error|delay:MS]'")
+        point = parts[0]
+        phase, nth = parts[1].split("=", 1)
+        action = parts[2] if len(parts) > 2 else "kill"
+        delay_ms = int(parts[3]) if action == "delay" and len(parts) > 3 \
+            else 0
+        rules.append(FaultRule(point, phase.strip(), int(nth), action,
+                               delay_ms))
+    return rules
+
+
+# (point, phase) -> hit count; rules parsed once per process (subprocess
+# tests re-exec with the env var set) or overridden via configure()
+_lock = threading.Lock()
+_rules: Optional[List[FaultRule]] = None
+_counts: Dict[Tuple[str, str], int] = {}
+
+
+def _get_rules() -> List[FaultRule]:
+    global _rules
+    if _rules is None:
+        spec = os.environ.get(ENV_VAR, "")
+        _rules = _parse(spec) if spec else []
+        if _rules:
+            _LOG.warning("fault injection ARMED: %s", _rules)
+    return _rules
+
+
+def configure(spec: Optional[str]) -> List[FaultRule]:
+    """Arm (or, with None/'', disarm) fault rules in-process, bypassing
+    the env var — the unit-test entry point."""
+    global _rules
+    with _lock:
+        _rules = _parse(spec) if spec else []
+        _counts.clear()
+        return _rules
+
+
+def reset():
+    """Disarm everything and forget hit counts (returns to env parsing)."""
+    global _rules
+    with _lock:
+        _rules = None
+        _counts.clear()
+
+
+def hit_counts() -> Dict[Tuple[str, str], int]:
+    return dict(_counts)
+
+
+def fault_point(point: str, phase: str = "before"):
+    """Declare a named fault point. Call sites bracket a critical
+    operation::
+
+        fault_point("checkpoint.commit", "before")
+        os.replace(tmp, final)
+        fault_point("checkpoint.commit", "after")
+
+    Inert (one dict lookup) unless ``MXNET_FAULT_INJECT``/``configure``
+    armed a matching rule.
+    """
+    rules = _get_rules()
+    if not rules:
+        return
+    with _lock:
+        key = (point, phase)
+        _counts[key] = n = _counts.get(key, 0) + 1
+        to_fire = [r for r in rules
+                   if r.point == point and r.phase == phase
+                   and not r.fired and r.nth == n]
+        for r in to_fire:
+            r.fired = True
+    for r in to_fire:
+        _fire(r)
+
+
+def _fire(rule: FaultRule):
+    _LOG.warning("fault injection FIRING %r", rule)
+    if rule.action == "kill":
+        # the hard preemption: no atexit, no finally, no flush — exactly
+        # what a pod eviction or OOM-kill does to the process
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif rule.action == "error":
+        raise FaultInjectedError(
+            f"injected IO failure at {rule.point}:{rule.phase}")
+    elif rule.action == "delay":
+        time.sleep(rule.delay_ms / 1000.0)

@@ -1,0 +1,96 @@
+"""Subprocess worker for the kill-9 crash-consistency tests
+(tests/test_checkpoint.py). Runs a deterministic TrainLoop with
+checkpointing; the parent arms MXNET_FAULT_INJECT so this process gets
+SIGKILLed mid-checkpoint, then re-runs it clean and asserts bit-exact
+loss parity with an uninterrupted run.
+
+Usage::
+
+    python checkpoint_crash_worker.py <ckpt_dir> <out_file> \
+        --mode fused|zero --opt sgd|adam --steps N [--every K]
+
+Writes one loss per line to <out_file> as ``<step_index> <loss>`` —
+appended AFTER the step completes, so a killed run leaves a truncated
+but parseable log.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import numpy as onp  # noqa: E402
+
+
+def batch(i, bs=8):
+    rng = onp.random.RandomState(1000 + i)
+    return (rng.randn(bs, 4).astype("float32"),
+            rng.randint(0, 3, size=(bs,)).astype("int32"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ckpt_dir")
+    ap.add_argument("out_file")
+    ap.add_argument("--mode", choices=["fused", "zero"], default="fused")
+    ap.add_argument("--opt", choices=["sgd", "adam"], default="sgd")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--every", type=int, default=2)
+    ap.add_argument("--sync", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import TrainLoop, Trainer, nn
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import make_mesh
+
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"))
+    net.add(nn.Dense(5, in_units=8, activation="relu"))
+    net.add(nn.Dense(3, in_units=5))
+    net.initialize()
+    opt_params = {"learning_rate": 0.05}
+    if args.opt == "sgd":
+        opt_params["momentum"] = 0.9
+    trainer = Trainer(net.collect_params(), args.opt, opt_params)
+    loss = gloss.SoftmaxCrossEntropyLoss()
+
+    mesh = make_mesh({"dp": 4}, jax.devices()[:4]) \
+        if args.mode == "zero" else None
+
+    def run():
+        loop = TrainLoop(net, trainer, loss,
+                         checkpoint_dir=args.ckpt_dir,
+                         checkpoint_every=args.every,
+                         async_checkpoint=not args.sync)
+        if args.mode == "zero":
+            # TrainLoop compiles via Trainer.compile_step with auto
+            # zero detection: the active mesh turns it on
+            assert mesh is not None
+        for i in range(loop.global_step, args.steps):
+            x, y = batch(i)
+            l = loop.step(nd.array(x), nd.array(y))
+            val = float(onp.asarray(l.asnumpy()).sum())
+            with open(args.out_file, "a") as f:
+                f.write(f"{i} {val:.9e}\n")
+                f.flush()
+                os.fsync(f.fileno())
+        loop.wait()
+        if args.mode == "zero":
+            assert loop.compiled_step.zero_sharded, "zero mode inactive"
+
+    if mesh is not None:
+        with mesh:
+            run()
+    else:
+        run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,638 @@
+"""Preemption-safe checkpointing (ISSUE 3).
+
+Covers: the atomic+checksummed on-disk format (staged temp dir, CRC
+manifest, os.replace commit, `latest` pointer, fallback past corrupt
+checkpoints), complete TrainState capture/restore across the eager,
+plain-fused, and ZeRO-sharded optimizer paths (bit-exact loss parity
+after resume, dp=N -> dp=M resharding), async snapshotting (same
+results, error propagation), TrainLoop auto-save/auto-resume/prune,
+the fault-injection harness, and — marked slow — subprocess kill-9
+tests that SIGKILL a real training run mid-commit and prove it resumes
+bit-exactly.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import (
+    CheckpointCorruptError, TrainCheckpointManager, apply_train_state,
+    assemble_segments, atomic_write_bytes, capture_train_state,
+    latest_valid, list_checkpoints, load_latest, prune_checkpoints,
+    read_checkpoint, write_checkpoint)
+from mxnet_tpu.checkpoint.atomic import step_dir_name
+from mxnet_tpu.gluon import TrainLoop, Trainer, nn
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.parallel import make_mesh, shard_batch
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import FaultInjectedError
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------- helpers
+def _build(seed=3):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"))
+    net.add(nn.Dense(5, in_units=8, activation="relu"))
+    net.add(nn.Dense(3, in_units=5))
+    net.initialize()
+    return net
+
+
+def _batch(i, bs=8):
+    rng = onp.random.RandomState(1000 + i)
+    return (nd.array(rng.randn(bs, 4).astype("float32")),
+            nd.array(rng.randint(0, 3, size=(bs,)).astype("int32")))
+
+
+def _loss_sum(l):
+    return float(onp.asarray(l.asnumpy()).sum())
+
+
+# ================================================================ atomic IO
+def test_write_read_roundtrip(tmp_path):
+    root = str(tmp_path / "ck")
+    arrays = {"a": onp.arange(6, dtype=onp.float32).reshape(2, 3),
+              "b/nested": onp.array([1, 2], dtype=onp.int64),
+              "c": onp.asarray(jax.numpy.ones((4,),
+                                              dtype=jax.numpy.bfloat16))}
+    path = write_checkpoint(root, 7, arrays, meta={"note": "hi"})
+    assert os.path.basename(path) == step_dir_name(7)
+    got, manifest = read_checkpoint(path)
+    assert manifest["step"] == 7 and manifest["meta"]["note"] == "hi"
+    assert sorted(got) == sorted(arrays)
+    assert got["a"].dtype == onp.float32
+    assert (got["a"] == arrays["a"]).all()
+    assert str(got["c"].dtype) == "bfloat16"
+    step, arrays2, _ = load_latest(root)
+    assert step == 7 and (arrays2["b/nested"] == arrays["b/nested"]).all()
+
+
+def test_corrupt_manifest_falls_back_to_older(tmp_path, caplog):
+    root = str(tmp_path / "ck")
+    write_checkpoint(root, 1, {"a": onp.zeros(3)})
+    write_checkpoint(root, 2, {"a": onp.ones(3)})
+    # corrupt the NEWEST manifest post-commit (disk rot)
+    with open(os.path.join(root, step_dir_name(2), "manifest.json"),
+              "w") as f:
+        f.write("{not json")
+    import logging
+    with caplog.at_level(logging.WARNING, "mxnet_tpu.checkpoint"):
+        step, arrays, _ = load_latest(root)
+    assert step == 1 and (arrays["a"] == 0).all()
+    assert any("corrupt" in r.message for r in caplog.records)
+
+
+def test_truncated_array_fails_crc_and_falls_back(tmp_path):
+    root = str(tmp_path / "ck")
+    write_checkpoint(root, 1, {"a": onp.zeros(64)})
+    write_checkpoint(root, 2, {"a": onp.ones(64)})
+    target = os.path.join(root, step_dir_name(2), "arrays", "0.npy")
+    raw = open(target, "rb").read()
+    with open(target, "wb") as f:
+        f.write(raw[:len(raw) // 2])     # torn write post-commit
+    with pytest.raises(CheckpointCorruptError, match="checksum|missing"):
+        read_checkpoint(os.path.join(root, step_dir_name(2)))
+    step, arrays, _ = load_latest(root)
+    assert step == 1
+
+
+def test_stale_latest_pointer_falls_back_to_scan(tmp_path):
+    root = str(tmp_path / "ck")
+    write_checkpoint(root, 3, {"a": onp.arange(4)})
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write(step_dir_name(9) + "\n")      # points at nothing
+    assert latest_valid(root)[0] == 3
+
+
+def test_prune_keeps_newest(tmp_path):
+    root = str(tmp_path / "ck")
+    for s in (1, 2, 3, 4):
+        write_checkpoint(root, s, {"a": onp.full(2, s)})
+    prune_checkpoints(root, keep_last=2)
+    assert list_checkpoints(root) == [3, 4]
+    # a leftover staging dir from a crashed writer is swept too
+    os.makedirs(os.path.join(root, ".tmp-step-junk"))
+    prune_checkpoints(root, keep_last=2)
+    assert not any(n.startswith(".tmp-") for n in os.listdir(root))
+
+
+def test_commit_crash_leaves_no_partial_visible(tmp_path):
+    """An injected failure BEFORE the commit rename must leave the root
+    exactly as it was: old checkpoint valid, no new step dir."""
+    root = str(tmp_path / "ck")
+    write_checkpoint(root, 1, {"a": onp.zeros(8)})
+    faults.configure("checkpoint.commit:before=1:error")
+    with pytest.raises(FaultInjectedError):
+        write_checkpoint(root, 2, {"a": onp.ones(8)})
+    faults.reset()
+    assert list_checkpoints(root) == [1]
+    assert latest_valid(root)[0] == 1
+
+
+# ================================================================ nd.save
+def test_nd_save_atomic_keeps_old_file_on_crash(tmp_path):
+    """Regression (ISSUE 3 satellite): a simulated crash mid-save leaves
+    the previous good file intact and loadable."""
+    fname = str(tmp_path / "arrs")
+    nd.save(fname, {"w": nd.array([1.0, 2.0])})
+    faults.configure("ndarray.save:before=1:error")
+    with pytest.raises(FaultInjectedError):
+        nd.save(fname, {"w": nd.array([9.0, 9.0, 9.0])})
+    faults.reset()
+    got = nd.load(fname)
+    assert got["w"].asnumpy().tolist() == [1.0, 2.0]
+    assert not [n for n in os.listdir(str(tmp_path))
+                if ".tmp-" in n], "temp staging file leaked"
+
+
+def test_atomic_write_bytes_replaces_whole(tmp_path):
+    f = str(tmp_path / "blob")
+    atomic_write_bytes(f, b"one")
+    atomic_write_bytes(f, b"two-longer")
+    assert open(f, "rb").read() == b"two-longer"
+
+
+# ================================================================ faults
+def test_fault_spec_parsing_and_counts():
+    rules = faults.configure(
+        "checkpoint.commit:after=1;x.y:before=3:error;z:before=1:delay:5")
+    assert [r.action for r in rules] == ["kill", "error", "delay"]
+    assert rules[2].delay_ms == 5
+    faults.fault_point("x.y", "before")     # 1st: no fire
+    faults.fault_point("x.y", "before")     # 2nd
+    with pytest.raises(FaultInjectedError):
+        faults.fault_point("x.y", "before")  # 3rd fires
+    faults.fault_point("x.y", "before")     # 4th: fired rules stay quiet
+    assert faults.hit_counts()[("x.y", "before")] == 4
+
+
+def test_fault_bad_spec_rejected():
+    with pytest.raises(ValueError):
+        faults.configure("nonsense")
+    faults.reset()
+
+
+def test_fault_delay_sleeps(monkeypatch):
+    slept = []
+    import time as _t
+    monkeypatch.setattr(_t, "sleep", lambda s: slept.append(s))
+    faults.configure("p:before=1:delay:250")
+    faults.fault_point("p", "before")
+    assert slept == [0.25]
+
+
+def test_assemble_segments_roundtrip():
+    full = onp.arange(12, dtype=onp.float32).reshape(6, 2)
+    arrays = {"x#seg0": full[:3], "x#seg3": full[3:], "y": onp.ones(2)}
+    meta = {"x#seg0": {"seg_of": "x", "dim0_start": 0,
+                       "global_shape": [6, 2]},
+            "x#seg3": {"seg_of": "x", "dim0_start": 3,
+                       "global_shape": [6, 2]}}
+    out = assemble_segments(arrays, meta)
+    assert (out["x"] == full).all() and (out["y"] == 1).all()
+    with pytest.raises(MXNetError, match="gap|incomplete"):
+        assemble_segments({"x#seg3": full[3:]}, {"x#seg3": meta["x#seg3"]})
+
+
+# ================================================================ TrainState
+def _train_run(mode, opt, n_steps, ckpt_dir=None, save_at=None,
+               resume=False, dp=4, lr=0.05, async_save=False):
+    """One deterministic run; returns per-step summed losses keyed by
+    absolute step index."""
+    net = _build()
+    opt_params = {"learning_rate": lr}
+    if opt == "sgd":
+        opt_params["momentum"] = 0.9
+    trainer = Trainer(net.collect_params(), opt, opt_params)
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": dp}, jax.devices()[:dp]) \
+        if mode == "zero" else None
+
+    def body():
+        mgr = TrainCheckpointManager(ckpt_dir, keep_last=3,
+                                     async_save=async_save) \
+            if ckpt_dir else None
+        start = 0
+        if mgr and resume and mgr.has_checkpoint():
+            meta = mgr.restore_latest(trainer=trainer, net=net)
+            start = int(meta["step"])
+        if mode == "eager":
+            from mxnet_tpu import autograd
+            losses = {}
+            for i in range(start, n_steps):
+                x, y = _batch(i)
+                with autograd.record():
+                    l = loss_blk(net(x), y)
+                l.backward()
+                trainer.step(8)
+                losses[i] = _loss_sum(l)
+                if mgr and save_at and (i + 1) in save_at:
+                    mgr.save(i + 1, trainer=trainer, net=net)
+            if mgr:
+                mgr.wait()
+            return losses
+        step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+        losses = {}
+        for i in range(start, n_steps):
+            x, y = _batch(i)
+            losses[i] = _loss_sum(step(x, y))
+            if mgr and save_at and (i + 1) in save_at:
+                mgr.save(i + 1, trainer=trainer, net=net)
+        if mgr:
+            mgr.wait()
+        if mode == "zero":
+            assert step.zero_sharded
+        return losses
+
+    if mesh is not None:
+        with mesh:
+            return body()
+    return body()
+
+
+@pytest.mark.parametrize("mode", ["eager", "fused", "zero"])
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_resume_bit_exact(tmp_path, mode, opt):
+    """Save at step 3 of 6, restore into a FRESH net/trainer/program,
+    replay — losses must match the uninterrupted run bit-exactly
+    (params, momenta/moments, Adam t counters, RNG all round-trip)."""
+    if mode == "zero" and len(jax.devices()) < 4:
+        pytest.skip("needs virtual mesh")
+    base = _train_run(mode, opt, 6)
+    d = str(tmp_path / "ck")
+    first = _train_run(mode, opt, 3, ckpt_dir=d, save_at={3})
+    assert first == {i: base[i] for i in range(3)}
+    resumed = _train_run(mode, opt, 6, ckpt_dir=d, resume=True)
+    assert resumed == {i: base[i] for i in range(3, 6)}
+
+
+def test_zero_reshard_dp4_to_dp2(tmp_path):
+    """dp=N checkpoint resumes on a dp=M mesh: the logical (unpadded,
+    per-param) state format re-pads and re-shards on restore."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs virtual mesh")
+    d = str(tmp_path / "ck")
+    base = _train_run("zero", "adam", 6, dp=4)
+    _train_run("zero", "adam", 3, ckpt_dir=d, save_at={3}, dp=4)
+    resumed = _train_run("zero", "adam", 6, ckpt_dir=d, resume=True, dp=2)
+    for i in range(3, 6):
+        assert resumed[i] == pytest.approx(base[i], rel=1e-5)
+
+
+def test_capture_restore_mid_run_live_plan(tmp_path):
+    """Restore INTO a live zero program (plan already materialized):
+    state is rebuilt in place and training continues bit-exactly."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs virtual mesh")
+    net = _build()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    with make_mesh({"dp": 4}, jax.devices()[:4]):
+        step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+        for i in range(3):
+            step(*_batch(i))
+        state = capture_train_state(trainer=trainer, net=net, step=3)
+        want = [_loss_sum(step(*_batch(i))) for i in range(3, 6)]
+        # keep training past the snapshot, then rewind
+        for i in range(6, 8):
+            step(*_batch(i))
+        apply_train_state(state, trainer=trainer, net=net)
+        got = [_loss_sum(step(*_batch(i))) for i in range(3, 6)]
+    assert got == want
+
+
+def test_multi_precision_masters_roundtrip(tmp_path, monkeypatch):
+    """bf16 + multi_precision zero mode: fp32 masters are captured and
+    restored exactly (NOT recast from the bf16 weights)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs virtual mesh")
+    monkeypatch.setenv("MXNET_ZERO_SHARD_MIN_SIZE", "1")
+
+    def build_mp():
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(6, in_units=4))
+        net.initialize()
+        net.cast("bfloat16")
+        trainer = Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2, "multi_precision": True})
+        return net, trainer
+
+    def run(n_pre, n_post, d=None):
+        net, trainer = build_mp()
+        with make_mesh({"dp": 4}, jax.devices()[:4]):
+            step = trainer.compile_step(lambda a: (net(a) ** 2).mean())
+            mgr = TrainCheckpointManager(d) if d else None
+            start = 0
+            if mgr and mgr.has_checkpoint():
+                start = mgr.restore_latest(trainer=trainer,
+                                           net=net)["step"]
+            rng = onp.random.RandomState(0)
+            x = nd.array(rng.randn(8, 4).astype("float32")) \
+                .astype("bfloat16")
+            out = []
+            for i in range(start, n_pre + n_post):
+                out.append(_loss_sum(step(x, batch_size=8)))
+                if mgr and i + 1 == n_pre:
+                    mgr.save(i + 1, trainer=trainer, net=net, block=True)
+            assert step.zero_sharded and step._zero.masters
+            return out
+
+    base = run(3, 3)
+    d = str(tmp_path / "ck")
+    run(3, 0, d=d)
+    resumed = run(3, 3, d=d)
+    assert resumed == base[3:]
+
+
+def test_state_includes_rng_and_scheduler(tmp_path):
+    from mxnet_tpu import lr_scheduler
+    net = _build()
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=0.1)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "lr_scheduler": sched})
+    state = capture_train_state(trainer=trainer, net=net, step=5)
+    assert "rng/key" in state.arrays
+    assert state.meta["lr_scheduler"] is not None
+    # mutate, then restore
+    sched.base_lr = 0.7
+    mx.random.seed(123456)
+    apply_train_state(state, trainer=trainer, net=net)
+    assert sched.base_lr == pytest.approx(0.1)
+    from mxnet_tpu.ndarray.random import get_key_state
+    assert (get_key_state() == state.arrays["rng/key"]).all()
+
+
+# ================================================================ TrainLoop
+def _loop_run(tmp_dir, n_steps, every=2, async_ckpt=True, keep_last=2):
+    net = _build()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05})
+    loop = TrainLoop(net, trainer, gloss.SoftmaxCrossEntropyLoss(),
+                     checkpoint_dir=tmp_dir, checkpoint_every=every,
+                     keep_last=keep_last, async_checkpoint=async_ckpt)
+    losses = {}
+    for i in range(loop.global_step, n_steps):
+        losses[i] = _loss_sum(loop.step(*_batch(i)))
+    loop.wait()
+    return loop, losses
+
+
+def test_trainloop_autoresume_bit_exact(tmp_path):
+    d = str(tmp_path / "ck")
+    base = _train_run("fused", "adam", 6)
+    loop1, first = _loop_run(d, 4)
+    assert loop1.checkpoint_manager.latest_step() == 4
+    loop2, resumed = _loop_run(d, 6)
+    assert loop2.global_step == 6
+    assert resumed == {i: base[i] for i in range(4, 6)}
+
+
+def test_trainloop_prunes_to_keep_last(tmp_path):
+    d = str(tmp_path / "ck")
+    _loop_run(d, 8, every=2, keep_last=2)
+    assert list_checkpoints(d) == [6, 8]
+
+
+def test_async_checkpoint_does_not_change_results(tmp_path):
+    da, ds = str(tmp_path / "a"), str(tmp_path / "s")
+    _, la = _loop_run(da, 5, async_ckpt=True)
+    _, ls = _loop_run(ds, 5, async_ckpt=False)
+    assert la == ls
+    sa = load_latest(da)
+    ss = load_latest(ds)
+    assert sa[0] == ss[0]
+    for k in sa[1]:
+        if k == "rng/key":
+            continue
+        assert (sa[1][k] == ss[1][k]).all(), k
+
+
+def test_async_write_error_propagates(tmp_path):
+    d = str(tmp_path / "ck")
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    mgr = TrainCheckpointManager(d, async_save=True)
+    faults.configure("checkpoint.stage:before=1:error")
+    mgr.save(1, trainer=trainer, net=net)       # fails on the worker
+    with pytest.raises(MXNetError, match="background checkpoint"):
+        mgr.wait()
+    faults.reset()
+    # the manager recovers: next save succeeds
+    mgr.save(2, trainer=trainer, net=net, block=True)
+    assert mgr.latest_step() == 2
+
+
+def test_trainloop_without_dir_rejects_manual_save():
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loop = TrainLoop(net, trainer, gloss.SoftmaxCrossEntropyLoss())
+    with pytest.raises(MXNetError, match="checkpoint_dir"):
+        loop.save_checkpoint()
+
+
+# ================================================================ Trainer API
+def test_save_states_raises_when_zero_owns_state(tmp_path):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs virtual mesh")
+    net = _build()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    with make_mesh({"dp": 4}, jax.devices()[:4]):
+        step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+        step(*_batch(0))
+        assert step.zero_sharded
+        with pytest.raises(MXNetError, match="ZeRO-sharded"):
+            trainer.save_states(str(tmp_path / "states"))
+
+
+def test_save_states_atomic_and_load_states_dir_shim(tmp_path):
+    """Plain trainers keep the reference single-file format (now written
+    crash-safely); load_states also accepts a new-format checkpoint
+    dir (deprecation shim)."""
+    from mxnet_tpu import autograd
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    for i in range(2):
+        x, y = _batch(i)
+        with autograd.record():
+            l = loss_blk(net(x), y)
+        l.backward()
+        trainer.step(8)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    assert os.path.exists(fname)
+
+    # old single-file path round-trips
+    net2 = _build()
+    trainer2 = Trainer(net2.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    trainer2.load_states(fname)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+    # dir shim: point load_states at an atomic checkpoint directory
+    state = capture_train_state(trainer=trainer, net=net, step=2)
+    root = str(tmp_path / "ck")
+    path = write_checkpoint(root, 2, state.arrays,
+                            array_meta=state.array_meta, meta=state.meta)
+    net3 = _build()
+    trainer3 = Trainer(net3.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    trainer3.load_states(path)
+    assert trainer3._optimizer.num_update == trainer._optimizer.num_update
+    st2 = capture_train_state(trainer=trainer3, step=2)
+    for k, v in st2.arrays.items():
+        if k.startswith("opt/"):
+            assert (v == state.arrays[k]).all(), k
+
+
+def test_trainer_train_state_convenience():
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    st = trainer.train_state(step=4, net=net)
+    assert st.step == 4 and any(k.startswith("param/")
+                                for k in st.arrays)
+    meta = trainer.load_train_state(st, net=net)
+    assert meta["step"] == 4
+
+
+# ================================================================ estimator
+def test_estimator_checkpoint_handler_atomic(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   Estimator)
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    rng = onp.random.RandomState(0)
+    ds = ArrayDataset(nd.array(rng.randn(16, 4).astype("float32")),
+                      nd.array(rng.randint(0, 3, (16,)).astype("int32")))
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    trainer=trainer)
+    h = CheckpointHandler(str(tmp_path), model_prefix="m", keep_last=2)
+    est.fit(DataLoader(ds, batch_size=8), epochs=2, event_handlers=[h])
+    assert os.path.exists(str(tmp_path / "m-epoch2.params"))
+    ckpt_root = str(tmp_path / "m-ckpt")
+    assert list_checkpoints(ckpt_root) == [1, 2]
+    # and the saved state restores into a fresh trainer
+    mgr = TrainCheckpointManager(ckpt_root)
+    net2 = _build()
+    tr2 = Trainer(net2.collect_params(), "sgd", {"learning_rate": 0.1})
+    meta = mgr.restore_latest(trainer=tr2, net=net2)
+    assert meta["step"] == 2
+    w1 = net._children["0"].weight.data().asnumpy()
+    w2 = net2._children["0"].weight.data().asnumpy()
+    assert (w1 == w2).all()
+
+
+# ================================================================ kill -9
+def _run_worker(ckpt_dir, out, mode, opt, steps=6, env_extra=None,
+                sync=False):
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_INJECT", None)
+    env.update(env_extra or {})
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(__file__),
+                        "checkpoint_crash_worker.py"),
+           ckpt_dir, out, "--mode", mode, "--opt", opt,
+           "--steps", str(steps)]
+    if sync:
+        cmd.append("--sync")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(cmd, env=env, cwd=repo, capture_output=True,
+                          text=True, timeout=300)
+
+
+def _losses(path):
+    out = {}
+    if os.path.exists(path):
+        for line in open(path):
+            i, v = line.split()
+            out[int(i)] = float(v)
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["fused", "zero"])
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_kill9_mid_commit_resumes_bit_exact(tmp_path, mode, opt):
+    """End-to-end crash consistency: a real training subprocess is
+    SIGKILLed DURING the checkpoint commit rename; rerunning it
+    auto-resumes from the newest valid checkpoint and reproduces the
+    uninterrupted run's losses bit-exactly."""
+    base_out = str(tmp_path / "base.log")
+    r = _run_worker(str(tmp_path / "nock"), base_out, mode, opt)
+    assert r.returncode == 0, r.stderr[-2000:]
+    base = _losses(base_out)
+    assert sorted(base) == list(range(6))
+
+    d = str(tmp_path / "ck")
+    killed_out = str(tmp_path / "killed.log")
+    r = _run_worker(
+        d, killed_out, mode, opt, sync=True,
+        env_extra={"MXNET_FAULT_INJECT": "checkpoint.commit:before=2"})
+    assert r.returncode == -9, (r.returncode, r.stderr[-2000:])
+    # the interrupted prefix matched while it lasted
+    for i, v in _losses(killed_out).items():
+        assert v == base[i]
+    # first commit survived; the torn second one is invisible
+    assert latest_valid(d)[0] == 2
+
+    resumed_out = str(tmp_path / "resumed.log")
+    r = _run_worker(d, resumed_out, mode, opt)
+    assert r.returncode == 0, r.stderr[-2000:]
+    resumed = _losses(resumed_out)
+    assert sorted(resumed) == [2, 3, 4, 5], "did not resume from step 2"
+    for i, v in resumed.items():
+        assert v == base[i], f"loss diverged at step {i}"
+
+
+@pytest.mark.slow
+def test_kill9_after_publish_keeps_latest_valid(tmp_path):
+    """Killed right AFTER publish: directory still has a valid latest;
+    resume starts from the just-published step."""
+    d = str(tmp_path / "ck")
+    out = str(tmp_path / "o.log")
+    r = _run_worker(
+        d, out, "fused", "sgd", sync=True,
+        env_extra={"MXNET_FAULT_INJECT": "checkpoint.publish:after=2"})
+    assert r.returncode == -9
+    assert latest_valid(d)[0] == 4
+    r = _run_worker(d, str(tmp_path / "o2.log"), "fused", "sgd")
+    assert r.returncode == 0
+    assert sorted(_losses(str(tmp_path / "o2.log"))) == [4, 5]
+
+
+@pytest.mark.slow
+def test_kill9_first_commit_means_fresh_start(tmp_path):
+    """Killed during the very FIRST commit: no valid checkpoint may be
+    visible — the rerun starts from scratch rather than loading trash."""
+    d = str(tmp_path / "ck")
+    r = _run_worker(
+        d, str(tmp_path / "o.log"), "fused", "sgd", sync=True,
+        env_extra={"MXNET_FAULT_INJECT": "checkpoint.commit:before=1"})
+    assert r.returncode == -9
+    assert latest_valid(d) is None
+    r = _run_worker(d, str(tmp_path / "o2.log"), "fused", "sgd")
+    assert r.returncode == 0
+    assert sorted(_losses(str(tmp_path / "o2.log"))) == list(range(6))

@@ -1,5 +1,7 @@
 """Model zoo construction + forward smoke tests
 (reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import os
+
 import numpy as onp
 import pytest
 
@@ -92,3 +94,82 @@ def test_model_save_load_roundtrip(tmp_path):
     net2.load_parameters(f)
     y1 = net2(x).asnumpy()
     onp.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# hardened downloads (ISSUE 3 satellite): retry + sha1 verify + atomic commit
+# ---------------------------------------------------------------------------
+
+def _sha1_of(path):
+    import hashlib
+    with open(path, "rb") as f:
+        return hashlib.sha1(f.read()).hexdigest()
+
+
+def test_download_sha1_verified_atomic(tmp_path):
+    from mxnet_tpu.gluon.utils import check_sha1, download
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload-bytes")
+    good = _sha1_of(str(src))
+    dst = str(tmp_path / "out.bin")
+    got = download(f"file://{src}", path=dst, sha1_hash=good, retries=2)
+    assert got == dst and check_sha1(dst, good)
+    assert not [n for n in os.listdir(str(tmp_path)) if ".tmp-" in n]
+
+
+def test_download_deletes_corrupt_temp_and_raises(tmp_path, monkeypatch):
+    import time as _time
+    from mxnet_tpu.gluon import utils as gutils
+    monkeypatch.setattr(_time, "sleep", lambda s: None)
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"corrupted!!")
+    dst = str(tmp_path / "out.bin")
+    with pytest.raises(mx.MXNetError, match="attempts"):
+        gutils.download(f"file://{src}", path=dst,
+                        sha1_hash="0" * 40, retries=3)
+    assert not os.path.exists(dst)
+    assert not [n for n in os.listdir(str(tmp_path)) if ".tmp-" in n]
+
+
+def test_download_retries_transient_then_succeeds(tmp_path, monkeypatch):
+    from mxnet_tpu.gluon import utils as gutils
+    attempts = []
+    real = gutils._fetch_once
+
+    def flaky(url, tmp):
+        attempts.append(url)
+        if len(attempts) < 3:
+            raise OSError("connection reset")
+        real(url, tmp)
+
+    monkeypatch.setattr(gutils, "_fetch_once", flaky)
+    import time as _time
+    monkeypatch.setattr(_time, "sleep", lambda s: None)
+    src = tmp_path / "w.params"
+    src.write_bytes(b"weights")
+    dst = str(tmp_path / "cache" / "w.params")
+    os.makedirs(str(tmp_path / "cache"))
+    got = gutils.download(f"file://{src}", path=dst,
+                          sha1_hash=_sha1_of(str(src)))
+    assert got == dst and len(attempts) == 3
+    assert open(dst, "rb").read() == b"weights"
+
+
+def test_get_model_file_refetches_bad_cache(tmp_path, monkeypatch):
+    from mxnet_tpu.gluon.model_zoo import model_store
+    mirror = tmp_path / "mirror" / "gluon" / "models"
+    os.makedirs(str(mirror))
+    (mirror / "tiny.params").write_bytes(b"good-weights")
+    sha = _sha1_of(str(mirror / "tiny.params"))
+    monkeypatch.setenv("MXNET_GLUON_REPO", f"file://{tmp_path}/mirror/")
+    root = str(tmp_path / "cache")
+    os.makedirs(root)
+    # poison the cache, register the true sha1 -> re-fetch replaces it
+    with open(os.path.join(root, "tiny.params"), "wb") as f:
+        f.write(b"rotten")
+    model_store.register_model_sha1("tiny", sha)
+    try:
+        path = model_store.get_model_file("tiny", root=root)
+    finally:
+        model_store._model_sha1.pop("tiny", None)
+    assert open(path, "rb").read() == b"good-weights"

@@ -327,3 +327,50 @@ def test_shard_batch_places_shards():
         assert len(x._data.sharding.device_set) == 8
         onp.testing.assert_allclose(
             x.asnumpy(), onp.arange(32, dtype="float32").reshape(16, 2))
+
+
+# ---------------------------------------------------------------------------
+# dist.initialize retry/backoff (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+def test_dist_initialize_retries_then_clear_error(monkeypatch):
+    """A flaky coordinator RPC is retried with backoff; exhausting the
+    budget raises an MXNetError naming the coordinator, not a raw RPC
+    error."""
+    from mxnet_tpu.parallel import dist
+    calls = []
+    monkeypatch.setattr(dist, "_initialized", [False])
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw) or (_ for _ in ()).throw(
+            RuntimeError("DEADLINE_EXCEEDED: rpc to master")))
+    monkeypatch.setattr(dist.time, "sleep", lambda s: None)
+    monkeypatch.setenv("MXNET_DIST_INIT_RETRIES", "4")
+    with pytest.raises(mx.MXNetError) as ei:
+        dist.initialize(coordinator_address="10.0.0.1:9000",
+                        num_processes=2, process_id=1)
+    assert len(calls) == 4
+    assert "10.0.0.1:9000" in str(ei.value)
+    assert "4 attempts" in str(ei.value)
+    assert not dist._initialized[0]
+
+
+def test_dist_initialize_succeeds_after_transient_failure(monkeypatch):
+    from mxnet_tpu.parallel import dist
+    monkeypatch.setattr(dist, "_initialized", [False])
+    attempts = []
+
+    def flaky(**kw):
+        attempts.append(kw)
+        if len(attempts) < 3:
+            raise RuntimeError("UNAVAILABLE: connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(dist.time, "sleep", lambda s: None)
+    monkeypatch.setenv("MXNET_DIST_INIT_RETRIES", "5")
+    monkeypatch.setenv("MXNET_DIST_INIT_TIMEOUT", "7.5")
+    dist.initialize(coordinator_address="h:1", num_processes=1,
+                    process_id=0)
+    assert dist._initialized[0]
+    assert len(attempts) == 3
+    assert attempts[0]["initialization_timeout"] == 7.5
