@@ -118,9 +118,33 @@ def framework_loop(net, lr, momentum=0.9):
     return mx.gluon.TrainLoop(net, trainer, SoftmaxCrossEntropyLoss())
 
 
+def analyze_framework_step(tag, loop, x_nd, y_nd):
+    """Structural fingerprint of the compiled step for the BENCH json:
+    n_traces, collective census, donated bytes, copied-donation and
+    host-transfer counts (mx.analysis program lint). A perf regression
+    then ships WITH its structural diff — e.g. img/s dropped AND
+    donated_bytes went to 0 says "donation broke", not just "slower"."""
+    try:
+        report = loop.compiled_step.analyze(x_nd, y_nd)
+    except Exception as e:  # pragma: no cover - analysis must not kill
+        log(f"bench[{tag}]: program analysis unavailable "
+            f"({type(e).__name__}: {e})")
+        return None
+    d = report.to_dict()
+    out = {"mode": d["mode"], "n_traces": d["n_traces"],
+           "collectives": d["collectives"],
+           "donated_bytes": d["donated_bytes"],
+           "donation_copied": len(report.donation.copied),
+           "host_transfers": d["host_transfers"],
+           "dtype_drift": d["dtype_drift"]}
+    log(f"bench[{tag}]: analysis {out}")
+    return out
+
+
 def run_framework_bench(tag, loop, x, y, warmup, steps):
     """AOT-compile the framework step for this shape bucket, then run
-    warmup + the timed loop. Returns (dt_seconds, flops, final_loss)."""
+    warmup + the timed loop. Returns (dt_seconds, flops, final_loss,
+    analysis_dict)."""
     import mxnet_tpu as mx
     x_nd, y_nd = mx.nd.from_jax(x), mx.nd.from_jax(y)
     flops = loop.compiled_step.aot_compile(x_nd, y_nd)
@@ -141,7 +165,8 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
     _flush(loss._data)
     dt = time.perf_counter() - t0
     log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f}")
-    return dt, flops, loss
+    analysis = analyze_framework_step(tag, loop, x_nd, y_nd)
+    return dt, flops, loss, analysis
 
 
 def matmul_roofline():
@@ -207,14 +232,14 @@ def bench_resnet(dtype):
                         .astype("float32"))
         y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,))
                         .astype("int32"))
-        dt, flops, _ = run_framework_bench("resnet", loop, x, y, warmup,
-                                           steps)
+        dt, flops, _, ana = run_framework_bench("resnet", loop, x, y,
+                                                warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     img_s = bs * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"img_s": img_s, "tflops": tfs, "bs": bs}
+    return {"img_s": img_s, "tflops": tfs, "bs": bs, "analysis": ana}
 
 
 def bench_bert(dtype):
@@ -243,14 +268,14 @@ def bench_bert(dtype):
         x = jnp.asarray(onp.random.randint(0, vocab, size=(bs, seqlen))
                         .astype("int32"))
         y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
-        dt, flops, _ = run_framework_bench("bert", loop, x, y, warmup,
-                                           steps)
+        dt, flops, _, ana = run_framework_bench("bert", loop, x, y,
+                                                warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     tok_s = bs * seqlen * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"tok_s": tok_s, "tflops": tfs}
+    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana}
 
 
 def bench_lstm(dtype):
@@ -288,14 +313,14 @@ def bench_lstm(dtype):
             0, vocab, size=(bs, seq)).astype("int32"))
         y = jnp.asarray(onp.random.randint(
             0, vocab, size=(bs, seq)).astype("int32"))
-        dt, flops, _ = run_framework_bench("lstm", loop, x, y, warmup,
-                                           steps)
+        dt, flops, _, ana = run_framework_bench("lstm", loop, x, y,
+                                                warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     tok_s = bs * seq * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"tok_s": tok_s, "tflops": tfs}
+    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana}
 
 
 class _SSDResNet50:
@@ -523,6 +548,10 @@ def main():
             "tflops": round(r["tflops"], 2) if r["tflops"] else None,
             "mfu": round(r["tflops"] / peak, 4)
             if r["tflops"] and peak else None,
+            # structural fingerprint (mx.analysis): a throughput drop
+            # arrives WITH its program diff — traces, collectives,
+            # donated bytes (docs/ANALYSIS.md)
+            "resnet_analysis": r.get("analysis"),
         })
     if model in ("all", "bert"):
         # isolate: a secondary-model failure must not destroy the
@@ -550,6 +579,7 @@ def main():
                 if b["tflops"] else None,
                 "bert_mfu": round(b["tflops"] / peak, 4)
                 if b["tflops"] and peak else None,
+                "bert_analysis": b.get("analysis"),
             })
     for name, fn, tok_field in (("lstm", bench_lstm, "lstm_tokens_per_sec"),
                                 ("ssd", bench_ssd, "ssd_img_per_sec")):
@@ -580,6 +610,8 @@ def main():
             f"{name}_mfu": round(r["tflops"] / peak, 4)
             if r["tflops"] and peak else None,
         })
+        if r.get("analysis") is not None:
+            out[f"{name}_analysis"] = r["analysis"]
     try:
         roof = matmul_roofline()
     except Exception as e:

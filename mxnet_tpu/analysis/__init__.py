@@ -1,0 +1,56 @@
+"""mxnet_tpu.analysis — static analysis of compiled train-step programs.
+
+Three cooperating checkers (docs/ANALYSIS.md):
+
+- **program lint** (:mod:`.program`): walks the jaxpr and optimized HLO
+  of a ``Trainer.compile_step`` program — collective census per mesh
+  axis, donation audit, host-transfer detection, dtype-drift detection,
+  retrace accounting.  ``mx.analysis.analyze_step(step, *batch)``.
+- **source lint** (:mod:`.lint`): AST pass over HybridBlock forwards /
+  loss functions for jit-unsafe Python (``.asnumpy()``, tracer-dependent
+  ``if``, unkeyed randomness).  ``python -m mxnet_tpu.analysis.lint``.
+- **runtime transfer guard** (:mod:`.guard`):
+  ``MXNET_TRANSFER_GUARD=log|raise`` catches silent device->host syncs
+  inside the training hot loop at run time.
+
+This ``__init__`` stays import-light (PEP 562 lazy submodules): the
+NDArray sync sites import :mod:`.guard` on the framework's critical
+import path.
+"""
+from .report import (CollectiveOp, CollectiveStats, DonationAudit,  # noqa
+                     Finding, ProgramReport)
+from .guard import (allow_transfers, hot_scope, transfer_guard)      # noqa
+
+__all__ = [
+    "Finding", "ProgramReport", "CollectiveOp", "CollectiveStats",
+    "DonationAudit",
+    "analyze_step", "analyze_lowered", "collective_census",
+    "donation_audit", "host_transfer_scan", "dtype_drift_scan",
+    "expect_mode", "explain_signature_diff",
+    "lint_source", "lint_path", "lint_module", "lint_function",
+    "load_allowlist", "filter_allowed",
+    "transfer_guard", "hot_scope", "allow_transfers",
+]
+
+_LAZY = {
+    "analyze_step": "program", "analyze_lowered": "program",
+    "collective_census": "program", "donation_audit": "program",
+    "host_transfer_scan": "program", "dtype_drift_scan": "program",
+    "expect_mode": "program", "explain_signature_diff": "program",
+    "lint_source": "lint", "lint_path": "lint", "lint_module": "lint",
+    "lint_function": "lint", "load_allowlist": "lint",
+    "filter_allowed": "lint",
+    "program": None, "lint": None, "guard": None, "hlo": None,
+    "report": None,
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(
+            f".{_LAZY[name] or name}", __name__)
+        if _LAZY[name] is None:
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

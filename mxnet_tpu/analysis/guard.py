@@ -1,0 +1,157 @@
+"""Runtime transfer guard: catch silent device->host syncs in hot loops.
+
+The program lint (analysis/program.py) catches host transfers that made
+it INTO a compiled program; this guard catches the ones that keep a
+program from compiling at all — a stray ``.asnumpy()`` / ``.item()`` /
+``float(loss)`` in a loss function silently demotes the whole fused
+step to the eager tape path, where it then costs one device round-trip
+per step, forever, with no error anywhere.
+
+``MXNET_TRANSFER_GUARD=log|raise`` arms the guard; the hot regions
+(``CompiledTrainStep.__call__`` — and through it ``TrainLoop.step``)
+declare themselves with :func:`hot_scope`, and every
+``NDArray.asnumpy``/``item``/``wait_to_read`` inside such a region
+logs the offending Python stack (``log``) or raises an ``MXNetError``
+(``raise``).  Syncs OUTSIDE a hot region — printing the loss after the
+step, metric updates between epochs — are never flagged.
+
+Explicit use, independent of the env var::
+
+    with mx.analysis.transfer_guard("raise"):
+        loss = step(x, y)        # any host sync inside raises
+
+Framework code that must legitimately sync inside a hot region (the
+dist-kvstore's one blessed host sync per step) wraps itself in
+:func:`allow_transfers`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
+
+__all__ = ["transfer_guard", "hot_scope", "allow_transfers", "armed",
+           "on_sync", "events", "clear_events", "env_mode"]
+
+_LOG = logging.getLogger("mxnet_tpu.analysis.guard")
+
+_MODES = ("log", "raise")
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.mode: Optional[str] = None   # active mode inside a scope
+        self.suppress: int = 0            # allow_transfers depth
+        self.scope: str = ""              # hot-region label for messages
+        self.events: List[Tuple[str, str]] = []   # (kind, where)
+
+
+_STATE = _State()
+
+
+def env_mode() -> Optional[str]:
+    """The MXNET_TRANSFER_GUARD env setting (None when unset/off)."""
+    v = os.environ.get("MXNET_TRANSFER_GUARD", "").strip().lower()
+    if not v or v in ("0", "off", "false", "no"):
+        return None
+    if v not in _MODES:
+        _LOG.warning("MXNET_TRANSFER_GUARD=%r is not one of %s; "
+                     "treating as 'log'", v, _MODES)
+        return "log"
+    return v
+
+
+def armed() -> bool:
+    """Fast check for the NDArray sync sites."""
+    return _STATE.mode is not None and _STATE.suppress == 0
+
+
+def events() -> List[Tuple[str, str]]:
+    """(kind, caller) tuples recorded by 'log' mode since the last
+    :func:`clear_events` — test hook."""
+    return list(_STATE.events)
+
+
+def clear_events():
+    _STATE.events.clear()
+
+
+def _caller() -> str:
+    """First stack frame outside this framework — the user line that
+    triggered the sync."""
+    import mxnet_tpu
+    pkg = os.path.dirname(os.path.abspath(mxnet_tpu.__file__))
+    for frame in reversed(traceback.extract_stack()):
+        fn = os.path.abspath(frame.filename)
+        if not fn.startswith(pkg):
+            return f"{frame.filename}:{frame.lineno} ({frame.name})"
+    return "<unknown>"
+
+
+def on_sync(kind: str, what: str = ""):
+    """Called from NDArray sync sites when :func:`armed`."""
+    st = _STATE
+    where = _caller()
+    st.events.append((kind, where))
+    desc = (f"device->host sync `{kind}` inside the hot region "
+            f"{st.scope or 'transfer_guard'}"
+            + (f" on {what}" if what else "")
+            + f" — triggered at {where}")
+    if st.mode == "raise":
+        from ..base import MXNetError
+        raise MXNetError(
+            desc + ". A sync here runs every step and blocks the device "
+            "pipeline; move it outside the loop, or wrap it in "
+            "mx.analysis.allow_transfers() if intentional. "
+            "(MXNET_TRANSFER_GUARD=log to only warn; docs/ANALYSIS.md)")
+    _LOG.warning("%s\n%s", desc,
+                 "".join(traceback.format_stack(limit=8)[:-1]))
+
+
+@contextmanager
+def transfer_guard(mode: str = "raise", scope: str = ""):
+    """Explicitly guard a region regardless of MXNET_TRANSFER_GUARD."""
+    if mode not in _MODES:
+        raise ValueError(f"transfer_guard mode must be one of {_MODES}, "
+                         f"got {mode!r}")
+    st = _STATE
+    prev_mode, prev_scope = st.mode, st.scope
+    st.mode, st.scope = mode, scope or "transfer_guard"
+    try:
+        yield
+    finally:
+        st.mode, st.scope = prev_mode, prev_scope
+
+
+@contextmanager
+def hot_scope(name: str):
+    """Declare a hot region; activates only when MXNET_TRANSFER_GUARD is
+    set (or an enclosing transfer_guard is already active)."""
+    st = _STATE
+    if st.mode is not None:          # nested: keep the outer mode
+        yield
+        return
+    mode = env_mode()
+    if mode is None:
+        yield
+        return
+    prev_scope = st.scope
+    st.mode, st.scope = mode, name
+    try:
+        yield
+    finally:
+        st.mode, st.scope = None, prev_scope
+
+
+@contextmanager
+def allow_transfers(reason: str = ""):
+    """Bless syncs in a sub-region of a guarded scope (the dist store's
+    one host sync per step, checkpoint capture, ...)."""
+    _STATE.suppress += 1
+    try:
+        yield
+    finally:
+        _STATE.suppress -= 1

@@ -1,0 +1,183 @@
+"""Minimal parser for XLA's optimized HLO text dumps.
+
+The program lint needs four things out of ``compiled.as_text()``: every
+op's result shape, opcode and operands (def-use edges, to classify the
+CPU backend's decomposed reduce-scatters), the ``input_output_alias``
+table in the module header (donation ground truth), replica groups on
+collectives (mesh-axis attribution), and custom-call targets (host
+callbacks).  A full HLO grammar is overkill — module text is one op per
+line with a stable ``%name = type opcode(operands), attrs`` shape, which
+this parses with regexes.  Parsing failures degrade to ``None`` fields,
+never exceptions: an analyzer must not take down the run it observes.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["HloOp", "HloModule", "parse_hlo", "parse_shape_elements",
+           "parse_replica_groups"]
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `%name = f32[2,3]{1,0} opcode(...)` | `%name = (f32[2]{0}, ...) opcode(...)`
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\(.*?\)|[\w]+\[[^\]]*\](?:\{[^}]*\})?)"
+    r"\s+([\w\-]+)\((.*)$")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def parse_shape_elements(type_str: str) -> Tuple[int, Optional[str], int]:
+    """(total elements, dtype of first array part, total bytes) of an HLO
+    result type — tuple types sum over their parts."""
+    total, first_dtype, total_bytes = 0, None, 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype == "token":
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+        total += n
+        total_bytes += n * _DTYPE_BYTES.get(dtype, 4)
+        if first_dtype is None:
+            first_dtype = dtype
+    return total, first_dtype, total_bytes
+
+
+@dataclass
+class HloOp:
+    name: str
+    opcode: str
+    type_str: str
+    elements: int
+    dtype: Optional[str]
+    bytes: int
+    operands: List[str]
+    line: str
+    replica_groups: Optional[List[Tuple[int, ...]]] = None
+    custom_call_target: Optional[str] = None
+
+
+@dataclass
+class HloModule:
+    ops: Dict[str, HloOp] = field(default_factory=dict)
+    # consumers: producer op name -> list of consumer op names
+    uses: Dict[str, List[str]] = field(default_factory=dict)
+    input_output_alias: List[Tuple[int, int]] = field(default_factory=list)
+    num_partitions: int = 1
+
+    def consumers(self, name: str) -> List[HloOp]:
+        return [self.ops[u] for u in self.uses.get(name, [])
+                if u in self.ops]
+
+    def by_opcode(self, *opcodes: str) -> List[HloOp]:
+        return [op for op in self.ops.values() if op.opcode in opcodes]
+
+
+def parse_replica_groups(line: str, num_devices: int) \
+        -> Optional[List[Tuple[int, ...]]]:
+    """Replica groups of a collective line, as explicit device-id tuples.
+
+    Handles the explicit form ``replica_groups={{0,1},{2,3}}`` and the
+    iota form ``replica_groups=[G,S]<=[N]`` (reshape iota(N) to GxS) with
+    an optional source-shape transpose ``<=[a,b]T(1,0)``."""
+    m = re.search(r"replica_groups=\{\{([\d,{}\s]*)\}\}", line)
+    if m:
+        groups = []
+        for grp in re.findall(r"[\d,\s]+", m.group(1)):
+            ids = tuple(int(x) for x in grp.replace(" ", "").split(",")
+                        if x != "")
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = re.search(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+        line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        src_dims = [int(x) for x in m.group(3).split(",")]
+        n = 1
+        for d in src_dims:
+            n *= d
+        if g * s != n:
+            return None
+        ids = list(range(n))
+        if m.group(4):
+            try:
+                import numpy as onp
+                perm = [int(x) for x in m.group(4).split(",")]
+                ids = list(onp.arange(n).reshape(src_dims)
+                           .transpose(perm).reshape(-1))
+            except Exception:
+                return None
+        return [tuple(int(i) for i in ids[i * s:(i + 1) * s])
+                for i in range(g)]
+    return None
+
+
+def _balanced_braces(text: str, start: int) -> str:
+    """The ``{...}`` block starting at ``start`` (which must point at a
+    ``{``), contents only, handling nesting."""
+    depth, i = 0, start
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start + 1:i]
+    return text[start + 1:]
+
+
+def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
+    mod = HloModule(num_partitions=num_devices)
+    header = text.splitlines()[0] if text else ""
+    at = header.find("input_output_alias={")
+    if at >= 0:
+        body = _balanced_braces(header, at + len("input_output_alias="))
+        # entries look like `{1}: (0, {}, may-alias)` — (output index
+        # tuple): (param number, param index, kind)
+        for om, pm in re.findall(r"\{([\d,\s]*)\}:\s*\((\d+)", body):
+            out_idx = int(om.split(",")[0]) if om.strip() else 0
+            mod.input_output_alias.append((out_idx, int(pm)))
+    np_m = re.search(r"num_partitions=(\d+)", text[:2000] if text else "")
+    if np_m:
+        mod.num_partitions = int(np_m.group(1))
+    for line in (text or "").splitlines():
+        om = _OP_RE.match(line)
+        if not om:
+            continue
+        name, type_str, opcode, rest = om.groups()
+        elems, dtype, nbytes = parse_shape_elements(type_str)
+        # operands = %refs inside the top-level parens (attrs after the
+        # closing paren also contain %refs for to_apply etc.; cut at the
+        # first `),` boundary which ends the operand list in practice)
+        operand_src = rest.split("), ")[0]
+        operands = _OPERAND_RE.findall(operand_src)
+        op = HloOp(name=name, opcode=opcode, type_str=type_str,
+                   elements=elems, dtype=dtype, bytes=nbytes,
+                   operands=operands, line=line)
+        if opcode in ("all-reduce", "all-gather", "reduce-scatter",
+                      "collective-permute", "all-to-all",
+                      "all-reduce-start", "all-gather-start",
+                      "reduce-scatter-start"):
+            op.replica_groups = parse_replica_groups(line, num_devices)
+        if opcode == "custom-call":
+            tm = re.search(r'custom_call_target="([^"]+)"', line)
+            if tm:
+                op.custom_call_target = tm.group(1)
+        # keep the first definition (entry computation ops can collide
+        # with fusion-internal names; censuses only need one)
+        if name not in mod.ops:
+            mod.ops[name] = op
+        for src in operands:
+            mod.uses.setdefault(src, []).append(name)
+    return mod

@@ -1,0 +1,457 @@
+"""Source lint: jit-unsafe Python in HybridBlock forwards and loss fns.
+
+The program lint inspects what DID compile; this pass reads the Python
+that is ABOUT to be traced and flags the constructs that either break
+the trace (demoting the fused step to eager, silently) or bake a bug
+into it:
+
+====== =====================================================
+rule   what it catches
+====== =====================================================
+MXA001 host materialization on a traced value — ``.asnumpy()``,
+       ``.item()``, ``.asscalar()``, ``.wait_to_read()``,
+       ``numpy.asarray(x)``, ``jax.device_get(x)``
+MXA002 Python scalar cast of a non-literal — ``float(x)`` /
+       ``int(x)`` / ``bool(x)`` concretize a tracer
+MXA003 Python ``if``/``while``/``assert`` on a tracer-dependent
+       condition — the branch is baked in at trace time
+MXA004 unkeyed host randomness — ``numpy.random.*`` / stdlib
+       ``random.*`` inside a forward runs ONCE at trace time and
+       becomes a constant (use ``mx.nd.random``, which threads the
+       per-step key through the compiled program)
+====== =====================================================
+
+Scope: ``forward`` / ``hybrid_forward`` method bodies (and functions
+nested in them).  Code outside a forward — training scripts, metric
+code — may sync freely and is never flagged.
+
+Blessing an intentional violation: append ``# mx-lint: allow`` (or
+``# mx-lint: allow=MXA001``) to the offending line, or list
+``<path-suffix>::<rule>`` entries in an allowlist file (the tier-1
+sweep uses ``tests/fixtures/lint_allowlist.txt`` — docs/ANALYSIS.md).
+
+CLI::
+
+    python -m mxnet_tpu.analysis.lint <module-or-path> [...]
+    python -m mxnet_tpu.analysis.lint --allowlist FILE mxnet_tpu/gluon
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .report import Finding
+
+__all__ = ["lint_source", "lint_path", "lint_module", "lint_function",
+           "load_allowlist", "filter_allowed", "main"]
+
+_SYNC_METHODS = {"asnumpy", "item", "asscalar", "wait_to_read",
+                 "wait_to_write", "tolist"}
+_NUMPY_SYNC_FUNCS = {"asarray", "array", "copy"}
+_NUMPY_ALIASES = {"numpy", "np", "onp"}
+_SCALAR_CASTS = {"float", "int", "bool"}
+# attributes that yield trace-static values — reading them off a traced
+# array is safe and UNtaints the expression
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "stype", "context",
+                 "ctx", "device", "name", "dtype_name"}
+_SAFE_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
+               "range", "enumerate", "zip"}
+
+
+def _allow_marker(line: str) -> Optional[Set[str]]:
+    """Rules blessed by an inline ``# mx-lint: allow[=MXA001[,MXA002]]``
+    comment; empty set means allow everything on the line."""
+    if "mx-lint:" not in line:
+        return None
+    frag = line.split("mx-lint:", 1)[1].strip()
+    if not frag.startswith("allow"):
+        return None
+    if "=" in frag:
+        return {r.strip() for r in
+                frag.split("=", 1)[1].split(",") if r.strip()}
+    return set()
+
+
+class _ForwardLint(ast.NodeVisitor):
+    """Lints ONE forward/loss function body with name-level taint
+    tracking: data arguments are tainted; assignments propagate; reading
+    a static attribute (``x.shape``) or calling a safe builtin
+    sanitizes."""
+
+    def __init__(self, filename: str, lines: Sequence[str], qualname: str,
+                 tainted: Set[str]):
+        self.filename = filename
+        self.lines = lines
+        self.qualname = qualname
+        self.tainted = set(tainted)
+        self.findings: List[Finding] = []
+
+    # ---------------- reporting ----------------
+    def _flag(self, node, rule: str, message: str, severity="error"):
+        lineno = getattr(node, "lineno", 0)
+        line = self.lines[lineno - 1] if 0 < lineno <= len(self.lines) \
+            else ""
+        allowed = _allow_marker(line)
+        blessed = allowed is not None and (not allowed or rule in allowed)
+        self.findings.append(Finding(
+            checker="source", rule=rule, message=message,
+            where=f"{self.filename}:{lineno}", severity=severity,
+            blessed=blessed))
+
+    # ---------------- taint machinery ----------------
+    def _is_tainted(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False                      # x.shape is static
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _SAFE_CALLS:
+                return False                      # len(x), isinstance(..)
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in _STATIC_ATTRS | {"astype", "reshape"}:
+                # x.astype(..)/x.reshape(..) stay tainted via receiver
+                return self._is_tainted(fn.value)
+            # any call fed a tainted argument taints the result
+            return any(self._is_tainted(a) for a in node.args) or \
+                any(self._is_tainted(k.value) for k in node.keywords) or \
+                (isinstance(fn, ast.Attribute)
+                 and self._is_tainted(fn.value))
+        if isinstance(node, (ast.BinOp,)):
+            return self._is_tainted(node.left) or \
+                self._is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` check argument STRUCTURE
+            # (which call pattern this trace is), not traced values —
+            # identity comparisons are trace-static by convention
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return self._is_tainted(node.left) or \
+                any(self._is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._is_tainted(node.body) or \
+                self._is_tainted(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self._is_tainted(node.value)
+        return False
+
+    def _bind(self, target, tainted: bool):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+
+    # ---------------- statements ----------------
+    def visit_Assign(self, node):
+        t = self._is_tainted(node.value)
+        for tgt in node.targets:
+            self._bind(tgt, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self._is_tainted(node.value):
+            self._bind(node.target, True)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._bind(node.target, self._is_tainted(node.value))
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._bind(node.target, self._is_tainted(node.iter))
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        if self._is_tainted(node.test):
+            self._flag(node, "MXA003",
+                       "Python `if` on a tracer-dependent condition — "
+                       "the branch taken at trace time is baked into the "
+                       "compiled program (use nd.where / lax.cond "
+                       "semantics instead)")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self._is_tainted(node.test):
+            self._flag(node, "MXA003",
+                       "Python `while` on a tracer-dependent condition — "
+                       "cannot trace; the step will fall back to eager")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self._is_tainted(node.test):
+            self._flag(node, "MXA003",
+                       "assert on a tracer-dependent condition "
+                       "concretizes the value at trace time",
+                       severity="warn")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        if self._is_tainted(node.test):
+            self._flag(node, "MXA003",
+                       "conditional expression on a tracer-dependent "
+                       "condition is baked in at trace time")
+        self.generic_visit(node)
+
+    # ---------------- calls ----------------
+    def visit_Call(self, node):
+        fn = node.func
+        # x.asnumpy() / x.item() / ...
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+            self._flag(node, "MXA001",
+                       f"`.{fn.attr}()` inside a forward/loss "
+                       "materializes the value on host — breaks the "
+                       "fused-step trace (or costs a device sync "
+                       "every step on the eager path)")
+        # numpy.asarray(x) / onp.array(x) on tainted values
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in _NUMPY_ALIASES and \
+                fn.attr in _NUMPY_SYNC_FUNCS and \
+                any(self._is_tainted(a) for a in node.args):
+            self._flag(node, "MXA001",
+                       f"`{fn.value.id}.{fn.attr}()` of a traced value "
+                       "pulls it to host at trace time")
+        # jax.device_get
+        if isinstance(fn, ast.Attribute) and fn.attr == "device_get":
+            self._flag(node, "MXA001",
+                       "`device_get` inside a forward/loss is a host "
+                       "transfer per step")
+        # float(x) / int(x) / bool(x)
+        if isinstance(fn, ast.Name) and fn.id in _SCALAR_CASTS and \
+                node.args and not isinstance(node.args[0], ast.Constant):
+            if self._is_tainted(node.args[0]):
+                self._flag(node, "MXA002",
+                           f"`{fn.id}()` of a traced value concretizes "
+                           "it on host — breaks the trace")
+            else:
+                self._flag(node, "MXA002",
+                           f"`{fn.id}()` of a non-literal inside a "
+                           "forward — if the argument derives from a "
+                           "traced array this concretizes it",
+                           severity="warn")
+        # unkeyed randomness: numpy.random.* / random.*
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Attribute) and \
+                    base.attr == "random" and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id in _NUMPY_ALIASES:
+                self._flag(node, "MXA004",
+                           f"`{base.value.id}.random.{fn.attr}` inside a "
+                           "forward runs ONCE at trace time and becomes "
+                           "a compiled-in constant — use mx.nd.random "
+                           "(keyed per step)")
+            elif isinstance(base, ast.Name) and base.id == "random" and \
+                    fn.attr in ("random", "randint", "uniform", "gauss",
+                                "choice", "shuffle", "sample",
+                                "randrange"):
+                self._flag(node, "MXA004",
+                           f"stdlib `random.{fn.attr}` inside a forward "
+                           "is evaluated at trace time, not per step")
+        self.generic_visit(node)
+
+
+def _iter_forward_functions(tree: ast.Module):
+    """(qualname, FunctionDef, tainted-arg-names) for every forward/
+    hybrid_forward method in the module."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name in ("forward", "hybrid_forward"):
+                args = [a.arg for a in item.args.args
+                        + item.args.posonlyargs + item.args.kwonlyargs]
+                if item.args.vararg:
+                    args.append(item.args.vararg.arg)
+                tainted = {a for a in args if a not in ("self", "F")}
+                yield f"{cls.name}.{item.name}", item, tainted
+
+
+def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
+    """Lint one file's source text; returns findings (blessed ones
+    included, marked)."""
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        return [Finding(checker="source", rule="MXA000", severity="warn",
+                        message=f"could not parse: {e}",
+                        where=f"{filename}:{e.lineno or 0}")]
+    lines = src.splitlines()
+    findings: List[Finding] = []
+    for qualname, fn, tainted in _iter_forward_functions(tree):
+        linter = _ForwardLint(filename, lines, qualname, tainted)
+        for stmt in fn.body:
+            linter.visit(stmt)
+        findings.extend(linter.findings)
+    return findings
+
+
+def lint_function(fn) -> List[Finding]:
+    """Lint a live function/lambda (loss functions handed to
+    ``Trainer.compile_step``): every parameter is treated as traced."""
+    import inspect
+    import textwrap
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        filename = inspect.getsourcefile(fn) or "<function>"
+        lineno = fn.__code__.co_firstlineno
+    except (OSError, TypeError):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    node = None
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            node = n
+            break
+    if node is None:
+        return []
+    args = [a.arg for a in node.args.args + node.args.posonlyargs]
+    tainted = {a for a in args if a not in ("self", "F")}
+    lines = src.splitlines()
+    linter = _ForwardLint(filename, lines, getattr(fn, "__name__", "<fn>"),
+                          tainted)
+    body = node.body if isinstance(node.body, list) else [node.body]
+    for stmt in body:
+        linter.visit(stmt)
+    for f in linter.findings:     # rebase onto real file line numbers
+        try:
+            path, ln = f.where.rsplit(":", 1)
+            f.where = f"{path}:{int(ln) + lineno - 1}"
+        except ValueError:
+            pass
+    return linter.findings
+
+
+def lint_path(path: str) -> List[Finding]:
+    """Lint a file, or every ``*.py`` under a directory."""
+    findings: List[Finding] = []
+    if os.path.isdir(path):
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git")]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    findings.extend(lint_path(os.path.join(root, f)))
+        return findings
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), filename=path)
+
+
+def lint_module(name: str) -> List[Finding]:
+    """Lint an importable module (or package) by name, without
+    importing it."""
+    spec = importlib.util.find_spec(name)
+    if spec is None or not spec.origin:
+        raise ImportError(f"cannot locate module {name!r}")
+    if spec.submodule_search_locations:
+        out: List[Finding] = []
+        for loc in spec.submodule_search_locations:
+            out.extend(lint_path(loc))
+        return out
+    return lint_path(spec.origin)
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+# ---------------------------------------------------------------------------
+
+def load_allowlist(path: str) -> List[Tuple[str, str]]:
+    """``<path-suffix>::<rule>`` entries (# comments and blanks
+    skipped); rule ``*`` blesses every rule at that path."""
+    entries: List[Tuple[str, str]] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "::" not in line:
+                entries.append((line, "*"))
+                continue
+            p, rule = line.rsplit("::", 1)
+            entries.append((p.strip(), rule.strip() or "*"))
+    return entries
+
+
+def filter_allowed(findings: Iterable[Finding],
+                   allowlist: Sequence[Tuple[str, str]]) -> List[Finding]:
+    """Findings NOT blessed by inline markers or allowlist entries."""
+    out = []
+    for f in findings:
+        if f.blessed:
+            continue
+        fpath = f.where.rsplit(":", 1)[0].replace(os.sep, "/")
+        hit = False
+        for suffix, rule in allowlist:
+            if fpath.endswith(suffix.replace(os.sep, "/")) and \
+                    rule in ("*", f.rule):
+                hit = True
+                break
+        if not hit:
+            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis.lint",
+        description="jit-safety lint for HybridBlock forward/loss code")
+    parser.add_argument("targets", nargs="+",
+                        help="files, directories, or importable module "
+                             "names")
+    parser.add_argument("--allowlist", default=None,
+                        help="file of <path-suffix>::<rule> blessed "
+                             "entries")
+    parser.add_argument("--show-blessed", action="store_true",
+                        help="also print violations blessed inline or by "
+                             "the allowlist")
+    args = parser.parse_args(argv)
+    findings: List[Finding] = []
+    for target in args.targets:
+        if os.path.exists(target):
+            findings.extend(lint_path(target))
+        else:
+            findings.extend(lint_module(target))
+    allow = load_allowlist(args.allowlist) if args.allowlist else []
+    active = filter_allowed(findings, allow)
+    shown = findings if args.show_blessed else active
+    for f in shown:
+        print(f)
+    n_blessed = len(findings) - len(active)
+    print(f"{len(active)} violation(s), {n_blessed} blessed",
+          file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

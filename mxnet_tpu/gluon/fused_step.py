@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import _tape
+from ..analysis import guard as _tguard
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..ndarray.random import next_key, push_trace_key, pop_trace_key
@@ -100,6 +101,29 @@ def _zero_min_size() -> int:
         return int(os.environ.get("MXNET_ZERO_SHARD_MIN_SIZE", "2048"))
     except ValueError:
         return 2048
+
+
+def _analysis_mode(requested: Optional[str]) -> Optional[str]:
+    """Normalize the ``analyze=`` kwarg / MXNET_ANALYSIS env setting to
+    one of None | 'report' | 'warn' | 'raise'."""
+    v = requested if requested is not None \
+        else os.environ.get("MXNET_ANALYSIS")
+    if v is None or v is False:
+        return None
+    if v is True:
+        return "warn"
+    v = str(v).strip().lower()
+    if v in ("", "0", "off", "false", "no", "none"):
+        return None
+    if v in ("1", "report"):
+        return "report"
+    if v in ("warn", "log"):
+        return "warn"
+    if v in ("raise", "error", "strict"):
+        return "raise"
+    _LOG.warning("unknown analysis mode %r (MXNET_ANALYSIS); "
+                 "treating as 'warn'", v)
+    return "warn"
 
 
 class _ZeroShardPlan:
@@ -283,7 +307,8 @@ class CompiledTrainStep:
 
     def __init__(self, trainer, loss_fn: Callable, donate: bool = True,
                  train_mode: bool = True, zero_shard: Optional[bool] = None,
-                 zero_axis: str = "dp", mesh=None):
+                 zero_axis: str = "dp", mesh=None,
+                 analyze: Optional[str] = None):
         self._trainer = trainer
         self._loss_fn = loss_fn
         self._donate = donate
@@ -291,8 +316,13 @@ class CompiledTrainStep:
         self._mode: Optional[str] = None   # None→undecided, 'fused'|'eager'
         self._lru: "OrderedDict[Any, dict]" = OrderedDict()
         self._trace_signatures: set = set()
+        self._sig_history: list = []   # bucket keys in trace order
         self._n_traces = 0
         self._steps_done = 0
+        # opt-in program lint after the first step (docs/ANALYSIS.md);
+        # default comes from MXNET_ANALYSIS
+        self._analyze = _analysis_mode(analyze)
+        self._analysis_report = None
         # ZeRO-1 sharded update: None = auto (on when a mesh with a
         # `zero_axis` axis is active), True = required, False = off
         self._zero_requested = zero_shard
@@ -333,6 +363,25 @@ class CompiledTrainStep:
     def zero_sharded(self) -> bool:
         """True when the ZeRO-1 sharded weight update is active."""
         return self._zero is not None or self._zero_ok is not None
+
+    @property
+    def analysis_report(self):
+        """The ProgramReport from the last opt-in ``analyze=`` run (or
+        ``None``)."""
+        return self._analysis_report
+
+    def explain_retrace(self) -> str:
+        """WHY the most recent retrace happened: a component-wise diff
+        of the last two program cache keys (shape-bucket signatures) —
+        new traced shapes/dtypes, changed static argument values,
+        changed argument structure (analysis/program.py)."""
+        if not self._sig_history:
+            return "no program traced yet"
+        if len(self._sig_history) < 2:
+            return "only one program traced (no retrace to explain)"
+        from ..analysis.program import explain_signature_diff
+        return explain_signature_diff(self._sig_history[-2],
+                                      self._sig_history[-1])
 
     def optimizer_state_bytes(self) -> int:
         """PER-REPLICA bytes of optimizer state (momenta/moments + fp32
@@ -427,6 +476,17 @@ class CompiledTrainStep:
 
     # ---------------- call ----------------
     def __call__(self, *args, batch_size: Optional[int] = None, **kwargs):
+        # the whole step is a transfer-guard hot region: with
+        # MXNET_TRANSFER_GUARD=log|raise any device->host sync in here —
+        # a .asnumpy() in the loss_fn concretizing the trace, a silent
+        # per-step sync on the eager fallback — logs its stack or raises
+        with _tguard.hot_scope("CompiledTrainStep.step"):
+            out = self._guarded_call(args, kwargs, batch_size)
+        if self._analyze is not None and self._analysis_report is None:
+            self._run_analysis(args, kwargs, batch_size)
+        return out
+
+    def _guarded_call(self, args, kwargs, batch_size):
         if self._mode is None:
             self._mode = self._decide_mode()
         if self._mode == "eager":
@@ -453,6 +513,35 @@ class CompiledTrainStep:
         return out
 
     step = __call__
+
+    def _run_analysis(self, args, kwargs, batch_size):
+        """Post-first-step program lint (``analyze=``/MXNET_ANALYSIS):
+        'report' stores the ProgramReport, 'warn' also logs findings,
+        'raise' raises on error-severity findings."""
+        from ..analysis import program as _aprog
+        from ..analysis.lint import lint_function
+        try:
+            report = _aprog.analyze_step(self, *args,
+                                         batch_size=batch_size, **kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:   # analysis must not kill a healthy run
+            _LOG.warning("compile_step: program analysis failed "
+                         "(%s: %s); skipping", type(e).__name__, e)
+            self._analysis_report = False
+            return
+        try:
+            # the source lint explains WHY a step fell back to eager
+            # (the .asnumpy() line) alongside the program findings
+            report.findings.extend(lint_function(self._loss_fn))
+        except Exception:        # pragma: no cover - defensive
+            pass
+        self._analysis_report = report
+        if self._analyze == "warn" and not report.ok:
+            _LOG.warning("compile_step program analysis:\n%s",
+                         report.summary())
+        elif self._analyze == "raise":
+            report.raise_if_findings()
 
     # ---------------- eager fallback ----------------
     def _eager_call(self, args, kwargs, batch_size):
@@ -501,6 +590,7 @@ class CompiledTrainStep:
             entry = self._build_bucket(arg_treedef, static_spec, nd_mask)
             self._lru[sig] = entry
             self._trace_signatures.add(sig)
+            self._sig_history.append(sig)
             cap = self._cache_cap()
             while cap > 0 and len(self._lru) > cap:
                 self._lru.popitem(last=False)
@@ -756,6 +846,142 @@ class CompiledTrainStep:
             for s, n in zip(st, ns):
                 s._data = n
         return NDArray(l)
+
+    # ---------------- program analysis (mx.analysis) ----------------
+    def analyze(self, *args, batch_size: Optional[int] = None, **kwargs):
+        """Run the program lint over this batch's shape bucket and
+        return the :class:`~mxnet_tpu.analysis.ProgramReport` —
+        collective census, donation audit, host transfers, dtype drift
+        (docs/ANALYSIS.md).  Does not advance optimizer counts."""
+        from ..analysis.program import analyze_step
+        return analyze_step(self, *args, batch_size=batch_size, **kwargs)
+
+    def lower_entry(self, *args, batch_size: Optional[int] = None,
+                    **kwargs):
+        """Lower this batch bucket's program for static analysis.
+
+        Returns a dict with the ``jax.stages.Lowered``, the traced
+        jaxpr, and the layout facts the checkers need (mesh/axis,
+        expected donated buffer count, shard-unit sizes, blessed dtype
+        conversions) — or ``None`` on the eager path, where there is no
+        program to lower.  Live weights and optimizer counts are
+        untouched; the retrace counter is restored (an analysis lower
+        is not a training retrace).  Cached per bucket."""
+        if self._mode is None:
+            self._mode = self._decide_mode()
+        if self._mode != "fused":
+            return None
+        if self._zero_ok is not None and self._zero is None:
+            self._prepare_zero()
+        elif self._plain_mesh is not None and not self._mesh_prepared:
+            mesh, _ = self._plain_mesh
+            repl_sharding = mesh.sharding()
+            for p in self._all_params:
+                p._write_fused(jax.device_put(p._data._data,
+                                              repl_sharding))
+            self._mesh_prepared = True
+        entry, traced = self._entry_for(args, kwargs)
+        if entry.get("analysis") is not None:
+            return entry["analysis"]
+        if batch_size is None:
+            batch_size = _infer_batch_size(traced)
+        opt = self._trainer._optimizer
+        n = len(self._trainer._params)
+        blessed = []
+        try:
+            from .. import amp as _amp
+            amp_on = _amp.is_enabled()
+        except Exception:            # pragma: no cover - defensive
+            amp_on = False
+        if opt.multi_precision or amp_on:
+            # the multi-precision master list: fp32 masters/islands are
+            # the POINT of these modes, widening to f32 is intentional
+            blessed = [("bfloat16", "float32"), ("float16", "float32")]
+        rescale = onp.float32(1.0 / batch_size)
+        clip = onp.float32(0.0)
+        key = next_key()
+        zeros = onp.zeros(n, onp.float32)
+        ones = onp.ones(n, onp.int32)
+        n_traces_before = self._n_traces
+        try:
+            if entry["kind"] == "zero":
+                plan = self._zero
+                pds = tuple(p._data._data for p in self._all_params)
+                sts = tuple(tuple(s._data for s in st)
+                            for st in plan.states)
+                masters = tuple(m._data for m in plan.masters)
+                leaf = tuple(plan.place_leaf(
+                    l._data if isinstance(l, NDArray) else l)
+                    for l in traced)
+                ulrs, uwds, uts = plan.pack_hparams(opt, zeros, zeros,
+                                                    ones)
+                fargs = (pds, sts, masters, leaf, ulrs, uwds, uts,
+                         rescale, clip, key)
+                lowered = entry["fn"].lower(*fargs)
+                jaxpr = self._safe_jaxpr(entry["fn"], fargs)
+                n_state = sum(len(st) for st in sts)
+                unit_sizes = sorted({u["padded"] for u in plan.units}
+                                    | {u["total"] for u in plan.units})
+                info = dict(
+                    kind="zero", mode="zero", lowered=lowered,
+                    jaxpr=jaxpr, mesh=plan.mesh, axis=plan.axis,
+                    expected_donated=(len(pds) + n_state + len(masters))
+                    if self._donate else None,
+                    unit_sizes=unit_sizes, n_params=len(pds),
+                    n_state_leaves=n_state, blessed_dtypes=blessed,
+                    report=None)
+            else:
+                states = self._ensure_states()
+                pds = tuple(p._data._data for p in self._all_params)
+                sts = tuple(tuple(s._data for s in st) for st in states)
+                leaf = tuple(l._data if isinstance(l, NDArray) else l
+                             for l in traced)
+                if self._mesh_prepared:
+                    mesh, axis = self._plain_mesh
+                    leaf = tuple(_place_on_mesh(mesh, axis, d)
+                                 for d in leaf)
+                if entry["kind"] == "split":
+                    fargs = (pds, leaf, key)
+                    lowered = entry["grad"].lower(*fargs)
+                    jaxpr = self._safe_jaxpr(entry["grad"], fargs)
+                    info = dict(kind="split", mode="split",
+                                lowered=lowered, jaxpr=jaxpr, mesh=None,
+                                axis=None, expected_donated=None,
+                                unit_sizes=[], n_params=len(pds),
+                                n_state_leaves=0,
+                                blessed_dtypes=blessed, report=None)
+                else:
+                    fargs = (pds, sts, leaf, zeros, zeros, ones, rescale,
+                             clip, key)
+                    lowered = entry["fn"].lower(*fargs)
+                    jaxpr = self._safe_jaxpr(entry["fn"], fargs)
+                    mesh = axis = None
+                    mode = "fused"
+                    if self._mesh_prepared:
+                        mesh, axis = self._plain_mesh
+                        mode = "fused-mesh"
+                    n_state = sum(len(st) for st in sts)
+                    info = dict(
+                        kind="fused", mode=mode, lowered=lowered,
+                        jaxpr=jaxpr, mesh=mesh, axis=axis,
+                        expected_donated=(len(pds) + n_state)
+                        if self._donate else None,
+                        unit_sizes=sorted({int(d.size) for d in pds}),
+                        n_params=len(pds), n_state_leaves=n_state,
+                        blessed_dtypes=blessed, report=None)
+        finally:
+            # lowering re-runs the traced python (n_traces side effect):
+            # an analysis lower is not a training retrace
+            self._n_traces = n_traces_before
+        entry["analysis"] = info
+        return info
+
+    @staticmethod
+    def _safe_jaxpr(fn, fargs):
+        try:
+            return jax.make_jaxpr(fn)(*fargs)
+        except Exception:            # pragma: no cover - defensive
+            return None
 
     # ---------------- AOT (bench integration) ----------------
     def aot_compile(self, *args, batch_size: Optional[int] = None,

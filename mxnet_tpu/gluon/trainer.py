@@ -79,7 +79,8 @@ class Trainer:
     def compile_step(self, loss_fn, donate: bool = True,
                      train_mode: bool = True,
                      zero_shard: Optional[bool] = None,
-                     zero_axis: str = "dp", mesh=None):
+                     zero_axis: str = "dp", mesh=None,
+                     analyze: Optional[str] = None):
         """Compile the ENTIRE training step — forward, backward, gradient
         reduction, optimizer update — into one donated-buffer XLA program
         per input-shape bucket (gluon/fused_step.py)::
@@ -109,12 +110,21 @@ class Trainer:
         (raises if no mesh), False = keep the plain in-program psum.
         Parameters below ``MXNET_ZERO_SHARD_MIN_SIZE`` elements bucket
         into one fused shard per dtype (docs/PERF_NOTES.md).
+
+        **Program analysis** (``analyze=`` — docs/ANALYSIS.md): after
+        the first step, run the ``mx.analysis`` program lint over the
+        compiled program (collective census, donation audit, host
+        transfers, dtype drift).  ``'report'`` stores the ProgramReport
+        on ``step.analysis_report``, ``'warn'`` also logs findings,
+        ``'raise'`` raises on error-severity findings.  Default comes
+        from ``MXNET_ANALYSIS``.
         """
         from .fused_step import CompiledTrainStep
         return CompiledTrainStep(self, loss_fn, donate=donate,
                                  train_mode=train_mode,
                                  zero_shard=zero_shard,
-                                 zero_axis=zero_axis, mesh=mesh)
+                                 zero_axis=zero_axis, mesh=mesh,
+                                 analyze=analyze)
 
     # ---------------- compiled-step registry ----------------
     def _register_compiled(self, step):
@@ -189,16 +199,21 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        if not self._update_on_kvstore:
-            # one fused multi-key call: a dist store packs the collectives
-            # into buckets and pays ONE host sync per step instead of one
-            # per parameter (kvstore.py pushpull_list)
-            keys = list(range(len(self._params)))
-            self._kvstore.pushpull_list(
-                keys, [p.list_grad() for p in self._params])
-            return
-        for i, p in enumerate(self._params):
-            self._kvstore.push(i, p.list_grad())
+        # the store's one-host-sync-per-step IS the design here — bless
+        # it for the transfer guard so MXNET_TRANSFER_GUARD only flags
+        # UNexpected syncs (analysis/guard.py)
+        from ..analysis.guard import allow_transfers
+        with allow_transfers("kvstore gradient reduction"):
+            if not self._update_on_kvstore:
+                # one fused multi-key call: a dist store packs the
+                # collectives into buckets and pays ONE host sync per
+                # step instead of one per parameter (pushpull_list)
+                keys = list(range(len(self._params)))
+                self._kvstore.pushpull_list(
+                    keys, [p.list_grad() for p in self._params])
+                return
+            for i, p in enumerate(self._params):
+                self._kvstore.push(i, p.list_grad())
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False):
         """Apply optimizer only (grads assumed reduced;

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import _tape, engine
+from ..analysis import guard as _tguard
 from ..base import MXNetError, jx_dtype, dtype_name
 from ..context import Context, current_context
 from ..ops.registry import invoke_raw
@@ -143,9 +144,25 @@ class NDArray:
 
     # ---------------- materialization ----------------
     def asnumpy(self) -> onp.ndarray:
+        if _tguard.armed():
+            # transfer guard (MXNET_TRANSFER_GUARD): a host
+            # materialization inside a declared hot region logs its
+            # stack or raises (analysis/guard.py); the nested
+            # wait_to_read must not double-report
+            _tguard.on_sync("asnumpy", self._what())
+            with _tguard.allow_transfers():
+                self.wait_to_read()
+                return onp.asarray(self._data)
         self.wait_to_read()
         a = onp.asarray(self._data)
         return a
+
+    def _what(self) -> str:
+        try:
+            return (f"NDArray(shape={tuple(self.shape)}, "
+                    f"dtype={dtype_name(self._data.dtype)})")
+        except Exception:            # pragma: no cover - defensive
+            return "NDArray"
 
     def item(self):
         return self.asnumpy().item()
@@ -186,6 +203,8 @@ class NDArray:
         """Block until the value is ready; async errors surface here
         (reference NDArray::WaitToRead, engine exception rethrow)."""
         if not _is_tracer(self._data):
+            if _tguard.armed():
+                _tguard.on_sync("wait_to_read", self._what())
             jax.block_until_ready(self._data)
 
     wait_to_write = wait_to_read

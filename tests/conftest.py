@@ -24,6 +24,30 @@ import numpy as onp
 import pytest
 
 
+@pytest.fixture
+def program_report():
+    """Factory running the mx.analysis program lint over a
+    CompiledTrainStep for one example batch — what the tier-1
+    structural assertions in test_fused_step.py / test_zero_shard.py
+    use to pin collective/donation expectations per mode."""
+    from mxnet_tpu.analysis import program as aprog
+
+    def make(step, *args, **kwargs):
+        return aprog.analyze_step(step, *args, **kwargs)
+
+    return make
+
+
+@pytest.fixture(scope="session")
+def lint_allowlist():
+    """The checked-in blessed-violation list for the source-lint sweep
+    (tests/fixtures/lint_allowlist.txt)."""
+    from mxnet_tpu.analysis.lint import load_allowlist
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "lint_allowlist.txt")
+    return load_allowlist(path)
+
+
 @pytest.fixture(autouse=True)
 def function_scope_seed(request):
     """Seed every test; print the seed on failure so it can be reproduced

@@ -326,3 +326,49 @@ def test_compile_step_hybridized_net_inlines():
         step(x, y)
     assert step.mode == "fused" and step.n_traces == 1
     _assert_params_close(net_e, net_f, rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# compiled-program structure (mx.analysis — ISSUE 4 acceptance)
+# ---------------------------------------------------------------------------
+
+def test_program_report_plain_fused_donates_everything(program_report):
+    """dp=1 plain-fused mode, machine-checked: EVERY param/state buffer
+    donated and actually aliased by XLA (no copy fallback), zero
+    collectives, zero host transfers, zero dtype drift — the structural
+    contract behind the writeback test above (which can't see a silent
+    donation->copy regression: numerics stay right, HBM pays double)."""
+    net = _build(with_bn=True)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+    x, y = _batch()
+    step(x, y)
+    assert step.mode == "fused"
+    rep = program_report(step, x, y)
+    assert rep.mode == "fused"
+    d = rep.donation
+    # every param (incl. BN running stats) + every optimizer-state leaf
+    assert d.expected == rep.meta["n_params"] + rep.meta["n_state_leaves"]
+    assert d.aliased == d.expected, rep.summary()
+    assert d.copied == [] and d.donated_bytes > 0
+    assert rep.collectives.ops == []
+    assert rep.host_transfers == [] and rep.dtype_drift == []
+    assert rep.ok, rep.summary()
+
+
+def test_program_report_donate_false_expects_nothing(program_report):
+    """donate=False: the audit must not demand aliasing that was never
+    requested."""
+    net = _build(with_bn=False)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b),
+                                donate=False)
+    x, y = _batch()
+    step(x, y)
+    rep = program_report(step, x, y)
+    assert rep.donation.expected is None
+    assert rep.ok, rep.summary()

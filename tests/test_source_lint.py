@@ -1,0 +1,58 @@
+"""Tier-1 jit-safety sweep: mx.analysis source lint over the framework's
+own forward code (mxnet_tpu/gluon/) and the shipped examples.
+
+Any NEW ``.asnumpy()``, tracer-dependent ``if``, or host-RNG call inside
+a forward/hybrid_forward fails here immediately — the regression class
+where a silently-untraceable forward demotes the whole fused train step
+to the eager tape path.  Intentional host-side code is blessed in
+tests/fixtures/lint_allowlist.txt (with a reason) or inline with
+``# mx-lint: allow=<rule>``; docs/ANALYSIS.md documents the workflow.
+"""
+import os
+
+import pytest
+
+from mxnet_tpu.analysis.lint import filter_allowed, lint_path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.lint
+
+
+def _sweep(rel, allowlist):
+    findings = lint_path(os.path.join(REPO, rel))
+    active = filter_allowed(findings, allowlist)
+    assert not active, (
+        f"jit-unsafe code in {rel} (bless intentional host-side code in "
+        "tests/fixtures/lint_allowlist.txt or inline with "
+        "`# mx-lint: allow=<rule>` — docs/ANALYSIS.md):\n"
+        + "\n".join(f"  {f}" for f in active))
+    return findings
+
+
+def test_gluon_forwards_are_jit_safe(lint_allowlist):
+    findings = _sweep(os.path.join("mxnet_tpu", "gluon"), lint_allowlist)
+    # the sweep must actually be LOOKING at something: the blessed
+    # vision-transform violations are known-present sentinels — if they
+    # vanish, the allowlist entries are stale (or the linter broke)
+    blessed = [f for f in findings
+               if "transforms.py" in f.where and f.rule == "MXA001"]
+    assert blessed, ("expected the documented host-side vision-transform "
+                     "findings; linter or allowlist is stale")
+
+
+def test_examples_are_jit_safe(lint_allowlist):
+    _sweep("examples", lint_allowlist)
+
+
+def test_allowlist_entries_all_still_hit(lint_allowlist):
+    """Every allowlist entry must still match a real finding — dead
+    entries hide future violations at the same path."""
+    findings = lint_path(os.path.join(REPO, "mxnet_tpu", "gluon"))
+    findings += lint_path(os.path.join(REPO, "examples"))
+    for suffix, rule in lint_allowlist:
+        hit = any(f.where.rsplit(":", 1)[0].replace(os.sep, "/")
+                  .endswith(suffix) and rule in ("*", f.rule)
+                  for f in findings)
+        assert hit, (f"allowlist entry `{suffix}::{rule}` no longer "
+                     "matches any finding — remove the stale entry")

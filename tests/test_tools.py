@@ -116,6 +116,18 @@ def test_diagnose_smoke(capsys):
                     "Environment"):
         assert section in out
     assert "Network Test" not in out  # egress checks are opt-in
+    assert "Program Analysis" not in out  # analysis section is opt-in
+
+
+def test_diagnose_analysis_section(capsys):
+    """--analysis: env reports include compiled-program health — the
+    tiny-MLP fused step's ProgramReport with an OK verdict."""
+    diagnose = _load("tools/diagnose.py", "diagnose2")
+    assert diagnose.main(["--analysis"]) == 0
+    out = capsys.readouterr().out
+    assert "Program Analysis" in out
+    assert "ProgramReport(mode=fused" in out
+    assert "verdict      : OK" in out
 
 
 # ---------------------------------------------------------------------------

@@ -63,6 +63,42 @@ def check_accelerator():
         print("accelerator check failed:", repr(e))
 
 
+def check_analysis():
+    """Compiled-program health: fuse a tiny MLP train step through
+    Trainer.compile_step and print the mx.analysis ProgramReport
+    (collective census, donation audit, host transfers, dtype drift) —
+    so an environment report shows not just that the device compiles,
+    but that the framework's ONE-program training contract holds on it
+    (docs/ANALYSIS.md)."""
+    print("----------Program Analysis----------")
+    try:
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon import Trainer, nn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        onp.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(8, 16).astype("float32"))
+        y = mx.nd.array(onp.random.randint(0, 8, size=(8,))
+                        .astype("int32"))
+        net(x)
+        loss = SoftmaxCrossEntropyLoss()
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          kvstore=None)
+        step = trainer.compile_step(lambda a, b: loss(net(a), b))
+        step(x, y)
+        report = step.analyze(x, y)
+        print(report.summary())
+        print("verdict      :", "OK" if report.ok else
+              "VIOLATIONS (see findings above)")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("program analysis failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -115,12 +151,18 @@ def main(argv=None):
     parser.add_argument("--network", action="store_true",
                         help="also run DNS connectivity checks "
                         "(off by default: egress-less environments)")
+    parser.add_argument("--analysis", action="store_true",
+                        help="also compile a tiny MLP train step and "
+                        "print its mx.analysis ProgramReport "
+                        "(collectives, donation, host transfers)")
     parser.add_argument("--timeout", type=int, default=10)
     args = parser.parse_args(argv)
     check_python()
     check_pip()
     check_mxnet()
     check_accelerator()
+    if args.analysis:
+        check_analysis()
     check_os()
     check_environment()
     if args.network:
