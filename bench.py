@@ -143,14 +143,24 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
 
 def run_framework_bench(tag, loop, x, y, warmup, steps):
     """AOT-compile the framework step for this shape bucket, then run
-    warmup + the timed loop. Returns (dt_seconds, flops, final_loss,
-    analysis_dict)."""
+    warmup + the timed loop. The timed loop runs PIPELINED: batches are
+    staged onto the device by the background prefetcher
+    (gluon/data/prefetcher.py), ``loop.step`` dispatches ahead of the
+    device under the bounded in-flight window (MXNET_INFLIGHT_STEPS),
+    and NO per-step host read happens — the one host fetch at the end is
+    the completion barrier the throughput number needs (block_until_ready
+    can return early on tunneled platforms). Returns (dt_seconds, flops,
+    final_loss, analysis_dict, engine_dict) where engine_dict carries
+    {input_wait_ms, inflight_window, host_sync_count, ...} for the BENCH
+    json."""
     import mxnet_tpu as mx
+    from mxnet_tpu.analysis import guard as tguard
     x_nd, y_nd = mx.nd.from_jax(x), mx.nd.from_jax(y)
     flops = loop.compiled_step.aot_compile(x_nd, y_nd)
     t0 = time.perf_counter()
     for _ in range(warmup):
         loss = loop.step(x_nd, y_nd)
+    loop.synchronize()
     _flush(loss._data)
     fused = loop.compiled_step.mode == "fused"
     log(f"bench[{tag}]: warmup (incl. compile) "
@@ -159,14 +169,29 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
         f"{loop.compiled_step.mode}, traces={loop.compiled_step.n_traces}")
     if not fused:  # pragma: no cover - diagnostic
         log(f"bench[{tag}]: WARNING framework step fell back to eager")
+    tguard.reset_sync_counts()
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = loop.step(x_nd, y_nd)
-    _flush(loss._data)
+    for bx, by in loop.prefetch((x_nd, y_nd) for _ in range(steps)):
+        loss = loop.step(bx, by)
+    loop.synchronize()
+    _flush(loss._data)   # completion barrier: ONE host read per leg
     dt = time.perf_counter() - t0
-    log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f}")
+    counts = tguard.sync_counts()
+    es = loop.engine_stats()
+    engine = {
+        # host syncs the pipeline did NOT design: NDArray-level
+        # asnumpy/item/wait_to_read inside the timed loop (target: 0)
+        "host_sync_count": counts.get("wait_to_read", 0),
+        "inflight_window": es.get("inflight_window"),
+        # consumer-side wait on input staging (prefetch hides h2d copy)
+        "input_wait_ms": round(es.get("input_wait_ms", 0.0), 2),
+        "window_retires": counts.get("window_retire", 0),
+        "prefetch_starvation": es.get("starvation_count"),
+    }
+    log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f} "
+        f"engine={engine}")
     analysis = analyze_framework_step(tag, loop, x_nd, y_nd)
-    return dt, flops, loss, analysis
+    return dt, flops, loss, analysis, engine
 
 
 def matmul_roofline():
@@ -232,14 +257,15 @@ def bench_resnet(dtype):
                         .astype("float32"))
         y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,))
                         .astype("int32"))
-        dt, flops, _, ana = run_framework_bench("resnet", loop, x, y,
-                                                warmup, steps)
+        dt, flops, _, ana, eng = run_framework_bench("resnet", loop, x, y,
+                                                     warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     img_s = bs * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"img_s": img_s, "tflops": tfs, "bs": bs, "analysis": ana}
+    return {"img_s": img_s, "tflops": tfs, "bs": bs, "analysis": ana,
+            "engine": eng}
 
 
 def bench_bert(dtype):
@@ -268,14 +294,14 @@ def bench_bert(dtype):
         x = jnp.asarray(onp.random.randint(0, vocab, size=(bs, seqlen))
                         .astype("int32"))
         y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
-        dt, flops, _, ana = run_framework_bench("bert", loop, x, y,
-                                                warmup, steps)
+        dt, flops, _, ana, eng = run_framework_bench("bert", loop, x, y,
+                                                     warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     tok_s = bs * seqlen * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana}
+    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana, "engine": eng}
 
 
 def bench_lstm(dtype):
@@ -313,14 +339,14 @@ def bench_lstm(dtype):
             0, vocab, size=(bs, seq)).astype("int32"))
         y = jnp.asarray(onp.random.randint(
             0, vocab, size=(bs, seq)).astype("int32"))
-        dt, flops, _, ana = run_framework_bench("lstm", loop, x, y,
-                                                warmup, steps)
+        dt, flops, _, ana, eng = run_framework_bench("lstm", loop, x, y,
+                                                     warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     tok_s = bs * seq * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana}
+    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana, "engine": eng}
 
 
 class _SSDResNet50:
@@ -552,6 +578,9 @@ def main():
             # arrives WITH its program diff — traces, collectives,
             # donated bytes (docs/ANALYSIS.md)
             "resnet_analysis": r.get("analysis"),
+            # async-engine observability: input-wait, in-flight window,
+            # host syncs inside the timed loop (docs/PERF_NOTES.md)
+            "resnet_engine": r.get("engine"),
         })
     if model in ("all", "bert"):
         # isolate: a secondary-model failure must not destroy the
@@ -580,6 +609,7 @@ def main():
                 "bert_mfu": round(b["tflops"] / peak, 4)
                 if b["tflops"] and peak else None,
                 "bert_analysis": b.get("analysis"),
+                "bert_engine": b.get("engine"),
             })
     for name, fn, tok_field in (("lstm", bench_lstm, "lstm_tokens_per_sec"),
                                 ("ssd", bench_ssd, "ssd_img_per_sec")):
@@ -612,6 +642,8 @@ def main():
         })
         if r.get("analysis") is not None:
             out[f"{name}_analysis"] = r["analysis"]
+        if r.get("engine") is not None:
+            out[f"{name}_engine"] = r["engine"]
     try:
         roof = matmul_roofline()
     except Exception as e:

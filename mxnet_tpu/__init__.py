@@ -54,6 +54,15 @@ try:
 except ImportError:
     pass
 
+# MXNET_COMPILE_CACHE=<dir>: persistent XLA compilation cache — restarts
+# and repeated bench warmups load executables from disk instead of
+# recompiling (runtime.setup_compile_cache logs hits/misses).
+try:
+    from .runtime import setup_compile_cache as _setup_compile_cache
+    _setup_compile_cache()
+except Exception:   # the cache is an optimization; never block import
+    pass
+
 try:
     from .attribute import AttrScope  # noqa: F401  (reference __init__:72)
 except ImportError:

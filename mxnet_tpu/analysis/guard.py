@@ -34,7 +34,8 @@ from contextlib import contextmanager
 from typing import List, Optional, Tuple
 
 __all__ = ["transfer_guard", "hot_scope", "allow_transfers", "armed",
-           "on_sync", "events", "clear_events", "env_mode"]
+           "on_sync", "events", "clear_events", "env_mode",
+           "count_sync", "sync_counts", "reset_sync_counts"]
 
 _LOG = logging.getLogger("mxnet_tpu.analysis.guard")
 
@@ -47,6 +48,7 @@ class _State(threading.local):
         self.suppress: int = 0            # allow_transfers depth
         self.scope: str = ""              # hot-region label for messages
         self.events: List[Tuple[str, str]] = []   # (kind, where)
+        self.counts: dict = {}            # kind -> total syncs (always on)
 
 
 _STATE = _State()
@@ -77,6 +79,27 @@ def events() -> List[Tuple[str, str]]:
 
 def clear_events():
     _STATE.events.clear()
+
+
+def count_sync(kind: str):
+    """Always-on per-thread census of device->host sync points — an int
+    increment, independent of whether the guard is armed. ``wait_to_read``
+    counts every NDArray-level sync (asnumpy/item route through it);
+    ``window_retire`` counts the engine's designed in-flight-window
+    boundary waits (engine.DispatchWindow). bench.py reads the delta over
+    a timed region to report ``host_sync_count``."""
+    st = _STATE
+    st.counts[kind] = st.counts.get(kind, 0) + 1
+
+
+def sync_counts() -> dict:
+    """Per-kind sync totals on this thread since the last
+    :func:`reset_sync_counts`."""
+    return dict(_STATE.counts)
+
+
+def reset_sync_counts():
+    _STATE.counts.clear()
 
 
 def _caller() -> str:

@@ -20,12 +20,16 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import weakref
+from collections import deque
+from typing import Any, Callable, Optional
 
 import jax
 
-from .base import get_env
+from .base import MXNetError, get_env
 
-__all__ = ["Engine", "get", "set_bulk_size", "bulk"]
+__all__ = ["Engine", "get", "set_bulk_size", "bulk", "DispatchWindow",
+           "inflight_steps"]
 
 
 class Engine:
@@ -54,6 +58,11 @@ class Engine:
         threaded_engine.cc:422-436). Deferred computation errors MUST
         propagate from here — only the absence of the barrier API itself is
         tolerated, never an error it reports."""
+        # drain live dispatch windows first: their retire path attributes
+        # an async failure to the STEP that faulted, which this barrier
+        # alone cannot do
+        for w in list(_live_windows):
+            w.drain()
         barrier = getattr(jax, "effects_barrier", None)
         if barrier is not None:
             barrier()
@@ -75,6 +84,102 @@ class Engine:
     @property
     def bulk_size(self) -> int:
         return self._bulk_size
+
+
+#: live DispatchWindows, drained by Engine.wait_for_all (mx.nd.waitall)
+_live_windows: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def inflight_steps(default: int = 2) -> int:
+    """The bounded dispatch-window size (``MXNET_INFLIGHT_STEPS``): how
+    many train-step futures the host may keep outstanding before it
+    blocks on the oldest. ``NaiveEngine`` forces 0 — every step retires
+    synchronously, the race-free oracle mode."""
+    if get().is_naive:
+        return 0
+    try:
+        v = int(get_env("MXNET_INFLIGHT_STEPS", str(default)))
+    except (TypeError, ValueError):
+        return default
+    return max(0, v)
+
+
+class DispatchWindow:
+    """Bounded in-flight async dispatch — ``Engine::PushAsync`` /
+    ``WaitForVar`` semantics on PjRt.
+
+    JAX arrays are already futures: a compiled step RETURNS immediately
+    while the device works. What the reference engine adds — and this
+    class reproduces — is the *bounded* part: ``push()`` records each
+    step's async result, and only when more than ``max_inflight`` results
+    are outstanding does the host block, on the OLDEST one (FIFO, the
+    WaitForVar of step N-k). That keeps the host a fixed number of steps
+    ahead of the device instead of either running unboundedly ahead or
+    (the pre-engine behavior) syncing every step.
+
+    Error contract (reference threaded_engine.cc:422-436): an async
+    failure surfaces at the retire of the step that faulted — wrapped in
+    an :class:`MXNetError` naming that step's tag — never silently at a
+    later sync point with an unrelated traceback.
+
+    The retire wait is the ONE blessed host sync of the pipelined hot
+    loop: it runs under ``analysis.guard.allow_transfers`` and is counted
+    separately (``window_retire``) from the unblessed NDArray syncs the
+    transfer guard flags.
+    """
+
+    def __init__(self, max_inflight: Optional[int] = None,
+                 sync_fn: Optional[Callable[[Any], Any]] = None,
+                 what: str = "train step"):
+        self.max_inflight = inflight_steps() if max_inflight is None \
+            else max(0, int(max_inflight))
+        self._sync = sync_fn if sync_fn is not None \
+            else jax.block_until_ready
+        self._what = what
+        self._pending: "deque[tuple]" = deque()
+        self.stats = {"pushes": 0, "retires": 0, "errors": 0,
+                      "max_pending": 0}
+        _live_windows.add(self)
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def push(self, payload, tag=None):
+        """Record one dispatched async result; returns immediately unless
+        the window is over capacity, in which case the OLDEST entry
+        retires (blocks until that step completed)."""
+        st = self.stats
+        st["pushes"] += 1
+        self._pending.append((tag, payload))
+        if len(self._pending) > st["max_pending"]:
+            st["max_pending"] = len(self._pending)
+        while len(self._pending) > self.max_inflight:
+            self._retire_oldest()
+
+    def _retire_oldest(self):
+        from .analysis import guard as _tguard
+        tag, payload = self._pending.popleft()
+        _tguard.count_sync("window_retire")
+        with _tguard.allow_transfers("dispatch-window retire"):
+            try:
+                self._sync(payload)
+            except MXNetError:
+                self.stats["errors"] += 1
+                raise
+            except Exception as e:
+                self.stats["errors"] += 1
+                raise MXNetError(
+                    f"async {self._what} "
+                    f"{tag if tag is not None else '<untagged>'} failed "
+                    f"(deferred error surfaced at its in-flight-window "
+                    f"retire): {type(e).__name__}: {e}") from e
+        self.stats["retires"] += 1
+
+    def drain(self):
+        """Retire every outstanding entry (WaitForVar on all of them);
+        deferred errors surface here attributed to their step."""
+        while self._pending:
+            self._retire_oldest()
 
 
 _host_engine = None

@@ -4,5 +4,6 @@ from .dataset import (Dataset, SimpleDataset, ArrayDataset,
 from .sampler import (Sampler, SequentialSampler, RandomSampler,
                       BatchSampler, FilterSampler, IntervalSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from .prefetcher import DevicePrefetcher
 from . import vision
 from . import batchify

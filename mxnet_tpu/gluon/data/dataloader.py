@@ -46,7 +46,18 @@ default_mp_batchify_fn = default_batchify_fn
 class DataLoader:
     """Loads batches from a Dataset (reference DataLoader API: batch_size,
     shuffle, sampler, batch_sampler, last_batch, batchify_fn, num_workers,
-    pin_memory, prefetch)."""
+    pin_memory, prefetch).
+
+    **Device prefetch** (``device=`` / ``prefetch_to_device=``): when a
+    target is given, batches are additionally staged host→device on a
+    background thread AHEAD of consumption (gluon/data/prefetcher.py) —
+    the copy of batch N+1 overlaps step N's compute instead of
+    serializing inside jit dispatch. ``device`` accepts ``True`` (the
+    process-default accelerator), an ``mx.Context``, a ``jax.Device``,
+    or a ``parallel.DeviceMesh`` (batches land dp-sharded over
+    ``device_axis`` when divisible, replicated otherwise — the fused
+    train step's exact input layout). ``prefetch_to_device`` bounds the
+    staged batches (default ``MXNET_DEVICE_PREFETCH``, 2)."""
 
     def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
                  shuffle: bool = False, sampler: Optional[Sampler] = None,
@@ -55,7 +66,9 @@ class DataLoader:
                  batchify_fn: Optional[Callable] = None,
                  num_workers: int = 0, pin_memory: bool = False,
                  pin_device_id: int = 0, prefetch: Optional[int] = None,
-                 thread_pool: bool = False, timeout: int = 120):
+                 thread_pool: bool = False, timeout: int = 120,
+                 device=None, prefetch_to_device: Optional[int] = None,
+                 device_axis: str = "dp"):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -79,6 +92,10 @@ class DataLoader:
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(self._num_workers, 1))
         self._timeout = timeout
+        self._device = device
+        self._device_axis = device_axis
+        self._prefetch_to_device = prefetch_to_device
+        self._device_prefetcher = None
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -88,6 +105,27 @@ class DataLoader:
         return self._batchify_fn(samples)
 
     def __iter__(self):
+        if self._device is not None or self._prefetch_to_device is not None:
+            from .prefetcher import DevicePrefetcher
+            dev, mesh = self._device, None
+            if dev is not None and hasattr(dev, "axis_names"):
+                dev, mesh = None, self._device   # a DeviceMesh target
+            self._device_prefetcher = DevicePrefetcher(
+                self._host_iter(), depth=self._prefetch_to_device,
+                device=dev, mesh=mesh, axis=self._device_axis,
+                timeout=self._timeout)
+            yield from self._device_prefetcher
+            return
+        yield from self._host_iter()
+
+    @property
+    def device_prefetch_stats(self):
+        """Staging stats of the most recent device-prefetching iteration
+        (``input_wait_ms``, ``starvation_count``, ...), or None."""
+        return None if self._device_prefetcher is None \
+            else dict(self._device_prefetcher.stats)
+
+    def _host_iter(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)

@@ -79,21 +79,11 @@ _ARRAY_TYPES = (NDArray, onp.ndarray, jax.Array)
 
 
 def _place_on_mesh(mesh, axis: str, d):
-    """Lay a step input out on the mesh: batch-shard dim0 over ``axis``
-    when divisible (``shard_batch`` semantics), else replicate; arrays
-    already resident on this mesh pass through."""
-    from jax.sharding import NamedSharding, PartitionSpec
-    if not hasattr(d, "shape"):
-        return d
-    sh = getattr(d, "sharding", None)
-    if isinstance(sh, NamedSharding) and sh.mesh == mesh.mesh:
-        return d
-    d = jnp.asarray(d)
-    n = int(mesh.shape[axis])
-    if d.ndim >= 1 and d.shape[0] and d.shape[0] % n == 0:
-        spec = PartitionSpec(axis, *([None] * (d.ndim - 1)))
-        return jax.device_put(d, NamedSharding(mesh.mesh, spec))
-    return jax.device_put(d, NamedSharding(mesh.mesh, PartitionSpec()))
+    """Mesh input layout (batch-shard dim0 when divisible, else
+    replicate) — shared with the device prefetcher via
+    ``parallel.mesh.place_on_mesh``."""
+    from ..parallel.mesh import place_on_mesh
+    return place_on_mesh(mesh, axis, d)
 
 
 def _zero_min_size() -> int:
@@ -382,6 +372,32 @@ class CompiledTrainStep:
         from ..analysis.program import explain_signature_diff
         return explain_signature_diff(self._sig_history[-2],
                                       self._sig_history[-1])
+
+    def input_placement(self) -> Optional[Callable]:
+        """The host→device placement this step applies to its input
+        leaves: ``place(x)`` device_puts a raw array with the step's
+        exact ``NamedSharding`` (dp-sharded batch on a mesh, replicated
+        otherwise), or ``None`` when the step runs single-device (plain
+        default-device placement suffices). The device prefetcher
+        (``gluon.data.DevicePrefetcher`` / ``TrainLoop.prefetch``) stages
+        upcoming batches through this so the host→device copy overlaps
+        the previous step's compute instead of serializing inside jit
+        dispatch."""
+        from ..parallel.mesh import current_mesh, place_on_mesh
+        mesh = axis = None
+        if self._zero_ok is not None:
+            mesh, axis = self._zero_ok
+        elif self._plain_mesh is not None:
+            mesh, axis = self._plain_mesh
+        else:
+            m = self._zero_mesh or current_mesh()
+            a = self._zero_axis
+            if m is not None and a in m.axis_names \
+                    and m.shape[a] >= 2:
+                mesh, axis = m, a
+        if mesh is None:
+            return None
+        return lambda d, _m=mesh, _a=axis: place_on_mesh(_m, _a, d)
 
     def optimizer_state_bytes(self) -> int:
         """PER-REPLICA bytes of optimizer state (momenta/moments + fp32
@@ -1044,6 +1060,27 @@ class TrainLoop:
     the last to the loss block, through ``Trainer.compile_step`` — the
     framework-level replacement for hand-rolled jitted train steps.
 
+    **Async dispatch** (docs/PERF_NOTES.md "async engine"): ``step()``
+    returns IMMEDIATELY with an async loss NDArray — JAX arrays are
+    futures, and the loop never forces them. A bounded in-flight window
+    (``mx.engine.DispatchWindow``, size ``MXNET_INFLIGHT_STEPS`` /
+    ``inflight=``, default 2; ``NaiveEngine`` forces 0) reproduces the
+    reference engine's ``PushAsync``/``WaitForVar`` discipline: the host
+    dispatches ahead of the device and blocks only when the window
+    fills, on the OLDEST step's loss. A step that faulted raises at its
+    own retire — named by step number — not at a later sync with the
+    wrong traceback. ``synchronize()`` drains the window;
+    ``engine_stats()`` reports pushes/retires/max-pending plus the last
+    prefetcher's input-wait stats. The whole ``step()`` body is a
+    transfer-guard hot region: with ``MXNET_TRANSFER_GUARD=raise`` any
+    host sync OTHER than the blessed window retire (and checkpoint
+    snapshots) raises.
+
+    **Device input prefetch**: ``for x, y in loop.prefetch(batches):``
+    stages upcoming host batches onto the device with the step's exact
+    sharding on a background thread, overlapping the host→device copy
+    with the previous step's compute (gluon/data/prefetcher.py).
+
     **Preemption safety** (``checkpoint_dir=...``): the loop owns a
     ``mx.checkpoint.TrainCheckpointManager`` — on construction it
     auto-resumes from the newest VALID checkpoint (params, fused/ZeRO
@@ -1061,11 +1098,15 @@ class TrainLoop:
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: Optional[int] = None,
                  keep_last: int = 3, async_checkpoint: bool = True,
-                 resume: bool = True):
+                 resume: bool = True, inflight: Optional[int] = None):
+        from .. import engine as _engine
         self._net = net
         self._loss = loss
         self._trainer = trainer
         self._step = trainer.compile_step(self._loss_fn, donate=donate)
+        self._window = _engine.DispatchWindow(max_inflight=inflight,
+                                              what="TrainLoop step")
+        self._prefetcher = None
         self._global_step = 0
         self._every = checkpoint_every
         self._manager = None
@@ -1088,14 +1129,58 @@ class TrainLoop:
         return self._loss(out, label)
 
     def step(self, *batch, batch_size: Optional[int] = None):
-        loss = self._step(*batch, batch_size=batch_size)
-        self._global_step += 1
-        if self._manager is not None and self._every and \
-                self._global_step % self._every == 0:
-            self.save_checkpoint()
+        # the WHOLE pipelined iteration is a transfer-guard hot region
+        # (nested inside CompiledTrainStep's own scope this is a no-op):
+        # the window retire below and the checkpoint snapshot are the
+        # only blessed syncs; anything else — a float(loss) leaking in,
+        # a per-step metric asnumpy — is flagged/raised when
+        # MXNET_TRANSFER_GUARD is armed
+        with _tguard.hot_scope("TrainLoop.step"):
+            loss = self._step(*batch, batch_size=batch_size)
+            self._global_step += 1
+            d = loss._data if isinstance(loss, NDArray) else loss
+            self._window.push(d, tag=self._global_step)
+            if self._manager is not None and self._every and \
+                    self._global_step % self._every == 0:
+                with _tguard.allow_transfers("checkpoint snapshot"):
+                    self.save_checkpoint()
         return loss
 
     __call__ = step
+
+    # ---------------- async engine surface ----------------
+    def synchronize(self):
+        """Drain the in-flight dispatch window — ``WaitForVar`` on every
+        outstanding step. Deferred async errors surface here attributed
+        to the step that faulted."""
+        self._window.drain()
+
+    def prefetch(self, batches, depth: Optional[int] = None):
+        """Wrap a host batch iterable in a device prefetcher staged with
+        THIS loop's input sharding (dp-sharded batch on a mesh,
+        replicated otherwise)::
+
+            for x, y in loop.prefetch(loader):
+                loop.step(x, y)
+
+        The host→device copy of batch N+1 overlaps step N's compute.
+        ``depth`` bounds staged batches (``MXNET_DEVICE_PREFETCH``,
+        default 2). Stats land in :meth:`engine_stats`."""
+        from .data.prefetcher import DevicePrefetcher
+        self._prefetcher = DevicePrefetcher(
+            batches, depth=depth, place=self._step.input_placement())
+        return self._prefetcher
+
+    def engine_stats(self) -> dict:
+        """Dispatch/prefetch observability: the in-flight window size and
+        its push/retire counters, plus the last :meth:`prefetch`
+        iterator's input-wait numbers (tools/diagnose.py --engine)."""
+        s = dict(self._window.stats)
+        s["inflight_window"] = self._window.max_inflight
+        s["pending"] = len(self._window)
+        if self._prefetcher is not None:
+            s.update(self._prefetcher.stats)
+        return s
 
     # ---------------- checkpointing ----------------
     def save_checkpoint(self, block: Optional[bool] = None):

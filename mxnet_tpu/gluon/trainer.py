@@ -89,6 +89,15 @@ class Trainer:
             for x, y in batches:
                 loss = step(x, y)          # == record/backward/step(bs)
 
+        The returned loss is an ASYNC NDArray — the call dispatches and
+        returns while the device works; reading it (``float``,
+        ``asnumpy``) is the sync point. Pair with ``gluon.TrainLoop``
+        for the bounded in-flight dispatch window
+        (``MXNET_INFLIGHT_STEPS``) and device input prefetch
+        (``loop.prefetch`` / ``DataLoader(device=...)``) that keep the
+        host a fixed number of steps ahead of the chip
+        (docs/PERF_NOTES.md "async engine").
+
         Gradient semantics match ``loss.backward()`` (seed ones) followed
         by ``trainer.step(batch_size)`` with ``batch_size`` inferred from
         the leading batch axis (override per call:

@@ -1,7 +1,22 @@
 """Evaluation metrics (reference: python/mxnet/gluon/metric.py — 32 classes).
 
-Metrics accumulate on host in float64 like the reference; update() accepts
-NDArrays or numpy arrays.
+``update()`` accepts NDArrays or numpy arrays. Accumulation is
+**sync-free on device inputs**: when a batch is device-resident
+(NDArray backed by a jax.Array), the per-batch statistic is computed ON
+the device and added into a device-resident running sum — ``update()``
+dispatches async work and returns without any device→host transfer, so
+per-batch metric updates inside a pipelined train/eval loop no longer
+stall the accelerator (the reference's engine would likewise keep these
+as async ops until an explicit wait). The ONE host sync happens at
+``get()``, which reads the accumulated scalars. Device sums accumulate
+in float32 (x64 is off under jit); pure-host inputs (numpy/lists) keep
+the reference's float64 host accumulation exactly.
+
+Metrics whose update is inherently host-side keep the sync:
+``PCC`` (its confusion matrix grows from the batch's max class index — a
+data-dependent host decision), ``PearsonCorrelation`` (stores raw
+vectors), ``CustomMetric`` (user feval takes numpy), and ``Perplexity``
+with ``ignore_label`` set (the valid-token count is data-dependent).
 """
 from __future__ import annotations
 
@@ -9,6 +24,9 @@ import math
 from typing import Optional
 
 import numpy as onp
+
+import jax
+import jax.numpy as jnp
 
 from .base import MXNetError
 
@@ -36,6 +54,34 @@ def _to_numpy(x):
     return onp.asarray(x)
 
 
+def _device_array(x):
+    """The backing jax.Array when ``x`` is a concrete device-resident
+    NDArray/jax array (not a tracer), else None."""
+    d = getattr(x, "_data", x)
+    if isinstance(d, jax.Array) and not isinstance(d, jax.core.Tracer):
+        return d
+    return None
+
+
+def _device_pair(label, pred):
+    """(label, pred) as jax arrays when at least one side is
+    device-resident — the signal to accumulate on device with no host
+    sync. Pure-host pairs return None (keep float64 host accumulation)."""
+    la, pa = _device_array(label), _device_array(pred)
+    if la is None and pa is None:
+        return None
+    if la is None:
+        la = jnp.asarray(getattr(label, "_data", label))
+    if pa is None:
+        pa = jnp.asarray(getattr(pred, "_data", pred))
+    return la, pa
+
+
+def _host(v) -> float:
+    """Read an accumulated scalar — THE designed sync point (get())."""
+    return float(v)
+
+
 def check_label_shapes(labels, preds, shape=False):
     if len(labels) != len(preds):
         raise MXNetError(
@@ -43,7 +89,12 @@ def check_label_shapes(labels, preds, shape=False):
 
 
 class EvalMetric:
-    """Base metric (reference metric.py EvalMetric)."""
+    """Base metric (reference metric.py EvalMetric).
+
+    ``sum_metric`` holds either a host float (numpy inputs) or a
+    device-resident scalar (NDArray inputs — accumulated async, no per-
+    batch sync); ``num_inst`` is always a host int derived from shapes.
+    ``get()`` is the one sync point."""
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = name
@@ -62,7 +113,7 @@ class EvalMetric:
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        return self.name, self.sum_metric / self.num_inst
+        return self.name, _host(self.sum_metric) / self.num_inst
 
     def get_name_value(self):
         name, value = self.get()
@@ -120,6 +171,17 @@ class Accuracy(EvalMetric):
         labels, preds = _as_list(labels), _as_list(preds)
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                if pd.ndim > ld.ndim:
+                    pd = jnp.argmax(pd, axis=self.axis)
+                eq = pd.astype(jnp.int32).reshape(-1) \
+                    == ld.astype(jnp.int32).reshape(-1)
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(eq, dtype=jnp.float32)
+                self.num_inst += int(onp.prod(ld.shape)) if ld.shape else 1
+                continue
             label = _to_numpy(label)
             pred = _to_numpy(pred)
             if pred.ndim > label.ndim:
@@ -138,6 +200,16 @@ class TopKAccuracy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                ld = ld.astype(jnp.int32).reshape(-1)
+                topk = jnp.argsort(-pd, axis=-1)[:, :self.top_k]
+                hit = jnp.any(topk == ld[:, None], axis=1)
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(hit, dtype=jnp.float32)
+                self.num_inst += int(ld.shape[0])
+                continue
             label = _to_numpy(label).astype("int64").flatten()
             pred = _to_numpy(pred)
             topk = onp.argsort(-pred, axis=-1)[:, :self.top_k]
@@ -152,6 +224,13 @@ class MAE(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                self.sum_metric = self.sum_metric + jnp.mean(
+                    jnp.abs(ld.reshape(pd.shape) - pd)).astype(jnp.float32)
+                self.num_inst += 1
+                continue
             label, pred = _to_numpy(label), _to_numpy(pred)
             self.sum_metric += float(onp.abs(label.reshape(pred.shape)
                                              - pred).mean())
@@ -165,6 +244,13 @@ class MSE(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                self.sum_metric = self.sum_metric + jnp.mean(
+                    (ld.reshape(pd.shape) - pd) ** 2).astype(jnp.float32)
+                self.num_inst += 1
+                continue
             label, pred = _to_numpy(label), _to_numpy(pred)
             self.sum_metric += float(((label.reshape(pred.shape)
                                        - pred) ** 2).mean())
@@ -179,7 +265,7 @@ class RMSE(MSE):
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        return self.name, math.sqrt(self.sum_metric / self.num_inst)
+        return self.name, math.sqrt(_host(self.sum_metric) / self.num_inst)
 
 
 @_register("ce", "crossentropy", "cross-entropy")
@@ -190,6 +276,15 @@ class CrossEntropy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                ld = ld.astype(jnp.int32).reshape(-1)
+                prob = pd[jnp.arange(ld.shape[0]), ld]
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(-jnp.log(prob + self.eps)).astype(jnp.float32)
+                self.num_inst += int(ld.shape[0])
+                continue
             label = _to_numpy(label).astype("int64").flatten()
             pred = _to_numpy(pred)
             prob = pred[onp.arange(label.shape[0]), label]
@@ -213,6 +308,19 @@ class Perplexity(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred) \
+                if self.ignore_label is None else None
+            if dev is not None:
+                # ignore_label needs a data-dependent valid-token count
+                # (host decision) — only the unmasked case stays on device
+                ld, pd = dev
+                ld = ld.astype(jnp.int32).reshape(-1)
+                pd = pd.reshape(ld.shape[0], -1)
+                prob = pd[jnp.arange(ld.shape[0]), ld]
+                self.sum_metric = self.sum_metric + jnp.sum(
+                    -jnp.log(jnp.maximum(prob, 1e-10))).astype(jnp.float32)
+                self.num_inst += int(ld.shape[0])
+                continue
             label = _to_numpy(label).astype("int64").reshape(-1)
             pred = _to_numpy(pred).reshape(label.shape[0], -1)
             prob = pred[onp.arange(label.shape[0]), label]
@@ -225,7 +333,7 @@ class Perplexity(EvalMetric):
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        return self.name, math.exp(self.sum_metric / self.num_inst)
+        return self.name, math.exp(_host(self.sum_metric) / self.num_inst)
 
 
 @_register("f1")
@@ -244,6 +352,22 @@ class F1(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                if pd.ndim > 1:
+                    pd = jnp.argmax(pd, axis=-1)
+                pd = pd.astype(jnp.int32).reshape(-1)
+                ld = ld.astype(jnp.int32).reshape(-1)
+                f32 = jnp.float32
+                self._tp = self._tp + jnp.sum((pd == 1) & (ld == 1),
+                                              dtype=f32)
+                self._fp = self._fp + jnp.sum((pd == 1) & (ld == 0),
+                                              dtype=f32)
+                self._fn = self._fn + jnp.sum((pd == 0) & (ld == 1),
+                                              dtype=f32)
+                self.num_inst += int(ld.shape[0])
+                continue
             label = _to_numpy(label).astype("int64").flatten()
             pred = _to_numpy(pred)
             if pred.ndim > 1:
@@ -259,8 +383,9 @@ class F1(EvalMetric):
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        prec = self._tp / max(self._tp + self._fp, 1e-12)
-        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        tp, fp, fn = _host(self._tp), _host(self._fp), _host(self._fn)
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
         b2 = self.beta * self.beta
         f1 = (1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12)
         return self.name, f1
@@ -278,6 +403,24 @@ class MCC(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                if pd.ndim > 1:
+                    pd = jnp.argmax(pd, axis=-1)
+                pd = pd.astype(jnp.int32).reshape(-1)
+                ld = ld.astype(jnp.int32).reshape(-1)
+                f32 = jnp.float32
+                self._tp = self._tp + jnp.sum((pd == 1) & (ld == 1),
+                                              dtype=f32)
+                self._fp = self._fp + jnp.sum((pd == 1) & (ld == 0),
+                                              dtype=f32)
+                self._fn = self._fn + jnp.sum((pd == 0) & (ld == 1),
+                                              dtype=f32)
+                self._tn = self._tn + jnp.sum((pd == 0) & (ld == 0),
+                                              dtype=f32)
+                self.num_inst += int(ld.shape[0])
+                continue
             label = _to_numpy(label).astype("int64").flatten()
             pred = _to_numpy(pred)
             if pred.ndim > 1:
@@ -292,7 +435,8 @@ class MCC(EvalMetric):
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        tp, fp = _host(self._tp), _host(self._fp)
+        fn, tn = _host(self._fn), _host(self._tn)
         den = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
         return self.name, (tp * tn - fp * fn) / den if den else 0.0
 
@@ -380,6 +524,12 @@ class Loss(EvalMetric):
 
     def update(self, _, preds):
         for pred in _as_list(preds):
+            pd = _device_array(pred)
+            if pd is not None:
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(pd).astype(jnp.float32)
+                self.num_inst += int(onp.prod(pd.shape)) if pd.shape else 1
+                continue
             loss = _to_numpy(pred)
             self.sum_metric += float(loss.sum())
             self.num_inst += loss.size
@@ -435,6 +585,15 @@ class BinaryAccuracy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                hit = (pd.reshape(-1) > self.threshold) \
+                    == (ld.reshape(-1) > 0.5)
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(hit, dtype=jnp.float32)
+                self.num_inst += int(onp.prod(ld.shape)) if ld.shape else 1
+                continue
             label = _to_numpy(label).flatten()
             pred = (_to_numpy(pred).flatten() > self.threshold)
             self.sum_metric += float((pred == (label > 0.5)).sum())
@@ -452,6 +611,15 @@ class MeanPairwiseDistance(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                d = (jnp.abs(pd - ld) ** self.p).sum(
+                    axis=tuple(range(1, ld.ndim))) ** (1.0 / self.p)
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(d).astype(jnp.float32)
+                self.num_inst += int(ld.shape[0])
+                continue
             label = _to_numpy(label)
             pred = _to_numpy(pred)
             d = (onp.abs(pred - label) ** self.p).sum(
@@ -471,6 +639,18 @@ class MeanCosineSimilarity(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
+            dev = _device_pair(label, pred)
+            if dev is not None:
+                ld, pd = dev
+                num = (ld * pd).sum(-1)
+                den = jnp.linalg.norm(ld, axis=-1) * \
+                    jnp.linalg.norm(pd, axis=-1)
+                sim = num / jnp.maximum(den, self.eps)
+                self.sum_metric = self.sum_metric + \
+                    jnp.sum(sim).astype(jnp.float32)
+                self.num_inst += int(onp.prod(sim.shape)) if sim.shape \
+                    else 1
+                continue
             label = _to_numpy(label)
             pred = _to_numpy(pred)
             num = (label * pred).sum(-1)

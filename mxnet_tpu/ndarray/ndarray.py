@@ -203,6 +203,7 @@ class NDArray:
         """Block until the value is ready; async errors surface here
         (reference NDArray::WaitToRead, engine exception rethrow)."""
         if not _is_tracer(self._data):
+            _tguard.count_sync("wait_to_read")
             if _tguard.armed():
                 _tguard.on_sync("wait_to_read", self._what())
             jax.block_until_ready(self._data)

@@ -8,7 +8,7 @@ ICI/DCN collectives.
 """
 from .mesh import (DeviceMesh, make_mesh, current_mesh, data_parallel_mesh,
                    shard_batch, replicate, shard_params, zero_shard_pad,
-                   zero_shard_sharding)
+                   zero_shard_sharding, place_on_mesh)
 from .compression import GradientCompression
 from . import mesh, compression, dist, collectives, pipeline
 from .collectives import (allreduce, allgather, reduce_scatter,

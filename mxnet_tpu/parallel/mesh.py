@@ -21,7 +21,7 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["DeviceMesh", "make_mesh", "current_mesh", "data_parallel_mesh",
            "shard_batch", "replicate", "shard_params", "zero_shard_pad",
-           "zero_shard_sharding", "P"]
+           "zero_shard_sharding", "place_on_mesh", "P"]
 
 _state = threading.local()
 
@@ -112,6 +112,28 @@ def shard_batch(data: NDArray, mesh: Optional[DeviceMesh] = None,
     spec[0] = axis
     sharding = mesh.sharding(*spec)
     return NDArray(jax.device_put(data._data, sharding))
+
+
+def place_on_mesh(mesh: DeviceMesh, axis: str, d):
+    """Lay a raw step input out on the mesh the way the fused train step
+    consumes it: batch-shard dim0 over ``axis`` when divisible
+    (``shard_batch`` semantics), else replicate; arrays already resident
+    on this mesh pass through untouched. Works on jax arrays / numpy /
+    python scalars (non-array leaves pass through). This is the sharding
+    contract the device prefetcher (gluon/data/prefetcher.py) stages
+    batches with so the host→device copy overlaps the previous step."""
+    import jax.numpy as jnp
+    if not hasattr(d, "shape"):
+        return d
+    sh = getattr(d, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh.mesh:
+        return d
+    d = jnp.asarray(d)
+    n = int(mesh.shape[axis])
+    if d.ndim >= 1 and d.shape[0] and d.shape[0] % n == 0:
+        spec = P(axis, *([None] * (d.ndim - 1)))
+        return jax.device_put(d, NamedSharding(mesh.mesh, spec))
+    return jax.device_put(d, NamedSharding(mesh.mesh, P()))
 
 
 def replicate(data: NDArray, mesh: Optional[DeviceMesh] = None) -> NDArray:

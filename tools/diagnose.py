@@ -99,6 +99,65 @@ def check_analysis():
         print("program analysis failed:", repr(e))
 
 
+def check_engine():
+    """Async-dispatch health: run a tiny MLP through the pipelined
+    gluon.TrainLoop (device-prefetched inputs + bounded in-flight
+    window) and print the dispatch stats — window size, host syncs per
+    100 steps, prefetch depth/starvation — so a misconfigured pipeline
+    (window 0, per-step syncs sneaking in, starved prefetcher) is
+    visible without a profiler (docs/PERF_NOTES.md "async engine")."""
+    print("----------Async Engine----------")
+    try:
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.analysis import guard as tguard
+        from mxnet_tpu.gluon import Trainer, TrainLoop, nn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+        from mxnet_tpu.runtime import compile_cache_stats
+
+        steps = 100
+        onp.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(16, 16).astype("float32"))
+        y = mx.nd.array(onp.random.randint(0, 8, size=(16,))
+                        .astype("int32"))
+        net(x)
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          kvstore=None)
+        loop = TrainLoop(net, trainer, SoftmaxCrossEntropyLoss())
+        loop.step(x, y)          # compile outside the counted region
+        loop.synchronize()
+        tguard.reset_sync_counts()
+        for bx, by in loop.prefetch((x, y) for _ in range(steps)):
+            loop.step(bx, by)
+        loop.synchronize()
+        counts = tguard.sync_counts()
+        s = loop.engine_stats()
+        print("mode         :", loop.compiled_step.mode)
+        print("window size  :", s["inflight_window"],
+              "(MXNET_INFLIGHT_STEPS)")
+        print("steps run    :", steps)
+        print("max in-flight:", s["max_pending"])
+        print("window waits :", counts.get("window_retire", 0),
+              "(the designed retire syncs)")
+        print("host syncs   :", counts.get("wait_to_read", 0),
+              f"per {steps} steps (unplanned NDArray syncs; want 0)")
+        print("prefetch     : depth", s.get("prefetch_depth"),
+              "starvation", s.get("starvation_count"),
+              f"input_wait {s.get('input_wait_ms', 0.0):.1f} ms")
+        cc = compile_cache_stats()
+        if cc["enabled"]:
+            print("compile cache:", cc["dir"],
+                  f"hits={cc['hits']} misses={cc['misses']}")
+        else:
+            print("compile cache: off (set MXNET_COMPILE_CACHE=<dir>)")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("engine check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -155,6 +214,10 @@ def main(argv=None):
                         help="also compile a tiny MLP train step and "
                         "print its mx.analysis ProgramReport "
                         "(collectives, donation, host transfers)")
+    parser.add_argument("--engine", action="store_true",
+                        help="also run a tiny pipelined TrainLoop and "
+                        "print async-dispatch stats (in-flight window, "
+                        "syncs per 100 steps, prefetch depth/starvation)")
     parser.add_argument("--timeout", type=int, default=10)
     args = parser.parse_args(argv)
     check_python()
@@ -163,6 +226,8 @@ def main(argv=None):
     check_accelerator()
     if args.analysis:
         check_analysis()
+    if args.engine:
+        check_engine()
     check_os()
     check_environment()
     if args.network:
