@@ -149,14 +149,20 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
     device under the bounded in-flight window (MXNET_INFLIGHT_STEPS),
     and NO per-step host read happens — the one host fetch at the end is
     the completion barrier the throughput number needs (block_until_ready
-    can return early on tunneled platforms). Returns (dt_seconds, flops,
-    final_loss, analysis_dict, engine_dict) where engine_dict carries
-    {input_wait_ms, inflight_window, host_sync_count, ...} for the BENCH
-    json."""
+    can return early on tunneled platforms). The loop runs with
+    MXNET_TELEMETRY semantics ON, so the leg ships the full telemetry
+    story: the engine dict ({input_wait_ms, inflight_window,
+    host_sync_count, ...}, now read from the metrics registry instead of
+    hand-rolled counters) plus a telemetry dict with the phase-duration
+    summary, the MFU gauge (cost_analysis flops / step time / roofline),
+    anomaly count, and the full registry snapshot. Returns (dt_seconds,
+    flops, final_loss, analysis_dict, engine_dict, telemetry_dict)."""
     import mxnet_tpu as mx
-    from mxnet_tpu.analysis import guard as tguard
+    from mxnet_tpu import telemetry
+    names = telemetry.names
     x_nd, y_nd = mx.nd.from_jax(x), mx.nd.from_jax(y)
     flops = loop.compiled_step.aot_compile(x_nd, y_nd)
+    telemetry.enable(True)
     t0 = time.perf_counter()
     for _ in range(warmup):
         loss = loop.step(x_nd, y_nd)
@@ -169,29 +175,56 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
         f"{loop.compiled_step.mode}, traces={loop.compiled_step.n_traces}")
     if not fused:  # pragma: no cover - diagnostic
         log(f"bench[{tag}]: WARNING framework step fell back to eager")
-    tguard.reset_sync_counts()
+    # zero every series so the leg's registry reads ARE the timed loop
+    telemetry.reset()
+    peak, _ = peak_tflops()
+    if flops:
+        loop.arm_mfu(x_nd, y_nd,
+                     peak_flops=peak * 1e12 if peak else None)
     t0 = time.perf_counter()
     for bx, by in loop.prefetch((x_nd, y_nd) for _ in range(steps)):
         loss = loop.step(bx, by)
     loop.synchronize()
     _flush(loss._data)   # completion barrier: ONE host read per leg
     dt = time.perf_counter() - t0
-    counts = tguard.sync_counts()
     es = loop.engine_stats()
+
+    def val(name, label=None, scale=1.0, digits=None):
+        v = telemetry.value(name, label)
+        if v is None:
+            return None
+        v = v * scale
+        return round(v, digits) if digits is not None else int(v)
+
     engine = {
         # host syncs the pipeline did NOT design: NDArray-level
         # asnumpy/item/wait_to_read inside the timed loop (target: 0)
-        "host_sync_count": counts.get("wait_to_read", 0),
+        "host_sync_count": val(names.HOST_SYNCS, "wait_to_read"),
         "inflight_window": es.get("inflight_window"),
         # consumer-side wait on input staging (prefetch hides h2d copy)
-        "input_wait_ms": round(es.get("input_wait_ms", 0.0), 2),
-        "window_retires": counts.get("window_retire", 0),
-        "prefetch_starvation": es.get("starvation_count"),
+        "input_wait_ms": val(names.PREFETCH_INPUT_WAIT, scale=1e3,
+                             digits=2),
+        "window_retires": val(names.HOST_SYNCS, "window_retire"),
+        "prefetch_starvation": val(names.PREFETCH_STARVATION),
+    }
+    phase_summary = {
+        phase: {k: round(v, 3) for k, v in s.items()}
+        for phase, s in telemetry.timeline().summary().items()}
+    wd = telemetry.watchdog()
+    telem = {
+        "mfu_gauge": telemetry.value(names.MFU),
+        "flops_per_step": telemetry.value(names.MODEL_FLOPS_PER_STEP),
+        "step_time_ewma_ms": val(names.STEP_TIME_EWMA, scale=1e3,
+                                 digits=3),
+        "anomalies": len(wd.anomalies()),
+        "phase_summary": phase_summary,
+        "snapshot": telemetry.snapshot(),
     }
     log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f} "
-        f"engine={engine}")
+        f"engine={engine} mfu_gauge={telem['mfu_gauge']} "
+        f"anomalies={telem['anomalies']}")
     analysis = analyze_framework_step(tag, loop, x_nd, y_nd)
-    return dt, flops, loss, analysis, engine
+    return dt, flops, loss, analysis, engine, telem
 
 
 def matmul_roofline():
@@ -257,15 +290,15 @@ def bench_resnet(dtype):
                         .astype("float32"))
         y = jnp.asarray(onp.random.randint(0, 1000, size=(bs,))
                         .astype("int32"))
-        dt, flops, _, ana, eng = run_framework_bench("resnet", loop, x, y,
-                                                     warmup, steps)
+        dt, flops, _, ana, eng, tel = run_framework_bench(
+            "resnet", loop, x, y, warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     img_s = bs * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
     return {"img_s": img_s, "tflops": tfs, "bs": bs, "analysis": ana,
-            "engine": eng}
+            "engine": eng, "telemetry": tel}
 
 
 def bench_bert(dtype):
@@ -294,14 +327,15 @@ def bench_bert(dtype):
         x = jnp.asarray(onp.random.randint(0, vocab, size=(bs, seqlen))
                         .astype("int32"))
         y = jnp.asarray(onp.random.randint(0, 2, size=(bs,)).astype("int32"))
-        dt, flops, _, ana, eng = run_framework_bench("bert", loop, x, y,
-                                                     warmup, steps)
+        dt, flops, _, ana, eng, tel = run_framework_bench(
+            "bert", loop, x, y, warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     tok_s = bs * seqlen * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana, "engine": eng}
+    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana,
+            "engine": eng, "telemetry": tel}
 
 
 def bench_lstm(dtype):
@@ -339,14 +373,15 @@ def bench_lstm(dtype):
             0, vocab, size=(bs, seq)).astype("int32"))
         y = jnp.asarray(onp.random.randint(
             0, vocab, size=(bs, seq)).astype("int32"))
-        dt, flops, _, ana, eng = run_framework_bench("lstm", loop, x, y,
-                                                     warmup, steps)
+        dt, flops, _, ana, eng, tel = run_framework_bench(
+            "lstm", loop, x, y, warmup, steps)
     finally:
         if dtype == "bf16":
             mx.amp.uninit()
     tok_s = bs * seq * steps / dt
     tfs = flops * steps / dt / 1e12 if flops and on_accel else None
-    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana, "engine": eng}
+    return {"tok_s": tok_s, "tflops": tfs, "analysis": ana,
+            "engine": eng, "telemetry": tel}
 
 
 class _SSDResNet50:
@@ -581,6 +616,9 @@ def main():
             # async-engine observability: input-wait, in-flight window,
             # host syncs inside the timed loop (docs/PERF_NOTES.md)
             "resnet_engine": r.get("engine"),
+            # full telemetry story: phase-duration summary, MFU gauge,
+            # anomaly count, registry snapshot (docs/OBSERVABILITY.md)
+            "resnet_telemetry": r.get("telemetry"),
         })
     if model in ("all", "bert"):
         # isolate: a secondary-model failure must not destroy the
@@ -610,6 +648,7 @@ def main():
                 if b["tflops"] and peak else None,
                 "bert_analysis": b.get("analysis"),
                 "bert_engine": b.get("engine"),
+                "bert_telemetry": b.get("telemetry"),
             })
     for name, fn, tok_field in (("lstm", bench_lstm, "lstm_tokens_per_sec"),
                                 ("ssd", bench_ssd, "ssd_img_per_sec")):
@@ -644,6 +683,8 @@ def main():
             out[f"{name}_analysis"] = r["analysis"]
         if r.get("engine") is not None:
             out[f"{name}_engine"] = r["engine"]
+        if r.get("telemetry") is not None:
+            out[f"{name}_telemetry"] = r["telemetry"]
     try:
         roof = matmul_roofline()
     except Exception as e:

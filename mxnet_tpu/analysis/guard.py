@@ -81,15 +81,29 @@ def clear_events():
     _STATE.events.clear()
 
 
+#: the process-global mx_guard_host_syncs_total{kind=} counter, bound on
+#: first use (the thread-local dict above it stays for per-region deltas)
+_SYNC_COUNTER = None
+
+
 def count_sync(kind: str):
-    """Always-on per-thread census of device->host sync points — an int
-    increment, independent of whether the guard is armed. ``wait_to_read``
-    counts every NDArray-level sync (asnumpy/item route through it);
+    """Always-on census of device->host sync points — an int increment,
+    independent of whether the guard is armed. ``wait_to_read`` counts
+    every NDArray-level sync (asnumpy/item route through it);
     ``window_retire`` counts the engine's designed in-flight-window
-    boundary waits (engine.DispatchWindow). bench.py reads the delta over
-    a timed region to report ``host_sync_count``."""
+    boundary waits (engine.DispatchWindow). The per-thread dict feeds
+    region deltas (:func:`sync_counts`); the process-global
+    ``mx_guard_host_syncs_total{kind=}`` series feeds the telemetry
+    exporters (docs/OBSERVABILITY.md)."""
+    global _SYNC_COUNTER
     st = _STATE
     st.counts[kind] = st.counts.get(kind, 0) + 1
+    if _SYNC_COUNTER is None:
+        from ..telemetry import names as _tnames
+        from ..telemetry.registry import default as _treg
+        _SYNC_COUNTER = _treg().counter(_tnames.HOST_SYNCS,
+                                        label_key="kind")
+    _SYNC_COUNTER.inc(label=kind)
 
 
 def sync_counts() -> dict:

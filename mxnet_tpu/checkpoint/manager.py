@@ -24,6 +24,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ..base import MXNetError
@@ -33,6 +34,16 @@ from .state import TrainState, apply_train_state, capture_train_state
 __all__ = ["TrainCheckpointManager"]
 
 _LOG = logging.getLogger("mxnet_tpu.checkpoint")
+
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from .. import telemetry as _t
+        _TELEM = _t
+    return _TELEM
 
 
 def _dist_rank_size():
@@ -72,6 +83,12 @@ class TrainCheckpointManager:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._last_saved: Optional[int] = None
+        t = _telemetry()
+        reg = t.registry()
+        self._m_saves = reg.counter(t.names.CHECKPOINT_SAVES)
+        self._m_errors = reg.counter(t.names.CHECKPOINT_ERRORS)
+        self._m_capture = reg.histogram(t.names.CHECKPOINT_CAPTURE_SECONDS)
+        self._m_write = reg.histogram(t.names.CHECKPOINT_SAVE_SECONDS)
 
     @property
     def directory(self) -> str:
@@ -84,8 +101,10 @@ class TrainCheckpointManager:
         """Capture (synchronously) and persist (async unless
         ``block=True``/``async_save=False``) the full train state."""
         self.wait()   # one write in flight; surfaces any prior failure
+        t0 = time.perf_counter()
         state = capture_train_state(trainer=trainer, net=net, step=step,
                                     extra=extra)
+        self._m_capture.observe(time.perf_counter() - t0)
         sync = not self._async if block is None else block
         if sync:
             self._write(state)
@@ -107,14 +126,24 @@ class TrainCheckpointManager:
         except BaseException as e:   # propagate via wait()/next save()
             _LOG.error("async checkpoint write for step %d failed: %s",
                        state.step, e)
+            self._m_errors.inc()
             self._error = e
 
     def _write(self, state: TrainState):
+        t0 = time.perf_counter()
         atomic.write_checkpoint(self._root, state.step, state.arrays,
                                 array_meta=state.array_meta,
                                 meta=state.meta)
         self._last_saved = state.step
         atomic.prune_checkpoints(self._root, self._keep_last)
+        t1 = time.perf_counter()
+        self._m_write.observe(t1 - t0)
+        self._m_saves.inc()
+        t = _telemetry()
+        if t.active():
+            # runs on the background writer thread for async saves; the
+            # timeline ring + histogram are thread-safe
+            t.timeline().record("checkpoint", t0, t1, step=state.step)
 
     def wait(self):
         """Block until the in-flight write finishes; re-raise its error."""

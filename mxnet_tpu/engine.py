@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 import weakref
 from collections import deque
 from typing import Any, Callable, Optional
@@ -27,6 +28,19 @@ from typing import Any, Callable, Optional
 import jax
 
 from .base import MXNetError, get_env
+
+# telemetry is imported lazily (the package initializes subsystems in
+# dependency order) and cached; the registry half is always-on, the
+# span/watchdog half gates itself on MXNET_TELEMETRY
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from . import telemetry as _t
+        _TELEM = _t
+    return _TELEM
 
 __all__ = ["Engine", "get", "set_bulk_size", "bulk", "DispatchWindow",
            "inflight_steps"]
@@ -139,6 +153,15 @@ class DispatchWindow:
         self._pending: "deque[tuple]" = deque()
         self.stats = {"pushes": 0, "retires": 0, "errors": 0,
                       "max_pending": 0}
+        self._last_retire_t: Optional[float] = None
+        t = _telemetry()
+        reg = t.registry()
+        self._m_pushes = reg.counter(t.names.WINDOW_PUSHES)
+        self._m_retires = reg.counter(t.names.WINDOW_RETIRES)
+        self._m_errors = reg.counter(t.names.WINDOW_ERRORS)
+        self._m_occupancy = reg.gauge(t.names.WINDOW_OCCUPANCY)
+        self._m_capacity = reg.gauge(t.names.WINDOW_CAPACITY)
+        self._m_capacity.set(self.max_inflight)
         _live_windows.add(self)
 
     def __len__(self) -> int:
@@ -150,30 +173,65 @@ class DispatchWindow:
         retires (blocks until that step completed)."""
         st = self.stats
         st["pushes"] += 1
-        self._pending.append((tag, payload))
+        self._m_pushes.inc()
+        # re-assert per push: gauges survive telemetry.reset() zeroing
+        self._m_capacity.set(self.max_inflight)
+        self._pending.append((tag, payload, time.perf_counter()))
         if len(self._pending) > st["max_pending"]:
             st["max_pending"] = len(self._pending)
+        self._m_occupancy.set(len(self._pending))
         while len(self._pending) > self.max_inflight:
             self._retire_oldest()
 
     def _retire_oldest(self):
         from .analysis import guard as _tguard
-        tag, payload = self._pending.popleft()
+        tag, payload, t_push = self._pending.popleft()
+        self._m_occupancy.set(len(self._pending))
         _tguard.count_sync("window_retire")
+        t_wait = time.perf_counter()
         with _tguard.allow_transfers("dispatch-window retire"):
             try:
                 self._sync(payload)
             except MXNetError:
                 self.stats["errors"] += 1
+                self._m_errors.inc()
                 raise
             except Exception as e:
                 self.stats["errors"] += 1
+                self._m_errors.inc()
                 raise MXNetError(
                     f"async {self._what} "
                     f"{tag if tag is not None else '<untagged>'} failed "
                     f"(deferred error surfaced at its in-flight-window "
                     f"retire): {type(e).__name__}: {e}") from e
-        self.stats["retires"] += 1
+            self.stats["retires"] += 1
+            self._m_retires.inc()
+            # still inside the blessed retire region: the watchdog's
+            # NaN peek at the (already completed) payload is the one
+            # designed device->host read telemetry adds
+            self._observe_retire(tag, payload, t_push, t_wait)
+
+    def _observe_retire(self, tag, payload, t_push, t_wait):
+        """Step-timeline spans + watchdog feed for one retire — gated on
+        MXNET_TELEMETRY / an active profiler; must never kill a run."""
+        t = _telemetry()
+        try:
+            if not t.active():
+                self._last_retire_t = None
+                return
+            t_done = time.perf_counter()
+            tl = t.timeline()
+            tl.record("window", t_push, t_done, step=tag)
+            tl.record("retire", t_wait, t_done, step=tag)
+            dt = None if self._last_retire_t is None \
+                else t_done - self._last_retire_t
+            self._last_retire_t = t_done
+            if t.enabled():
+                t.watchdog().observe_retire(tag, payload=payload, dt=dt)
+        except Exception:            # pragma: no cover - defensive
+            import logging
+            logging.getLogger("mxnet_tpu.telemetry").warning(
+                "window retire telemetry failed", exc_info=True)
 
     def drain(self):
         """Retire every outstanding entry (WaitForVar on all of them);

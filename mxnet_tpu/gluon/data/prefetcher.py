@@ -43,6 +43,16 @@ __all__ = ["DevicePrefetcher", "default_prefetch_depth"]
 
 _DONE = object()
 
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from ... import telemetry as _t
+        _TELEM = _t
+    return _TELEM
+
 
 def default_prefetch_depth(default: int = 2) -> int:
     try:
@@ -90,6 +100,11 @@ class DevicePrefetcher:
         self.stats = {"prefetch_depth": self._depth,
                       "prefetch_batches": 0, "input_wait_ms": 0.0,
                       "starvation_count": 0}
+        t = _telemetry()
+        reg = t.registry()
+        self._m_batches = reg.counter(t.names.PREFETCH_BATCHES)
+        self._m_starved = reg.counter(t.names.PREFETCH_STARVATION)
+        self._m_wait = reg.counter(t.names.PREFETCH_INPUT_WAIT)
 
     @staticmethod
     def _resolve_device(device):
@@ -125,12 +140,40 @@ class DevicePrefetcher:
             return self._put(batch)
         return batch
 
+    # ---------------- telemetry ----------------
+    def _record_fetch(self, ordinal, t0, t1):
+        """batch_fetch span (source pull + device staging) — producer
+        side; ordinal is this prefetcher's batch number, the closest
+        step attribution the data layer has."""
+        t = _telemetry()
+        if t.active():
+            t.timeline().record("batch_fetch", t0, t1, step=ordinal)
+
+    def _record_wait(self, ordinal, t0, t1):
+        """h2d_wait span (consumer blocked on staged input)."""
+        self.stats["input_wait_ms"] += (t1 - t0) * 1e3
+        self._m_wait.inc(t1 - t0)
+        t = _telemetry()
+        if t.active():
+            t.timeline().record("h2d_wait", t0, t1, step=ordinal)
+
     # ---------------- iteration ----------------
     def __iter__(self):
         if self._depth == 0:
-            for batch in self._source:
+            it = iter(self._source)
+            n = 0
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                staged = self._stage(batch)
+                self._record_fetch(n, t0, time.perf_counter())
                 self.stats["prefetch_batches"] += 1
-                yield self._stage(batch)
+                self._m_batches.inc()
+                n += 1
+                yield staged
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self._depth)
@@ -138,8 +181,17 @@ class DevicePrefetcher:
 
         def produce():
             try:
-                for batch in self._source:
+                it = iter(self._source)
+                n = 0
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
                     staged = self._stage(batch)
+                    self._record_fetch(n, t0, time.perf_counter())
+                    n += 1
                     while not stop.is_set():
                         try:
                             q.put(staged, timeout=0.1)
@@ -162,9 +214,11 @@ class DevicePrefetcher:
                                   name="mx-device-prefetch")
         worker.start()
         try:
+            n = 0
             while True:
                 if q.empty():
                     self.stats["starvation_count"] += 1
+                    self._m_starved.inc()
                 t0 = time.perf_counter()
                 try:
                     item = q.get(timeout=self._timeout)
@@ -172,13 +226,14 @@ class DevicePrefetcher:
                     raise MXNetError(
                         f"DevicePrefetcher produced no batch within "
                         f"timeout={self._timeout}s") from None
-                self.stats["input_wait_ms"] += \
-                    (time.perf_counter() - t0) * 1e3
+                self._record_wait(n, t0, time.perf_counter())
                 if item is _DONE:
                     return
                 if isinstance(item, _Raised):
                     raise item.exc
                 self.stats["prefetch_batches"] += 1
+                self._m_batches.inc()
+                n += 1
                 yield item
         finally:
             stop.set()

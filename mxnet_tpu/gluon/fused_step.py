@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
@@ -74,6 +75,16 @@ from .block import ParamBinding, _TRACED
 __all__ = ["CompiledTrainStep", "TrainLoop"]
 
 _LOG = logging.getLogger("mxnet_tpu.fused_step")
+
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from .. import telemetry as _t
+        _TELEM = _t
+    return _TELEM
 
 _ARRAY_TYPES = (NDArray, onp.ndarray, jax.Array)
 
@@ -604,6 +615,8 @@ class CompiledTrainStep:
         entry = self._lru.get(sig)
         if entry is None:
             entry = self._build_bucket(arg_treedef, static_spec, nd_mask)
+            t = _telemetry()
+            t.registry().counter(t.names.COMPILE_RETRACES).inc()
             self._lru[sig] = entry
             self._trace_signatures.add(sig)
             self._sig_history.append(sig)
@@ -1048,6 +1061,42 @@ class CompiledTrainStep:
             entry["flops"] = None
         return entry["flops"]
 
+    # ---------------- telemetry (mx.telemetry MFU gauge) ----------------
+    def step_flops(self, *args, batch_size: Optional[int] = None,
+                   **kwargs):
+        """FLOPs of THIS batch bucket's compiled program, from XLA's
+        ``cost_analysis()`` — the numerator of the live MFU gauge
+        (docs/OBSERVABILITY.md). Reuses the AOT executable's count when
+        :meth:`aot_compile` ran; otherwise lowers+compiles the bucket
+        once via the cached :meth:`lower_entry` analysis artifact and
+        caches the count. Returns None on the eager path (no program)
+        or where cost_analysis is unavailable. For the split (dist
+        store) mode the count covers the grad program only — the update
+        program's FLOPs are negligible next to fwd+bwd."""
+        if self._mode is None:
+            self._mode = self._decide_mode()
+        if self._mode != "fused":
+            return None
+        entry, _ = self._entry_for(args, kwargs)
+        if entry.get("flops") is not None:
+            return entry["flops"]
+        if "flops_cost" in entry:
+            return entry["flops_cost"]
+        flops = None
+        try:
+            info = self.lower_entry(*args, batch_size=batch_size,
+                                    **kwargs)
+            if info is not None:
+                ca = info["lowered"].compile().cost_analysis()
+                ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                f = float(ca.get("flops", 0.0))
+                flops = f if f > 0 else None
+        except Exception as e:   # pragma: no cover - platform-dependent
+            _LOG.warning("step_flops: cost_analysis unavailable "
+                         "(%s: %s)", type(e).__name__, e)
+        entry["flops_cost"] = flops
+        return flops
+
 
 class TrainLoop:
     """Convenience wrapper for the canonical (net, loss, trainer) triple:
@@ -1108,6 +1157,8 @@ class TrainLoop:
                                               what="TrainLoop step")
         self._prefetcher = None
         self._global_step = 0
+        t = _telemetry()
+        self._m_steps = t.registry().counter(t.names.TRAIN_STEPS)
         self._every = checkpoint_every
         self._manager = None
         if checkpoint_dir is not None:
@@ -1136,8 +1187,23 @@ class TrainLoop:
         # a per-step metric asnumpy — is flagged/raised when
         # MXNET_TRANSFER_GUARD is armed
         with _tguard.hot_scope("TrainLoop.step"):
-            loss = self._step(*batch, batch_size=batch_size)
-            self._global_step += 1
+            t = _telemetry()
+            step_no = self._global_step + 1
+            if t.active():
+                # dispatch span + the XProf bridge: StepTraceAnnotation
+                # groups this step's device kernels under the same step
+                # number the host spans carry, so the merged trace
+                # aligns host phases with XLA execution
+                t0 = time.perf_counter()
+                with jax.profiler.StepTraceAnnotation(
+                        "mx_train_step", step_num=step_no):
+                    loss = self._step(*batch, batch_size=batch_size)
+                t.timeline().record("dispatch", t0,
+                                    time.perf_counter(), step=step_no)
+            else:
+                loss = self._step(*batch, batch_size=batch_size)
+            self._global_step = step_no
+            self._m_steps.inc()
             d = loss._data if isinstance(loss, NDArray) else loss
             self._window.push(d, tag=self._global_step)
             if self._manager is not None and self._every and \
@@ -1170,6 +1236,25 @@ class TrainLoop:
         self._prefetcher = DevicePrefetcher(
             batches, depth=depth, place=self._step.input_placement())
         return self._prefetcher
+
+    def arm_mfu(self, *batch, peak_flops: Optional[float] = None,
+                batch_size: Optional[int] = None) -> Optional[float]:
+        """Arm the live MFU gauge (``mx_model_mfu_ratio``): read this
+        batch bucket's FLOPs from XLA ``cost_analysis()``
+        (:meth:`CompiledTrainStep.step_flops`) into the telemetry
+        watchdog; ``peak_flops`` (FLOP/s — bench's measured roofline or
+        the chip's spec peak) arms the denominator. The watchdog then
+        updates flops/s and MFU on every window retire. Call OUTSIDE
+        the timed loop: the first call per bucket may pay one
+        lower+compile. Returns the per-step FLOPs (None where no
+        compiled program / cost model exists)."""
+        flops = self._step.step_flops(*batch, batch_size=batch_size)
+        wd = _telemetry().watchdog()
+        if flops:
+            wd.set_model_flops(flops)
+        if peak_flops:
+            wd.set_peak_flops(peak_flops)
+        return flops
 
     def engine_stats(self) -> dict:
         """Dispatch/prefetch observability: the in-flight window size and

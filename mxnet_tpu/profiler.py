@@ -11,10 +11,19 @@ stream — every imperative invoke, with dispatch wall time — dumped in Chrome
 tracing format (chrome://tracing / Perfetto), plus aggregate tables like the
 reference's ``dumps(); aggregate_stats=True``.
 
-Async caveat (same as the reference's "dispatch vs run" distinction): under
-the default async engine an event's duration is host dispatch time; run with
-MXNET_ENGINE_TYPE=NaiveEngine (every op synchronous) for true per-op wall
-time on small workloads.
+Async attribution (the reference's "dispatch vs run" distinction, made
+explicit in the events rather than a docstring caveat): under the default
+async engine an op event's duration is host DISPATCH time — the op
+returns before the device ran it — so every per-op event carries
+``args.phase = "dispatch"`` (``"sync"`` under MXNET_ENGINE_TYPE=
+NaiveEngine, where ops block until complete and the duration is true
+wall time). The moments work actually COMPLETES appear on the same
+timeline as the step-phase spans the telemetry subsystem records
+(``cat: "step"``: window residency push→retire and the blocking retire
+wait, stamped from ``engine.DispatchWindow``'s retire timestamps, plus
+batch_fetch/h2d_wait/dispatch/checkpoint) — see docs/OBSERVABILITY.md.
+So a Chrome trace of a pipelined run is honest: dispatch-time op slices,
+retire-time step boundaries, one merged stream.
 """
 from __future__ import annotations
 
@@ -64,16 +73,30 @@ class Profiler:
     # -- recording ---------------------------------------------------------
 
     def record(self, name: str, t_start: float, t_end: float,
-               cat: str = "operator"):
+               cat: str = "operator", args: Optional[dict] = None):
+        """Append one complete ('X') slice; ``args`` lands in the Chrome
+        event's args field — per-op events carry the dispatch/sync phase,
+        step spans carry {step, phase} (docs/OBSERVABILITY.md)."""
         if not self.running or self.paused:
             return
+        ev = {
+            "name": (self._scope + name) if self._scope else name,
+            "cat": cat, "ph": "X",
+            "ts": t_start * 1e6, "dur": (t_end - t_start) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+        }
+        if args:
+            ev["args"] = args
         with self._ev_lock:
-            self._events.append({
-                "name": (self._scope + name) if self._scope else name,
-                "cat": cat, "ph": "X",
-                "ts": t_start * 1e6, "dur": (t_end - t_start) * 1e6,
-                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
-            })
+            self._events.append(ev)
+
+    @staticmethod
+    def _op_phase() -> str:
+        """Honest attribution for per-op durations: host 'dispatch' time
+        under the async engine (the op returned before the device ran
+        it), true 'sync' wall time under NaiveEngine."""
+        from .engine import get as _engine_get
+        return "sync" if _engine_get().is_naive else "dispatch"
 
     def _invoke_wrapper(self, name, fn):
         prof = self
@@ -89,7 +112,8 @@ class Profiler:
                 with jax.profiler.TraceAnnotation(name):
                     return fn(*args, **kwargs)
             finally:
-                prof.record(name, t0, time.perf_counter())
+                prof.record(name, t0, time.perf_counter(),
+                            args={"phase": prof._op_phase()})
         return wrapped
 
     def _install_hook(self):

@@ -54,14 +54,20 @@ def setup_compile_cache() -> bool:
             pass
     try:
         from jax._src import monitoring as _mon
+        from .telemetry import names as _tnames
+        from .telemetry.registry import default as _treg
+        _hits = _treg().counter(_tnames.COMPILE_CACHE_HITS)
+        _misses = _treg().counter(_tnames.COMPILE_CACHE_MISSES)
 
         def _on_event(event: str, **kwargs):
             if event == "/jax/compilation_cache/cache_hits":
                 _CACHE_STATS["hits"] += 1
+                _hits.inc()
                 _LOG.info("compile cache HIT (%d so far) [%s]",
                           _CACHE_STATS["hits"], cache_dir)
             elif event == "/jax/compilation_cache/cache_misses":
                 _CACHE_STATS["misses"] += 1
+                _misses.inc()
                 _LOG.info("compile cache MISS (%d so far) — compiling, "
                           "will persist to %s",
                           _CACHE_STATS["misses"], cache_dir)
@@ -82,6 +88,21 @@ def compile_cache_stats() -> dict:
     """{'enabled', 'dir', 'hits', 'misses'} for the persistent
     compilation cache (tools/diagnose.py prints this)."""
     return dict(_CACHE_STATS)
+
+
+def _cache_collector(reg):
+    """Pull-model refresh for the compile-cache gauge at export time
+    (telemetry registers this; hits/misses increment live)."""
+    from .telemetry import names as _tnames
+    reg.gauge(_tnames.COMPILE_CACHE_ENABLED).set(
+        1.0 if _CACHE_STATS["enabled"] else 0.0)
+
+
+try:
+    from .telemetry.registry import default as _telemetry_registry
+    _telemetry_registry().register_collector(_cache_collector)
+except Exception:       # pragma: no cover - telemetry must not block
+    pass
 
 Feature = collections.namedtuple("Feature", ["name", "enabled"])
 

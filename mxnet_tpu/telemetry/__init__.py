@@ -1,0 +1,100 @@
+"""mx.telemetry — unified runtime telemetry (docs/OBSERVABILITY.md).
+
+Three cooperating pieces, replacing the scattered ad-hoc stats
+(``guard.sync_counts``, ``engine_stats()``, ``compile_cache_stats()``,
+hand-rolled bench plumbing) with one subsystem:
+
+1. **Step-timeline tracing** (:mod:`.timeline`): structured spans for a
+   train step's full lifecycle — batch fetch, prefetch h2d wait, host
+   dispatch, window residency, retire — recorded from instrumentation
+   points inside ``engine.DispatchWindow``, ``gluon.data
+   .DevicePrefetcher``, ``gluon.TrainLoop``, and
+   ``checkpoint.TrainCheckpointManager``, and emitted into the SAME
+   Chrome-trace stream as the profiler's per-op events.
+2. **Process-global metrics registry** (:mod:`.registry`): counters /
+   gauges / histograms with bounded cardinality, named exclusively from
+   the catalog in :mod:`.names`, behind pluggable exporters
+   (:mod:`.exporters`): JSON :func:`snapshot`, Prometheus text file,
+   periodic structured-log heartbeat.
+3. **MFU gauge + anomaly watchdog** (:mod:`.watchdog`): per-bucket
+   FLOPs from XLA ``cost_analysis()`` over measured step time, plus
+   NaN/inf-loss and step-time-stall detection piggybacked on window
+   retires.
+
+Cost model: registry counters/gauges are ALWAYS on (one uncontended
+lock + float update per event, no host syncs — the transfer guard is
+the enforcement mechanism). Span recording and the watchdog are gated
+by :func:`enabled` — ``MXNET_TELEMETRY=1`` or :func:`enable` — and the
+watchdog's NaN check adds one small device->host read per retire,
+inside the already-blessed retire sync.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import names
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       default as registry)
+from .timeline import PHASES, StepTimeline, timeline
+from .watchdog import Watchdog, stall_factor, watchdog
+from .exporters import (SCHEMA_VERSION, Heartbeat, heartbeat_interval,
+                        prometheus_file, prometheus_text, snapshot,
+                        start_heartbeat, stop_heartbeat,
+                        write_prometheus)
+
+__all__ = ["names", "registry", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "timeline", "StepTimeline", "PHASES",
+           "watchdog", "Watchdog", "stall_factor", "snapshot",
+           "prometheus_text", "write_prometheus", "prometheus_file",
+           "Heartbeat", "start_heartbeat", "stop_heartbeat",
+           "heartbeat_interval", "SCHEMA_VERSION", "enabled", "enable",
+           "value", "reset"]
+
+# every catalog series exists from import time: an exporter always shows
+# the full schema (zero is information; absence is a question)
+registry().ensure_catalog()
+
+_OVERRIDE: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Whether the gated (span/watchdog) half of telemetry is on:
+    ``MXNET_TELEMETRY`` truthy, or an :func:`enable` override. The
+    always-on registry counters do not consult this."""
+    if _OVERRIDE is not None:
+        return _OVERRIDE
+    v = os.environ.get("MXNET_TELEMETRY", "").strip().lower()
+    return v not in ("", "0", "off", "false", "no")
+
+
+def enable(on: bool = True):
+    """Programmatic override of ``MXNET_TELEMETRY`` (``enable(None)``
+    restores env control)."""
+    global _OVERRIDE
+    _OVERRIDE = on
+
+
+def active() -> bool:
+    """Span-recording gate for instrumentation points: telemetry is
+    enabled OR the host profiler is running (so a profiler session gets
+    step spans in its Chrome trace without MXNET_TELEMETRY)."""
+    if enabled():
+        return True
+    from ..profiler import Profiler
+    prof = Profiler.get()
+    return prof.running and not prof.paused
+
+
+def value(name: str, label: Optional[str] = None):
+    """Convenience read of one series from the default registry."""
+    return registry().value(name, label)
+
+
+def reset():
+    """Zero every metric, clear the timeline ring and the watchdog state
+    (registrations, cached metric objects, and collectors survive) —
+    the test/bench isolation hook."""
+    registry().reset()
+    timeline().clear()
+    watchdog().reset()

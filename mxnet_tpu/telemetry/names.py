@@ -1,0 +1,230 @@
+"""Metric-name catalog — the single source of truth for runtime telemetry.
+
+Every metric the framework registers lives HERE as a module constant,
+and the registry enforces it at registration time: a name must match the
+convention regex, and any ``mx_``-prefixed name must be declared in
+:data:`CATALOG` with the kind it is registered as.  Framework code never
+passes string literals to ``registry.counter/gauge/histogram`` — it
+imports the constant (the tier-1 lint sweep in
+tests/test_metric_names_lint.py greps for violations), so exporter
+cardinality cannot silently drift: a new series requires a catalog entry,
+which requires touching this file and docs/OBSERVABILITY.md.
+
+Naming convention (Prometheus-compatible):
+
+- ``<prefix>_<what>[_<unit>]``, lowercase snake case, >= 2 tokens
+  (:data:`NAME_RE`); the ``mx_`` prefix is RESERVED for catalog
+  entries — user code registers its own metrics under its own prefix;
+- counters end in ``_total``;
+- histograms end in a unit suffix (``_seconds``);
+- gauges end in neither ``_total`` nor ``_bucket`` (a unit suffix such
+  as ``_seconds`` is fine);
+- label keys are single, fixed per metric, with bounded value
+  cardinality (:data:`MAX_LABEL_VALUES`; overflow collapses into
+  :data:`OVERFLOW_LABEL`).
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["NAME_RE", "MAX_LABEL_VALUES", "OVERFLOW_LABEL", "CATALOG",
+           "is_valid", "kind_ok", "check"]
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+
+#: max distinct label values per labeled metric before new values
+#: collapse into OVERFLOW_LABEL (bounded exporter cardinality)
+MAX_LABEL_VALUES = 24
+OVERFLOW_LABEL = "other"
+
+# ---------------------------------------------------------------------------
+# engine / dispatch window
+# ---------------------------------------------------------------------------
+TRAIN_STEPS = "mx_train_steps_total"
+WINDOW_PUSHES = "mx_engine_window_pushes_total"
+WINDOW_RETIRES = "mx_engine_window_retires_total"
+WINDOW_ERRORS = "mx_engine_window_errors_total"
+WINDOW_OCCUPANCY = "mx_engine_window_occupancy"
+WINDOW_CAPACITY = "mx_engine_window_capacity"
+
+# ---------------------------------------------------------------------------
+# transfer guard (analysis/guard.py sync census)
+# ---------------------------------------------------------------------------
+HOST_SYNCS = "mx_guard_host_syncs_total"
+
+# ---------------------------------------------------------------------------
+# device input prefetch (gluon/data/prefetcher.py)
+# ---------------------------------------------------------------------------
+PREFETCH_BATCHES = "mx_prefetch_batches_total"
+PREFETCH_STARVATION = "mx_prefetch_starvation_total"
+PREFETCH_INPUT_WAIT = "mx_prefetch_input_wait_seconds_total"
+
+# ---------------------------------------------------------------------------
+# compilation (runtime.py persistent cache + fused_step retraces)
+# ---------------------------------------------------------------------------
+COMPILE_CACHE_HITS = "mx_compile_cache_hits_total"
+COMPILE_CACHE_MISSES = "mx_compile_cache_misses_total"
+COMPILE_CACHE_ENABLED = "mx_compile_cache_enabled"
+COMPILE_RETRACES = "mx_compile_retraces_total"
+
+# ---------------------------------------------------------------------------
+# checkpoint (checkpoint/manager.py)
+# ---------------------------------------------------------------------------
+CHECKPOINT_SAVES = "mx_checkpoint_saves_total"
+CHECKPOINT_ERRORS = "mx_checkpoint_errors_total"
+CHECKPOINT_CAPTURE_SECONDS = "mx_checkpoint_capture_seconds"
+CHECKPOINT_SAVE_SECONDS = "mx_checkpoint_save_seconds"
+
+# ---------------------------------------------------------------------------
+# step timeline (telemetry/timeline.py)
+# ---------------------------------------------------------------------------
+STEP_PHASE_SECONDS = "mx_step_phase_seconds"
+STEP_TIME_SECONDS = "mx_step_time_seconds"
+
+# ---------------------------------------------------------------------------
+# MFU gauge + anomaly watchdog (telemetry/watchdog.py)
+# ---------------------------------------------------------------------------
+MODEL_FLOPS_PER_STEP = "mx_model_flops_per_step"
+MODEL_FLOPS_PER_SEC = "mx_model_flops_per_sec"
+MFU = "mx_model_mfu_ratio"
+STEP_TIME_EWMA = "mx_watchdog_step_time_ewma_seconds"
+ANOMALIES = "mx_anomalies_total"
+
+# ---------------------------------------------------------------------------
+# telemetry self-observation (telemetry/exporters.py)
+# ---------------------------------------------------------------------------
+HEARTBEATS = "mx_telemetry_heartbeats_total"
+
+
+#: name -> {kind, help, label}: the complete set of series the framework
+#: may export. Registration of an unknown ``mx_*`` name raises.
+CATALOG = {
+    TRAIN_STEPS: dict(
+        kind="counter", label=None,
+        help="train steps dispatched through gluon.TrainLoop"),
+    WINDOW_PUSHES: dict(
+        kind="counter", label=None,
+        help="async results pushed into any DispatchWindow"),
+    WINDOW_RETIRES: dict(
+        kind="counter", label=None,
+        help="DispatchWindow FIFO retires (the designed blessed sync)"),
+    WINDOW_ERRORS: dict(
+        kind="counter", label=None,
+        help="deferred async failures surfaced at a window retire"),
+    WINDOW_OCCUPANCY: dict(
+        kind="gauge", label=None,
+        help="in-flight step futures currently outstanding"),
+    WINDOW_CAPACITY: dict(
+        kind="gauge", label=None,
+        help="configured in-flight window bound (MXNET_INFLIGHT_STEPS)"),
+    HOST_SYNCS: dict(
+        kind="counter", label="kind",
+        help="NDArray-level sync points by kind, process-wide across "
+             "ALL threads (wait_to_read includes data-pipeline host "
+             "reads on loader threads; window_retire = designed engine "
+             "waits; guard.sync_counts() gives the per-thread hot-loop "
+             "view)"),
+    PREFETCH_BATCHES: dict(
+        kind="counter", label=None,
+        help="batches staged device-side by DevicePrefetcher"),
+    PREFETCH_STARVATION: dict(
+        kind="counter", label=None,
+        help="times the consumer found the staging queue empty"),
+    PREFETCH_INPUT_WAIT: dict(
+        kind="counter", label=None,
+        help="cumulative consumer-side wait on staged input, seconds"),
+    COMPILE_CACHE_HITS: dict(
+        kind="counter", label=None,
+        help="persistent compilation cache hits (MXNET_COMPILE_CACHE)"),
+    COMPILE_CACHE_MISSES: dict(
+        kind="counter", label=None,
+        help="persistent compilation cache misses"),
+    COMPILE_CACHE_ENABLED: dict(
+        kind="gauge", label=None,
+        help="1 when the persistent compilation cache is armed"),
+    COMPILE_RETRACES: dict(
+        kind="counter", label=None,
+        help="new compiled shape buckets built by Trainer.compile_step"),
+    CHECKPOINT_SAVES: dict(
+        kind="counter", label=None,
+        help="checkpoints committed by TrainCheckpointManager"),
+    CHECKPOINT_ERRORS: dict(
+        kind="counter", label=None,
+        help="failed checkpoint writes (surfaced on next save/wait)"),
+    CHECKPOINT_CAPTURE_SECONDS: dict(
+        kind="histogram", label=None,
+        help="device->host state capture latency (pauses training)"),
+    CHECKPOINT_SAVE_SECONDS: dict(
+        kind="histogram", label=None,
+        help="serialize+fsync+commit latency (overlapped, background)"),
+    STEP_PHASE_SECONDS: dict(
+        kind="histogram", label="phase",
+        help="step-lifecycle phase durations (batch_fetch, h2d_wait, "
+             "dispatch, window, retire, checkpoint)"),
+    STEP_TIME_SECONDS: dict(
+        kind="histogram", label=None,
+        help="retire-to-retire step wall time (pipelined steady state)"),
+    MODEL_FLOPS_PER_STEP: dict(
+        kind="gauge", label=None,
+        help="XLA cost_analysis FLOPs of one compiled train step"),
+    MODEL_FLOPS_PER_SEC: dict(
+        kind="gauge", label=None,
+        help="flops_per_step / measured step time"),
+    MFU: dict(
+        kind="gauge", label=None,
+        help="model FLOPs utilization vs the configured roofline"),
+    STEP_TIME_EWMA: dict(
+        kind="gauge", label=None,
+        help="exponentially-weighted mean step time the stall detector "
+             "compares against"),
+    ANOMALIES: dict(
+        kind="counter", label="kind",
+        help="structured anomaly events by kind (nan_loss, stall)"),
+    HEARTBEATS: dict(
+        kind="counter", label=None,
+        help="periodic telemetry heartbeat log lines emitted"),
+}
+
+
+def is_valid(name: str) -> bool:
+    """Whether ``name`` matches the documented naming convention."""
+    return bool(NAME_RE.match(name))
+
+
+def kind_ok(name: str, kind: str) -> bool:
+    """Kind-suffix rules: counters end ``_total``, histograms end
+    ``_seconds``, gauges end in neither ``_total`` nor ``_bucket``."""
+    if kind == "counter":
+        return name.endswith("_total")
+    if kind == "histogram":
+        return name.endswith("_seconds")
+    if kind == "gauge":
+        return not name.endswith(("_total", "_bucket"))
+    return False
+
+
+def check(name: str, kind: str):
+    """Registration-time validation (raises ``MXNetError``): convention
+    regex + kind suffix for everyone; ``mx_``-prefixed names must also
+    be declared in :data:`CATALOG` with a matching kind."""
+    from ..base import MXNetError
+    if not is_valid(name):
+        raise MXNetError(
+            f"metric name {name!r} violates the telemetry naming "
+            f"convention {NAME_RE.pattern!r} (docs/OBSERVABILITY.md)")
+    if not kind_ok(name, kind):
+        raise MXNetError(
+            f"metric {name!r} registered as {kind} violates the kind-"
+            "suffix rule (counters *_total, histograms *_seconds; "
+            "docs/OBSERVABILITY.md)")
+    if name.startswith("mx_"):
+        decl = CATALOG.get(name)
+        if decl is None:
+            raise MXNetError(
+                f"metric {name!r} uses the framework prefix but is not "
+                "declared in mxnet_tpu/telemetry/names.py CATALOG — add "
+                "it there (single source of truth) before registering")
+        if decl["kind"] != kind:
+            raise MXNetError(
+                f"metric {name!r} declared as {decl['kind']} in the "
+                f"catalog but registered as {kind}")

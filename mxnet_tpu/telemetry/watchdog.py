@@ -1,0 +1,213 @@
+"""Live MFU gauge + anomaly watchdog, piggybacking on window retires.
+
+The watchdog is fed from exactly one hot-path site — the dispatch
+window's FIFO retire (engine.py), which is already the pipelined loop's
+ONE blessed host sync — so it adds no sync of its own:
+
+- **step time**: retire-to-retire wall time is the steady-state step
+  time of a pipelined run; it feeds the ``mx_step_time_seconds``
+  histogram and an EWMA gauge.
+- **MFU gauge**: per-bucket FLOPs from XLA ``cost_analysis()`` on the
+  already-compiled train step (``CompiledTrainStep.step_flops`` /
+  ``TrainLoop.arm_mfu``) divided by measured step time, against the
+  configured roofline (bench's measured or spec peak) —
+  ``mx_model_mfu_ratio``.
+- **NaN/inf-loss detection**: the retired payload IS the step's loss;
+  once the retire has blocked for completion, reading the small loss
+  buffer is one cheap device->host copy inside the already-blessed
+  retire region. An episode TRANSITION (finite -> non-finite) emits
+  exactly one structured ``nan_loss`` anomaly attributed to the step
+  number the window tagged — not one event per poisoned step after it.
+- **stall detection**: a retire whose step time exceeds
+  ``MXNET_WATCHDOG_STALL_FACTOR`` x the EWMA (after a minimum sample
+  count) emits one ``stall`` anomaly; the stalled sample is NOT folded
+  into the EWMA, and re-arming requires a normal step, so one artificial
+  stall produces exactly one event.
+
+Anomaly events are structured dicts ``{kind, step, message, value,
+time_unix}`` kept in a bounded ring (:meth:`Watchdog.anomalies`),
+counted in ``mx_anomalies_total{kind=}``, and logged as one JSON line
+on the ``mxnet_tpu.telemetry`` logger.
+
+Everything here is gated behind ``MXNET_TELEMETRY`` (telemetry.enabled)
+at the engine call site; when telemetry is off the watchdog never runs.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as onp
+
+from . import names
+from .registry import default as _default_registry
+
+__all__ = ["Watchdog", "watchdog", "stall_factor"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+#: EWMA smoothing for the reference step time
+_ALPHA = 0.2
+#: samples before the stall detector arms (lets compile/warmup settle)
+_MIN_SAMPLES = 5
+#: largest loss buffer (elements) the NaN check will fetch
+_MAX_FETCH = 1 << 20
+
+
+def stall_factor(default: float = 4.0) -> float:
+    """``MXNET_WATCHDOG_STALL_FACTOR``: a step slower than factor x the
+    EWMA step time raises a ``stall`` anomaly (docs/OBSERVABILITY.md)."""
+    try:
+        v = float(os.environ.get("MXNET_WATCHDOG_STALL_FACTOR", default))
+    except (TypeError, ValueError):
+        return default
+    return v if v > 1.0 else default
+
+
+class Watchdog:
+    """Process-global MFU gauge + NaN/stall anomaly detector."""
+
+    def __init__(self, max_events: int = 256):
+        self._lock = threading.Lock()
+        self._events: "deque[dict]" = deque(maxlen=max_events)
+        self._ewma: Optional[float] = None
+        self._samples = 0
+        self._nan_active = False
+        self._stall_active = False
+        self._flops: Optional[float] = None
+        self._peak: Optional[float] = None
+        reg = _default_registry()
+        self._c_anom = reg.counter(names.ANOMALIES, label_key="kind")
+        self._h_step = reg.histogram(names.STEP_TIME_SECONDS)
+        self._g_ewma = reg.gauge(names.STEP_TIME_EWMA)
+        self._g_flops = reg.gauge(names.MODEL_FLOPS_PER_STEP)
+        self._g_fps = reg.gauge(names.MODEL_FLOPS_PER_SEC)
+        self._g_mfu = reg.gauge(names.MFU)
+
+    # ---------------- configuration ----------------
+    def set_model_flops(self, flops_per_step: float):
+        """Arm the MFU numerator: XLA cost_analysis FLOPs of the ONE
+        compiled program the chip runs per step."""
+        with self._lock:
+            self._flops = float(flops_per_step)
+        self._g_flops.set(float(flops_per_step))
+
+    def set_peak_flops(self, peak_flops_per_sec: float):
+        """Arm the MFU denominator: the roofline in FLOP/s (bench's
+        measured matmul roofline, or the chip's spec peak)."""
+        with self._lock:
+            self._peak = float(peak_flops_per_sec)
+
+    @property
+    def model_flops(self) -> Optional[float]:
+        return self._flops
+
+    @property
+    def peak_flops(self) -> Optional[float]:
+        return self._peak
+
+    # ---------------- the retire hook ----------------
+    def observe_retire(self, step, payload=None,
+                       dt: Optional[float] = None):
+        """Called at each window retire (AFTER the blocking sync, inside
+        the blessed ``allow_transfers`` region). ``dt`` is the
+        retire-to-retire wall time (None on a window's first retire);
+        ``payload`` is the retired async result — inspected for
+        NaN/inf when it looks like a small float loss buffer."""
+        if dt is not None and dt > 0:
+            self._observe_step_time(step, dt)
+        if payload is not None:
+            self._check_finite(step, payload)
+
+    def _observe_step_time(self, step, dt: float):
+        self._h_step.observe(dt)
+        with self._lock:
+            ewma, samples = self._ewma, self._samples
+        factor = stall_factor()
+        if ewma is not None and samples >= _MIN_SAMPLES \
+                and dt > factor * ewma:
+            with self._lock:
+                fire = not self._stall_active
+                self._stall_active = True
+            if fire:
+                self._anomaly(
+                    "stall", step, value=dt,
+                    message=f"step {step} took {dt*1e3:.1f}ms, "
+                            f"> {factor:g}x the {ewma*1e3:.1f}ms EWMA "
+                            "step time")
+            # the stalled sample is NOT folded into the EWMA: the
+            # reference step time must not chase the pathology
+        else:
+            with self._lock:
+                self._stall_active = False
+                self._ewma = dt if self._ewma is None else \
+                    (1 - _ALPHA) * self._ewma + _ALPHA * dt
+                self._samples += 1
+                ewma = self._ewma
+                flops, peak = self._flops, self._peak
+            self._g_ewma.set(ewma)
+            if flops:
+                fps = flops / dt
+                self._g_fps.set(fps)
+                if peak:
+                    self._g_mfu.set(fps / peak)
+
+    def _check_finite(self, step, payload):
+        arr = getattr(payload, "_data", payload)   # NDArray -> jax.Array
+        dtype = getattr(arr, "dtype", None)
+        if dtype is None or getattr(arr, "size", _MAX_FETCH + 1) \
+                > _MAX_FETCH:
+            return
+        try:
+            if not onp.issubdtype(onp.dtype(dtype), onp.floating):
+                return
+            # the retire already blocked for completion; this is one
+            # small device->host copy inside the blessed retire region
+            finite = bool(onp.isfinite(onp.asarray(arr)).all())
+        except Exception:           # exotic payloads: never kill a run
+            return
+        with self._lock:
+            fire = not finite and not self._nan_active
+            self._nan_active = not finite
+        if fire:
+            self._anomaly(
+                "nan_loss", step, value=None,
+                message=f"non-finite loss first observed at step {step}")
+
+    # ---------------- events ----------------
+    def _anomaly(self, kind: str, step, message: str, value=None):
+        evt = {"kind": kind, "step": step, "message": message,
+               "value": value, "time_unix": time.time()}
+        with self._lock:
+            self._events.append(evt)
+        self._c_anom.inc(label=kind)
+        _LOG.warning("mx-anomaly %s", json.dumps(evt))
+
+    def anomalies(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return evs if kind is None else [e for e in evs
+                                         if e["kind"] == kind]
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self._ewma = None
+            self._samples = 0
+            self._nan_active = False
+            self._stall_active = False
+            self._flops = None
+            self._peak = None
+
+
+_watchdog = Watchdog()
+
+
+def watchdog() -> Watchdog:
+    """The process-global watchdog (``mx.telemetry.watchdog()``)."""
+    return _watchdog

@@ -1,0 +1,172 @@
+"""Tier-1 metric-name sweep: telemetry/names.py is the single source of
+truth for every series the framework exports.
+
+Exporter cardinality drifts silently when ad-hoc metric names appear at
+call sites — a per-shape or per-step label value, a counter named
+outside the convention, a series registered in one branch of one module
+that no dashboard knows about. This sweep pins the contract:
+
+- every catalog entry obeys the naming convention (regex + kind-suffix
+  rules);
+- framework code NEVER registers a metric by string literal — call
+  sites import the constant from ``telemetry/names.py``;
+- every catalog constant is referenced by live framework code (a dead
+  catalog entry would export a forever-zero series and hide the moment
+  its instrumentation point silently vanished);
+- the registry enforces the convention at runtime (invalid names,
+  undeclared ``mx_*`` names, and kind mismatches raise).
+"""
+import os
+import re
+
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry import names
+from mxnet_tpu.telemetry.registry import MetricsRegistry
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mxnet_tpu")
+NAMES_PY = os.path.join(PKG, "telemetry", "names.py")
+
+
+def _py_files():
+    for root, _dirs, files in os.walk(PKG):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# the catalog itself
+# ---------------------------------------------------------------------------
+
+def test_catalog_names_match_convention():
+    assert names.CATALOG, "catalog must not be empty"
+    for name, decl in names.CATALOG.items():
+        assert name.startswith("mx_"), \
+            f"catalog entry {name!r} must use the reserved mx_ prefix"
+        assert names.is_valid(name), \
+            f"catalog entry {name!r} violates {names.NAME_RE.pattern!r}"
+        assert names.kind_ok(name, decl["kind"]), \
+            (f"catalog entry {name!r} ({decl['kind']}) violates the "
+             "kind-suffix rule (counters *_total, histograms *_seconds)")
+        assert decl["help"], f"catalog entry {name!r} needs help text"
+
+
+def test_catalog_constants_unique():
+    consts = {k: v for k, v in vars(names).items()
+              if k.isupper() and isinstance(v, str)
+              and v.startswith("mx_")}
+    assert len(set(consts.values())) == len(consts), \
+        "two catalog constants share a metric name"
+    for const, value in consts.items():
+        assert value in names.CATALOG, \
+            f"names.{const} = {value!r} has no CATALOG declaration"
+
+
+# ---------------------------------------------------------------------------
+# call-site discipline across mxnet_tpu/
+# ---------------------------------------------------------------------------
+
+_LITERAL_REG = re.compile(
+    r"\.\s*(counter|gauge|histogram)\s*\(\s*[\"']")
+
+
+def test_no_string_literal_metric_registration():
+    """Framework code must register through names.py constants — a
+    literal at a call site bypasses the single source of truth."""
+    offenders = []
+    for path in _py_files():
+        src = _read(path)
+        for m in _LITERAL_REG.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            offenders.append(f"{os.path.relpath(path, REPO)}:{line}")
+    assert not offenders, (
+        "metric registered by string literal (declare the name in "
+        "mxnet_tpu/telemetry/names.py and import the constant — "
+        "docs/OBSERVABILITY.md):\n" + "\n".join(offenders))
+
+
+def test_every_catalog_constant_is_wired():
+    """Each constant must be referenced by an instrumentation point or
+    exporter OUTSIDE names.py — dead entries export forever-zero series
+    and hide a silently-removed instrumentation point."""
+    consts = {k for k, v in vars(names).items()
+              if k.isupper() and isinstance(v, str)
+              and v in names.CATALOG}
+    sources = [(_read(p), p) for p in _py_files()
+               if os.path.abspath(p) != NAMES_PY]
+    dead = []
+    for const in sorted(consts):
+        pat = re.compile(rf"\b{const}\b")
+        if not any(pat.search(src) for src, _ in sources):
+            dead.append(const)
+    assert not dead, (
+        "catalog constants referenced by NO framework code (remove the "
+        "entry or restore its instrumentation point): "
+        + ", ".join(dead))
+
+
+# ---------------------------------------------------------------------------
+# runtime enforcement (the registry is the gate)
+# ---------------------------------------------------------------------------
+
+def test_registry_rejects_convention_violations():
+    reg = MetricsRegistry()
+    with pytest.raises(MXNetError, match="naming convention"):
+        reg.counter("BadName_total")
+    with pytest.raises(MXNetError, match="naming convention"):
+        reg.counter("single")                 # needs >= 2 tokens
+    with pytest.raises(MXNetError, match="kind-suffix"):
+        reg.counter("my_events")              # counter without _total
+    with pytest.raises(MXNetError, match="kind-suffix"):
+        reg.histogram("my_latency_total")     # histogram without unit
+    with pytest.raises(MXNetError, match="kind-suffix"):
+        reg.gauge("my_level_total")           # gauge with _total
+
+
+def test_registry_rejects_undeclared_mx_names():
+    reg = MetricsRegistry()
+    with pytest.raises(MXNetError, match="single source of truth"):
+        reg.counter("mx_rogue_series_total")
+    # user prefixes stay open for extension
+    reg.counter("myapp_events_total")
+
+
+def test_registry_rejects_kind_and_label_drift():
+    reg = MetricsRegistry()
+    reg.counter(names.TRAIN_STEPS)
+    with pytest.raises(MXNetError, match="already registered"):
+        reg.gauge(names.TRAIN_STEPS)
+    with pytest.raises(MXNetError, match="declared"):
+        # catalog says HOST_SYNCS is labeled by 'kind'
+        reg.counter(names.HOST_SYNCS, label_key="step")
+    with pytest.raises(MXNetError, match="declared as histogram"):
+        # gauge *_seconds passes the suffix rule but not the catalog kind
+        reg.gauge(names.STEP_TIME_SECONDS)
+
+
+def test_default_registry_holds_only_cataloged_framework_names():
+    """After importing the framework and touching the instrumented
+    layers, every mx_* series in the default registry is cataloged."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.engine import DispatchWindow
+    from mxnet_tpu.gluon.data.prefetcher import DevicePrefetcher
+    DispatchWindow(max_inflight=1, sync_fn=lambda p: None)
+    list(DevicePrefetcher([(1,)], depth=0))
+    mx.analysis.guard.count_sync("wait_to_read")
+    for m in telemetry.registry().metrics():
+        assert m.name.startswith("mx_"), \
+            f"non-framework series {m.name!r} in the default registry"
+        assert m.name in names.CATALOG, \
+            f"registered series {m.name!r} missing from the catalog"
+        assert names.CATALOG[m.name]["kind"] == m.kind
