@@ -211,6 +211,27 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
         phase: {k: round(v, 3) for k, v in s.items()}
         for phase, s in telemetry.timeline().summary().items()}
     wd = telemetry.watchdog()
+    # space-domain fingerprint (docs/OBSERVABILITY.md "memory"): the
+    # compiled program's static peak, the census's live bytes by pool,
+    # and the measured per-replica optimizer-state bytes — a ZeRO leg
+    # must show the ~N× `optimizer` drop HERE, in measured bytes (the
+    # dryrun zero-sharded leg asserts it; these fields put the same
+    # numbers next to every BENCH throughput figure)
+    try:
+        mem_report = loop.compiled_step.memory_report(x_nd, y_nd)
+    except Exception as e:  # pragma: no cover - platform-dependent
+        log(f"bench[{tag}]: memory_report unavailable "
+            f"({type(e).__name__}: {e})")
+        mem_report = None
+    memory = {
+        "compiled_peak_bytes": mem_report.peak_bytes if mem_report
+        else None,
+        "compiled": mem_report.to_dict() if mem_report else None,
+        "live_bytes_by_pool":
+            telemetry.memory.census().live_bytes_by_pool(),
+        "optimizer_state_bytes":
+            loop.compiled_step.optimizer_state_bytes(),
+    }
     telem = {
         "mfu_gauge": telemetry.value(names.MFU),
         "flops_per_step": telemetry.value(names.MODEL_FLOPS_PER_STEP),
@@ -218,11 +239,14 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
                                  digits=3),
         "anomalies": len(wd.anomalies()),
         "phase_summary": phase_summary,
+        "memory": memory,
         "snapshot": telemetry.snapshot(),
     }
     log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f} "
         f"engine={engine} mfu_gauge={telem['mfu_gauge']} "
-        f"anomalies={telem['anomalies']}")
+        f"anomalies={telem['anomalies']} "
+        f"peak_bytes={memory['compiled_peak_bytes']} "
+        f"pools={memory['live_bytes_by_pool']}")
     analysis = analyze_framework_step(tag, loop, x_nd, y_nd)
     return dt, flops, loss, analysis, engine, telem
 

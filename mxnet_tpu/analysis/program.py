@@ -333,6 +333,12 @@ def analyze_lowered(lowered, mesh=None, expected_donated=None,
         mem = mem[0] if isinstance(mem, (list, tuple)) else mem
     except Exception:               # pragma: no cover - defensive
         mem = None
+    if mem is not None:
+        try:
+            from ..telemetry.memory import MemoryReport
+            report.memory = MemoryReport.from_compiled(compiled).to_dict()
+        except Exception:           # pragma: no cover - defensive
+            report.memory = None
     report.collectives = collective_census(hlo_text, mesh=mesh)
     report.donation = donation_audit(stablehlo, hlo_text, mem,
                                      expected=expected_donated)

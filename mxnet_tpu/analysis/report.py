@@ -162,6 +162,10 @@ class ProgramReport:
     findings: List[Finding] = field(default_factory=list)
     n_traces: int = 0
     meta: Dict[str, Any] = field(default_factory=dict)
+    #: compiled-program memory accounting (telemetry.MemoryReport
+    #: .to_dict(): argument/output/temp/generated_code/donated bytes +
+    #: peak estimate) — None where memory_analysis is unavailable
+    memory: Optional[Dict[str, int]] = None
 
     def add(self, finding: Finding):
         self.findings.append(finding)
@@ -207,6 +211,7 @@ class ProgramReport:
             "donation": self.donation.to_dict(),
             "host_transfers": len(self._unblessed(self.host_transfers)),
             "dtype_drift": len(self._unblessed(self.dtype_drift)),
+            "memory": self.memory,
             "findings": [str(f) for f in self.all_findings()],
         }
 
@@ -225,6 +230,14 @@ class ProgramReport:
         lines.append(f"  donation    : declared={d.declared} "
                      f"aliased={d.aliased} copied={len(d.copied)} "
                      f"bytes={d.donated_bytes}")
+        if self.memory:
+            m = self.memory
+            lines.append(f"  memory      : peak~{m['peak_bytes']} "
+                         f"(args={m['argument_bytes']} "
+                         f"temp={m['temp_bytes']} "
+                         f"out={m['output_bytes']} "
+                         f"code={m['generated_code_bytes']} "
+                         f"donated={m['donated_bytes']})")
         n_bless = len(self.host_transfers) + len(self.dtype_drift) \
             - len(self._unblessed(self.host_transfers)) \
             - len(self._unblessed(self.dtype_drift))

@@ -105,6 +105,12 @@ class TrainCheckpointManager:
         state = capture_train_state(trainer=trainer, net=net, step=step,
                                     extra=extra)
         self._m_capture.observe(time.perf_counter() - t0)
+        try:
+            # the capture copies live until the background write drops
+            # them — visible in the census `checkpoint` pool meanwhile
+            _telemetry().memory.census().register("checkpoint", state)
+        except Exception:        # pragma: no cover - census must never
+            pass                 # block a save
         sync = not self._async if block is None else block
         if sync:
             self._write(state)

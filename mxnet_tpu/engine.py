@@ -192,13 +192,20 @@ class DispatchWindow:
         with _tguard.allow_transfers("dispatch-window retire"):
             try:
                 self._sync(payload)
-            except MXNetError:
+            except MXNetError as e:
                 self.stats["errors"] += 1
                 self._m_errors.inc()
+                _telemetry().memory.maybe_record_oom(
+                    e, "dispatch-window retire", step=tag)
                 raise
             except Exception as e:
                 self.stats["errors"] += 1
                 self._m_errors.inc()
+                # a deferred RESOURCE_EXHAUSTED surfaces HERE, steps
+                # after the allocation that failed — write the ranked
+                # post-mortem before wrapping (telemetry/memory.py)
+                _telemetry().memory.maybe_record_oom(
+                    e, "dispatch-window retire", step=tag)
                 raise MXNetError(
                     f"async {self._what} "
                     f"{tag if tag is not None else '<untagged>'} failed "
@@ -228,6 +235,10 @@ class DispatchWindow:
             self._last_retire_t = t_done
             if t.enabled():
                 t.watchdog().observe_retire(tag, payload=payload, dt=dt)
+                # memory-budget headroom check, piggybacked on the same
+                # blessed retire (no sync of its own; no-op unless
+                # MXNET_MEMORY_BUDGET is set)
+                t.memory.maybe_check_budget(step=tag)
         except Exception:            # pragma: no cover - defensive
             import logging
             logging.getLogger("mxnet_tpu.telemetry").warning(

@@ -127,17 +127,28 @@ class DevicePrefetcher:
             return jax.device_put(d)
         return jax.device_put(d, self._device)
 
+    def _track(self, staged):
+        """File one staged device buffer in the census ``prefetch`` pool
+        (weakref — it leaves the pool when the consumer drops the
+        batch; the early-break release test counts on this)."""
+        try:
+            _telemetry().memory.census().register("prefetch", staged)
+        except Exception:        # pragma: no cover - census must never
+            pass                 # kill the producer thread
+        return staged
+
     def _stage(self, batch):
         """Recursively device_put a batch, preserving structure and
-        handle types (NDArray stays NDArray)."""
+        handle types (NDArray stays NDArray). Each staged device buffer
+        is tracked in the census ``prefetch`` pool."""
         if isinstance(batch, NDArray):
-            return NDArray(self._put(batch._data))
+            return self._track(NDArray(self._put(batch._data)))
         if isinstance(batch, (tuple, list)):
             return type(batch)(self._stage(b) for b in batch)
         if isinstance(batch, dict):
             return {k: self._stage(v) for k, v in batch.items()}
         if isinstance(batch, (onp.ndarray, jax.Array)):
-            return self._put(batch)
+            return self._track(self._put(batch))
         return batch
 
     # ---------------- telemetry ----------------
@@ -230,10 +241,26 @@ class DevicePrefetcher:
                 if item is _DONE:
                     return
                 if isinstance(item, _Raised):
+                    # a device_put that exhausted HBM is carried here
+                    # from the producer thread — record the post-mortem
+                    # at the seam the user actually sees
+                    _telemetry().memory.maybe_record_oom(
+                        item.exc, "prefetch staging", step=n)
                     raise item.exc
                 self.stats["prefetch_batches"] += 1
                 self._m_batches.inc()
                 n += 1
                 yield item
         finally:
+            # deterministic staging release: on early break / error the
+            # queue still holds up to `depth` staged device batches —
+            # stop the producer, then DROP the queued references so the
+            # census `prefetch` pool (and HBM) drains immediately
+            # instead of at whenever this generator is collected
             stop.set()
+            worker.join(timeout=5.0)
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass

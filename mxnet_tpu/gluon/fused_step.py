@@ -269,21 +269,25 @@ class _ZeroShardPlan:
     # ---------------- observability ----------------
     @staticmethod
     def _per_replica_bytes(a) -> int:
-        sh = getattr(a, "sharding", None)
-        if sh is not None:
-            try:
-                shp = sh.shard_shape(a.shape)
-                return int(onp.prod(shp)) * a.dtype.itemsize
-            except Exception:   # pragma: no cover - exotic shardings
-                pass
-        return int(a.size) * a.dtype.itemsize
+        """Addressable-shard bytes — delegates to the ONE accounting
+        helper the buffer census uses (telemetry/memory.py), so
+        ``state_bytes_per_replica`` and the census ``optimizer`` pool
+        agree byte-for-byte by construction."""
+        from ..telemetry.memory import device_bytes
+        return device_bytes(a)
 
     def state_bytes_per_replica(self) -> int:
+        """PER-REPLICA bytes of the sharded state + masters; every
+        buffer walked is (re-)filed in the census ``optimizer`` pool —
+        the walk IS the registration (one accounting path)."""
+        c = _telemetry().memory.census()
         total = 0
         for st in self.states:
             for s in st:
+                c.register("optimizer", s)
                 total += self._per_replica_bytes(s._data)
         for m in self.masters:
+            c.register("optimizer", m)
             total += self._per_replica_bytes(m._data)
         return total
 
@@ -331,6 +335,7 @@ class CompiledTrainStep:
         self._zero_mesh = mesh
         self._zero_ok: Optional[tuple] = None   # (mesh, axis) once decided
         self._zero: Optional[_ZeroShardPlan] = None
+        self._census_done = False
         self._plain_mesh: Optional[tuple] = None  # mesh-aware plain mode
         self._mesh_prepared = False
 
@@ -415,16 +420,108 @@ class CompiledTrainStep:
         master copies). Under the ZeRO-1 sharded mode each replica holds
         1/N of every state buffer; in the plain fused and eager modes
         state is fully replicated — the ratio between the two is the
-        memory the sharded update frees (~N× for Adam)."""
+        memory the sharded update frees (~N× for Adam). Accounting is
+        the census's own ``telemetry.memory.device_bytes`` and every
+        buffer walked is (re-)filed in the census ``optimizer`` pool,
+        so this number and ``census().live_bytes_by_pool()['optimizer']``
+        agree byte-for-byte (tests/test_memory.py pins it)."""
         if self._zero is not None:
             return self._zero.state_bytes_per_replica()
+        c = _telemetry().memory.census()
         total = 0
         for st in self._trainer._updater.states.values():
             for s in jax.tree_util.tree_leaves(
                     st, is_leaf=lambda x: isinstance(x, NDArray)):
                 if isinstance(s, NDArray):
+                    c.register("optimizer", s)
                     total += _ZeroShardPlan._per_replica_bytes(s._data)
         return total
+
+    def memory_report(self, *args, batch_size: Optional[int] = None,
+                      **kwargs):
+        """Static HBM footprint of the compiled step program
+        (:class:`~mxnet_tpu.telemetry.MemoryReport`): per shape-bucket
+        ``memory_analysis()`` — argument/output/temp/generated-code
+        bytes, donated alias bytes, peak estimate.
+
+        With a batch: that bucket's report (lower+compile once, cached
+        on the bucket entry; the AOT executable is reused when
+        :meth:`aot_compile` already built it). With NO arguments: the
+        field-wise max over every bucket analyzed so far (buckets run
+        one at a time, so the worst bucket is the run's headroom), or
+        ``None`` when none was. Eager mode: ``None`` — there is no
+        compiled program to attribute. Split (dist-store) mode covers
+        the grad program only. Each report also refreshes the
+        ``mx_hbm_compiled_bytes{component}`` / ``mx_hbm_peak_estimate_
+        bytes`` gauges and registers with the OOM forensics, so a
+        post-mortem dump names every bucket's static peak."""
+        t = _telemetry()
+        if not args and not kwargs:
+            reports = [e["memory"] for e in self._lru.values()
+                       if e.get("memory") is not None]
+            return t.memory.MemoryReport.merge(reports) if reports \
+                else None
+        if self._mode is None:
+            self._mode = self._decide_mode()
+        if self._mode != "fused":
+            return None
+        entry, _ = self._entry_for(args, kwargs)
+        if entry.get("memory") is not None:
+            return entry["memory"]
+        compiled = entry.get("exe")
+        if compiled is None:
+            info = self.lower_entry(*args, batch_size=batch_size,
+                                    **kwargs)
+            if info is None:
+                return None
+            compiled = info["lowered"].compile()
+        report = t.memory.MemoryReport.from_compiled(compiled)
+        entry["memory"] = report
+        n_buckets = sum(1 for e in self._lru.values()
+                        if e.get("memory") is not None)
+        t.memory.register_compiled_report(
+            f"{self._mode}:bucket{n_buckets}", report)
+        self._publish_hbm()
+        return report
+
+    def _publish_hbm(self):
+        """``mx_hbm_*`` gauges = field-wise max over analyzed buckets."""
+        t = _telemetry()
+        reports = [e["memory"] for e in self._lru.values()
+                   if e.get("memory") is not None]
+        if not reports:
+            return
+        merged = t.memory.MemoryReport.merge(reports)
+        reg = t.registry()
+        g = reg.gauge(t.names.HBM_COMPILED_BYTES)
+        for field in merged.FIELDS:
+            g.set(getattr(merged, field),
+                  label=field.replace("_bytes", ""))
+        reg.gauge(t.names.HBM_PEAK_BYTES).set(merged.peak_bytes)
+
+    def _register_census(self):
+        """File the step's long-lived device buffers in the live-buffer
+        census (telemetry/memory.py): parameters under ``params``,
+        optimizer state/masters under ``optimizer``. Weakref-based and
+        idempotent — one call after the first step covers the whole run
+        because writeback rebinds ``_data`` INSIDE the same handles."""
+        try:
+            c = _telemetry().memory.census()
+            for p in self._all_params:
+                if p._data is not None:
+                    c.register("params", p._data)
+            if self._zero is not None:
+                self._zero.state_bytes_per_replica()   # registers
+            else:
+                for st in self._trainer._updater.states.values():
+                    for s in jax.tree_util.tree_leaves(
+                            st, is_leaf=lambda x: isinstance(x, NDArray)):
+                        if isinstance(s, NDArray):
+                            c.register("optimizer", s)
+        except Exception:        # pragma: no cover - census must never
+            _LOG.debug("census registration failed", exc_info=True)
+            return                  # kill a step; retry next call
+        self._census_done = True
 
     # ---------------- mode decision ----------------
     def _decide_mode(self) -> str:
@@ -516,8 +613,14 @@ class CompiledTrainStep:
     def _guarded_call(self, args, kwargs, batch_size):
         if self._mode is None:
             self._mode = self._decide_mode()
+        t = _telemetry()
         if self._mode == "eager":
-            return self._eager_call(args, kwargs, batch_size)
+            with t.memory.oom_guard("CompiledTrainStep.step (eager)",
+                                    step=self._steps_done + 1):
+                out = self._eager_call(args, kwargs, batch_size)
+            if not self._census_done:
+                self._register_census()
+            return out
         opt = self._trainer._optimizer
         # first call: the trace may fail AFTER hyperparameter counts were
         # advanced — snapshot so the eager fallback replays step 1 as
@@ -525,7 +628,13 @@ class CompiledTrainStep:
         snapshot = (opt.num_update, dict(opt._index_update_count)) \
             if not self._steps_done else None
         try:
-            out = self._fused_call(args, kwargs, batch_size)
+            # the OOM seam: a RESOURCE_EXHAUSTED at compile or dispatch
+            # writes its ranked post-mortem BEFORE the fallback/raise
+            # machinery sees it (telemetry/memory.py)
+            with t.memory.oom_guard("CompiledTrainStep.step (compile/"
+                                    "dispatch)",
+                                    step=self._steps_done + 1):
+                out = self._fused_call(args, kwargs, batch_size)
         except Exception as e:
             if self._steps_done:
                 raise   # the program is proven; this is a genuine error
@@ -537,6 +646,8 @@ class CompiledTrainStep:
             self._mode = "eager"
             return self._eager_call(args, kwargs, batch_size)
         self._steps_done += 1
+        if not self._census_done:
+            self._register_census()
         return out
 
     step = __call__

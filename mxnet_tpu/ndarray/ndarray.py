@@ -106,6 +106,31 @@ class NDArray:
         return self._data.ndim
 
     @property
+    def nbytes(self) -> int:
+        """Logical bytes of the full array (size × itemsize)."""
+        return self.size * onp.dtype(str(self._data.dtype)).itemsize
+
+    @property
+    def device_nbytes(self) -> int:
+        """PER-REPLICA bytes this handle's backing buffer occupies on
+        one device: the addressable-shard footprint (1/N for
+        NamedSharding-partitioned buffers, full size when replicated) —
+        the accounting rule of the device-memory census
+        (``mx.telemetry.memory.device_bytes``)."""
+        from ..telemetry.memory import device_bytes
+        return device_bytes(self._data)
+
+    def track_memory(self, pool: str = "ndarray") -> "NDArray":
+        """File this handle in the live-buffer census
+        (``mx.telemetry.memory.census()``) under ``pool`` (default
+        ``ndarray`` — the user pool). Weakref-based: the buffer leaves
+        the census when the handle is collected. Returns ``self`` so it
+        chains: ``x = mx.nd.array(...).track_memory()``."""
+        from ..telemetry.memory import census
+        census().register(pool, self)
+        return self
+
+    @property
     def context(self) -> Context:
         if self._ctx is not None:
             return self._ctx
@@ -201,12 +226,19 @@ class NDArray:
     # ---------------- sync (engine semantics) ----------------
     def wait_to_read(self):
         """Block until the value is ready; async errors surface here
-        (reference NDArray::WaitToRead, engine exception rethrow)."""
+        (reference NDArray::WaitToRead, engine exception rethrow). A
+        deferred RESOURCE_EXHAUSTED surfacing at this sync point writes
+        its OOM post-mortem (telemetry/memory.py) before propagating."""
         if not _is_tracer(self._data):
             _tguard.count_sync("wait_to_read")
             if _tguard.armed():
                 _tguard.on_sync("wait_to_read", self._what())
-            jax.block_until_ready(self._data)
+            try:
+                jax.block_until_ready(self._data)
+            except Exception as e:
+                from ..telemetry.memory import maybe_record_oom
+                maybe_record_oom(e, "NDArray.wait_to_read")
+                raise
 
     wait_to_write = wait_to_read
 

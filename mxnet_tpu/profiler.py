@@ -226,23 +226,21 @@ def dump_memory(path: str = "memory.pprof") -> str:
 
 
 def memory_summary() -> dict:
-    """Per-device live-buffer byte totals (host-queryable summary of the
-    XLA allocator state; the aggregate the reference printed from its
-    storage profiler)."""
-    import jax
-    out = {}
-    for d in jax.local_devices():
-        try:
-            stats = d.memory_stats()
-        except Exception:
-            stats = None
-        if stats:
-            out[str(d)] = {
-                "bytes_in_use": stats.get("bytes_in_use"),
-                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
-                "bytes_limit": stats.get("bytes_limit"),
-            }
-    return out
+    """Per-device memory totals (the aggregate the reference printed
+    from its storage profiler), routed through the telemetry catalog:
+    each read refreshes the ``mx_mem_device_bytes_in_use`` /
+    ``_peak_bytes`` / ``_limit_bytes`` gauges instead of living in an
+    ad-hoc dict only this call ever saw.
+
+    Backends with allocator counters (TPU/GPU BFC) report
+    ``{bytes_in_use, peak_bytes_in_use, bytes_limit, source:
+    "allocator"}``. XLA:CPU exposes NO allocator stats — the documented
+    fallback prices every live ``jax.Array`` shard on its device
+    (``source: "live_arrays"``; peak/limit stay None because live
+    accounting has no high-water mark) rather than returning the silent
+    Nones this function used to."""
+    from .telemetry.memory import device_memory_stats
+    return device_memory_stats()
 
 
 def pause():

@@ -38,6 +38,8 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        default as registry)
 from .timeline import PHASES, StepTimeline, timeline
 from .watchdog import Watchdog, stall_factor, watchdog
+from . import memory
+from .memory import BufferCensus, MemoryReport, census
 from .exporters import (SCHEMA_VERSION, Heartbeat, heartbeat_interval,
                         prometheus_file, prometheus_text, snapshot,
                         start_heartbeat, stop_heartbeat,
@@ -49,7 +51,8 @@ __all__ = ["names", "registry", "MetricsRegistry", "Counter", "Gauge",
            "prometheus_text", "write_prometheus", "prometheus_file",
            "Heartbeat", "start_heartbeat", "stop_heartbeat",
            "heartbeat_interval", "SCHEMA_VERSION", "enabled", "enable",
-           "value", "reset"]
+           "value", "reset", "memory", "census", "BufferCensus",
+           "MemoryReport"]
 
 # every catalog series exists from import time: an exporter always shows
 # the full schema (zero is information; absence is a question)
@@ -94,7 +97,11 @@ def value(name: str, label: Optional[str] = None):
 def reset():
     """Zero every metric, clear the timeline ring and the watchdog state
     (registrations, cached metric objects, and collectors survive) —
-    the test/bench isolation hook."""
+    the test/bench isolation hook. The buffer census is NOT cleared:
+    its weakref pools track live objects, not accumulated values, so
+    zeroing would silently untrack still-live buffers registered once
+    at compile time (``memory.census().clear()`` exists for tests that
+    need a fresh census)."""
     registry().reset()
     timeline().clear()
     watchdog().reset()

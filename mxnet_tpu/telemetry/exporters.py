@@ -226,6 +226,7 @@ class Heartbeat:
         self.beats = 0
 
     def start(self) -> "Heartbeat":
+        _install_atexit()   # short runs still flush a final snapshot
         self._thread.start()
         return self
 
@@ -259,6 +260,35 @@ class Heartbeat:
 
 _active_heartbeat: Optional[Heartbeat] = None
 _hb_lock = threading.Lock()
+_atexit_installed = False
+
+
+def _install_atexit():
+    global _atexit_installed
+    if not _atexit_installed:
+        import atexit
+        atexit.register(_atexit_flush)
+        _atexit_installed = True
+
+
+def _atexit_flush():
+    """Final exporter flush at interpreter exit: a short run that exits
+    before the first heartbeat interval (or between intervals) still
+    leaves one last structured log line and a final
+    ``MXNET_PROMETHEUS_FILE`` snapshot on disk — a scraper never reads
+    a stale or absent file because the process was brief. With no
+    heartbeat running, a configured Prometheus file is still refreshed.
+    Never raises (exit paths must stay clean)."""
+    with _hb_lock:
+        hb = _active_heartbeat
+    try:
+        if hb is not None and hb.running:
+            hb.beat()
+            hb.stop()
+        elif prometheus_file():
+            write_prometheus()
+    except Exception:            # pragma: no cover - defensive
+        _LOG.warning("telemetry atexit flush failed", exc_info=True)
 
 
 def start_heartbeat(interval: Optional[float] = None,
@@ -280,3 +310,9 @@ def stop_heartbeat():
         hb, _active_heartbeat = _active_heartbeat, None
     if hb is not None:
         hb.stop()
+
+
+# the flush re-checks configuration at exit time (env may be set after
+# import), so installing unconditionally is a no-op for unconfigured
+# processes and a final-snapshot guarantee for configured ones
+_install_atexit()

@@ -1,0 +1,701 @@
+"""Device-memory observability: HBM accounting, buffer census, OOM forensics.
+
+PR 6 gave the runtime the TIME domain (step timeline, MFU watchdog);
+this module is the SPACE domain — where every HBM byte of a training
+run lives, measured, not asserted (docs/OBSERVABILITY.md "memory"):
+
+1. **Compiled-program memory report** (:class:`MemoryReport`): XLA's
+   ``compiled.memory_analysis()`` parsed into structured per-bucket
+   bytes — arguments, outputs, temps, generated code, donated aliases —
+   plus a peak-HBM estimate. Full-program compilation makes memory
+   statically attributable per executable (arXiv:1810.09868): the ONE
+   program the chip runs per step has ONE buffer assignment, so "will
+   this batch size fit" is a number, not a try-and-see. Exposed as
+   ``CompiledTrainStep.memory_report()``, merged into the analysis
+   ``ProgramReport``, published as ``mx_hbm_*`` gauges.
+
+2. **Live-buffer census** (:class:`BufferCensus`): weakref-based
+   registration of the framework's long-lived device buffers by POOL —
+   ``params`` (replicated weights), ``optimizer`` (momenta/moments/fp32
+   masters; 1/N per replica under ZeRO, arXiv:2004.13336), ``checkpoint``
+   (host capture copies awaiting serialization), ``prefetch`` (staged
+   input batches), ``ndarray`` (user-tracked handles). Weakrefs mean
+   registration is free of lifetime bugs: a buffer leaves its pool the
+   moment its handle is collected. ``reconcile()`` diffs the pools
+   against ``jax.live_arrays()`` and flags untracked device buffers as
+   suspected leaks. Accounting is PER-REPLICA (addressable-shard bytes)
+   via :func:`device_bytes` — the single helper
+   ``optimizer_state_bytes()`` / ``state_bytes_per_replica`` now share,
+   so the ZeRO N× state reduction is one measured number with one
+   definition everywhere.
+
+3. **Memory watchdog + budget**: per-device capacity from the backend's
+   allocator stats where available (``device_memory_stats()``, with a
+   documented live-array fallback on XLA:CPU); ``MXNET_MEMORY_BUDGET``
+   arms a headroom check piggybacked on window retires that emits
+   exactly ONE ``memory_budget`` anomaly per over-budget episode
+   through the PR 6 watchdog channel.
+
+4. **OOM forensics**: ``RESOURCE_EXHAUSTED`` caught at the compile and
+   dispatch seams (fused step, window retire, prefetch staging, NDArray
+   sync) writes one atomic ranked post-mortem JSON to
+   ``MXNET_MEMORY_DUMP_DIR`` — top live buffers by pool/shape/dtype,
+   per-bucket compiled peaks, window/ZeRO/batch sizing hints — and
+   emits exactly one ``oom`` anomaly per failure, however many seams
+   the exception propagates through (the exception object is marked).
+
+Cost model: registration is a weakref-set add (hot paths register a
+handle once); byte accounting walks the pools only when read (pull-model
+registry collector, budget check at retire, dumps). Nothing here ever
+adds a device->host sync — all numbers come from shapes/dtypes/shardings
+and allocator counters.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import weakref
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+import numpy as onp
+
+import jax
+
+from ..base import MXNetError
+from . import names
+from .registry import MetricsRegistry, default as _default_registry
+from .watchdog import watchdog as _watchdog
+
+__all__ = ["POOLS", "MemoryReport", "BufferCensus", "census",
+           "device_bytes", "device_memory_stats", "memory_budget",
+           "parse_budget", "maybe_check_budget", "dump_dir",
+           "is_resource_exhausted", "maybe_record_oom", "oom_guard",
+           "register_compiled_report", "compiled_reports"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+#: the census pool taxonomy (docs/OBSERVABILITY.md "memory"); earlier
+#: pools win when two pools reach the same physical buffer
+POOLS = ("params", "optimizer", "checkpoint", "prefetch", "ndarray")
+
+#: schema of the OOM post-mortem dump (golden-tested)
+DUMP_SCHEMA_VERSION = 1
+
+#: buffers listed in dumps / top_buffers()
+_TOP_N = 20
+
+
+# ---------------------------------------------------------------------------
+# byte accounting — the ONE helper every accounting path shares
+# ---------------------------------------------------------------------------
+
+def device_bytes(arr) -> int:
+    """PER-REPLICA bytes of one buffer: the addressable-shard footprint
+    of a ``jax.Array`` (full size for replicated arrays, 1/N for
+    NamedSharding-partitioned ones — the ZeRO state buffers), ``nbytes``
+    for host numpy. This is the single accounting rule behind the
+    census, ``CompiledTrainStep.optimizer_state_bytes()`` and
+    ``_ZeroShardPlan.state_bytes_per_replica()``."""
+    d = getattr(arr, "_data", arr)          # NDArray -> jax.Array
+    if d is None:
+        return 0
+    if isinstance(d, (onp.ndarray, onp.generic)):
+        return int(d.nbytes)
+    dtype = getattr(d, "dtype", None)
+    if dtype is None:
+        return 0
+    itemsize = onp.dtype(str(dtype)).itemsize if str(dtype) == "bfloat16" \
+        else dtype.itemsize
+    sh = getattr(d, "sharding", None)
+    if sh is not None:
+        try:
+            shp = sh.shard_shape(d.shape)
+            return int(onp.prod(shp)) * itemsize if shp else itemsize
+        except Exception:       # pragma: no cover - exotic shardings
+            pass
+    size = getattr(d, "size", None)
+    return int(size) * itemsize if size is not None else 0
+
+
+def _is_sharded(d) -> bool:
+    sh = getattr(d, "sharding", None)
+    if sh is None:
+        return False
+    try:
+        return tuple(sh.shard_shape(d.shape)) != tuple(d.shape)
+    except Exception:           # pragma: no cover - exotic shardings
+        return False
+
+
+# ---------------------------------------------------------------------------
+# compiled-program memory report
+# ---------------------------------------------------------------------------
+
+class MemoryReport:
+    """Structured view of one compiled executable's
+    ``memory_analysis()`` (XLA's static buffer assignment):
+
+    - ``argument_bytes`` / ``output_bytes`` — program I/O buffers;
+    - ``temp_bytes`` — XLA-allocated intermediates (the activations /
+      workspace the batch size drives);
+    - ``generated_code_bytes`` — the executable itself in HBM;
+    - ``donated_bytes`` — argument bytes aliased into outputs
+      (donation working: these are NOT paid twice);
+    - ``peak_bytes`` — the headroom estimate:
+      ``argument + output + temp + generated_code - donated``.
+    """
+
+    FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+              "generated_code_bytes", "donated_bytes")
+
+    def __init__(self, argument_bytes: int = 0, output_bytes: int = 0,
+                 temp_bytes: int = 0, generated_code_bytes: int = 0,
+                 donated_bytes: int = 0):
+        self.argument_bytes = int(argument_bytes)
+        self.output_bytes = int(output_bytes)
+        self.temp_bytes = int(temp_bytes)
+        self.generated_code_bytes = int(generated_code_bytes)
+        self.donated_bytes = int(donated_bytes)
+
+    @property
+    def peak_bytes(self) -> int:
+        return max(0, self.argument_bytes + self.output_bytes
+                   + self.temp_bytes + self.generated_code_bytes
+                   - self.donated_bytes)
+
+    @classmethod
+    def from_compiled(cls, compiled) -> "MemoryReport":
+        """Parse ``compiled.memory_analysis()`` (a
+        ``CompiledMemoryStats``; lists of per-device stats take the
+        first entry — SPMD programs share one buffer assignment)."""
+        mem = compiled.memory_analysis()
+        mem = mem[0] if isinstance(mem, (list, tuple)) else mem
+        get = lambda k: int(getattr(mem, k, 0) or 0)     # noqa: E731
+        return cls(argument_bytes=get("argument_size_in_bytes"),
+                   output_bytes=get("output_size_in_bytes"),
+                   temp_bytes=get("temp_size_in_bytes"),
+                   generated_code_bytes=get("generated_code_size_in_bytes"),
+                   donated_bytes=get("alias_size_in_bytes"))
+
+    @classmethod
+    def merge(cls, reports: List["MemoryReport"]) -> "MemoryReport":
+        """Field-wise max over shape buckets: buckets run one at a time,
+        so the headroom a mixed-shape run needs is the worst bucket's."""
+        out = cls()
+        for r in reports:
+            for f in cls.FIELDS:
+                setattr(out, f, max(getattr(out, f), getattr(r, f)))
+        return out
+
+    def to_dict(self) -> dict:
+        d = {f: getattr(self, f) for f in self.FIELDS}
+        d["peak_bytes"] = self.peak_bytes
+        return d
+
+    def __repr__(self):
+        return (f"MemoryReport(peak={self.peak_bytes}, "
+                f"args={self.argument_bytes}, temp={self.temp_bytes}, "
+                f"donated={self.donated_bytes})")
+
+
+#: tag -> MemoryReport dict of recently compiled programs (bounded), so
+#: an OOM dump can name every bucket's static peak
+_compiled_reports: "Dict[str, dict]" = {}
+_compiled_lock = threading.Lock()
+_COMPILED_CAP = 32
+
+
+def register_compiled_report(tag: str, report: "MemoryReport"):
+    """Record one compiled program's memory report for OOM forensics
+    (``CompiledTrainStep.memory_report`` calls this per shape bucket)."""
+    with _compiled_lock:
+        if tag in _compiled_reports:
+            _compiled_reports.pop(tag)
+        elif len(_compiled_reports) >= _COMPILED_CAP:
+            _compiled_reports.pop(next(iter(_compiled_reports)))
+        _compiled_reports[tag] = report.to_dict()
+
+
+def compiled_reports() -> Dict[str, dict]:
+    with _compiled_lock:
+        return dict(_compiled_reports)
+
+
+# ---------------------------------------------------------------------------
+# live-buffer census
+# ---------------------------------------------------------------------------
+
+def _leaf_arrays(handle):
+    """The raw buffers one registered handle owns: NDArray -> its
+    jax.Array; a checkpoint ``TrainState`` -> its host numpy arrays;
+    raw jax/numpy arrays pass through."""
+    arrays = getattr(handle, "arrays", None)
+    if isinstance(arrays, dict):                 # checkpoint.TrainState
+        return list(arrays.values())
+    d = getattr(handle, "_data", handle)         # NDArray or raw array
+    return [] if d is None else [d]
+
+
+def _buffer_info(d, pool: str) -> dict:
+    return {"pool": pool,
+            "shape": list(getattr(d, "shape", ()) or ()),
+            "dtype": str(getattr(d, "dtype", "?")),
+            "bytes": device_bytes(d),
+            "sharded": _is_sharded(d),
+            "host": isinstance(d, (onp.ndarray, onp.generic))}
+
+
+class BufferCensus:
+    """Pool-tagged weakref registry of the framework's live buffers.
+
+    ``register(pool, handle)`` files a weak reference to a HANDLE — an
+    ``NDArray`` (whose ``_data`` rebinds per step while the handle
+    survives, so one registration covers a donated buffer's whole
+    lifetime), a raw ``jax.Array``, or a checkpoint ``TrainState``.
+    Reads (:meth:`live_bytes_by_pool`, :meth:`buffers`,
+    :meth:`reconcile`) walk the surviving weakrefs and price each
+    underlying buffer once — a buffer reachable from two pools counts
+    toward the earlier pool in :data:`POOLS`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # id-keyed (NOT WeakSet: set membership would hash/== the
+        # referents, and NDArray's elementwise __eq__ makes that raise)
+        self._pools: Dict[str, "weakref.WeakValueDictionary"] = {
+            p: weakref.WeakValueDictionary() for p in POOLS}
+
+    def register(self, pool: str, handle) -> bool:
+        """File ``handle`` under ``pool``; idempotent; returns False for
+        handles that cannot be weak-referenced (plain tuples etc.)."""
+        if pool not in self._pools:
+            raise MXNetError(
+                f"unknown census pool {pool!r}; the taxonomy is {POOLS} "
+                "(docs/OBSERVABILITY.md)")
+        try:
+            with self._lock:
+                self._pools[pool][id(handle)] = handle
+            return True
+        except TypeError:
+            return False
+
+    def clear(self):
+        """Drop every registration (test isolation; live handles are
+        NOT re-registered — their owners re-file on next accounting)."""
+        with self._lock:
+            for s in self._pools.values():
+                s.clear()
+
+    # ---------------- accounting ----------------
+    def _collect(self) -> Dict[str, Dict[int, dict]]:
+        """pool -> {id(buffer): info}, deduped across pools by POOLS
+        precedence (a buffer never counts twice)."""
+        with self._lock:
+            handles = {p: list(s.values()) for p, s in self._pools.items()}
+        seen: set = set()
+        out: Dict[str, Dict[int, dict]] = {}
+        for pool in POOLS:
+            bufs: Dict[int, dict] = {}
+            for h in handles[pool]:
+                for d in _leaf_arrays(h):
+                    k = id(d)
+                    if k in seen:
+                        continue
+                    seen.add(k)
+                    bufs[k] = _buffer_info(d, pool)
+            out[pool] = bufs
+        return out
+
+    def live_bytes_by_pool(self) -> Dict[str, int]:
+        """Current per-replica bytes per pool (every pool present, 0
+        when empty) — the measured form of the ZeRO paper's state-memory
+        claim: compare ``optimizer`` here between the plain and sharded
+        modes."""
+        c = self._collect()
+        return {p: sum(i["bytes"] for i in c[p].values()) for p in POOLS}
+
+    def live_count_by_pool(self) -> Dict[str, int]:
+        c = self._collect()
+        return {p: len(c[p]) for p in POOLS}
+
+    def buffers(self, pool: Optional[str] = None) -> List[dict]:
+        """Live buffer infos (``{pool, shape, dtype, bytes, sharded,
+        host}``), biggest first."""
+        c = self._collect()
+        pools = (pool,) if pool is not None else POOLS
+        out = [i for p in pools for i in c.get(p, {}).values()]
+        return sorted(out, key=lambda i: -i["bytes"])
+
+    def top_buffers(self, n: int = _TOP_N) -> List[dict]:
+        return self.buffers()[:n]
+
+    # ---------------- reconciliation ----------------
+    def reconcile(self) -> dict:
+        """Diff the pools against ``jax.live_arrays()``: device buffers
+        alive in the process but claimed by NO pool are suspected leaks
+        (or untracked user arrays). Host (numpy) pool entries are
+        outside jax's view and excluded from the diff."""
+        c = self._collect()
+        tracked_ids = {k for bufs in c.values() for k in bufs}
+        untracked = []
+        total = 0
+        try:
+            live = jax.live_arrays()
+        except Exception:       # pragma: no cover - defensive
+            live = []
+        for a in live:
+            if id(a) in tracked_ids:
+                continue
+            info = _buffer_info(a, "untracked")
+            untracked.append(info)
+            total += info["bytes"]
+        untracked.sort(key=lambda i: -i["bytes"])
+        return {
+            "by_pool": {p: sum(i["bytes"] for i in c[p].values())
+                        for p in POOLS},
+            "counts": {p: len(c[p]) for p in POOLS},
+            "untracked": {"count": len(untracked), "bytes": total,
+                          "top": untracked[:_TOP_N]},
+        }
+
+    # ---------------- registry publication ----------------
+    def publish(self, registry: Optional[MetricsRegistry] = None):
+        """Refresh the ``mx_mem_pool_*`` / ``mx_mem_untracked_bytes``
+        gauges from the current census (the pull-model collector
+        exporters run before every export)."""
+        reg = registry if registry is not None else _default_registry()
+        rec = self.reconcile()
+        g_bytes = reg.gauge(names.MEM_POOL_BYTES)
+        g_count = reg.gauge(names.MEM_POOL_BUFFERS)
+        for p in POOLS:
+            g_bytes.set(rec["by_pool"][p], label=p)
+            g_count.set(rec["counts"][p], label=p)
+        reg.gauge(names.MEM_UNTRACKED_BYTES).set(
+            rec["untracked"]["bytes"])
+
+
+_census = BufferCensus()
+
+
+def census() -> BufferCensus:
+    """The process-global buffer census (``mx.telemetry.memory.census()``)."""
+    return _census
+
+
+def _collector(reg: MetricsRegistry):
+    """Registry pull-model collector: census pools + device stats are
+    refreshed before every snapshot/Prometheus export."""
+    _census.publish(reg)
+    device_memory_stats(registry=reg)
+    b = memory_budget()
+    if b is not None:
+        reg.gauge(names.MEM_BUDGET_BYTES).set(b)
+
+
+# ---------------------------------------------------------------------------
+# device capacity + budget watchdog
+# ---------------------------------------------------------------------------
+
+def device_memory_stats(registry: Optional[MetricsRegistry] = None
+                        ) -> Dict[str, dict]:
+    """Per-device memory stats, routed through the telemetry catalog
+    (``mx_mem_device_*`` gauges): allocator counters
+    (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``,
+    ``source: "allocator"``) where the backend exposes them (TPU/GPU
+    BFC). XLA:CPU exposes NO allocator stats — instead of the silent
+    ``None``s the old profiler dict carried, the documented fallback
+    prices every ``jax.live_arrays()`` shard on its device
+    (``source: "live_arrays"``; ``peak_bytes_in_use``/``bytes_limit``
+    stay None — live accounting has no high-water mark)."""
+    reg = registry if registry is not None else _default_registry()
+    out: Dict[str, dict] = {}
+    fallback_devices = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(d)] = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+                "source": "allocator",
+            }
+        else:
+            fallback_devices.append(str(d))
+    if fallback_devices:
+        per_dev: Dict[str, int] = {k: 0 for k in fallback_devices}
+        try:
+            for a in jax.live_arrays():
+                for shard in getattr(a, "addressable_shards", []):
+                    k = str(shard.device)
+                    if k in per_dev:
+                        per_dev[k] += device_bytes(shard.data)
+        except Exception:       # pragma: no cover - defensive
+            pass
+        for k in fallback_devices:
+            out[k] = {"bytes_in_use": per_dev.get(k, 0),
+                      "peak_bytes_in_use": None, "bytes_limit": None,
+                      "source": "live_arrays"}
+    g_use = reg.gauge(names.MEM_DEVICE_IN_USE)
+    g_peak = reg.gauge(names.MEM_DEVICE_PEAK)
+    g_lim = reg.gauge(names.MEM_DEVICE_LIMIT)
+    for k, s in out.items():
+        g_use.set(s["bytes_in_use"] or 0, label=k)
+        g_peak.set(-1 if s["peak_bytes_in_use"] is None
+                   else s["peak_bytes_in_use"], label=k)
+        g_lim.set(-1 if s["bytes_limit"] is None else s["bytes_limit"],
+                  label=k)
+    return out
+
+
+_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_budget(value: str,
+                 capacity: Optional[int] = None) -> Optional[int]:
+    """Parse a ``MXNET_MEMORY_BUDGET`` value: plain bytes (``8589934592``),
+    a K/M/G/T-suffixed size (``28g``, ``500MB``), or a strict fraction
+    in (0, 1) of the device capacity (``0.9`` — only meaningful when
+    the backend reports ``bytes_limit``). Returns None for
+    unset/unparsable."""
+    v = (value or "").strip().lower()
+    if not v:
+        return None
+    mult = 1
+    if v.endswith("b"):
+        v = v[:-1]
+    if v and v[-1] in _SUFFIX:
+        mult = _SUFFIX[v[-1]]
+        v = v[:-1]
+    try:
+        f = float(v)
+    except ValueError:
+        return None
+    if f <= 0:
+        return None
+    if mult == 1 and f < 1.0:
+        return int(f * capacity) if capacity else None
+    return int(f * mult)
+
+
+def _device_capacity() -> Optional[int]:
+    cap = None
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        lim = (stats or {}).get("bytes_limit")
+        if lim:
+            cap = lim if cap is None else min(cap, lim)
+    return cap
+
+
+def memory_budget() -> Optional[int]:
+    """The configured headroom bound in bytes (``MXNET_MEMORY_BUDGET``),
+    or None when unset."""
+    raw = os.environ.get("MXNET_MEMORY_BUDGET")
+    if not raw:
+        return None
+    return parse_budget(raw, capacity=_device_capacity())
+
+
+def maybe_check_budget(step=None) -> Optional[dict]:
+    """The retire-piggybacked headroom check (engine.DispatchWindow
+    feeds this when telemetry is enabled): no-op when
+    ``MXNET_MEMORY_BUDGET`` is unset. In-use bytes come from the
+    allocator's worst device where stats exist, else the census pools
+    (tracked buffers only — the cheap hot-path number). Exceeding the
+    budget emits exactly one ``memory_budget`` anomaly per episode via
+    the watchdog channel; dropping back under re-arms."""
+    budget = memory_budget()
+    if budget is None:
+        return None
+    in_use = None
+    source = "allocator"
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        b = (stats or {}).get("bytes_in_use")
+        if b is not None:
+            in_use = b if in_use is None else max(in_use, b)
+    if in_use is None:
+        by_pool = _census.live_bytes_by_pool()
+        in_use = sum(by_pool.values())
+        source = "census"
+    over = in_use > budget
+    reg = _default_registry()
+    reg.gauge(names.MEM_BUDGET_BYTES).set(budget)
+    top = ""
+    if over:
+        by_pool = _census.live_bytes_by_pool()
+        if any(by_pool.values()):
+            pool = max(by_pool, key=by_pool.get)
+            top = f"; largest pool: {pool} ({by_pool[pool]} B)"
+    _watchdog().episode(
+        "memory_budget", over, step=step, value=in_use,
+        message=(f"device memory {in_use} B exceeds the "
+                 f"MXNET_MEMORY_BUDGET of {budget} B "
+                 f"({source} accounting){top}") if over else "")
+    return {"budget": budget, "in_use": in_use, "over": over,
+            "source": source}
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def dump_dir() -> Optional[str]:
+    """``MXNET_MEMORY_DUMP_DIR`` (None = no post-mortem files; the
+    ``oom`` anomaly event still fires)."""
+    return os.environ.get("MXNET_MEMORY_DUMP_DIR") or None
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM when allocating", "Resource exhausted")
+
+
+def _exc_chain(exc):
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        yield exc
+        exc = exc.__cause__ or exc.__context__
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """Whether ``exc`` (or anything in its cause chain) is an XLA
+    allocation failure."""
+    for e in _exc_chain(exc):
+        if type(e).__name__ == "XlaRuntimeError" and "RESOURCE" in str(e):
+            return True
+        msg = str(e)
+        if any(m in msg for m in _OOM_MARKERS):
+            return True
+    return False
+
+
+def _sizing_hints(by_pool: Dict[str, int], compiled: Dict[str, dict],
+                  budget: Optional[int]) -> List[str]:
+    """Actionable knobs ranked by what the census says dominates."""
+    hints = []
+    opt, params = by_pool.get("optimizer", 0), by_pool.get("params", 0)
+    sharded_opt = any(i["sharded"]
+                      for i in _census.buffers("optimizer"))
+    if opt and not sharded_opt and opt >= params / 2:
+        hints.append(
+            "optimizer state is fully replicated: enable the ZeRO-1 "
+            "sharded update (compile_step on a dp mesh, zero_shard=True) "
+            "for an ~N-per-replica reduction (docs/PERF_NOTES.md)")
+    if by_pool.get("prefetch", 0):
+        hints.append(
+            "staged input batches hold HBM: lower MXNET_DEVICE_PREFETCH "
+            "and/or MXNET_INFLIGHT_STEPS to shrink the in-flight window")
+    peak = max((r.get("peak_bytes", 0) for r in compiled.values()),
+               default=0)
+    temp = max((r.get("temp_bytes", 0) for r in compiled.values()),
+               default=0)
+    if temp and temp >= peak / 2:
+        hints.append(
+            "XLA temp buffers (activations/workspace) dominate the "
+            "compiled peak: reduce the batch size or enable remat "
+            "(hybridize(backend='remat'))")
+    if by_pool.get("checkpoint", 0):
+        hints.append(
+            "a checkpoint capture is in flight: stagger checkpoint_every "
+            "away from peak-memory steps, or save with block=True")
+    if budget is not None:
+        hints.append(
+            f"MXNET_MEMORY_BUDGET is {budget} B: re-run with "
+            "tools/diagnose.py --memory to see standing headroom")
+    if not hints:
+        hints.append(
+            "inspect top_buffers below; XLA_PYTHON_CLIENT_MEM_FRACTION "
+            "bounds the allocator if the host shares the device")
+    return hints
+
+
+def maybe_record_oom(exc: BaseException, seam: str,
+                     step=None) -> Optional[str]:
+    """OOM post-mortem: if ``exc`` is an allocation failure not already
+    handled at an inner seam, emit exactly one ``oom`` anomaly and write
+    one ranked dump file (atomic tmp+rename) to
+    ``MXNET_MEMORY_DUMP_DIR``. Returns the dump path (None when no dump
+    was written). Never raises — forensics must not mask the original
+    error."""
+    try:
+        if not is_resource_exhausted(exc):
+            return None
+        for e in _exc_chain(exc):
+            if getattr(e, "_mx_oom_handled", False):
+                return None
+        try:
+            exc._mx_oom_handled = True
+        except Exception:        # pragma: no cover - frozen exc types
+            pass
+        rec = _census.reconcile()
+        by_pool = rec["by_pool"]
+        compiled = compiled_reports()
+        budget = memory_budget()
+        dump = {
+            "schema_version": DUMP_SCHEMA_VERSION,
+            "time_unix": time.time(),
+            "seam": seam,
+            "step": step,
+            "error": f"{type(exc).__name__}: {exc}",
+            "budget_bytes": budget,
+            "device_stats": device_memory_stats(),
+            "live_bytes_by_pool": by_pool,
+            "untracked": rec["untracked"],
+            "top_buffers": _census.top_buffers(_TOP_N),
+            "compiled": compiled,
+            "hints": _sizing_hints(by_pool, compiled, budget),
+        }
+        path = None
+        d = dump_dir()
+        if d:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"mx_oom_{int(time.time())}_{os.getpid()}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(dump, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            _default_registry().counter(names.OOM_DUMPS).inc()
+        _watchdog().report(
+            "oom", step, value=None,
+            message=f"allocation failure at {seam}"
+                    + (f" (step {step})" if step is not None else "")
+                    + (f"; post-mortem dump: {path}" if path else
+                       "; set MXNET_MEMORY_DUMP_DIR for a ranked "
+                       "post-mortem dump"))
+        return path
+    except Exception:            # pragma: no cover - defensive
+        _LOG.warning("OOM forensics failed", exc_info=True)
+        return None
+
+
+@contextmanager
+def oom_guard(seam: str, step=None):
+    """Wrap a compile/dispatch seam: an escaping allocation failure gets
+    its post-mortem recorded (once, however nested the seams) and then
+    propagates unchanged."""
+    try:
+        yield
+    except BaseException as e:
+        maybe_record_oom(e, seam, step=step)
+        raise
+
+
+# publish pools/device stats before every export (snapshot, Prometheus)
+_default_registry().register_collector(_collector)

@@ -91,6 +91,20 @@ STEP_TIME_EWMA = "mx_watchdog_step_time_ewma_seconds"
 ANOMALIES = "mx_anomalies_total"
 
 # ---------------------------------------------------------------------------
+# device-memory observability (telemetry/memory.py)
+# ---------------------------------------------------------------------------
+HBM_COMPILED_BYTES = "mx_hbm_compiled_bytes"
+HBM_PEAK_BYTES = "mx_hbm_peak_estimate_bytes"
+MEM_POOL_BYTES = "mx_mem_pool_bytes"
+MEM_POOL_BUFFERS = "mx_mem_pool_buffers"
+MEM_UNTRACKED_BYTES = "mx_mem_untracked_bytes"
+MEM_DEVICE_IN_USE = "mx_mem_device_bytes_in_use"
+MEM_DEVICE_PEAK = "mx_mem_device_peak_bytes"
+MEM_DEVICE_LIMIT = "mx_mem_device_limit_bytes"
+MEM_BUDGET_BYTES = "mx_mem_budget_bytes"
+OOM_DUMPS = "mx_mem_oom_dumps_total"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -180,6 +194,44 @@ CATALOG = {
     ANOMALIES: dict(
         kind="counter", label="kind",
         help="structured anomaly events by kind (nan_loss, stall)"),
+    HBM_COMPILED_BYTES: dict(
+        kind="gauge", label="component",
+        help="compiled train-step memory_analysis bytes by component "
+             "(argument, output, temp, generated_code, donated) — max "
+             "over compiled shape buckets"),
+    HBM_PEAK_BYTES: dict(
+        kind="gauge", label=None,
+        help="estimated peak HBM of one compiled train step: "
+             "argument+output+temp+generated_code minus donated aliases"),
+    MEM_POOL_BYTES: dict(
+        kind="gauge", label="pool",
+        help="live per-replica buffer bytes by census pool (params, "
+             "optimizer, checkpoint, prefetch, ndarray)"),
+    MEM_POOL_BUFFERS: dict(
+        kind="gauge", label="pool",
+        help="live buffer count by census pool"),
+    MEM_UNTRACKED_BYTES: dict(
+        kind="gauge", label=None,
+        help="jax.live_arrays() bytes NOT claimed by any census pool "
+             "(suspected leaks / user temporaries)"),
+    MEM_DEVICE_IN_USE: dict(
+        kind="gauge", label="device",
+        help="allocator bytes_in_use per device (live-array accounting "
+             "on backends without allocator stats, e.g. XLA:CPU)"),
+    MEM_DEVICE_PEAK: dict(
+        kind="gauge", label="device",
+        help="allocator peak_bytes_in_use per device (-1 where the "
+             "backend exposes no high-water mark)"),
+    MEM_DEVICE_LIMIT: dict(
+        kind="gauge", label="device",
+        help="allocator bytes_limit per device (-1 where unknown)"),
+    MEM_BUDGET_BYTES: dict(
+        kind="gauge", label=None,
+        help="configured MXNET_MEMORY_BUDGET headroom bound in bytes"),
+    OOM_DUMPS: dict(
+        kind="counter", label=None,
+        help="OOM post-mortem dump files written to "
+             "MXNET_MEMORY_DUMP_DIR"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

@@ -79,6 +79,8 @@ class Watchdog:
         self._samples = 0
         self._nan_active = False
         self._stall_active = False
+        # external episodic kinds (memory_budget, ...): kind -> active
+        self._episode_active: dict = {}
         self._flops: Optional[float] = None
         self._peak: Optional[float] = None
         reg = _default_registry()
@@ -180,13 +182,38 @@ class Watchdog:
                 message=f"non-finite loss first observed at step {step}")
 
     # ---------------- events ----------------
-    def _anomaly(self, kind: str, step, message: str, value=None):
+    def report(self, kind: str, step, message: str, value=None) -> dict:
+        """Emit one structured anomaly event on the watchdog channel —
+        the SAME ring/counter/log-line path the built-in NaN and stall
+        detectors use. Other subsystems (the memory watchdog, OOM
+        forensics) publish through here so every anomaly, whatever its
+        source, lands in ``anomalies()``, ``mx_anomalies_total{kind=}``
+        and one ``mx-anomaly`` JSON log line. For a CONDITION (vs a
+        one-shot event) use :meth:`episode` to get exactly-one-per-
+        episode semantics."""
         evt = {"kind": kind, "step": step, "message": message,
                "value": value, "time_unix": time.time()}
         with self._lock:
             self._events.append(evt)
         self._c_anom.inc(label=kind)
         _LOG.warning("mx-anomaly %s", json.dumps(evt))
+        return evt
+
+    _anomaly = report
+
+    def episode(self, kind: str, active: bool, step=None,
+                message: str = "", value=None) -> bool:
+        """Episode-transition reporting for external detectors: fires
+        :meth:`report` exactly ONCE when ``kind`` goes inactive->active
+        (the memory-budget discipline — a run sitting over budget for
+        1000 steps produces one event, not 1000); recovery re-arms.
+        Returns True when an event was emitted."""
+        with self._lock:
+            fire = bool(active) and not self._episode_active.get(kind)
+            self._episode_active[kind] = bool(active)
+        if fire:
+            self.report(kind, step, message=message, value=value)
+        return fire
 
     def anomalies(self, kind: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -201,6 +228,7 @@ class Watchdog:
             self._samples = 0
             self._nan_active = False
             self._stall_active = False
+            self._episode_active.clear()
             self._flops = None
             self._peak = None
 

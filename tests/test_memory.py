@@ -1,0 +1,640 @@
+"""Device-memory observability (ISSUE 7): HBM accounting, live-buffer
+census, memory budget, OOM forensics.
+
+Acceptance bar:
+
+- a ZeRO dp-mesh run's ``live_bytes_by_pool`` shows the ~N× optimizer-
+  state reduction vs plain fused, sourced from the CENSUS (weakref pool
+  walk over the actual buffers), not a hand computation;
+- ``optimizer_state_bytes()`` / ``state_bytes_per_replica`` and the
+  census agree byte-for-byte (one accounting path);
+- early-break/error in ``DevicePrefetcher`` leaves ZERO retained
+  staging buffers, and a 10-step pipelined run leaks zero live arrays
+  (``jax.live_arrays()`` delta);
+- an injected allocation failure produces exactly ONE anomaly event
+  plus one ranked OOM dump file whose schema a golden test validates;
+- ``MXNET_MEMORY_BUDGET`` over-budget emits exactly one
+  ``memory_budget`` anomaly per episode; recovery re-arms;
+- ``profiler.memory_summary()`` routes through the telemetry catalog
+  with the documented CPU live-array fallback instead of silent Nones.
+"""
+import gc
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, profiler, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import Trainer, TrainLoop, nn
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon.data.prefetcher import DevicePrefetcher
+from mxnet_tpu.telemetry import memory as tmem
+from mxnet_tpu.telemetry import names
+
+DP = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memory_telemetry():
+    """Fresh census + zeroed registry/watchdog around every test."""
+    telemetry.reset()
+    tmem.census().clear()
+    yield
+    telemetry.enable(None)
+    telemetry.reset()
+    tmem.census().clear()
+
+
+def _build(seed=3, in_units=4, hidden=16, classes=3):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, in_units=in_units, activation="relu"))
+    net.add(nn.Dense(classes, in_units=hidden))
+    net.initialize()
+    return net
+
+
+def _batch(bs=8, seed=0, in_units=4, classes=3):
+    rng = onp.random.RandomState(seed)
+    x = nd.array(rng.randn(bs, in_units).astype("float32"))
+    y = nd.array(rng.randint(0, classes, size=(bs,)).astype("int32"))
+    return x, y
+
+
+def _compiled(net=None, opt="adam", kwargs=None):
+    net = net or _build()
+    trainer = Trainer(net.collect_params(), opt,
+                      dict(kwargs or {"learning_rate": 1e-3}))
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    return net, trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+
+
+class _FakeXlaRuntimeError(Exception):
+    pass
+
+
+_FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+def _oom_exc():
+    return _FakeXlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 bytes")
+
+
+# ---------------------------------------------------------------------------
+# byte accounting helper (the one rule)
+# ---------------------------------------------------------------------------
+
+def test_device_bytes_numpy_jax_ndarray():
+    assert tmem.device_bytes(onp.zeros((4, 5), "float32")) == 80
+    assert tmem.device_bytes(jnp.zeros((3, 3), jnp.float32)) == 36
+    a = nd.array(onp.zeros((2, 8), "float32"))
+    assert tmem.device_bytes(a) == 64
+    assert a.device_nbytes == 64
+    assert a.nbytes == 64
+    assert tmem.device_bytes(jnp.zeros((4,), jnp.bfloat16)) == 8
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual mesh")
+def test_device_bytes_is_per_replica_for_sharded():
+    from jax.sharding import NamedSharding, PartitionSpec
+    from mxnet_tpu.parallel import make_mesh
+    with make_mesh({"dp": DP}, jax.devices()[:DP]) as mesh:
+        flat = jax.device_put(
+            jnp.zeros((DP * 8,), jnp.float32),
+            NamedSharding(mesh.mesh, PartitionSpec("dp")))
+        assert tmem.device_bytes(flat) == DP * 8 * 4 // DP
+        repl = jax.device_put(jnp.zeros((16,), jnp.float32),
+                              mesh.sharding())
+        assert tmem.device_bytes(repl) == 64   # replicated: full copy
+
+
+# ---------------------------------------------------------------------------
+# compiled-program memory report
+# ---------------------------------------------------------------------------
+
+def test_memory_report_components_and_peak():
+    net, step = _compiled()
+    x, y = _batch()
+    step(x, y)
+    r = step.memory_report(x, y)
+    assert r is not None
+    d = r.to_dict()
+    assert set(d) == {"argument_bytes", "output_bytes", "temp_bytes",
+                      "generated_code_bytes", "donated_bytes",
+                      "peak_bytes"}
+    assert all(v >= 0 for v in d.values())
+    assert d["argument_bytes"] > 0
+    assert d["donated_bytes"] > 0, "param+state donation must alias"
+    assert d["peak_bytes"] == (d["argument_bytes"] + d["output_bytes"]
+                               + d["temp_bytes"]
+                               + d["generated_code_bytes"]
+                               - d["donated_bytes"])
+    # cached per bucket: the same object comes back
+    assert step.memory_report(x, y) is r
+    # no-arg merge over analyzed buckets
+    merged = step.memory_report()
+    assert merged.peak_bytes == r.peak_bytes
+
+
+def test_memory_report_publishes_hbm_gauges_and_forensics_registry():
+    net, step = _compiled()
+    x, y = _batch()
+    step(x, y)
+    step.memory_report(x, y)
+    snap = telemetry.snapshot()
+    comp = snap["gauges"][names.HBM_COMPILED_BYTES]
+    assert comp["argument"] > 0 and "temp" in comp and "donated" in comp
+    assert snap["gauges"][names.HBM_PEAK_BYTES] == \
+        step.memory_report().peak_bytes
+    # registered for OOM dumps
+    assert any(v["peak_bytes"] == step.memory_report().peak_bytes
+               for v in tmem.compiled_reports().values())
+
+
+def test_memory_report_merges_buckets_field_wise_max():
+    net, step = _compiled()
+    x8, y8 = _batch(bs=8)
+    x16, y16 = _batch(bs=16)
+    step(x8, y8)
+    step(x16, y16)
+    r8 = step.memory_report(x8, y8)
+    r16 = step.memory_report(x16, y16)
+    merged = step.memory_report()
+    for f in merged.FIELDS:
+        assert getattr(merged, f) == max(getattr(r8, f),
+                                         getattr(r16, f))
+
+
+def test_memory_report_none_on_eager():
+    net, step = _compiled()
+    x, y = _batch()
+    step._mode = "eager"
+    assert step.memory_report(x, y) is None
+    assert step.memory_report() is None
+
+
+def test_analysis_report_carries_memory():
+    net, step = _compiled()
+    x, y = _batch()
+    step(x, y)
+    rep = step.analyze(x, y)
+    m = rep.to_dict()["memory"]
+    assert m is not None and m["peak_bytes"] > 0
+    assert "memory" in rep.summary()
+
+
+# ---------------------------------------------------------------------------
+# live-buffer census
+# ---------------------------------------------------------------------------
+
+def test_census_register_and_weakref_release():
+    c = tmem.census()
+    a = nd.array(onp.zeros((64,), "float32")).track_memory()
+    assert c.live_bytes_by_pool()["ndarray"] == 256
+    assert c.live_count_by_pool()["ndarray"] == 1
+    del a
+    gc.collect()
+    assert c.live_bytes_by_pool()["ndarray"] == 0
+
+
+def test_census_rejects_unknown_pool_and_dedupes_across_pools():
+    c = tmem.census()
+    with pytest.raises(MXNetError, match="unknown census pool"):
+        c.register("hbm", nd.array([1.0]))
+    a = nd.array(onp.zeros((8,), "float32"))
+    c.register("params", a)
+    c.register("ndarray", a)   # same underlying buffer, lower pool
+    by_pool = c.live_bytes_by_pool()
+    assert by_pool["params"] == 32
+    assert by_pool["ndarray"] == 0   # POOLS precedence: counted once
+
+
+def test_census_buffers_ranked_and_reconcile_flags_untracked():
+    c = tmem.census()
+    small = nd.array(onp.zeros((4,), "float32")).track_memory()
+    big = nd.array(onp.zeros((1024,), "float32")).track_memory()
+    bufs = c.buffers()
+    assert bufs[0]["bytes"] == 4096 and bufs[0]["pool"] == "ndarray"
+    assert [b["bytes"] for b in bufs] == \
+        sorted((b["bytes"] for b in bufs), reverse=True)
+    # an untracked device array shows up in the reconciliation
+    stray = jnp.zeros((2048,), jnp.float32) + 0   # materialized, unique
+    rec = c.reconcile()
+    assert rec["by_pool"]["ndarray"] == 4096 + 16
+    assert rec["untracked"]["count"] >= 1
+    assert rec["untracked"]["bytes"] >= 8192
+    assert rec["untracked"]["top"][0]["bytes"] >= 8192
+    del small, big, stray
+
+
+def test_census_pool_gauges_published_on_export():
+    keep = nd.array(onp.zeros((16,), "float32")).track_memory()
+    snap = telemetry.snapshot()
+    pools = snap["gauges"][names.MEM_POOL_BYTES]
+    assert set(pools) == set(tmem.POOLS)
+    assert pools["ndarray"] == 64
+    assert names.MEM_UNTRACKED_BYTES in snap["gauges"]
+    del keep
+
+
+# ---------------------------------------------------------------------------
+# one accounting path: optimizer_state_bytes == census optimizer pool
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_optimizer_state_bytes_agrees_with_census_fused(opt):
+    kwargs = {"learning_rate": 1e-2}
+    if opt == "sgd":
+        kwargs["momentum"] = 0.9
+    net, step = _compiled(opt=opt, kwargs=kwargs)
+    x, y = _batch()
+    step(x, y)
+    assert step.mode == "fused"
+    reported = step.optimizer_state_bytes()
+    assert reported > 0
+    assert tmem.census().live_bytes_by_pool()["optimizer"] == reported
+
+
+def test_optimizer_state_bytes_agrees_with_census_eager():
+    net, step = _compiled(opt="adam")
+    x, y = _batch()
+    step._mode = "eager"
+    step(x, y)
+    reported = step.optimizer_state_bytes()
+    assert reported > 0
+    assert tmem.census().live_bytes_by_pool()["optimizer"] == reported
+
+
+def test_params_pool_registered_after_first_step():
+    net, step = _compiled()
+    x, y = _batch()
+    step(x, y)
+    n_param_bytes = sum(
+        int(onp.prod(p.shape)) * 4
+        for p in net.collect_params().values())
+    assert tmem.census().live_bytes_by_pool()["params"] == n_param_bytes
+
+
+# ---------------------------------------------------------------------------
+# the ZeRO acceptance bar: census-measured ~N× optimizer-state drop
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual mesh")
+def test_zero_census_state_drop_vs_plain(monkeypatch):
+    """The arXiv:2004.13336 headline, measured: the census `optimizer`
+    pool drops ~DP× between plain fused and ZeRO-sharded, and both
+    modes' `optimizer_state_bytes()` agree with the census
+    byte-for-byte."""
+    from mxnet_tpu.parallel import make_mesh, shard_batch
+    monkeypatch.setenv("MXNET_ZERO_SHARD_MIN_SIZE", "1")
+    x, y = _batch()
+
+    def measure(mode):
+        gc.collect()
+        tmem.census().clear()
+        net, step = _compiled(opt="adam",
+                              kwargs={"learning_rate": 1e-2})
+        if mode == "zero":
+            with make_mesh({"dp": DP}, jax.devices()[:DP]) as mesh:
+                step(shard_batch(x, mesh), shard_batch(y, mesh))
+            assert step.zero_sharded
+        else:
+            step(x, y)
+            assert not step.zero_sharded
+        census_bytes = tmem.census().live_bytes_by_pool()["optimizer"]
+        assert census_bytes == step.optimizer_state_bytes()
+        # keep the net alive until after the census read
+        return census_bytes, net
+
+    full, net_a = measure("plain")
+    shard, net_z = measure("zero")
+    assert full > 0 and shard > 0
+    # padding of non-divisible shapes costs a little; still ~1/DP
+    assert shard <= full / DP * 1.5, (full, shard)
+    # under zero the state buffers really are NamedSharding-partitioned
+    assert any(b["sharded"]
+               for b in tmem.census().buffers("optimizer"))
+
+
+# ---------------------------------------------------------------------------
+# prefetch staging release
+# ---------------------------------------------------------------------------
+
+def _staged_batches(n, bs=4):
+    rng = onp.random.RandomState(0)
+    for _ in range(n):
+        yield (nd.array(rng.randn(bs, 4).astype("float32")),
+               nd.array(rng.randint(0, 3, size=(bs,)).astype("int32")))
+
+
+def test_prefetcher_stages_into_census_pool():
+    pf = DevicePrefetcher(_staged_batches(4), depth=2)
+    it = iter(pf)
+    b = next(it)
+    assert tmem.census().live_bytes_by_pool()["prefetch"] > 0
+    for b in it:
+        pass
+    del b, it, pf
+    gc.collect()
+    assert tmem.census().live_bytes_by_pool()["prefetch"] == 0
+
+
+def test_prefetcher_early_break_releases_all_staging():
+    """Early break with a deep queue: the consumer's cleanup drains the
+    staged batches deterministically — zero retained staging buffers,
+    counted by the census."""
+    pf = DevicePrefetcher(_staged_batches(32), depth=4)
+    for i, b in enumerate(pf):
+        if i == 1:
+            break
+    del b
+    gc.collect()
+    assert tmem.census().live_bytes_by_pool()["prefetch"] == 0
+    assert tmem.census().live_count_by_pool()["prefetch"] == 0
+
+
+def test_prefetcher_error_releases_all_staging():
+    def bad_source():
+        yield from _staged_batches(3)
+        raise RuntimeError("source died")
+
+    pf = DevicePrefetcher(bad_source(), depth=4)
+    it = iter(pf)
+    consumed = [next(it) for _ in range(3)]
+    with pytest.raises(RuntimeError, match="source died"):
+        next(it)
+    del consumed, it
+    gc.collect()
+    assert tmem.census().live_bytes_by_pool()["prefetch"] == 0
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_live_arrays_delta_zero_across_pipelined_run():
+    """Tier-1 leak test: a 10-step pipelined TrainLoop run creates NO
+    net-new live device arrays — every staged batch, async loss and
+    donated intermediate is released by the time the window drains."""
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loop = TrainLoop(net, trainer, gloss.SoftmaxCrossEntropyLoss(),
+                     inflight=2)
+    x, y = _batch()
+
+    def run(steps):
+        for bx, by in loop.prefetch((x, y) for _ in range(steps)):
+            loop.step(bx, by)
+        loop.synchronize()
+
+    run(3)           # warmup: compile, materialize optimizer state
+    gc.collect()
+    before = len(jax.live_arrays())
+    run(10)
+    gc.collect()
+    after = len(jax.live_arrays())
+    assert after - before == 0, \
+        f"pipelined run leaked {after - before} live arrays"
+
+
+# ---------------------------------------------------------------------------
+# memory budget watchdog
+# ---------------------------------------------------------------------------
+
+def test_parse_budget_forms():
+    assert tmem.parse_budget("1024") == 1024
+    assert tmem.parse_budget("2k") == 2048
+    assert tmem.parse_budget("2K") == 2048
+    assert tmem.parse_budget("1.5g") == int(1.5 * (1 << 30))
+    assert tmem.parse_budget("500MB") == 500 * (1 << 20)
+    assert tmem.parse_budget("0.5", capacity=1000) == 500
+    assert tmem.parse_budget("0.5") is None     # fraction, no capacity
+    assert tmem.parse_budget("") is None
+    assert tmem.parse_budget("nonsense") is None
+    assert tmem.parse_budget("-4") is None
+
+
+def test_budget_unset_is_noop(monkeypatch):
+    monkeypatch.delenv("MXNET_MEMORY_BUDGET", raising=False)
+    assert tmem.maybe_check_budget() is None
+    assert telemetry.watchdog().anomalies("memory_budget") == []
+
+
+def test_budget_over_emits_exactly_one_anomaly_per_episode(monkeypatch):
+    a = nd.array(onp.zeros((1024,), "float32")).track_memory()
+    monkeypatch.setenv("MXNET_MEMORY_BUDGET", "1")   # 1 byte: over
+    for i in range(5):
+        st = tmem.maybe_check_budget(step=i + 1)
+        assert st["over"]
+    evs = telemetry.watchdog().anomalies("memory_budget")
+    assert len(evs) == 1, "one event per episode, not per check"
+    assert evs[0]["step"] == 1
+    assert "MXNET_MEMORY_BUDGET" in evs[0]["message"]
+    assert telemetry.value(names.ANOMALIES, "memory_budget") == 1
+    # recovery re-arms: under budget, then over again -> second event
+    monkeypatch.setenv("MXNET_MEMORY_BUDGET", "1g")
+    assert not tmem.maybe_check_budget(step=6)["over"]
+    monkeypatch.setenv("MXNET_MEMORY_BUDGET", "1")
+    assert tmem.maybe_check_budget(step=7)["over"]
+    evs = telemetry.watchdog().anomalies("memory_budget")
+    assert len(evs) == 2 and evs[1]["step"] == 7
+    del a
+
+
+def test_budget_checked_at_window_retire(monkeypatch):
+    """The engine feeds the budget check from the blessed retire when
+    telemetry is enabled — a pipelined over-budget run raises exactly
+    one memory_budget anomaly."""
+    monkeypatch.setenv("MXNET_MEMORY_BUDGET", "1")
+    telemetry.enable(True)
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loop = TrainLoop(net, trainer, gloss.SoftmaxCrossEntropyLoss(),
+                     inflight=1)
+    x, y = _batch()
+    for _ in range(6):
+        loop.step(x, y)
+    loop.synchronize()
+    assert len(telemetry.watchdog().anomalies("memory_budget")) == 1
+    snap = telemetry.snapshot()
+    assert snap["gauges"][names.MEM_BUDGET_BYTES] == 1
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def test_is_resource_exhausted_matches_chain():
+    assert tmem.is_resource_exhausted(_oom_exc())
+    assert not tmem.is_resource_exhausted(ValueError("shape mismatch"))
+    try:
+        try:
+            raise _oom_exc()
+        except Exception as inner:
+            raise MXNetError("step 3 failed") from inner
+    except MXNetError as wrapped:
+        assert tmem.is_resource_exhausted(wrapped)
+
+
+def test_oom_dump_golden(tmp_path, monkeypatch):
+    """The acceptance bar: one injected allocation failure -> exactly
+    one anomaly event + one ranked dump file with the documented
+    schema."""
+    monkeypatch.setenv("MXNET_MEMORY_DUMP_DIR", str(tmp_path))
+    # populate pools so the dump ranks something real
+    big = nd.array(onp.zeros((4096,), "float32")).track_memory()
+    small = nd.array(onp.zeros((8,), "float32")).track_memory()
+    net, step = _compiled()
+    x, y = _batch()
+    step(x, y)
+    step.memory_report(x, y)
+
+    win = engine.DispatchWindow(
+        max_inflight=0,
+        sync_fn=lambda p: (_ for _ in ()).throw(_oom_exc()),
+        what="train step")
+    with pytest.raises(MXNetError, match="step 7"):
+        win.push(object(), tag=7)
+
+    evs = telemetry.watchdog().anomalies("oom")
+    assert len(evs) == 1, "exactly one oom anomaly per failure"
+    assert evs[0]["step"] == 7
+    files = [f for f in os.listdir(tmp_path)
+             if f.startswith("mx_oom_") and f.endswith(".json")]
+    assert len(files) == 1
+    assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
+    with open(tmp_path / files[0]) as f:
+        dump = json.load(f)
+    # golden schema
+    assert set(dump) == {
+        "schema_version", "time_unix", "seam", "step", "error",
+        "budget_bytes", "device_stats", "live_bytes_by_pool",
+        "untracked", "top_buffers", "compiled", "hints"}
+    assert dump["schema_version"] == tmem.DUMP_SCHEMA_VERSION == 1
+    assert dump["seam"] == "dispatch-window retire"
+    assert dump["step"] == 7
+    assert "RESOURCE_EXHAUSTED" in dump["error"]
+    assert set(dump["live_bytes_by_pool"]) == set(tmem.POOLS)
+    assert dump["live_bytes_by_pool"]["ndarray"] >= 4096 * 4
+    # ranked: bytes strictly descending order
+    sizes = [b["bytes"] for b in dump["top_buffers"]]
+    assert sizes == sorted(sizes, reverse=True) and sizes[0] >= 16384
+    assert all(set(b) >= {"pool", "shape", "dtype", "bytes"}
+               for b in dump["top_buffers"])
+    # per-bucket compiled peaks are attached
+    assert any(v["peak_bytes"] > 0 for v in dump["compiled"].values())
+    assert dump["hints"], "sizing hints must not be empty"
+    assert telemetry.value(names.OOM_DUMPS) == 1
+    del big, small
+
+
+def test_oom_single_event_across_nested_seams(tmp_path, monkeypatch):
+    """An OOM propagating through several seams (retire -> waitall ->
+    user catch) records ONE dump + ONE anomaly — the exception chain is
+    marked at the innermost seam."""
+    monkeypatch.setenv("MXNET_MEMORY_DUMP_DIR", str(tmp_path))
+    exc = _oom_exc()
+    path1 = tmem.maybe_record_oom(exc, "inner seam", step=1)
+    assert path1 is not None
+    wrapped = MXNetError("outer")
+    wrapped.__cause__ = exc
+    assert tmem.maybe_record_oom(wrapped, "outer seam", step=1) is None
+    assert len(telemetry.watchdog().anomalies("oom")) == 1
+    assert len(list(os.listdir(tmp_path))) == 1
+
+
+def test_oom_without_dump_dir_still_fires_anomaly(monkeypatch):
+    monkeypatch.delenv("MXNET_MEMORY_DUMP_DIR", raising=False)
+    assert tmem.maybe_record_oom(_oom_exc(), "seam") is None
+    evs = telemetry.watchdog().anomalies("oom")
+    assert len(evs) == 1
+    assert "MXNET_MEMORY_DUMP_DIR" in evs[0]["message"]
+    assert telemetry.value(names.OOM_DUMPS) == 0
+
+
+def test_non_oom_errors_do_not_trigger_forensics(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_MEMORY_DUMP_DIR", str(tmp_path))
+    win = engine.DispatchWindow(
+        max_inflight=0,
+        sync_fn=lambda p: (_ for _ in ()).throw(ValueError("nan grads")),
+        what="train step")
+    with pytest.raises(MXNetError):
+        win.push(object(), tag=1)
+    assert telemetry.watchdog().anomalies("oom") == []
+    assert list(os.listdir(tmp_path)) == []
+
+
+def test_oom_guard_reraises_unchanged():
+    with pytest.raises(_FakeXlaRuntimeError):
+        with tmem.oom_guard("test seam", step=2):
+            raise _oom_exc()
+    assert len(telemetry.watchdog().anomalies("oom")) == 1
+
+
+def test_sizing_hints_name_the_dominant_knob():
+    # replicated optimizer state dominates -> ZeRO hint
+    hints = tmem._sizing_hints(
+        {"params": 100, "optimizer": 200, "prefetch": 0,
+         "checkpoint": 0, "ndarray": 0}, {}, None)
+    assert any("ZeRO" in h for h in hints)
+    # staged batches -> prefetch/window hint
+    hints = tmem._sizing_hints(
+        {"params": 0, "optimizer": 0, "prefetch": 50, "checkpoint": 0,
+         "ndarray": 0}, {}, None)
+    assert any("MXNET_DEVICE_PREFETCH" in h for h in hints)
+    # XLA temps dominate the compiled peak -> batch/remat hint
+    hints = tmem._sizing_hints(
+        {p: 0 for p in tmem.POOLS},
+        {"fused:bucket1": {"peak_bytes": 100, "temp_bytes": 90}}, None)
+    assert any("remat" in h for h in hints)
+
+
+# ---------------------------------------------------------------------------
+# device stats / profiler routing (satellite)
+# ---------------------------------------------------------------------------
+
+def test_memory_summary_cpu_fallback_documented_not_silent():
+    keep = nd.array(onp.zeros((256,), "float32"))
+    out = profiler.memory_summary()
+    assert out, "every local device must report"
+    for dev, s in out.items():
+        assert set(s) == {"bytes_in_use", "peak_bytes_in_use",
+                          "bytes_limit", "source"}
+        assert s["source"] in ("allocator", "live_arrays")
+        assert s["bytes_in_use"] is not None
+    if jax.default_backend() == "cpu":
+        assert all(s["source"] == "live_arrays" for s in out.values())
+        assert sum(s["bytes_in_use"] for s in out.values()) >= 1024
+    # routed through the catalog: the gauges carry the same numbers
+    reg = telemetry.registry()
+    for dev, s in out.items():
+        assert reg.gauge(names.MEM_DEVICE_IN_USE).value(dev) == \
+            s["bytes_in_use"]
+    del keep
+
+
+def test_checkpoint_capture_lands_in_census_pool(tmp_path):
+    net = _build()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loop = TrainLoop(net, trainer, gloss.SoftmaxCrossEntropyLoss(),
+                     checkpoint_dir=str(tmp_path), checkpoint_every=None)
+    x, y = _batch()
+    loop.step(x, y)
+    loop.synchronize()
+    from mxnet_tpu.checkpoint.manager import TrainCheckpointManager
+    state = loop.checkpoint_manager.save(
+        1, trainer=trainer, net=net, block=True)
+    assert tmem.census().live_bytes_by_pool()["checkpoint"] > 0
+    assert any(b["host"] for b in tmem.census().buffers("checkpoint"))
+    del state
+    gc.collect()
+    assert tmem.census().live_bytes_by_pool()["checkpoint"] == 0

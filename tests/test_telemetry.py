@@ -228,6 +228,49 @@ def test_heartbeat_requires_positive_interval(monkeypatch):
     monkeypatch.delenv("MXNET_TELEMETRY_HEARTBEAT_SEC", raising=False)
     with pytest.raises(MXNetError, match="positive interval"):
         telemetry.Heartbeat()
+
+
+def test_atexit_flush_writes_final_snapshot(tmp_path, monkeypatch):
+    """A run that exits BEFORE the first heartbeat interval still leaves
+    a final Prometheus snapshot + one structured log line: the atexit
+    hook beats once and stops the thread (exporters._atexit_flush —
+    installed via atexit.register; exercised directly here since a real
+    interpreter exit can't run inside the test)."""
+    import atexit
+    from mxnet_tpu.telemetry import exporters
+    path = str(tmp_path / "final.prom")
+    monkeypatch.setenv("MXNET_PROMETHEUS_FILE", path)
+    # the hook is registered with the interpreter
+    assert exporters._atexit_installed
+    hb = telemetry.start_heartbeat(interval=3600.0)   # never fires alone
+    assert hb.beats == 0 and not os.path.exists(path)
+    exporters._atexit_flush()
+    assert os.path.exists(path), "no final Prometheus snapshot written"
+    assert hb.beats == 1
+    assert not hb.running, "atexit flush must also stop the thread"
+    text = open(path).read()
+    assert f"# TYPE {names.HEARTBEATS} counter" in text
+    # idempotent: a second flush (stopped heartbeat) refreshes the file
+    os.remove(path)
+    exporters._atexit_flush()
+    assert os.path.exists(path)
+    assert hb.beats == 1, "stopped heartbeat must not beat again"
+    atexit.unregister(exporters._atexit_flush)   # keep the test process
+    exporters._atexit_installed = False          # clean for re-install
+    exporters._install_atexit()
+    assert exporters._atexit_installed
+
+
+def test_atexit_flush_without_heartbeat_refreshes_file(tmp_path,
+                                                       monkeypatch):
+    from mxnet_tpu.telemetry import exporters
+    path = str(tmp_path / "nohb.prom")
+    monkeypatch.setenv("MXNET_PROMETHEUS_FILE", path)
+    telemetry.stop_heartbeat()
+    exporters._atexit_flush()
+    assert os.path.exists(path)
+    monkeypatch.delenv("MXNET_PROMETHEUS_FILE")
+    exporters._atexit_flush()    # unconfigured: clean no-op
     monkeypatch.setenv("MXNET_TELEMETRY_HEARTBEAT_SEC", "0.25")
     hb = telemetry.Heartbeat()
     assert hb.interval == 0.25 and not hb.running

@@ -242,6 +242,72 @@ def check_telemetry():
         print("telemetry check failed:", repr(e))
 
 
+def check_memory():
+    """Device-memory health: compile a tiny MLP train step and print
+    (a) the compiled program's memory report (argument/output/temp/
+    generated-code/donated bytes + peak estimate), (b) the live-buffer
+    census by pool with the jax.live_arrays() reconciliation (untracked
+    bytes = suspected leaks), (c) per-device allocator stats with their
+    source (allocator vs the documented live-array fallback on CPU),
+    and (d) the MXNET_MEMORY_BUDGET headroom status
+    (docs/OBSERVABILITY.md "memory")."""
+    print("----------Device Memory----------")
+    try:
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import telemetry
+        from mxnet_tpu.gluon import Trainer, nn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        onp.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(16, 16).astype("float32"))
+        y = mx.nd.array(onp.random.randint(0, 8, size=(16,))
+                        .astype("int32"))
+        net(x)
+        trainer = Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-3}, kvstore=None)
+        step = trainer.compile_step(
+            lambda a, b: SoftmaxCrossEntropyLoss()(net(a), b))
+        step(x, y)
+        report = step.memory_report(x, y)
+        print("-- compiled step (per shape bucket) --")
+        if report is None:
+            print("no compiled program (eager mode)")
+        else:
+            for k, v in report.to_dict().items():
+                print(f"{k:<22s}: {v}")
+        census = telemetry.memory.census()
+        rec = census.reconcile()
+        print("-- live-buffer census --")
+        print(f"{'pool':<12s}{'buffers':>8s}{'bytes':>14s}")
+        for pool in telemetry.memory.POOLS:
+            print(f"{pool:<12s}{rec['counts'][pool]:>8d}"
+                  f"{rec['by_pool'][pool]:>14d}")
+        u = rec["untracked"]
+        print(f"{'untracked':<12s}{u['count']:>8d}{u['bytes']:>14d}"
+              "   (suspected leaks / user temporaries)")
+        print("-- per-device stats --")
+        for dev, s in telemetry.memory.device_memory_stats().items():
+            print(f"{dev}: in_use={s['bytes_in_use']} "
+                  f"peak={s['peak_bytes_in_use']} "
+                  f"limit={s['bytes_limit']} (source={s['source']})")
+        print("-- budget --")
+        status = telemetry.memory.maybe_check_budget()
+        if status is None:
+            print("MXNET_MEMORY_BUDGET unset (no headroom check)")
+        else:
+            print(f"budget={status['budget']} in_use={status['in_use']} "
+                  f"over={status['over']} (source={status['source']})")
+        dd = telemetry.memory.dump_dir()
+        print("OOM dumps    :", dd or
+              "disabled (set MXNET_MEMORY_DUMP_DIR)")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("memory check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -307,6 +373,11 @@ def main(argv=None):
                         "telemetry on and print the metrics-registry "
                         "snapshot, a 10-step phase-timeline summary "
                         "(p50/p99), and the MFU estimate")
+    parser.add_argument("--memory", action="store_true",
+                        help="also compile a tiny train step and print "
+                        "its memory report, the live-buffer census by "
+                        "pool (+ untracked reconciliation), per-device "
+                        "allocator stats, and the memory-budget status")
     parser.add_argument("--timeout", type=int, default=10)
     args = parser.parse_args(argv)
     check_python()
@@ -319,6 +390,8 @@ def main(argv=None):
         check_engine()
     if args.telemetry:
         check_telemetry()
+    if args.memory:
+        check_memory()
     check_os()
     check_environment()
     if args.network:
