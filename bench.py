@@ -141,6 +141,65 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
     return out
 
 
+def numerics_probe(tag, loop, x_nd, y_nd, steps=6):
+    """Numerics-domain fingerprint + overhead for one leg
+    (docs/OBSERVABILITY.md "numerics"): re-time a short pipelined loop
+    with numerics OFF, switch the step to MXNET_NUMERICS=global (one
+    extra compile for the instrumented bucket — the mode is part of the
+    cache signature), time again, and report {grad_norm_final,
+    update_ratio, nonfinite_events, numerics_overhead_pct}. The main
+    timed loop above keeps its numbers untouched."""
+    from mxnet_tpu import telemetry
+    step = loop.compiled_step
+    if step.mode != "fused":
+        return None
+    prev_mode = step.numerics
+
+    def timed():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = loop.step(x_nd, y_nd)
+        loop.synchronize()
+        _flush(loss._data)
+        return (time.perf_counter() - t0) / steps
+
+    try:
+        step.set_numerics("off")
+        loop.step(x_nd, y_nd)        # (re)warm the uninstrumented bucket
+        loop.synchronize()
+        t_off = timed()
+        step.set_numerics("global")
+        loop.step(x_nd, y_nd)        # compile the instrumented bucket
+        loop.synchronize()
+        t_on = timed()
+        last = telemetry.numerics.monitor().last() or {}
+        nf = telemetry.value(telemetry.names.ANOMALIES,
+                             "nonfinite_grad") or 0
+        def sig(v):
+            v = float(v)
+            return float(f"{v:.6g}") if onp.isfinite(v) else repr(v)
+
+        out = {
+            "grad_norm_final": sig(last.get("grad_norm", 0.0)),
+            "update_ratio": sig(last.get("update_ratio", 0.0)),
+            "nonfinite_events": int(nf),
+            "numerics_overhead_pct":
+                round((t_on - t_off) / t_off * 100.0, 2)
+                if t_off > 0 else None,
+        }
+        log(f"bench[{tag}]: numerics {out}")
+        return out
+    except Exception as e:  # pragma: no cover - must not kill the leg
+        log(f"bench[{tag}]: numerics probe failed "
+            f"({type(e).__name__}: {e})")
+        return None
+    finally:
+        try:
+            step.set_numerics(prev_mode)
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+
 def run_framework_bench(tag, loop, x, y, warmup, steps):
     """AOT-compile the framework step for this shape bucket, then run
     warmup + the timed loop. The timed loop runs PIPELINED: batches are
@@ -242,6 +301,9 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
         "memory": memory,
         "snapshot": telemetry.snapshot(),
     }
+    # numerics-domain fingerprint AFTER the snapshot: the probe runs
+    # its own short loops and must not skew the timed-loop series
+    telem["numerics"] = numerics_probe(tag, loop, x_nd, y_nd)
     log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f} "
         f"engine={engine} mfu_gauge={telem['mfu_gauge']} "
         f"anomalies={telem['anomalies']} "

@@ -468,7 +468,7 @@ def expect_mode(report: ProgramReport, mode: Optional[str] = None,
 # ---------------------------------------------------------------------------
 
 _SIG_FIELDS = ("train_mode", "arg_treedef", "static_spec", "nd_mask",
-               "shapes_dtypes")
+               "shapes_dtypes", "numerics_mode")
 
 
 def explain_signature_diff(old, new) -> str:

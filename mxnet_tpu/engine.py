@@ -167,16 +167,20 @@ class DispatchWindow:
     def __len__(self) -> int:
         return len(self._pending)
 
-    def push(self, payload, tag=None):
+    def push(self, payload, tag=None, aux=None):
         """Record one dispatched async result; returns immediately unless
         the window is over capacity, in which case the OLDEST entry
-        retires (blocks until that step completed)."""
+        retires (blocks until that step completed). ``aux`` is an
+        optional numerics record (``telemetry.StepNumerics``) riding
+        alongside the payload: its on-device statistics are read at
+        this entry's retire — inside the same blessed sync, after the
+        step's program has completed — so numerics stay sync-free."""
         st = self.stats
         st["pushes"] += 1
         self._m_pushes.inc()
         # re-assert per push: gauges survive telemetry.reset() zeroing
         self._m_capacity.set(self.max_inflight)
-        self._pending.append((tag, payload, time.perf_counter()))
+        self._pending.append((tag, payload, aux, time.perf_counter()))
         if len(self._pending) > st["max_pending"]:
             st["max_pending"] = len(self._pending)
         self._m_occupancy.set(len(self._pending))
@@ -185,7 +189,7 @@ class DispatchWindow:
 
     def _retire_oldest(self):
         from .analysis import guard as _tguard
-        tag, payload, t_push = self._pending.popleft()
+        tag, payload, aux, t_push = self._pending.popleft()
         self._m_occupancy.set(len(self._pending))
         _tguard.count_sync("window_retire")
         t_wait = time.perf_counter()
@@ -216,13 +220,18 @@ class DispatchWindow:
             # still inside the blessed retire region: the watchdog's
             # NaN peek at the (already completed) payload is the one
             # designed device->host read telemetry adds
-            self._observe_retire(tag, payload, t_push, t_wait)
+            self._observe_retire(tag, payload, aux, t_push, t_wait)
 
-    def _observe_retire(self, tag, payload, t_push, t_wait):
+    def _observe_retire(self, tag, payload, aux, t_push, t_wait):
         """Step-timeline spans + watchdog feed for one retire — gated on
-        MXNET_TELEMETRY / an active profiler; must never kill a run."""
+        MXNET_TELEMETRY / an active profiler; must never kill a run.
+        The numerics aux (when the step was compiled with numerics
+        instrumentation) is consumed FIRST and regardless of the
+        telemetry gate — MXNET_NUMERICS is its own opt-in."""
         t = _telemetry()
         try:
+            if aux is not None:
+                t.numerics.monitor().observe_retire(tag, aux)
             if not t.active():
                 self._last_retire_t = None
                 return

@@ -51,6 +51,14 @@ Contracts:
   Parameters smaller than ``MXNET_ZERO_SHARD_MIN_SIZE`` elements bucket
   into one fused shard per dtype so tiny tensors don't pay a collective
   each. See ``_ZeroShardPlan``.
+- **Numerics instrumentation.** ``numerics='global'|'per_layer'``
+  (``MXNET_NUMERICS``) threads auxiliary on-device statistics through
+  the same program — global grad/param norms, update/weight ratio,
+  per-dtype non-finite counts, per-layer norms — as pure reductions of
+  values the step already computes: params/loss stay BIT-EXACT vs
+  numerics=off, and under ZeRO the reductions are psum-composed from
+  the flat shards so every replica reports true global norms
+  (telemetry/numerics.py; docs/OBSERVABILITY.md "numerics").
 """
 from __future__ import annotations
 
@@ -313,7 +321,8 @@ class CompiledTrainStep:
     def __init__(self, trainer, loss_fn: Callable, donate: bool = True,
                  train_mode: bool = True, zero_shard: Optional[bool] = None,
                  zero_axis: str = "dp", mesh=None,
-                 analyze: Optional[str] = None):
+                 analyze: Optional[str] = None,
+                 numerics: Optional[str] = None):
         self._trainer = trainer
         self._loss_fn = loss_fn
         self._donate = donate
@@ -328,6 +337,12 @@ class CompiledTrainStep:
         # default comes from MXNET_ANALYSIS
         self._analyze = _analysis_mode(analyze)
         self._analysis_report = None
+        # in-program numerics instrumentation (docs/OBSERVABILITY.md
+        # "numerics"): None | 'global' | 'per_layer'; default from
+        # MXNET_NUMERICS. Part of the bucket signature — switching mode
+        # compiles a fresh instrumented program.
+        self._numerics = _telemetry().numerics.mode(numerics)
+        self._pending_numerics = None
         # ZeRO-1 sharded update: None = auto (on when a mesh with a
         # `zero_axis` axis is active), True = required, False = off
         self._zero_requested = zero_shard
@@ -375,6 +390,42 @@ class CompiledTrainStep:
         """The ProgramReport from the last opt-in ``analyze=`` run (or
         ``None``)."""
         return self._analysis_report
+
+    # ---------------- numerics instrumentation ----------------
+    @property
+    def numerics(self) -> Optional[str]:
+        """Active numerics mode: None (off) | 'global' | 'per_layer'."""
+        return self._numerics
+
+    def set_numerics(self, mode: Optional[str]):
+        """Switch the numerics instrumentation mode ('off'/None,
+        'global', 'per_layer'). The mode is part of the bucket
+        signature, so the next call compiles a fresh program for its
+        shape bucket; existing buckets stay cached."""
+        self._numerics = _telemetry().numerics.mode(mode or "off")
+
+    def take_numerics(self):
+        """Pop the :class:`~mxnet_tpu.telemetry.StepNumerics` record of
+        the most recent step (None when numerics is off). The TrainLoop
+        pushes this into the dispatch window alongside the loss so the
+        statistics are read sync-free at the blessed retire; windowless
+        callers can hand it to ``telemetry.numerics.monitor()`` or read
+        :meth:`numerics_values` directly."""
+        rec, self._pending_numerics = self._pending_numerics, None
+        return rec
+
+    def numerics_values(self) -> Optional[dict]:
+        """Convenience synchronous read of the last step's numerics:
+        pops the pending record, publishes it through the monitor
+        (gauges + divergence anomalies + forensics, as a window retire
+        would), and returns the host values dict — or None when
+        numerics is off / no step ran. This BLOCKS on the step's
+        program; prefer the TrainLoop's window path in hot loops."""
+        rec = self.take_numerics()
+        if rec is None:
+            return None
+        return _telemetry().numerics.monitor().observe_retire(
+            self._steps_done, rec)
 
     def explain_retrace(self) -> str:
         """WHY the most recent retrace happened: a component-wise diff
@@ -615,6 +666,12 @@ class CompiledTrainStep:
             self._mode = self._decide_mode()
         t = _telemetry()
         if self._mode == "eager":
+            if self._numerics:
+                _LOG.warning(
+                    "compile_step: numerics instrumentation requires "
+                    "the fused path (this program runs eager); disabled"
+                    " — MXNET_INSPECT_NAN=1 is the eager-mode guard")
+                self._numerics = None
             with t.memory.oom_guard("CompiledTrainStep.step (eager)",
                                     step=self._steps_done + 1):
                 out = self._eager_call(args, kwargs, batch_size)
@@ -722,7 +779,8 @@ class CompiledTrainStep:
             (tuple((l._data if isinstance(l, NDArray) else l).shape),
              str((l._data if isinstance(l, NDArray) else l).dtype))
             for l in traced)
-        sig = (self._train, arg_treedef, static_spec, nd_mask, shapes)
+        sig = (self._train, arg_treedef, static_spec, nd_mask, shapes,
+               self._numerics)
         entry = self._lru.get(sig)
         if entry is None:
             entry = self._build_bucket(arg_treedef, static_spec, nd_mask)
@@ -746,6 +804,25 @@ class CompiledTrainStep:
         opt_fn = self._trainer._optimizer.fused_step_fn()
         donate = (0, 1) if self._donate else ()
         step_self = self
+
+        # in-program numerics aux (docs/OBSERVABILITY.md "numerics"):
+        # scalar reductions of values the program already computes —
+        # the update dataflow itself is untouched, so numerics=on is
+        # bit-exact on params/loss vs off
+        numerics = self._numerics
+        if numerics and self._host_allreduce():
+            _LOG.warning(
+                "compile_step: numerics instrumentation is not wired "
+                "for the split (host-allreduce) mode; disabled")
+            numerics = None
+        nxm = _telemetry().numerics if numerics else None
+        if numerics:
+            # trainable-param dtypes are static at build time (fused
+            # mode guarantees materialized shapes)
+            grad_dtype_groups: "dict[str, list]" = {}
+            for j, p in enumerate(self._trainer._params):
+                grad_dtype_groups.setdefault(
+                    str(p._data._data.dtype), []).append(j)
 
         def run_loss(pds, traced_leaves, key):
             it = iter(NDArray(l) if m else l
@@ -833,12 +910,58 @@ class CompiledTrainStep:
                 # GSPMD replicate the persistent buffers on the way out
                 new_sts = tuple(tuple(wsc(s, shard) for s in st)
                                 for st in new_sts)
-                return (tuple(new_pds), new_sts, tuple(new_masters), l)
+                out = (tuple(new_pds), new_sts, tuple(new_masters), l)
+                if numerics:
+                    out = out + (zero_aux(ws_u, gs_u, new_ws, gs,
+                                          rescale),)
+                return out
+
+            def zero_aux(ws_u, gs_u, new_ws, gs, rescale):
+                """Numerics aux from the flat 1/N-per-replica unit
+                buffers: each sumsq/count is a shard-local reduction
+                GSPMD psums on the dp axis, so every replica reports
+                the exact GLOBAL statistic without materializing a
+                replicated gradient (zero padding is finite/zero and
+                never skews anything)."""
+                r2 = jnp.square(jnp.asarray(rescale, jnp.float32))
+                aux = {
+                    "grad_sq": r2 * sum(nxm.sumsq(g) for g in gs_u),
+                    "param_sq": sum(nxm.sumsq(w) for w in ws_u),
+                    "upd_sq": sum(
+                        nxm.sumsq(nw.astype(jnp.float32)
+                                  - w.astype(jnp.float32))
+                        for nw, w in zip(new_ws, ws_u)),
+                }
+                by_dt: "dict[str, list]" = {}
+                for k, u in enumerate(units):
+                    by_dt.setdefault(str(u["dtypes"][0]), []).append(k)
+                aux["nonfinite"] = {
+                    dt: sum(nxm.nonfinite_count(gs_u[k]) for k in ks)
+                    for dt, ks in sorted(by_dt.items())}
+                if numerics == "per_layer":
+                    # per-parameter norms consume the LOGICAL grads —
+                    # under ZeRO this can force XLA to materialize the
+                    # full gradient it would otherwise reduce-scatter
+                    # away (the documented per-layer cost)
+                    aux["layer_grad_sq"] = jnp.stack(
+                        [r2 * nxm.sumsq(g) for g in gs])
+                drifts = []
+                for k, u in enumerate(units):
+                    if u["mp"]:
+                        d = new_ws[k]
+                        q = d.astype(u["dtypes"][0]).astype(jnp.float32)
+                        drifts.append(jnp.max(
+                            jnp.abs(d - q) / (jnp.abs(d) + 1e-8)))
+                if drifts:
+                    aux["master_drift"] = drifts[0] if len(drifts) == 1 \
+                        else jnp.max(jnp.stack(drifts))
+                return aux
 
             donate_z = (0, 1, 2) if self._donate else ()
             return {"kind": "zero",
                     "fn": jax.jit(zero_fused, donate_argnums=donate_z),
-                    "exe": None, "flops": None}
+                    "exe": None, "flops": None, "numerics": numerics,
+                    "probe": grad_part}
 
         if self._host_allreduce():
             # split mode (dist stores): program A computes loss+grads+
@@ -852,7 +975,31 @@ class CompiledTrainStep:
 
             return {"kind": "split", "grad": grad_fn,
                     "update": jax.jit(update, donate_argnums=donate),
-                    "exe": None, "flops": None}
+                    "exe": None, "flops": None, "numerics": None,
+                    "probe": grad_part}
+
+        def fused_aux(ws, gs, new_ws, rescale):
+            """Numerics aux for the plain fused modes: reductions of
+            the grads/weights the update already holds. On a dp mesh
+            (params replicated, batch sharded) GSPMD composes each
+            reduction with the gradient psum, so the norms are global
+            there too."""
+            r2 = jnp.square(jnp.asarray(rescale, jnp.float32))
+            gsq = [nxm.sumsq(g) for g in gs]
+            aux = {
+                "grad_sq": r2 * sum(gsq),
+                "param_sq": sum(nxm.sumsq(w) for w in ws),
+                "upd_sq": sum(
+                    nxm.sumsq(nw.astype(jnp.float32)
+                              - w.astype(jnp.float32))
+                    for nw, w in zip(new_ws, ws)),
+                "nonfinite": {
+                    dt: sum(nxm.nonfinite_count(gs[j]) for j in js)
+                    for dt, js in sorted(grad_dtype_groups.items())},
+            }
+            if numerics == "per_layer":
+                aux["layer_grad_sq"] = jnp.stack([r2 * s for s in gsq])
+            return aux
 
         def fused(pds, sts, traced_leaves, lrs, wds, ts, rescale, clip,
                   key):
@@ -864,11 +1011,15 @@ class CompiledTrainStep:
             new_pds = list(state)   # BN-stat rebinds + identity for rest
             for j, i in enumerate(t_pos):
                 new_pds[i] = new_ws[j]
-            return tuple(new_pds), new_sts, l
+            out = (tuple(new_pds), new_sts, l)
+            if numerics:
+                out = out + (fused_aux(ws, gs, new_ws, rescale),)
+            return out
 
         return {"kind": "fused",
                 "fn": jax.jit(fused, donate_argnums=donate),
-                "exe": None, "flops": None}
+                "exe": None, "flops": None, "numerics": numerics,
+                "probe": grad_part}
 
     def _ensure_states(self):
         updater = self._trainer._updater
@@ -911,9 +1062,14 @@ class CompiledTrainStep:
         ulrs, uwds, uts = plan.pack_hparams(self._trainer._optimizer,
                                             lrs, wds, ts)
         key = next_key()
-        new_pds, new_sts, new_masters, l = entry["fn"](
+        outs = entry["fn"](
             pds, sts, masters, leaf_datas, ulrs, uwds, uts, rescale, clip,
             key)
+        if entry.get("numerics"):
+            new_pds, new_sts, new_masters, l, auxd = outs
+        else:
+            new_pds, new_sts, new_masters, l = outs
+            auxd = None
         # writeback: same handles, new buffers (donation contract); the
         # state/master handles stay sharded across steps
         for p, nw in zip(self._all_params, new_pds):
@@ -923,6 +1079,9 @@ class CompiledTrainStep:
                 s._data = n
         for m, nm in zip(plan.masters, new_masters):
             m._data = nm
+        if auxd is not None:
+            self._stash_numerics(entry, auxd, leaf_datas, batch_size,
+                                 key)
         return NDArray(l)
 
     def _fused_call(self, args, kwargs, batch_size):
@@ -976,8 +1135,12 @@ class CompiledTrainStep:
                 new_pds[i] = new_ws[j]
         else:
             fn = entry["exe"] or entry["fn"]
-            new_pds, new_sts, l = fn(pds, sts, leaf_datas, lrs, wds, ts,
-                                     rescale, clip, key)
+            outs = fn(pds, sts, leaf_datas, lrs, wds, ts,
+                      rescale, clip, key)
+            if entry.get("numerics"):
+                new_pds, new_sts, l, auxd = outs
+            else:
+                (new_pds, new_sts, l), auxd = outs, None
 
         # writeback: same handles, new buffers (donation contract)
         for p, nw in zip(self._all_params, new_pds):
@@ -985,7 +1148,110 @@ class CompiledTrainStep:
         for st, ns in zip(states, new_sts):
             for s, n in zip(st, ns):
                 s._data = n
+        if entry["kind"] != "split" and auxd is not None:
+            self._stash_numerics(entry, auxd, leaf_datas, batch_size,
+                                 key)
         return NDArray(l)
+
+    # ---------------- numerics plumbing ----------------
+    def _stash_numerics(self, entry, auxd, leaf_datas, batch_size, key):
+        """Wrap this step's on-device aux in a StepNumerics record for
+        the dispatch window: small device scalars (still async), the
+        host-side lr/loss-scale context, and the one-shot NaN-origin
+        forensic closure over the CAPTURED input batch + RNG key.
+        Holding the leaf refs keeps at most window-depth input batches
+        alive — the price of being able to replay the faulting batch.
+        Must never kill a step."""
+        t = _telemetry()
+        try:
+            rec = t.numerics.StepNumerics(
+                mode=entry["numerics"], raw=auxd,
+                param_names=self._numerics_param_names(),
+                context=self._numerics_context(batch_size),
+                forensic=self._make_forensic(entry, leaf_datas, key))
+            self._pending_numerics = rec
+        except Exception:        # pragma: no cover - defensive
+            _LOG.warning("numerics stash failed", exc_info=True)
+
+    def _numerics_param_names(self):
+        """UNIQUE trainable-parameter names in trainer._params order:
+        the collect_params dict keys where available (Parameter.name
+        alone is 'weight'/'bias' and collides across blocks)."""
+        names = getattr(self, "_numerics_names", None)
+        if names is None:
+            tr = self._trainer
+            by_id = {id(p): n for p, n in zip(tr._all_params,
+                                              tr._param_names)}
+            names = [by_id.get(id(p), p.name) for p in tr._params]
+            self._numerics_names = names
+        return names
+
+    def _numerics_context(self, batch_size):
+        opt = self._trainer._optimizer
+        ctx = opt.hparam_snapshot()
+        ctx["batch_size"] = batch_size
+        ctx["step_in_program"] = self._steps_done + 1
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        ctx["loss_scale"] = float(scaler.loss_scale) \
+            if scaler is not None else None
+        ctx["mode"] = "zero" if self._zero is not None else "fused"
+        return ctx
+
+    def _make_forensic(self, entry, leaf_datas, key):
+        step_self = self
+
+        def run(step_tag):
+            return step_self._numerics_forensics(entry, leaf_datas, key,
+                                                 step_tag)
+        return run
+
+    def _numerics_forensics(self, entry, leaf_datas, key, step_tag):
+        """NaN-origin forensics, run ONCE per non-finite episode and
+        OUTSIDE the hot loop (the monitor calls this under a blessed
+        allow_transfers region when the ``nonfinite_grad`` anomaly
+        fires): re-execute this bucket's loss+grad computation on the
+        captured batch under ``jax.debug_nans``/``debug_infs`` to name
+        the first non-finite-producing primitive, then once more plain
+        (no donation) for the ranked per-layer norm table. Params are
+        the CURRENT handles — the faulting step's pre-update weights
+        were donated away — so the replay chases the batch, not the
+        exact weight state (recorded in the dump)."""
+        t = _telemetry()
+        probe = entry.get("probe")
+        if probe is None:
+            return None
+        pds = tuple(p._data._data for p in self._all_params)
+        info = {"params_at": "retire (post-update handles)"}
+        info["offending_op"] = t.numerics.localize_nonfinite(
+            lambda: probe(pds, leaf_datas, key))
+        try:
+            l, _state, gs = jax.jit(probe)(pds, leaf_datas, key)
+            lv = onp.asarray(l, dtype="float64")
+            info["loss"] = float(lv.mean())
+            layers = []
+            for name, p, g in zip(self._numerics_param_names(),
+                                  self._trainer._params, gs):
+                ga = onp.asarray(jnp.asarray(g, jnp.float32),
+                                 dtype="float64")
+                nf = int((~onp.isfinite(ga)).sum())
+                finite = ga[onp.isfinite(ga)]
+                gnorm = float(onp.sqrt((finite ** 2).sum()))
+                pa = onp.asarray(
+                    jnp.asarray(p._data._data, jnp.float32),
+                    dtype="float64")
+                layers.append({
+                    "param": name,
+                    "shape": list(ga.shape),
+                    "dtype": str(g.dtype),
+                    "grad_norm": gnorm,
+                    "param_norm": float(onp.linalg.norm(pa)),
+                    "nonfinite": nf,
+                })
+            layers.sort(key=lambda d: (-d["nonfinite"], -d["grad_norm"]))
+            info["layers"] = layers
+        except Exception as e:
+            info["reexec_error"] = f"{type(e).__name__}: {e}"
+        return info
 
     # ---------------- program analysis (mx.analysis) ----------------
     def analyze(self, *args, batch_size: Optional[int] = None, **kwargs):
@@ -1241,6 +1507,15 @@ class TrainLoop:
     sharding on a background thread, overlapping the host→device copy
     with the previous step's compute (gluon/data/prefetcher.py).
 
+    **Numerics observability** (``numerics=`` / ``MXNET_NUMERICS``,
+    docs/OBSERVABILITY.md "numerics"): the compiled step's in-program
+    grad/param health statistics (global grad norm, update/weight
+    ratio, non-finite counts, per-layer norms) ride the dispatch
+    window alongside each loss and surface as ``mx_numerics_*`` series
+    plus divergence anomalies at the blessed retire — zero extra host
+    syncs; a non-finite gradient triggers one NaN-origin forensic
+    re-execution and an atomic post-mortem dump.
+
     **Preemption safety** (``checkpoint_dir=...``): the loop owns a
     ``mx.checkpoint.TrainCheckpointManager`` — on construction it
     auto-resumes from the newest VALID checkpoint (params, fused/ZeRO
@@ -1258,12 +1533,14 @@ class TrainLoop:
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: Optional[int] = None,
                  keep_last: int = 3, async_checkpoint: bool = True,
-                 resume: bool = True, inflight: Optional[int] = None):
+                 resume: bool = True, inflight: Optional[int] = None,
+                 numerics: Optional[str] = None):
         from .. import engine as _engine
         self._net = net
         self._loss = loss
         self._trainer = trainer
-        self._step = trainer.compile_step(self._loss_fn, donate=donate)
+        self._step = trainer.compile_step(self._loss_fn, donate=donate,
+                                          numerics=numerics)
         self._window = _engine.DispatchWindow(max_inflight=inflight,
                                               what="TrainLoop step")
         self._prefetcher = None
@@ -1316,7 +1593,10 @@ class TrainLoop:
             self._global_step = step_no
             self._m_steps.inc()
             d = loss._data if isinstance(loss, NDArray) else loss
-            self._window.push(d, tag=self._global_step)
+            # the numerics aux (MXNET_NUMERICS) rides the window with
+            # the loss and is read at the blessed retire — sync-free
+            self._window.push(d, tag=self._global_step,
+                              aux=self._step.take_numerics())
             if self._manager is not None and self._every and \
                     self._global_step % self._every == 0:
                 with _tguard.allow_transfers("checkpoint snapshot"):

@@ -80,7 +80,8 @@ class Trainer:
                      train_mode: bool = True,
                      zero_shard: Optional[bool] = None,
                      zero_axis: str = "dp", mesh=None,
-                     analyze: Optional[str] = None):
+                     analyze: Optional[str] = None,
+                     numerics: Optional[str] = None):
         """Compile the ENTIRE training step — forward, backward, gradient
         reduction, optimizer update — into one donated-buffer XLA program
         per input-shape bucket (gluon/fused_step.py)::
@@ -127,13 +128,28 @@ class Trainer:
         on ``step.analysis_report``, ``'warn'`` also logs findings,
         ``'raise'`` raises on error-severity findings.  Default comes
         from ``MXNET_ANALYSIS``.
+
+        **Numerics observability** (``numerics=`` — docs/OBSERVABILITY
+        .md "numerics"): ``'global'`` threads global grad/param norms,
+        the update/weight ratio, and per-dtype non-finite counts
+        through the compiled program as auxiliary outputs (bit-exact on
+        params/loss, psum-composed under ZeRO so shards report true
+        global norms); ``'per_layer'`` adds a per-parameter norm vector
+        (costlier — see the docs note). The statistics retire sync-free
+        through the TrainLoop's dispatch window, feed the
+        ``mx_numerics_*`` series and the divergence watchdog
+        (grad_spike / nonfinite_grad / update_ratio / master_drift
+        anomalies), and a non-finite gradient triggers NaN-origin
+        forensics plus an atomic post-mortem dump
+        (``MXNET_NUMERICS_DUMP_DIR``). Default comes from
+        ``MXNET_NUMERICS``.
         """
         from .fused_step import CompiledTrainStep
         return CompiledTrainStep(self, loss_fn, donate=donate,
                                  train_mode=train_mode,
                                  zero_shard=zero_shard,
                                  zero_axis=zero_axis, mesh=mesh,
-                                 analyze=analyze)
+                                 analyze=analyze, numerics=numerics)
 
     # ---------------- compiled-step registry ----------------
     def _register_compiled(self, step):

@@ -10,9 +10,10 @@ the producing op — the eager analog of jax's debug_nans.
 """
 from __future__ import annotations
 
+import io
 import os
 import zlib
-from typing import Callable, List, Tuple, Union
+from typing import Callable, List, Optional, Tuple, Union
 
 import numpy as onp
 
@@ -104,11 +105,19 @@ class TensorInspector:
 
     def dump_to_file(self, tag: str, directory: str = ".") -> str:
         """Write .npy named <tag>_<n>.npy (reference dump_to_file naming
-        with a per-tag visit counter)."""
+        with a per-tag visit counter). The write is crash-safe — staged
+        to a temp file, fsynced, and os.replace'd via the same atomic
+        helper ``nd.save`` and the telemetry dump writers use — so a
+        kill mid-dump never leaves a torn .npy; the sequence number
+        advances only on a durable write (a failed attempt retries
+        under the same name)."""
+        from .checkpoint.atomic import atomic_write_bytes
         count = _dump_counters.get(tag, 0) + 1
-        _dump_counters[tag] = count
         path = os.path.join(directory, f"{tag}_{count}.npy")
-        onp.save(path, onp.asarray(self._t))
+        buf = io.BytesIO()
+        onp.save(buf, onp.asarray(self._t))
+        atomic_write_bytes(path, buf.getvalue(), fault="inspector.dump")
+        _dump_counters[tag] = count
         return path
 
 
@@ -119,36 +128,54 @@ _dump_counters: dict = {}
 # ---------------------------------------------------------------------------
 
 _guard_installed = False
+#: output-check hook that was active before install (restored on remove)
+_prev_output_check: Optional[Callable] = None
+
+
+def _numerics_monitor():
+    """The telemetry numerics monitor (lazy: the guard must work even
+    if telemetry failed to import) — eager non-finite hits feed the
+    SAME anomaly channel as the compiled-step numerics watchdog, one
+    ``nonfinite_eager`` event per episode."""
+    try:
+        from .telemetry import numerics
+        return numerics.monitor()
+    except Exception:            # pragma: no cover - defensive
+        return None
+
+
+def _check_concrete_outputs(name, outs):
+    """Shared checker for both funnels: raise (naming the op) on the
+    first non-finite float output, and report/arm the telemetry
+    episode. Tracers are skipped — inside a trace values are unknown."""
+    import jax
+    checked = False
+    for i, o in enumerate(outs):
+        d = _raw(o)
+        if isinstance(d, jax.core.Tracer):
+            continue
+        if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating):
+            checked = True
+            if not bool(jnp.all(jnp.isfinite(d))):
+                mon = _numerics_monitor()
+                if mon is not None:
+                    mon.eager_nonfinite(name, i)
+                raise MXNetError(
+                    f"MXNET_INSPECT_NAN: op {name!r} produced a "
+                    f"non-finite value in output {i}")
+    if checked:
+        mon = _numerics_monitor()
+        if mon is not None:
+            mon.eager_clean()       # a clean op re-arms the episode
 
 
 def _nan_guard_wrapper(name, fn):
     def wrapped(*args, **kwargs):
         out = fn(*args, **kwargs)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        for i, o in enumerate(outs):
-            d = _raw(o)
-            if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating):
-                import jax
-                if isinstance(d, jax.core.Tracer):
-                    continue  # inside a trace: values unknown
-                if not bool(jnp.all(jnp.isfinite(d))):
-                    raise MXNetError(
-                        f"MXNET_INSPECT_NAN: op {name!r} produced a "
-                        f"non-finite value in output {i}")
+        _check_concrete_outputs(
+            name, out if isinstance(out, (tuple, list)) else (out,))
         return out
     return wrapped
-
-
-def _check_concrete_outputs(name, outs):
-    import jax
-    for i, d in enumerate(outs):
-        if isinstance(d, jax.core.Tracer):
-            continue
-        if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating):
-            if not bool(jnp.all(jnp.isfinite(d))):
-                raise MXNetError(
-                    f"MXNET_INSPECT_NAN: op {name!r} produced a "
-                    f"non-finite value in output {i}")
 
 
 def install_nan_guard():
@@ -157,22 +184,41 @@ def install_nan_guard():
     enabled at import when MXNET_INSPECT_NAN=1). Covers both plain eager
     ops (invoke wrapper) and ops under autograd.record (tape hook on the
     concrete vjp primals — inside record the kernel itself only sees
-    Tracers). Synchronizes per op — debugging tool, not a production
-    mode."""
-    global _guard_installed
-    if not _guard_installed:
-        from . import _tape
-        _registry.add_invoke_wrapper(_nan_guard_wrapper)
-        _tape.set_output_check(_check_concrete_outputs)
-        _guard_installed = True
+    Tracers). Each violation also emits one ``nonfinite_eager`` anomaly
+    per episode on the telemetry watchdog channel (a clean checked op
+    re-arms). Idempotent: calling it twice never double-wraps.
+    Synchronizes per op — debugging tool, not a production mode."""
+    global _guard_installed, _prev_output_check
+    if _guard_installed:
+        return
+    from . import _tape
+    # defensive de-dup before add: even if install state was corrupted
+    # (e.g. a prior exception), the funnel carries at most one wrapper
+    _registry.remove_invoke_wrapper(_nan_guard_wrapper)
+    _registry.add_invoke_wrapper(_nan_guard_wrapper)
+    try:
+        _prev_output_check = _tape.set_output_check(
+            _check_concrete_outputs)
+    except BaseException:        # pragma: no cover - defensive
+        _registry.remove_invoke_wrapper(_nan_guard_wrapper)
+        raise
+    _guard_installed = True
 
 
 def remove_nan_guard():
-    global _guard_installed
-    if _guard_installed:
-        from . import _tape
+    """Uninstall the guard (idempotent) and RESTORE whatever output
+    check was active before install — never clobbers another
+    subsystem's hook, and restores cleanly even if the unwrap path
+    raises."""
+    global _guard_installed, _prev_output_check
+    if not _guard_installed:
+        return
+    from . import _tape
+    try:
         _registry.remove_invoke_wrapper(_nan_guard_wrapper)
-        _tape.set_output_check(None)
+    finally:
+        _tape.set_output_check(_prev_output_check)
+        _prev_output_check = None
         _guard_installed = False
 
 

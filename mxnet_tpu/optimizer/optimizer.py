@@ -303,6 +303,27 @@ class Optimizer:
         return (onp.asarray(lrs, onp.float32), onp.asarray(wds, onp.float32),
                 onp.asarray(ts, onp.int32))
 
+    def hparam_snapshot(self) -> dict:
+        """Small host-side view of the hyperparameter state driving the
+        current step — the lr/clip/update-count context the numerics
+        forensics dump records next to the per-layer norm table
+        (telemetry/numerics.py; docs/OBSERVABILITY.md "numerics")."""
+        try:
+            lr = float(self.learning_rate)
+        except Exception:        # pragma: no cover - exotic schedulers
+            lr = None
+        return {
+            "optimizer": type(self).__name__,
+            "learning_rate": lr,
+            "wd": float(getattr(self, "wd", 0.0) or 0.0),
+            "rescale_grad": float(self.rescale_grad),
+            "clip_gradient": None if self.clip_gradient is None
+            else float(self.clip_gradient),
+            "num_update": int(self.num_update),
+            "multi_precision": bool(getattr(self, "multi_precision",
+                                            False)),
+        }
+
     def _jitted_multi(self):
         """Multi-tensor fused step (reference multi_sgd_mom_update,
         src/operator/optimizer_op.cc): ALL parameter updates compile into

@@ -21,6 +21,11 @@ hand-rolled bench plumbing) with one subsystem:
    NaN/inf-loss and step-time-stall detection piggybacked on window
    retires.
 
+Two further domains build on these: device memory (:mod:`.memory` —
+HBM accounting, buffer census, OOM forensics) and training numerics
+(:mod:`.numerics` — in-program grad/param health threaded through the
+compiled step, divergence watchdog, NaN-origin forensics).
+
 Cost model: registry counters/gauges are ALWAYS on (one uncontended
 lock + float update per event, no host syncs — the transfer guard is
 the enforcement mechanism). Span recording and the watchdog are gated
@@ -40,6 +45,8 @@ from .timeline import PHASES, StepTimeline, timeline
 from .watchdog import Watchdog, stall_factor, watchdog
 from . import memory
 from .memory import BufferCensus, MemoryReport, census
+from . import numerics
+from .numerics import NumericsMonitor, StepNumerics
 from .exporters import (SCHEMA_VERSION, Heartbeat, heartbeat_interval,
                         prometheus_file, prometheus_text, snapshot,
                         start_heartbeat, stop_heartbeat,
@@ -52,7 +59,8 @@ __all__ = ["names", "registry", "MetricsRegistry", "Counter", "Gauge",
            "Heartbeat", "start_heartbeat", "stop_heartbeat",
            "heartbeat_interval", "SCHEMA_VERSION", "enabled", "enable",
            "value", "reset", "memory", "census", "BufferCensus",
-           "MemoryReport"]
+           "MemoryReport", "numerics", "NumericsMonitor",
+           "StepNumerics"]
 
 # every catalog series exists from import time: an exporter always shows
 # the full schema (zero is information; absence is a question)
@@ -105,3 +113,4 @@ def reset():
     registry().reset()
     timeline().clear()
     watchdog().reset()
+    numerics.monitor().reset()

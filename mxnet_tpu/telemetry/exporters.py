@@ -197,7 +197,8 @@ def _heartbeat_payload() -> dict:
             continue
         out[k] = _metric_values(m)
     for k in (names.STEP_TIME_EWMA, names.MFU,
-              names.MODEL_FLOPS_PER_SEC):
+              names.MODEL_FLOPS_PER_SEC, names.NUMERICS_GRAD_NORM,
+              names.NUMERICS_PARAM_NORM):
         g = reg.get(k)
         v = g.value() if g is not None else None
         if v is not None:

@@ -16,7 +16,9 @@ Naming convention (Prometheus-compatible):
   (:data:`NAME_RE`); the ``mx_`` prefix is RESERVED for catalog
   entries — user code registers its own metrics under its own prefix;
 - counters end in ``_total``;
-- histograms end in a unit suffix (``_seconds``);
+- histograms end in a unit suffix (``_seconds`` for latencies,
+  ``_ratio`` for unitless ratios such as the numerics update/weight
+  ratio);
 - gauges end in neither ``_total`` nor ``_bucket`` (a unit suffix such
   as ``_seconds`` is fine);
 - label keys are single, fixed per metric, with bounded value
@@ -103,6 +105,18 @@ MEM_DEVICE_PEAK = "mx_mem_device_peak_bytes"
 MEM_DEVICE_LIMIT = "mx_mem_device_limit_bytes"
 MEM_BUDGET_BYTES = "mx_mem_budget_bytes"
 OOM_DUMPS = "mx_mem_oom_dumps_total"
+
+# ---------------------------------------------------------------------------
+# training-numerics observability (telemetry/numerics.py)
+# ---------------------------------------------------------------------------
+NUMERICS_GRAD_NORM = "mx_numerics_grad_norm"
+NUMERICS_PARAM_NORM = "mx_numerics_param_norm"
+NUMERICS_GRAD_NORM_EWMA = "mx_numerics_grad_norm_ewma"
+NUMERICS_UPDATE_RATIO = "mx_numerics_update_ratio"
+NUMERICS_LAYER_GRAD_NORM = "mx_numerics_layer_grad_norm"
+NUMERICS_MASTER_DRIFT = "mx_numerics_master_drift"
+NUMERICS_NONFINITE = "mx_numerics_nonfinite_total"
+NUMERICS_DUMPS = "mx_numerics_dumps_total"
 
 # ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
@@ -232,6 +246,39 @@ CATALOG = {
         kind="counter", label=None,
         help="OOM post-mortem dump files written to "
              "MXNET_MEMORY_DUMP_DIR"),
+    NUMERICS_GRAD_NORM: dict(
+        kind="gauge", label=None,
+        help="global L2 norm of the rescaled gradient of the last "
+             "retired step (psum-composed in-program: exact under "
+             "ZeRO/dp sharding)"),
+    NUMERICS_PARAM_NORM: dict(
+        kind="gauge", label=None,
+        help="global L2 norm of the trainable parameters (fp32 masters "
+             "under multi-precision) before the last retired update"),
+    NUMERICS_GRAD_NORM_EWMA: dict(
+        kind="gauge", label=None,
+        help="exponentially-weighted mean grad norm the grad_spike "
+             "detector compares against"),
+    NUMERICS_UPDATE_RATIO: dict(
+        kind="histogram", label=None,
+        help="per-step update/weight ratio ||delta w|| / ||w|| "
+             "distribution (healthy runs sit around 1e-3..1e-2)"),
+    NUMERICS_LAYER_GRAD_NORM: dict(
+        kind="gauge", label="param",
+        help="per-parameter grad norm, top-K largest layers only "
+             "(MXNET_NUMERICS=per_layer; bounded label cardinality)"),
+    NUMERICS_MASTER_DRIFT: dict(
+        kind="gauge", label=None,
+        help="max relative drift between fp32 masters and their "
+             "low-precision weight casts (ZeRO multi-precision units)"),
+    NUMERICS_NONFINITE: dict(
+        kind="counter", label="dtype",
+        help="non-finite gradient elements observed at retires, by "
+             "parameter dtype"),
+    NUMERICS_DUMPS: dict(
+        kind="counter", label=None,
+        help="numerics post-mortem dump files written to "
+             "MXNET_NUMERICS_DUMP_DIR"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),
@@ -244,12 +291,13 @@ def is_valid(name: str) -> bool:
 
 
 def kind_ok(name: str, kind: str) -> bool:
-    """Kind-suffix rules: counters end ``_total``, histograms end
-    ``_seconds``, gauges end in neither ``_total`` nor ``_bucket``."""
+    """Kind-suffix rules: counters end ``_total``, histograms end in a
+    unit suffix (``_seconds`` / ``_ratio``), gauges end in neither
+    ``_total`` nor ``_bucket``."""
     if kind == "counter":
         return name.endswith("_total")
     if kind == "histogram":
-        return name.endswith("_seconds")
+        return name.endswith(("_seconds", "_ratio"))
     if kind == "gauge":
         return not name.endswith(("_total", "_bucket"))
     return False

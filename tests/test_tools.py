@@ -130,6 +130,23 @@ def test_diagnose_analysis_section(capsys):
     assert "verdict      : OK" in out
 
 
+def test_diagnose_numerics_section(capsys, tmp_path, monkeypatch):
+    """--numerics: the 10-step norm table prints with finite values and
+    the simulated-divergence demo produces exactly one anomaly plus a
+    post-mortem dump in MXNET_NUMERICS_DUMP_DIR."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    monkeypatch.setenv("MXNET_NUMERICS_DUMP_DIR", str(tmp_path))
+    diagnose = _load("tools/diagnose.py", "diagnose3")
+    assert diagnose.main(["--numerics"]) == 0
+    out = capsys.readouterr().out
+    assert "Training Numerics" in out
+    assert "grad_norm" in out and "upd/w ratio" in out
+    assert "anomalies    : 1 (want exactly 1)" in out
+    assert list(tmp_path.glob("mx_numerics_*.json"))
+    telemetry.reset()
+
+
 # ---------------------------------------------------------------------------
 # launch.py graceful stop
 # ---------------------------------------------------------------------------
