@@ -136,7 +136,11 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
            "donated_bytes": d["donated_bytes"],
            "donation_copied": len(report.donation.copied),
            "host_transfers": d["host_transfers"],
-           "dtype_drift": d["dtype_drift"]}
+           "dtype_drift": d["dtype_drift"],
+           # fusion posture next to MFU (docs/ANALYSIS.md "Fusion
+           # census"): the pending hardware re-capture records these
+           # as the per-leg baselines the regression gate bands around
+           "fusion": d["fusion"]}
     log(f"bench[{tag}]: analysis {out}")
     return out
 

@@ -1,11 +1,16 @@
 """mxnet_tpu.analysis — static analysis of compiled train-step programs.
 
-Three cooperating checkers (docs/ANALYSIS.md):
+Four cooperating checkers (docs/ANALYSIS.md):
 
 - **program lint** (:mod:`.program`): walks the jaxpr and optimized HLO
   of a ``Trainer.compile_step`` program — collective census per mesh
   axis, donation audit, host-transfer detection, dtype-drift detection,
   retrace accounting.  ``mx.analysis.analyze_step(step, *batch)``.
+- **fusion census** (:mod:`.fusion`): audits XLA's fusion decisions in
+  the optimized HLO against the ideal-fusion diff of arXiv:2301.13062 —
+  stranded elementwise ops, HBM boundary materializations, per-kernel
+  arithmetic intensity, and a checked-in per-leg regression gate
+  (``MXNET_FUSION_BASELINE``).  ``mx.analysis.fusion_census(hlo)``.
 - **source lint** (:mod:`.lint`): AST pass over HybridBlock forwards /
   loss functions for jit-unsafe Python (``.asnumpy()``, tracer-dependent
   ``if``, unkeyed randomness).  ``python -m mxnet_tpu.analysis.lint``.
@@ -23,10 +28,11 @@ from .guard import (allow_transfers, hot_scope, transfer_guard)      # noqa
 
 __all__ = [
     "Finding", "ProgramReport", "CollectiveOp", "CollectiveStats",
-    "DonationAudit",
+    "DonationAudit", "FusionReport",
     "analyze_step", "analyze_lowered", "collective_census",
     "donation_audit", "host_transfer_scan", "dtype_drift_scan",
     "expect_mode", "explain_signature_diff",
+    "fusion_census", "check_baseline", "load_baselines",
     "lint_source", "lint_path", "lint_module", "lint_function",
     "load_allowlist", "filter_allowed",
     "transfer_guard", "hot_scope", "allow_transfers",
@@ -37,11 +43,13 @@ _LAZY = {
     "collective_census": "program", "donation_audit": "program",
     "host_transfer_scan": "program", "dtype_drift_scan": "program",
     "expect_mode": "program", "explain_signature_diff": "program",
+    "fusion_census": "fusion", "check_baseline": "fusion",
+    "load_baselines": "fusion", "FusionReport": "fusion",
     "lint_source": "lint", "lint_path": "lint", "lint_module": "lint",
     "lint_function": "lint", "load_allowlist": "lint",
     "filter_allowed": "lint",
     "program": None, "lint": None, "guard": None, "hlo": None,
-    "report": None,
+    "report": None, "fusion": None,
 }
 
 

@@ -1,14 +1,20 @@
 """Minimal parser for XLA's optimized HLO text dumps.
 
-The program lint needs four things out of ``compiled.as_text()``: every
+The program lint needs five things out of ``compiled.as_text()``: every
 op's result shape, opcode and operands (def-use edges, to classify the
 CPU backend's decomposed reduce-scatters), the ``input_output_alias``
 table in the module header (donation ground truth), replica groups on
-collectives (mesh-axis attribution), and custom-call targets (host
-callbacks).  A full HLO grammar is overkill — module text is one op per
-line with a stable ``%name = type opcode(operands), attrs`` shape, which
-this parses with regexes.  Parsing failures degrade to ``None`` fields,
-never exceptions: an analyzer must not take down the run it observes.
+collectives (mesh-axis attribution), custom-call targets (host
+callbacks), and — since the fusion census — the COMPUTATION STRUCTURE:
+which ops live inside which computation, which computations are fusion
+bodies (``calls=`` from a ``fusion`` op), scalar appliers
+(``to_apply=`` on reduces), or control-flow bodies (``body=`` /
+``condition=`` / ``branch_computations=`` — these run as sequences of
+kernels, like the entry).  A full HLO grammar is overkill — module text
+is one op per line with a stable ``%name = type opcode(operands),
+attrs`` shape, which this parses with regexes.  Parsing failures
+degrade to ``None`` fields, never exceptions: an analyzer must not take
+down the run it observes.
 """
 from __future__ import annotations
 
@@ -16,8 +22,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["HloOp", "HloModule", "parse_hlo", "parse_shape_elements",
-           "parse_replica_groups"]
+__all__ = ["HloOp", "HloComputation", "HloModule", "parse_hlo",
+           "parse_shape_elements", "parse_replica_groups"]
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
@@ -31,6 +37,25 @@ _OP_RE = re.compile(
     r"\s+([\w\-]+)\((.*)$")
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
+# `f32[8,4]{1,0} %operand` — typed operand inside the operand list
+_TYPED_OPERAND_RE = re.compile(
+    r"(\w+\[[^\]]*\](?:\{[^}]*\})?)\s+%([\w.\-]+)")
+# `%fused_computation.1 (param_0: f32[8]) -> f32[8] {`  |
+# `ENTRY %main.22 (Arg_0.1: f32[8,8], ...) -> (f32[8], ...) {`
+_COMPUTATION_RE = re.compile(
+    r"^(ENTRY\s+)?%([\w.\-]+)\s*\(.*\)\s*->\s*.+\{\s*$")
+# computation references in op attributes, by role
+_CALLED_RE = re.compile(
+    r"(calls|to_apply|condition|body|true_computation|false_computation"
+    r"|comparator|select|scatter)=%([\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_FUSION_KIND_RE = re.compile(r"kind=k(\w+)")
+
+#: computation roles whose ops execute INSIDE a single kernel (fusion
+#: bodies, scalar reduction/sort appliers) — everything else (entry,
+#: while bodies, conditional branches) schedules its ops as kernels
+_KERNEL_INTERNAL_ROLES = frozenset(
+    {"calls", "to_apply", "comparator", "select", "scatter"})
 
 
 def parse_shape_elements(type_str: str) -> Tuple[int, Optional[str], int]:
@@ -64,6 +89,44 @@ class HloOp:
     line: str
     replica_groups: Optional[List[Tuple[int, ...]]] = None
     custom_call_target: Optional[str] = None
+    #: name of the computation this op's line appeared in
+    computation: Optional[str] = None
+    #: fusion ops: kind=kLoop|kInput|kOutput|kCustom, lowercased
+    fusion_kind: Optional[str] = None
+    #: computations referenced from this op's attributes, by role
+    #: ({"calls": [...], "body": [...], ...})
+    called: Dict[str, List[str]] = field(default_factory=dict)
+    #: HLO result type of each operand where the line names it
+    #: (aligned with ``operands``; None where untyped, e.g. tuples)
+    operand_types: List[Optional[str]] = field(default_factory=list)
+    #: True for a computation's ROOT op (its output, not a boundary)
+    is_root: bool = False
+
+    def operand_bytes(self, i: int) -> Optional[int]:
+        """Bytes of operand ``i``, from its typed mention on this line
+        (None where the operand is untyped in the text)."""
+        if i < len(self.operand_types) and self.operand_types[i]:
+            return parse_shape_elements(self.operand_types[i])[2]
+        return None
+
+
+@dataclass
+class HloComputation:
+    """One named computation: the entry, a fusion body, a reduction
+    applier, or a control-flow body. ``op_names`` preserve text order."""
+    name: str
+    is_entry: bool = False
+    op_names: List[str] = field(default_factory=list)
+    #: (op name, role) pairs that reference this computation
+    called_by: List[Tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def kernel_internal(self) -> bool:
+        """True when this computation's ops execute inside ONE kernel
+        (a fusion body or a scalar to_apply) rather than as a schedule
+        of kernels (the entry, while bodies, cond branches)."""
+        return any(role in _KERNEL_INTERNAL_ROLES
+                   for _, role in self.called_by)
 
 
 @dataclass
@@ -73,6 +136,8 @@ class HloModule:
     uses: Dict[str, List[str]] = field(default_factory=dict)
     input_output_alias: List[Tuple[int, int]] = field(default_factory=list)
     num_partitions: int = 1
+    computations: Dict[str, HloComputation] = field(default_factory=dict)
+    entry: Optional[str] = None
 
     def consumers(self, name: str) -> List[HloOp]:
         return [self.ops[u] for u in self.uses.get(name, [])
@@ -80,6 +145,37 @@ class HloModule:
 
     def by_opcode(self, *opcodes: str) -> List[HloOp]:
         return [op for op in self.ops.values() if op.opcode in opcodes]
+
+    def fused_ops(self, op: HloOp) -> List[HloOp]:
+        """The ops inside a fusion op's body computation (``calls=``),
+        text order; [] for non-fusion ops or unresolvable bodies."""
+        out: List[HloOp] = []
+        for comp_name in op.called.get("calls", ()):
+            comp = self.computations.get(comp_name)
+            if comp is None:
+                continue
+            out.extend(self.ops[n] for n in comp.op_names
+                       if n in self.ops)
+        return out
+
+    def schedulable_computations(self) -> List[HloComputation]:
+        """Computations whose ops run as a SCHEDULE of kernels: the
+        entry plus control-flow bodies (while body/cond, conditional
+        branches). Fusion bodies and scalar appliers are excluded —
+        their ops live inside one kernel."""
+        return [c for c in self.computations.values()
+                if not c.kernel_internal]
+
+    def parent_fusion(self, op: HloOp) -> Optional[HloOp]:
+        """The fusion op whose body contains ``op`` (None for ops at a
+        schedulable level or in non-fusion computations)."""
+        comp = self.computations.get(op.computation or "")
+        if comp is None:
+            return None
+        for caller, role in comp.called_by:
+            if role == "calls" and caller in self.ops:
+                return self.ops[caller]
+        return None
 
 
 def parse_replica_groups(line: str, num_devices: int) \
@@ -151,7 +247,20 @@ def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
     np_m = re.search(r"num_partitions=(\d+)", text[:2000] if text else "")
     if np_m:
         mod.num_partitions = int(np_m.group(1))
+    current: Optional[HloComputation] = None
     for line in (text or "").splitlines():
+        cm = _COMPUTATION_RE.match(line)
+        if cm and "=" not in line.split("(", 1)[0]:
+            name = cm.group(2)
+            current = mod.computations.setdefault(
+                name, HloComputation(name=name))
+            if cm.group(1):
+                current.is_entry = True
+                mod.entry = name
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
         om = _OP_RE.match(line)
         if not om:
             continue
@@ -162,9 +271,14 @@ def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
         # first `),` boundary which ends the operand list in practice)
         operand_src = rest.split("), ")[0]
         operands = _OPERAND_RE.findall(operand_src)
+        typed = dict(
+            (n, t) for t, n in _TYPED_OPERAND_RE.findall(operand_src))
         op = HloOp(name=name, opcode=opcode, type_str=type_str,
                    elements=elems, dtype=dtype, bytes=nbytes,
-                   operands=operands, line=line)
+                   operands=operands, line=line,
+                   computation=current.name if current else None,
+                   operand_types=[typed.get(o) for o in operands],
+                   is_root=line.lstrip().startswith("ROOT "))
         if opcode in ("all-reduce", "all-gather", "reduce-scatter",
                       "collective-permute", "all-to-all",
                       "all-reduce-start", "all-gather-start",
@@ -174,10 +288,29 @@ def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
             tm = re.search(r'custom_call_target="([^"]+)"', line)
             if tm:
                 op.custom_call_target = tm.group(1)
+        if opcode == "fusion":
+            km = _FUSION_KIND_RE.search(rest)
+            if km:
+                op.fusion_kind = km.group(1).lower()
+        for role, comp_name in _CALLED_RE.findall(rest):
+            op.called.setdefault(role, []).append(comp_name)
+        bm = _BRANCHES_RE.search(rest)
+        if bm:
+            for ref in _OPERAND_RE.findall(bm.group(1)):
+                op.called.setdefault("branch", []).append(ref)
+        if current is not None:
+            current.op_names.append(name)
         # keep the first definition (entry computation ops can collide
         # with fusion-internal names; censuses only need one)
         if name not in mod.ops:
             mod.ops[name] = op
         for src in operands:
             mod.uses.setdefault(src, []).append(name)
+    # link computation <- caller references
+    for op in mod.ops.values():
+        for role, comps in op.called.items():
+            for comp_name in comps:
+                comp = mod.computations.get(comp_name)
+                if comp is not None:
+                    comp.called_by.append((op.name, role))
     return mod

@@ -19,6 +19,14 @@ MXA004 unkeyed host randomness — ``numpy.random.*`` / stdlib
        ``random.*`` inside a forward runs ONCE at trace time and
        becomes a constant (use ``mx.nd.random``, which threads the
        per-step key through the compiled program)
+MXA005 Python ``for`` loop over a tracer/tensor dimension — ``for i
+       in range(x.shape[0])`` (or iterating a traced array directly)
+       unrolls into one long unfusable op chain at trace time; XLA
+       cannot fuse across the unrolled iterations and the fusion
+       census shows the fragmentation (use ``lax.scan`` semantics —
+       ``gluon.rnn``'s fused layers — or vectorize).  Literal
+       ``range(<const>)`` loops are not flagged; intentionally-small
+       dynamic loops are blessed via the allowlist
 ====== =====================================================
 
 Scope: ``forward`` / ``hybrid_forward`` method bodies (and functions
@@ -82,15 +90,19 @@ class _ForwardLint(ast.NodeVisitor):
     sanitizes."""
 
     def __init__(self, filename: str, lines: Sequence[str], qualname: str,
-                 tainted: Set[str]):
+                 tainted: Set[str],
+                 rules: Optional[Set[str]] = None):
         self.filename = filename
         self.lines = lines
         self.qualname = qualname
         self.tainted = set(tainted)
+        self.rules = rules            # None = every rule
         self.findings: List[Finding] = []
 
     # ---------------- reporting ----------------
     def _flag(self, node, rule: str, message: str, severity="error"):
+        if self.rules is not None and rule not in self.rules:
+            return
         lineno = getattr(node, "lineno", 0)
         line = self.lines[lineno - 1] if 0 < lineno <= len(self.lines) \
             else ""
@@ -180,7 +192,41 @@ class _ForwardLint(ast.NodeVisitor):
 
     def visit_For(self, node):
         self._bind(node.target, self._is_tainted(node.iter))
+        self._check_unrolled_loop(node)
         self.generic_visit(node)
+
+    def _check_unrolled_loop(self, node):
+        """MXA005: a ``for`` that unrolls tensor work at trace time.
+
+        Candidates: ``range(<non-literal>)`` (shape-derived or variable
+        trip counts — ``range(3)`` is visibly small and static, never
+        flagged) and direct iteration over a traced array.  Only loops
+        whose BODY touches traced values fire — a loop over config
+        lists or child blocks is ordinary Python."""
+        it = node.iter
+        over = None
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range" and it.args:
+            if not all(isinstance(a, ast.Constant) for a in it.args):
+                over = "range(<dynamic>)"
+        elif self._is_tainted(it):
+            over = "a traced array"
+        if over is None:
+            return
+        body_touches_tracer = any(
+            isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            and n.id in self.tainted
+            for stmt in node.body for n in ast.walk(stmt))
+        if not body_touches_tracer:
+            return
+        self._flag(node, "MXA005",
+                   f"Python `for` over {over} inside a forward unrolls "
+                   "into one long unfusable op chain at trace time "
+                   "(every iteration compiles its own ops; XLA cannot "
+                   "fuse across them) — use lax.scan semantics "
+                   "(gluon.rnn fused layers) or vectorize; bless "
+                   "intentionally-small static loops via the allowlist",
+                   severity="warn")
 
     def visit_If(self, node):
         if self._is_tainted(node.test):
@@ -273,20 +319,26 @@ class _ForwardLint(ast.NodeVisitor):
 
 
 def _iter_forward_functions(tree: ast.Module):
-    """(qualname, FunctionDef, tainted-arg-names) for every forward/
-    hybrid_forward method in the module."""
+    """(qualname, FunctionDef, tainted-arg-names, rule-subset) for every
+    forward/hybrid_forward method in the module — plus ``unroll``
+    methods (the rnn API's forward-over-time), scanned for the
+    loop-unrolling rule MXA005 only: unroll takes config flags
+    (``layout``, ``merge_outputs``) that the all-args-tainted forward
+    convention would false-flag under the other rules."""
     for cls in ast.walk(tree):
         if not isinstance(cls, ast.ClassDef):
             continue
         for item in cls.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and item.name in ("forward", "hybrid_forward"):
+                    and item.name in ("forward", "hybrid_forward",
+                                      "unroll"):
                 args = [a.arg for a in item.args.args
                         + item.args.posonlyargs + item.args.kwonlyargs]
                 if item.args.vararg:
                     args.append(item.args.vararg.arg)
                 tainted = {a for a in args if a not in ("self", "F")}
-                yield f"{cls.name}.{item.name}", item, tainted
+                rules = {"MXA005"} if item.name == "unroll" else None
+                yield f"{cls.name}.{item.name}", item, tainted, rules
 
 
 def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
@@ -300,8 +352,9 @@ def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
                         where=f"{filename}:{e.lineno or 0}")]
     lines = src.splitlines()
     findings: List[Finding] = []
-    for qualname, fn, tainted in _iter_forward_functions(tree):
-        linter = _ForwardLint(filename, lines, qualname, tainted)
+    for qualname, fn, tainted, rules in _iter_forward_functions(tree):
+        linter = _ForwardLint(filename, lines, qualname, tainted,
+                              rules=rules)
         for stmt in fn.body:
             linter.visit(stmt)
         findings.extend(linter.findings)

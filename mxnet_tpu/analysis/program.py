@@ -243,12 +243,20 @@ def host_transfer_scan(closed_jaxpr, hlo_text: str = "") -> List[Finding]:
                             (f" (callback={cb!r})" if cb else ""),
                     where=_eqn_where(eqn)))
     mod = parse_hlo(hlo_text or "")
+
+    def _where(op):
+        # an op XLA already pulled into a fusion body is still a host
+        # round-trip per step — name the kernel it hides in
+        parent = mod.parent_fusion(op)
+        return f"{op.name} (inside fusion %{parent.name})" if parent \
+            else op.name
+
     for op in mod.ops.values():
         if op.opcode in _HOST_OPCODES:
             findings.append(Finding(
                 checker="program", rule="host-transfer",
                 message=f"`{op.opcode}` op in the optimized program",
-                where=op.name))
+                where=_where(op)))
         elif op.opcode == "custom-call" and op.custom_call_target and \
                 any(k in op.custom_call_target
                     for k in _HOST_CUSTOM_CALL_MARKERS):
@@ -256,7 +264,7 @@ def host_transfer_scan(closed_jaxpr, hlo_text: str = "") -> List[Finding]:
                 checker="program", rule="host-transfer",
                 message="host-callback custom-call "
                         f"`{op.custom_call_target}`",
-                where=op.name))
+                where=_where(op)))
     return findings
 
 
@@ -264,9 +272,45 @@ def host_transfer_scan(closed_jaxpr, hlo_text: str = "") -> List[Finding]:
 # dtype drift
 # ---------------------------------------------------------------------------
 
+_HLO_DTYPE_NAMES = {"bf16": "bfloat16", "f16": "float16",
+                    "f32": "float32", "f64": "float64"}
+
+
+def _dtype_drift_scan_hlo(hlo_text: str, blessed) -> List[Finding]:
+    """HLO-level widening-``convert`` scan — the fallback when no
+    jaxpr is available (canned programs, lowered-only analysis).
+    Walks EVERY computation, so converts XLA already pulled into a
+    fusion body are seen and attributed to their kernel."""
+    mod = parse_hlo(hlo_text or "")
+    findings: List[Finding] = []
+    for op in mod.ops.values():
+        if op.opcode != "convert":
+            continue
+        src_t = op.operand_types[0] if op.operand_types else None
+        src = _HLO_DTYPE_NAMES.get(
+            (src_t or "").split("[", 1)[0])
+        dst = _HLO_DTYPE_NAMES.get(op.dtype or "")
+        if not src or not dst:
+            continue
+        if _WIDTH.get(dst, 0) <= _WIDTH.get(src, 0):
+            continue
+        is_blessed = (src, dst) in blessed and dst != "float64"
+        parent = mod.parent_fusion(op)
+        findings.append(Finding(
+            checker="program", rule="dtype-drift",
+            severity="error" if dst == "float64" else "warn",
+            blessed=is_blessed,
+            message=f"widening convert {src} -> {dst} in the optimized "
+                    "program" + (" (blessed by the multi-precision "
+                                 "master list)" if is_blessed else ""),
+            where=f"{op.name} (inside fusion %{parent.name})" if parent
+            else op.name))
+    return findings
+
+
 def dtype_drift_scan(closed_jaxpr,
-                     blessed: Optional[Sequence[Tuple[str, str]]] = None) \
-        -> List[Finding]:
+                     blessed: Optional[Sequence[Tuple[str, str]]] = None,
+                     hlo_text: str = "") -> List[Finding]:
     """Unexpected widening ``convert_element_type`` chains.
 
     Narrowing (f32->bf16 AMP casts) is free; widening silently doubles
@@ -274,11 +318,16 @@ def dtype_drift_scan(closed_jaxpr,
     dtype-name pairs that are intentional — the multi-precision master
     list blesses ('bfloat16','float32')/('float16','float32') because
     fp32 masters are the POINT of that mode.  f32->f64 is never blessed
-    (nothing in this framework wants f64)."""
+    (nothing in this framework wants f64).
+
+    The jaxpr (pre-optimization) sees every convert, fused or not;
+    when no jaxpr is available the scan falls back to the optimized
+    HLO's ``convert`` ops — walking fusion BODIES too, which the old
+    entry-only reading silently skipped once XLA fused a convert."""
     blessed = {tuple(b) for b in (blessed or ())}
     findings: List[Finding] = []
     if closed_jaxpr is None:
-        return findings
+        return _dtype_drift_scan_hlo(hlo_text, blessed)
     for eqn in _iter_eqns(closed_jaxpr):
         if eqn.primitive.name != "convert_element_type":
             continue
@@ -343,7 +392,21 @@ def analyze_lowered(lowered, mesh=None, expected_donated=None,
     report.donation = donation_audit(stablehlo, hlo_text, mem,
                                      expected=expected_donated)
     report.host_transfers = host_transfer_scan(jaxpr, hlo_text)
-    report.dtype_drift = dtype_drift_scan(jaxpr, blessed=blessed_dtypes)
+    report.dtype_drift = dtype_drift_scan(jaxpr, blessed=blessed_dtypes,
+                                          hlo_text=hlo_text)
+    if hlo_text:
+        try:
+            from . import fusion as _fusion
+            report.fusion = _fusion.fusion_census(hlo_text)
+            report.findings.extend(report.fusion.findings)
+            env = _fusion.baseline_from_env()
+            if env is not None:
+                baselines, leg = env
+                report.findings.extend(_fusion.check_baseline(
+                    report.fusion, baselines, leg or mode))
+            _fusion.publish(report.fusion)
+        except Exception:       # pragma: no cover - defensive
+            _LOG.debug("fusion census failed", exc_info=True)
     for p in report.donation.copied:
         report.add(Finding(
             checker="program", rule="donation-copy",
@@ -460,6 +523,23 @@ def expect_mode(report: ProgramReport, mode: Optional[str] = None,
                 severity="warn",
                 message=f"single-device fused step emits collectives "
                         f"({c.by_kind}) — unexpected partitioning"))
+    # fusion pack (every compiled mode): the optimized program must
+    # have NO fusable elementwise/broadcast/convert op stranded between
+    # two fusions above the size floor — each one is two avoidable HBM
+    # round-trips per step the value-level tests cannot see
+    # (arXiv:2301.13062; the fusion census produces the evidence)
+    fr = report.fusion
+    if mode in ("fused", "fused-mesh", "zero") and fr is not None \
+            and fr.stranded:
+        worst = fr.stranded[0]
+        report.add(Finding(
+            checker="fusion", rule="stranded-op",
+            message=f"{len(fr.stranded)} fusable op(s) above the "
+                    f"{fr.stranded_floor} B floor stranded between "
+                    f"fusions in the {mode} step (worst: "
+                    f"`{worst.opcode}` {worst.bytes} B at {worst.name})"
+                    " — the ideal-fusion contract regressed",
+            where=worst.name))
     return report
 
 

@@ -166,6 +166,9 @@ class ProgramReport:
     #: .to_dict(): argument/output/temp/generated_code/donated bytes +
     #: peak estimate) — None where memory_analysis is unavailable
     memory: Optional[Dict[str, int]] = None
+    #: fusion census of the optimized program (analysis.fusion
+    #: .FusionReport) — None where there was no HLO text to audit
+    fusion: Optional[Any] = None
 
     def add(self, finding: Finding):
         self.findings.append(finding)
@@ -212,6 +215,8 @@ class ProgramReport:
             "host_transfers": len(self._unblessed(self.host_transfers)),
             "dtype_drift": len(self._unblessed(self.dtype_drift)),
             "memory": self.memory,
+            "fusion": self.fusion.brief() if self.fusion is not None
+            else None,
             "findings": [str(f) for f in self.all_findings()],
         }
 
@@ -238,6 +243,8 @@ class ProgramReport:
                          f"out={m['output_bytes']} "
                          f"code={m['generated_code_bytes']} "
                          f"donated={m['donated_bytes']})")
+        if self.fusion is not None:
+            lines.append("  fusion      : " + self.fusion.summary_line())
         n_bless = len(self.host_transfers) + len(self.dtype_drift) \
             - len(self._unblessed(self.host_transfers)) \
             - len(self._unblessed(self.dtype_drift))

@@ -1257,10 +1257,24 @@ class CompiledTrainStep:
     def analyze(self, *args, batch_size: Optional[int] = None, **kwargs):
         """Run the program lint over this batch's shape bucket and
         return the :class:`~mxnet_tpu.analysis.ProgramReport` —
-        collective census, donation audit, host transfers, dtype drift
-        (docs/ANALYSIS.md).  Does not advance optimizer counts."""
+        collective census, donation audit, host transfers, dtype drift,
+        fusion census (docs/ANALYSIS.md).  Does not advance optimizer
+        counts."""
         from ..analysis.program import analyze_step
         return analyze_step(self, *args, batch_size=batch_size, **kwargs)
+
+    def fusion_report(self, *args, batch_size: Optional[int] = None,
+                      **kwargs):
+        """Fusion census of this batch bucket's OPTIMIZED program
+        (:class:`~mxnet_tpu.analysis.fusion.FusionReport`): every
+        fusion/compute kernel with its op census, FLOP estimate and
+        boundary bytes, the stranded-op ideal-fusion diff, and the
+        compute-/memory-bound classification against the BENCH roofline
+        ridge (docs/ANALYSIS.md "Fusion census").  ``None`` on the
+        eager path — there is no compiled program to audit.  Cached
+        with the bucket's :meth:`analyze` report."""
+        report = self.analyze(*args, batch_size=batch_size, **kwargs)
+        return getattr(report, "fusion", None)
 
     def lower_entry(self, *args, batch_size: Optional[int] = None,
                     **kwargs):

@@ -119,6 +119,14 @@ NUMERICS_NONFINITE = "mx_numerics_nonfinite_total"
 NUMERICS_DUMPS = "mx_numerics_dumps_total"
 
 # ---------------------------------------------------------------------------
+# fusion census (analysis/fusion.py)
+# ---------------------------------------------------------------------------
+FUSION_REGIONS = "mx_fusion_regions"
+FUSION_STRANDED = "mx_fusion_stranded_ops"
+FUSION_BOUNDARY_BYTES = "mx_fusion_boundary_bytes"
+FUSION_COMPUTE_BOUND = "mx_fusion_compute_bound_ratio"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -279,6 +287,24 @@ CATALOG = {
         kind="counter", label=None,
         help="numerics post-mortem dump files written to "
              "MXNET_NUMERICS_DUMP_DIR"),
+    FUSION_REGIONS: dict(
+        kind="gauge", label=None,
+        help="fusion kernels in the last-analyzed compiled step "
+             "program (analysis/fusion.py census)"),
+    FUSION_STRANDED: dict(
+        kind="gauge", label=None,
+        help="unfused elementwise/broadcast/convert ops stranded "
+             "between two fusions above the size floor — each one two "
+             "avoidable HBM round-trips per step"),
+    FUSION_BOUNDARY_BYTES: dict(
+        kind="gauge", label=None,
+        help="intermediate bytes materialized at kernel boundaries of "
+             "the last-analyzed step program (written to and re-read "
+             "from HBM)"),
+    FUSION_COMPUTE_BOUND: dict(
+        kind="gauge", label=None,
+        help="FLOP-weighted share (0-1) of kernels whose arithmetic "
+             "intensity clears the measured roofline ridge point"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

@@ -358,6 +358,31 @@ def test_program_report_plain_fused_donates_everything(program_report):
     assert rep.ok, rep.summary()
 
 
+def test_program_report_fused_step_zero_stranded_ops(program_report):
+    """ISSUE 9 structural acceptance: the plain fused MLP step's
+    OPTIMIZED program carries a populated fusion census with ZERO
+    fusable ops stranded between two fusions above the size floor —
+    XLA fused everything it could, and the ideal-fusion diff
+    (arXiv:2301.13062) stays silent.  A future change that fragments
+    the step program (an op XLA stops fusing, a layout transpose
+    between kernels) fails HERE, not as an MFU drop later."""
+    net = _build(with_bn=True)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+    x, y = _batch()
+    step(x, y)
+    rep = program_report(step, x, y)
+    fr = rep.fusion
+    assert fr is not None and fr.n_fusions > 0, rep.summary()
+    assert fr.stranded == [], rep.summary()
+    assert fr.boundary_bytes > 0          # kernels do exchange data
+    assert all(k.kind in ("loop", "input", "output", "custom")
+               for k in fr.fusions)
+    assert rep.ok, rep.summary()
+
+
 def test_program_report_donate_false_expects_nothing(program_report):
     """donate=False: the audit must not demand aliasing that was never
     requested."""

@@ -130,6 +130,20 @@ def test_diagnose_analysis_section(capsys):
     assert "verdict      : OK" in out
 
 
+def test_diagnose_fusion_section(capsys):
+    """--fusion: the census prints a kernel table for both canonical
+    legs (tiny MLP + the LSTM-LM example architecture) with bound
+    classes and the stranded-op verdict."""
+    diagnose = _load("tools/diagnose.py", "diagnose4")
+    assert diagnose.main(["--fusion"]) == 0
+    out = capsys.readouterr().out
+    assert "Fusion Census" in out
+    assert "tiny MLP" in out and "LSTM LM" in out
+    assert "fusions=" in out and "boundary_bytes=" in out
+    assert "memory" in out            # bound class column populated
+    assert "stranded ops : none above the" in out
+
+
 def test_diagnose_numerics_section(capsys, tmp_path, monkeypatch):
     """--numerics: the 10-step norm table prints with finite values and
     the simulated-divergence demo produces exactly one anomaly plus a

@@ -374,6 +374,90 @@ def check_numerics():
         print("numerics check failed:", repr(e))
 
 
+def _fusion_leg(title, step, x, y):
+    """Compile one train-step leg and print its fusion census: the
+    kernel table (kind, ops, FLOPs, boundary bytes, bound class), the
+    headline posture, and the top stranded ops."""
+    step(x, y)
+    report = step.analyze(x, y)
+    fr = report.fusion
+    print(f"-- {title} (mode={report.mode}) --")
+    if fr is None:
+        print("no compiled program (eager mode) — nothing to audit")
+        return
+    print(fr.summary_line())
+    print(fr.table(top=12))
+    if fr.stranded:
+        print("top stranded ops (unfused between two fusions):")
+        for s in fr.stranded[:5]:
+            print(f"  {s.name:<36s} {s.opcode:<12s} {s.bytes:>10d} B "
+                  f"between {s.producer} -> {','.join(s.consumers[:2])}")
+    else:
+        print("stranded ops : none above the "
+              f"{fr.stranded_floor} B floor")
+    if fr.boundaries:
+        print("largest boundary materializations:")
+        for b in fr.boundaries[:5]:
+            print(f"  {b.name:<36s} {b.opcode:<12s} {b.bytes:>10d} B -> "
+                  f"{len(b.consumers)} consumer(s)")
+
+
+def check_fusion():
+    """Fusion-census health (docs/ANALYSIS.md "Fusion census"): audit
+    XLA's fusion decisions for two canonical legs — a tiny MLP and the
+    LSTM-LM architecture of examples/train_lstm_lm.py (the worst-MFU
+    BENCH leg) — printing each kernel's kind/ops/FLOPs/boundary bytes
+    and bound class, plus any stranded ops the ideal-fusion diff of
+    arXiv:2301.13062 flags."""
+    print("----------Fusion Census----------")
+    try:
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon import Trainer, nn, rnn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        onp.random.seed(0)
+        loss = SoftmaxCrossEntropyLoss()
+
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(16, 16).astype("float32"))
+        y = mx.nd.array(onp.random.randint(0, 8, size=(16,))
+                        .astype("int32"))
+        net(x)
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          kvstore=None)
+        step = trainer.compile_step(lambda a, b: loss(net(a), b))
+        _fusion_leg("tiny MLP", step, x, y)
+
+        class _LM(mx.gluon.HybridBlock):   # examples/train_lstm_lm.py
+            def __init__(self, vocab, embed, hidden):
+                super().__init__()
+                self.emb = nn.Embedding(vocab, embed)
+                self.lstm = rnn.LSTM(hidden, num_layers=1, layout="NTC")
+                self.head = nn.Dense(vocab, flatten=False)
+
+            def forward(self, tokens):
+                return self.head(self.lstm(self.emb(tokens)))
+
+        vocab = 16
+        lm = _LM(vocab, 8, 16)
+        lm.initialize()
+        xt = mx.nd.array(onp.random.randint(0, vocab, size=(4, 8))
+                         .astype("int32"))
+        yt = mx.nd.array(onp.random.randint(0, vocab, size=(4, 8))
+                         .astype("int32"))
+        lm(xt)
+        lm_tr = Trainer(lm.collect_params(), "adam",
+                        {"learning_rate": 5e-3}, kvstore=None)
+        lm_step = lm_tr.compile_step(lambda a, b: loss(lm(a), b))
+        _fusion_leg("LSTM LM (worst-MFU leg)", lm_step, xt, yt)
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("fusion check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -449,6 +533,11 @@ def main(argv=None):
                         "train step: 10-step grad/param-norm table plus "
                         "a simulated-divergence demo (one anomaly, "
                         "NaN-origin forensics, post-mortem dump)")
+    parser.add_argument("--fusion", action="store_true",
+                        help="also audit XLA's fusion decisions for a "
+                        "tiny MLP and the LSTM-LM example: kernel "
+                        "table (kind/ops/FLOPs/boundary bytes/bound "
+                        "class) plus top stranded ops")
     parser.add_argument("--timeout", type=int, default=10)
     args = parser.parse_args(argv)
     check_python()
@@ -465,6 +554,8 @@ def main(argv=None):
         check_memory()
     if args.numerics:
         check_numerics()
+    if args.fusion:
+        check_fusion()
     check_os()
     check_environment()
     if args.network:
