@@ -104,6 +104,16 @@ def compile_step(step_fn, *args):
     return comp, flops
 
 
+def _kernel_path():
+    """{kernel: pallas|interpret|xla} under the live env/backend
+    (ops/kernels dispatch gate)."""
+    try:
+        from mxnet_tpu.ops import kernels as _k
+        return _k.dispatch_table()
+    except Exception:  # pragma: no cover - must not kill a bench
+        return None
+
+
 def framework_loop(net, lr, momentum=0.9):
     """The PRODUCT train-step path: gluon.TrainLoop over
     Trainer.compile_step — forward+backward+update as ONE donated-buffer
@@ -140,7 +150,11 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
            # fusion posture next to MFU (docs/ANALYSIS.md "Fusion
            # census"): the pending hardware re-capture records these
            # as the per-leg baselines the regression gate bands around
-           "fusion": d["fusion"]}
+           "fusion": d["fusion"],
+           # which implementation produced this number: per-kernel
+           # MXNET_PALLAS dispatch (pallas/interpret/xla) — a perf
+           # delta between captures must name its kernel path
+           "kernel_path": _kernel_path()}
     log(f"bench[{tag}]: analysis {out}")
     return out
 

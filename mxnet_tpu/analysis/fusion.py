@@ -50,9 +50,9 @@ from .hlo import HloModule, HloOp, parse_hlo, parse_shape_elements
 from .report import Finding
 
 __all__ = ["FusionKernel", "StrandedOp", "Boundary", "FusionReport",
-           "fusion_census", "op_flops", "load_baselines",
-           "check_baseline", "baseline_from_env", "publish",
-           "STRANDED_FLOOR_BYTES", "BOUNDARY_FLOOR_BYTES",
+           "fusion_census", "op_flops", "register_custom_call_flops",
+           "load_baselines", "check_baseline", "baseline_from_env",
+           "publish", "STRANDED_FLOOR_BYTES", "BOUNDARY_FLOOR_BYTES",
            "RIDGE_FLOPS_PER_BYTE"]
 
 _LOG = logging.getLogger("mxnet_tpu.analysis")
@@ -125,6 +125,129 @@ def _dims_of(type_str: Optional[str]) -> List[int]:
     return [int(d) for d in m.group(1).split(",") if d]
 
 
+def _prod(dims: List[int]) -> int:
+    out = 1
+    for d in dims:
+        out *= d
+    return out
+
+
+# custom-call FLOP estimators: without these every hand-written kernel
+# (flash attention today, the ops/kernels layer's scan/optimizer/norm
+# kernels tomorrow) counts ZERO FLOPs in the census — its arithmetic
+# intensity degenerates to 0, it classifies memory-bound, and
+# compute_bound_pct under-counts the very kernels written to be
+# compute-dense. Matchers are substrings tested against the op's full
+# HLO line (Mosaic kernels all share the `tpu_custom_call` target; the
+# kernel function name survives in the op_name metadata).
+_CUSTOM_CALL_FLOPS: List[tuple] = []
+
+
+def register_custom_call_flops(name: str, fn, match: Optional[str] = None):
+    """Register a FLOP estimator for custom-call kernels.
+
+    ``fn(op: HloOp, mod: HloModule|None) -> int`` runs when ``match``
+    (default: ``name``) appears in the custom-call's HLO line (target
+    or metadata op_name). First match in registration order wins on
+    overlap; re-registering an existing ``name`` replaces it
+    (idempotent module reloads)."""
+    key = (match or name).lower()
+    for i, (n, _, _) in enumerate(_CUSTOM_CALL_FLOPS):
+        if n == name:
+            _CUSTOM_CALL_FLOPS[i] = (name, key, fn)
+            return
+    _CUSTOM_CALL_FLOPS.append((name, key, fn))
+
+
+def _custom_call_flops(op: HloOp, mod: Optional[HloModule]) -> int:
+    line = op.line.lower()
+    for _, key, fn in _CUSTOM_CALL_FLOPS:
+        if key in line:
+            try:
+                return int(fn(op, mod))
+            except Exception:      # estimator bug must not kill a census
+                _LOG.debug("custom-call flop estimator failed for %s",
+                           op.name, exc_info=True)
+                return 0
+    return 0
+
+
+def _operand_dims(op: HloOp, mod: Optional[HloModule],
+                  i: int) -> List[int]:
+    """Dims of operand ``i``: from the inline operand type when the
+    HLO carries it, else resolved through the producing op."""
+    if i < len(op.operand_types) and op.operand_types[i]:
+        return _dims_of(op.operand_types[i])
+    if mod is not None and i < len(op.operands):
+        prod = mod.ops.get(op.operands[i])
+        if prod is not None:
+            return _dims_of(prod.type_str)
+    return []
+
+
+def _flash_fwd_flops(op: HloOp, mod=None) -> int:
+    # q (BH, Sq, D), k (BH, Sk, D): two (Sq x Sk x D) matmuls
+    q = _operand_dims(op, mod, 0)
+    k = _operand_dims(op, mod, 1)
+    if len(q) < 3 or len(k) < 3:
+        return 0
+    return 4 * q[0] * q[1] * k[1] * q[2]
+
+
+def _flash_bwd_flops(factor: int):
+    def fn(op: HloOp, mod=None) -> int:
+        base = _flash_fwd_flops(op, mod)
+        return base // 4 * factor
+    return fn
+
+
+def _rnn_scan_flops(op: HloOp, mod=None) -> int:
+    # xw (T, N, G*H) + resident w_hh (G*H, H): T h2h matmuls + gates
+    xw = _operand_dims(op, mod, 0)
+    if len(xw) < 3:
+        return 0
+    t, n, gh = xw[0], xw[1], xw[2]
+    w = next((d for d in (_operand_dims(op, mod, i)
+                          for i in range(1, len(op.operands)))
+              if len(d) == 2 and d[0] == gh), None)
+    h = w[1] if w else gh
+    return 2 * t * n * gh * h + 10 * t * n * gh
+
+
+def _elementwise_flops(per_element: int):
+    def fn(op: HloOp, mod=None) -> int:
+        widest = max((_prod(_operand_dims(op, mod, i))
+                      for i in range(len(op.operands))), default=0)
+        return per_element * max(op.elements, widest)
+    return fn
+
+
+# the built-in kernel layer (ops/attention.py + ops/kernels/)
+register_custom_call_flops("flash_attention_fwd", _flash_fwd_flops,
+                           match="_flash_kernel")
+register_custom_call_flops("flash_attention_bwd_dq",
+                           _flash_bwd_flops(6), match="_flash_bwd_dq")
+register_custom_call_flops("flash_attention_bwd_dkv",
+                           _flash_bwd_flops(8), match="_flash_bwd_dkv")
+register_custom_call_flops("flash_attention_bwd_fused",
+                           _flash_bwd_flops(10),
+                           match="_flash_bwd_fused")
+register_custom_call_flops("rnn_scan_fwd", _rnn_scan_flops,
+                           match="_fwd_kernel")
+register_custom_call_flops("rnn_scan_bwd", _rnn_scan_flops,
+                           match="_bwd_kernel")
+register_custom_call_flops("opt_update", _elementwise_flops(10),
+                           match="_opt_kernel")
+register_custom_call_flops("layernorm_fwd", _elementwise_flops(8),
+                           match="_ln_fwd_kernel")
+register_custom_call_flops("layernorm_bwd", _elementwise_flops(12),
+                           match="_ln_bwd_kernel")
+register_custom_call_flops("bias_gelu_fwd", _elementwise_flops(15),
+                           match="_bg_fwd_kernel")
+register_custom_call_flops("bias_gelu_bwd", _elementwise_flops(18),
+                           match="_bg_bwd_kernel")
+
+
 def op_flops(op: HloOp, mod: Optional[HloModule] = None) -> int:
     """Estimated FLOPs of one HLO op from its line's shapes.
 
@@ -166,6 +289,8 @@ def op_flops(op: HloOp, mod: Optional[HloModule] = None) -> int:
         if in_bytes is not None and op.operand_types[0]:
             return parse_shape_elements(op.operand_types[0])[0]
         return op.elements
+    if op.opcode == "custom-call":
+        return _custom_call_flops(op, mod)
     if op.opcode in _EW_FLOP_OPCODES:
         return op.elements
     return 0

@@ -859,6 +859,21 @@ class CompiledTrainStep:
             # reduce-scatter feeding it), elementwise rule on each
             # replica's shard against permanently-sharded state, new
             # weights constrained back to replicated (all-gather).
+            # The elementwise rule goes through the Pallas fused
+            # multi-tensor update kernel when the MXNET_PALLAS gate
+            # selects it (ops/kernels/opt_update.py; bit-exact vs the
+            # XLA chain, pinned by tests) — one kernel per flat unit
+            # instead of a per-op elementwise chain.
+            try:
+                from ..ops.kernels.opt_update import \
+                    kernel_step_fn as _opt_kfn
+                opt_kernel_fn = _opt_kfn(self._trainer._optimizer)
+            except Exception:   # kernel layer must never kill a step
+                _LOG.debug("opt-update kernel unavailable",
+                           exc_info=True)
+                opt_kernel_fn = None
+            if opt_kernel_fn is not None:
+                opt_fn = opt_kernel_fn
             plan = self._zero
             shard, repl = plan.shard, plan.repl
             units = plan.units

@@ -123,7 +123,14 @@ class MultiHeadAttention(HybridBlock):
 
 
 class PositionwiseFFN(HybridBlock):
-    """Transformer FFN: dense → activation → dense (+ dropout)."""
+    """Transformer FFN: dense → activation → dense (+ dropout).
+
+    With ``activation='gelu'`` the first dense's bias add and the GELU
+    fuse into one Pallas kernel when the MXNET_PALLAS gate selects it
+    (ops/kernels/norm.py ``bias_gelu``; the matmul stays on the MXU) —
+    XLA otherwise materializes the (tokens, hidden) pre-activation to
+    HBM between the two. Identical math: gelu((x W^T) + b), exact erf
+    form, same parameters."""
 
     def __init__(self, units: int, hidden_size: int, dropout: float = 0.0,
                  activation: str = "gelu", **kwargs):
@@ -133,8 +140,32 @@ class PositionwiseFFN(HybridBlock):
         self._activation = activation
         self.dropout = Dropout(dropout)
 
+    def _bias_gelu_path(self, x):
+        """'interpret'/'pallas' when the fused bias-GELU kernel should
+        take this call, else None (reference Dense→Activation)."""
+        if self._activation != "gelu" or self.ffn_1.bias is None:
+            return None
+        from ...ops.kernels import dispatch as _kdispatch
+        from ...ops.kernels import norm as _knorm
+        why = _knorm.norm_supported(x, self.ffn_1.weight.shape[0])
+        path, _ = _kdispatch("bias_gelu", supported=why is None,
+                             reason=why)
+        return None if path == "xla" else path
+
     def forward(self, x):
-        h = F.Activation(self.ffn_1(x), act_type=self._activation)
+        path = self._bias_gelu_path(x)
+        if path is not None:
+            from ...ops.kernels.norm import bias_gelu
+            interpret = path == "interpret"
+
+            def fn(x_, w_, b_):
+                return bias_gelu(x_ @ w_.T, b_, interpret=interpret)
+
+            h = invoke_raw("bias_gelu_dense", fn,
+                           [x, self.ffn_1.weight.data(),
+                            self.ffn_1.bias.data()])
+        else:
+            h = F.Activation(self.ffn_1(x), act_type=self._activation)
         return self.dropout(self.ffn_2(h))
 
 

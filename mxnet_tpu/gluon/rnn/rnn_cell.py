@@ -123,6 +123,78 @@ class _BaseRNNCell(RecurrentCell):
         return [{"shape": (batch_size, self._hidden_size),
                  "__layout__": "NC"}]
 
+    def _fused_mode(self) -> Optional[str]:
+        """ops/rnn.py mode string when this EXACT cell class's step
+        math matches the fused recurrence (None: keep the step loop).
+        Subclasses/modifier cells override forward, so only the three
+        plain gated cells qualify."""
+        return None
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroller dispatch: a plain gated cell over a merged (N, T,
+        C)/(T, N, C) tensor routes through the SAME fused recurrence
+        as the rnn_layer.py layers (one MXU matmul for all input
+        projections + the time-fused Pallas scan kernel / lax.scan
+        reference, per the MXNET_PALLAS gate) instead of a Python
+        step loop — identical math and step semantics (the parity is
+        pinned by tests). Step lists, valid_length masking and
+        modifier cells keep the reference loop."""
+        mode = self._fused_mode()
+        if (mode is None or valid_length is not None
+                or not isinstance(inputs, NDArray)
+                or getattr(inputs, "ndim", 0) != 3
+                or layout not in ("NTC", "TNC")):
+            return super().unroll(length, inputs, begin_state, layout,
+                                  merge_outputs, valid_length)
+        from ...ops import rnn as rnn_ops
+        from ...ops.registry import invoke_raw
+        x = inputs
+        t_axis = layout.find("T")
+        if layout == "NTC":
+            x = x.transpose((1, 0, 2))
+        if length is not None and x.shape[0] != length:
+            raise MXNetError(
+                f"expected {length} steps, got {x.shape[0]}")
+        if self._input_size == 0:
+            self._input_size = x.shape[-1]
+            self.i2h_weight.shape = (self.i2h_weight.shape[0],
+                                     x.shape[-1])
+        try:
+            pd = [p.data() for p in (self.i2h_weight, self.h2h_weight,
+                                     self.i2h_bias, self.h2h_bias)]
+        except Exception:   # deferred init: the step loop infers it
+            return super().unroll(length, inputs, begin_state, layout,
+                                  merge_outputs, valid_length)
+        batch = x.shape[1]
+        if begin_state is None:
+            begin_state = self.begin_state(batch, dtype=str(x.dtype))
+        lstm = mode == "lstm"
+        h0 = begin_state[0].reshape((1,) + tuple(begin_state[0].shape))
+        c0 = begin_state[1].reshape(h0.shape) if lstm else None
+
+        def fn(x_, h0_, *rest):
+            if lstm:
+                c0_, *pk = rest
+            else:
+                c0_, pk = None, list(rest)
+            y, h, c = rnn_ops.fused_rnn(x_, h0_, c0_, pk, mode, 1,
+                                        False)
+            return (y, h, c) if c is not None else (y, h)
+
+        n_state = 2 if lstm else 1
+        res = invoke_raw(f"rnn_{mode}_unroll", fn,
+                         [x, h0] + ([c0] if lstm else []) + pd,
+                         n_outputs=1 + n_state)
+        y, out_states = res[0], [s.reshape(tuple(s.shape[1:]))
+                                 for s in res[1:]]
+        if layout == "NTC":
+            y = y.transpose((1, 0, 2))
+        if merge_outputs:
+            return y, out_states
+        return ([y.take(i, axis=t_axis) for i in range(y.shape[t_axis])],
+                out_states)
+
     def _proj(self, x, h):
         if self._input_size == 0:
             self._input_size = x.shape[-1]
@@ -149,6 +221,12 @@ class RNNCell(_BaseRNNCell):
         super().__init__(hidden_size, **kwargs)
         self._activation = activation
 
+    def _fused_mode(self):
+        if type(self) is RNNCell and self._activation in ("tanh",
+                                                          "relu"):
+            return f"rnn_{self._activation}"
+        return None
+
     def forward(self, inputs, states):
         i2h, h2h = self._proj(inputs, states[0])
         out = F.Activation(i2h + h2h, act_type=self._activation)
@@ -163,6 +241,9 @@ class LSTMCell(_BaseRNNCell):
     def state_info(self, batch_size=0):
         return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
                 {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _fused_mode(self):
+        return "lstm" if type(self) is LSTMCell else None
 
     def forward(self, inputs, states):
         h, c = states
@@ -182,6 +263,9 @@ class GRUCell(_BaseRNNCell):
     """GRU cell, gate order [r, z, n] (reference rnn_cell.py GRUCell)."""
 
     _gates = 3
+
+    def _fused_mode(self):
+        return "gru" if type(self) is GRUCell else None
 
     def forward(self, inputs, states):
         h = states[0]

@@ -8,4 +8,5 @@ autograd tape, and symbolic/deferred-compute tracing.
 """
 from . import registry
 from . import attention
+from . import kernels
 from .registry import Op, register, get_op, invoke, invoke_raw, list_ops

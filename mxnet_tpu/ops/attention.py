@@ -137,15 +137,18 @@ def _head_group(bh: int, block_q: int, block_k: int,
     launch + DMA setup) dominates short-seq attention when the grid has
     one program per (batch, head) — 384 programs for BERT-base bs=32.
     Batch G heads per program, bounded by the CONCURRENT (G, bq, bk) f32
-    tiles' VMEM footprint (~16 MiB/core on v5e, keep them ≤ 4 MiB
-    total). ``n_tiles`` is how many such score-shaped tiles the kernel
-    holds live at once: 1 for the forward (s; p overwrites it), 4 for
-    the fused backward (s, p, dp, ds) — budgeting the backward as a
-    single tile oversizes G and fails Mosaic lowering at large blocks."""
+    tiles' VMEM footprint (~16 MiB/core on v5e; the shared tile budget
+    lives in ops/kernels — the rnn_scan timestep-block sizer accounts
+    against the same number). ``n_tiles`` is how many such score-shaped
+    tiles the kernel holds live at once: 1 for the forward (s; p
+    overwrites it), 4 for the fused backward (s, p, dp, ds) — budgeting
+    the backward as a single tile oversizes G and fails Mosaic lowering
+    at large blocks."""
+    from .kernels import VMEM_TILE_BUDGET_BYTES
     g = 1
     while (g * 2 <= 8 and bh % (g * 2) == 0
            and g * 2 * block_q * block_k * 4 * n_tiles
-           <= 4 * 1024 * 1024):
+           <= VMEM_TILE_BUDGET_BYTES):
         g *= 2
     return g
 
@@ -624,7 +627,15 @@ def flash_attention(q, k, v, causal: bool = False,
         vl = jnp.asarray(valid_length, jnp.float32)
         return _flash_vl(q, k, v, vl, causal, float(sm_scale))
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        # the shared MXNET_PALLAS three-tier gate (ops/kernels):
+        # compiled kernels on TPU, interpret-mode bodies when forced
+        # on other backends, blockwise-XLA reference otherwise
+        from .kernels import dispatch as _kdispatch
+        path, _ = _kdispatch("flash_attention")
+        if path != "xla":
+            return _flash_tpu(q, k, v, causal, float(sm_scale),
+                              path == "interpret")
+        return _flash(q, k, v, causal, float(sm_scale))
     if use_pallas:
         # full-Pallas path: flash forward AND FlashAttention-2-style
         # backward kernels (dq + dkv) off the saved log-sum-exp

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel layer: hand-written kernels for the fusion gaps
+XLA's automatic fuser cannot close (arXiv:2301.13062 measured them; the
+census in analysis/fusion.py ranks them per program).
+
+Members (each joins the flash-attention kernels in ops/attention.py):
+
+- :mod:`.rnn_scan` — time-fused LSTM/GRU/vanilla-RNN recurrence: the
+  hidden-to-hidden matmul, gate nonlinearities and carry update of a
+  whole timestep block live in ONE kernel with h/c pinned in VMEM,
+  killing the per-step HBM round-trips that made LSTM the worst-MFU
+  BENCH leg (0.17).
+- :mod:`.opt_update` — fused elementwise optimizer update (SGD-mom,
+  Adam) over the ZeRO flat padded 1/N shards of gluon/fused_step.py.
+- :mod:`.norm` — LayerNorm and bias-GELU forward+backward kernels for
+  the transformer/BERT leg.
+
+Dispatch discipline (shared by every kernel in this package, and by
+``ops.attention.flash_attention``): one ``MXNET_PALLAS`` gate with
+three tiers —
+
+- ``auto`` (default): compiled Pallas kernels on TPU backends, the XLA
+  reference implementation everywhere else;
+- ``on``: Pallas on TPU; on non-TPU backends the kernels run in
+  ``pl.pallas_call(interpret=True)`` mode — the kernel BODY executes
+  (as plain XLA ops), which is how tier-1 CPU tests exercise kernels
+  and how the parity sweep pins kernel-vs-reference equivalence;
+- ``off``: XLA reference everywhere (including TPU) — the A/B switch
+  for attribution and the escape hatch for a miscompiling kernel.
+
+Every decision is recorded (``decisions()``, ``tools/diagnose.py
+--kernels``) and counted (``mx_kernel_dispatch_total{path}``), and the
+per-leg BENCH json attaches ``dispatch_table()`` next to the fusion
+posture so a throughput number always names the path that produced it.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = ["pallas_mode", "dispatch", "decisions", "dispatch_table",
+           "KERNELS", "VMEM_TILE_BUDGET_BYTES", "VMEM_BYTES_PER_CORE"]
+
+#: VMEM ceiling one kernel's CONCURRENT working-set tiles may claim —
+#: the budget ops.attention._head_group sizes head groups against, and
+#: the one rnn_scan sizes its timestep block against. ~16 MiB/core is
+#: the physical VMEM (v5e); 4 MiB leaves room for Mosaic's own double
+#: buffering of the streamed operands.
+VMEM_BYTES_PER_CORE = 16 * 1024 * 1024
+VMEM_TILE_BUDGET_BYTES = 4 * 1024 * 1024
+
+#: the kernel names the dispatch gate knows (bench/diagnose vocabulary)
+KERNELS = ("rnn_scan", "opt_update", "layernorm", "bias_gelu",
+           "flash_attention")
+
+# last decision per kernel name: {kernel: (path, reason)}
+_DECISIONS: Dict[str, Tuple[str, str]] = {}
+
+
+def pallas_mode() -> str:
+    """Normalized ``MXNET_PALLAS`` setting: 'auto' | 'on' | 'off'."""
+    v = os.environ.get("MXNET_PALLAS", "auto").strip().lower()
+    if v in ("", "auto", "default"):
+        return "auto"
+    if v in ("1", "on", "true", "yes", "force"):
+        return "on"
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    return "auto"
+
+
+def dispatch(kernel: str, supported: bool = True,
+             reason: Optional[str] = None) -> Tuple[str, str]:
+    """The three-tier dispatch decision for one kernel call site.
+
+    Returns ``(path, reason)`` with path one of ``'pallas'`` (compiled
+    TPU kernel), ``'interpret'`` (kernel body under
+    ``pallas_call(interpret=True)``), ``'xla'`` (reference
+    implementation). ``supported=False`` forces the XLA tier with the
+    caller's ``reason`` (shape/mode the kernel does not cover) — the
+    fallback is automatic, never an error."""
+    import jax
+    mode = pallas_mode()
+    if not supported:
+        out = ("xla", reason or "kernel does not cover this case")
+    elif mode == "off":
+        out = ("xla", "MXNET_PALLAS=off")
+    else:
+        backend = jax.default_backend()
+        if backend == "tpu":
+            out = ("pallas", f"MXNET_PALLAS={mode} on tpu")
+        elif mode == "on":
+            out = ("interpret",
+                   f"MXNET_PALLAS=on, non-TPU backend ({backend}): "
+                   "kernel body in interpret mode")
+        else:
+            out = ("xla", f"MXNET_PALLAS=auto, non-TPU backend "
+                          f"({backend}): XLA reference")
+    _DECISIONS[kernel] = out
+    try:
+        from ...telemetry import names as tn
+        from ...telemetry import registry as treg
+        treg().counter(tn.KERNEL_DISPATCH,
+                       label_key="path").inc(label=out[0])
+    except Exception:   # telemetry must never fail a kernel call
+        pass
+    return out
+
+
+def decisions() -> Dict[str, Tuple[str, str]]:
+    """Last dispatch decision per kernel: {name: (path, reason)}."""
+    return dict(_DECISIONS)
+
+
+def dispatch_table() -> Dict[str, str]:
+    """Current {kernel: path} for every known kernel under the live
+    env/backend — the BENCH json's per-leg ``kernel_path`` field (no
+    decision is recorded; this is a pure read)."""
+    import jax
+    mode = pallas_mode()
+    backend = jax.default_backend()
+    if mode == "off":
+        path = "xla"
+    elif backend == "tpu":
+        path = "pallas"
+    elif mode == "on":
+        path = "interpret"
+    else:
+        path = "xla"
+    return {k: path for k in KERNELS}

@@ -228,7 +228,21 @@ def batch_norm_train(x, gamma, beta, eps: float):
 
 def layer_norm(x, gamma, beta, axis: int = -1, eps: float = 1e-5):
     """Reference LayerNorm (src/operator/nn/layer_norm.cc). f32 stats,
-    activation-dtype output."""
+    activation-dtype output.
+
+    Trailing-axis calls dispatch through the Pallas kernel layer when
+    the MXNET_PALLAS gate selects it (ops/kernels/norm.py: one VMEM
+    pass per row block, fused forward+backward; fp32 forward bit-exact
+    vs this reference for 128-lane-aligned widths)."""
+    if axis == -1 or axis == x.ndim - 1:
+        from .kernels import dispatch as _kdispatch
+        from .kernels import norm as _knorm
+        why = _knorm.norm_supported(x, int(x.shape[-1]))
+        path, _ = _kdispatch("layernorm", supported=why is None,
+                             reason=why)
+        if path != "xla":
+            return _knorm.layer_norm(x, gamma, beta, eps,
+                                     interpret=(path == "interpret"))
     dt = _stat_dtype(x)
     xf = x.astype(dt)
     mean = jnp.mean(xf, axis=axis, keepdims=True)

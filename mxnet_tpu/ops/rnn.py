@@ -19,7 +19,8 @@ from jax import lax
 
 from ..base import MXNetError
 
-__all__ = ["GATES", "fused_rnn", "rnn_packed_param_size"]
+__all__ = ["GATES", "fused_rnn", "scan_reference",
+           "rnn_packed_param_size"]
 
 GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
@@ -60,11 +61,12 @@ def _step_fns(mode: str):
     raise MXNetError(f"unknown RNN mode {mode!r}")
 
 
-def _one_direction(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse):
-    """x: (T, N, C) → (ys (T, N, H), h_T, c_T|None). One MXU matmul for all
-    input projections, then a scan over the h2h recurrence."""
+def scan_reference(xw, h0, c0, w_hh, b_hh, mode, reverse=False):
+    """The ``lax.scan`` XLA reference recurrence over precomputed input
+    projections ``xw`` (T, N, G*H) — the numeric oracle the Pallas
+    time-fused kernel (ops/kernels/rnn_scan.py) is bit-parity-tested
+    against, and the automatic fallback tier of its dispatch."""
     step = _step_fns(mode)
-    xw = x @ w_ih.T + b_ih                      # (T, N, G*H)
     carry0 = (h0, c0) if mode == "lstm" else (h0,)
 
     def body(carry, xw_t):
@@ -76,6 +78,16 @@ def _one_direction(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse):
     h_t = carry[0]
     c_t = carry[1] if mode == "lstm" else None
     return ys, h_t, c_t
+
+
+def _one_direction(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse):
+    """x: (T, N, C) → (ys (T, N, H), h_T, c_T|None). One MXU matmul for all
+    input projections, then the recurrence: the Pallas time-fused scan
+    kernel where the MXNET_PALLAS gate selects it, else the lax.scan
+    reference (identical math; ops/kernels/rnn_scan.py)."""
+    xw = x @ w_ih.T + b_ih                      # (T, N, G*H)
+    from .kernels.rnn_scan import rnn_scan
+    return rnn_scan(xw, h0, c0, w_hh, b_hh, mode, reverse=reverse)
 
 
 def fused_rnn(x, h0, c0, params: Sequence, mode: str, num_layers: int,

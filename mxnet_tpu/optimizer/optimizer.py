@@ -269,6 +269,16 @@ class Optimizer:
 
         return stepfn
 
+    def kernel_step_fn(self):
+        """The Pallas fused multi-tensor update over flat 1-d shards
+        (ops/kernels/opt_update.py), signature-compatible with
+        :meth:`fused_step_fn` — or ``None`` when the ``MXNET_PALLAS``
+        gate selects the XLA path or this rule is not kernelized
+        (exact SGD/Adam only; subclasses may override ``_rule`` so
+        they keep the reference path)."""
+        from ..ops.kernels.opt_update import kernel_step_fn as _kfn
+        return _kfn(self)
+
     @staticmethod
     def pack_shard_hparams(lrs, wds, ts, member_idx, sizes, padded):
         """Per-shard lr/wd packing for a ZeRO bucket: several small

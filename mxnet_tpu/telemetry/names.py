@@ -127,6 +127,11 @@ FUSION_BOUNDARY_BYTES = "mx_fusion_boundary_bytes"
 FUSION_COMPUTE_BOUND = "mx_fusion_compute_bound_ratio"
 
 # ---------------------------------------------------------------------------
+# Pallas kernel layer (ops/kernels dispatch gate)
+# ---------------------------------------------------------------------------
+KERNEL_DISPATCH = "mx_kernel_dispatch_total"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -305,6 +310,12 @@ CATALOG = {
         kind="gauge", label=None,
         help="FLOP-weighted share (0-1) of kernels whose arithmetic "
              "intensity clears the measured roofline ridge point"),
+    KERNEL_DISPATCH: dict(
+        kind="counter", label="path",
+        help="Pallas kernel-layer dispatch decisions by path taken "
+             "(pallas = compiled TPU kernel, interpret = kernel body "
+             "under pallas interpret mode, xla = reference fallback; "
+             "MXNET_PALLAS gate, docs/PERF_NOTES.md)"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

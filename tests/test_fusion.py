@@ -628,3 +628,89 @@ def test_fusion_baseline_sweep(leg, builder):
         f"tests/fixtures/fusion_baselines.json "
         f"(measured: {fr.brief()}):\n"
         + "\n".join(f"  {f}" for f in findings))
+
+
+@pytest.mark.lint
+def test_fusion_baseline_sweep_lstm_kernel(monkeypatch):
+    """The lstm leg compiled with the Pallas kernel layer forced to
+    its interpret tier (MXNET_PALLAS=on): the kernel-path program is
+    gated by its own checked-in baseline so a regression in the
+    kernelized program fails tier-1 just like the XLA path. (The raw
+    interpret-mode boundary_bytes are NOT comparable to the XLA leg's
+    — the interpret harness carries whole buffers through its grid
+    while-loops; the kernel's actual HBM win is pinned as the strict
+    backward-residual ratchet in tests/test_kernels.py.)"""
+    monkeypatch.setenv("MXNET_PALLAS", "on")
+    step, x, y = _lstm_leg()
+    step(x, y)
+    fr = step.fusion_report(x, y)
+    assert fr is not None and fr.n_fusions > 0
+    baselines = afusion.load_baselines(BASELINES)
+    findings = afusion.check_baseline(fr, baselines, "lstm_kernel")
+    assert findings == [], (
+        f"[lstm_kernel] fusion posture regressed "
+        f"(measured: {fr.brief()}):\n"
+        + "\n".join(f"  {f}" for f in findings))
+
+
+# ---------------------------------------------------------------------------
+# custom-call FLOP estimators (PR 10 satellite: kernel legs stop
+# under-counting in the bound classification)
+# ---------------------------------------------------------------------------
+
+_CUSTOM_CALL_HLO = """\
+HloModule cc_test
+
+ENTRY %main {
+  %p0 = f32[16,512,64]{2,1,0} parameter(0)
+  %p1 = f32[16,512,64]{2,1,0} parameter(1)
+  %p2 = f32[16,512,64]{2,1,0} parameter(2)
+  %cc = f32[16,512,64]{2,1,0} custom-call(%p0, %p1, %p2), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/flash_fwd/_flash_kernel"}
+  %xw = f32[8,4,512]{2,1,0} parameter(3)
+  %wh = f32[512,128]{1,0} parameter(4)
+  %sc = f32[8,4,128]{2,1,0} custom-call(%xw, %wh), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/rnn/_fwd_kernel"}
+  %un = f32[16,512,64]{2,1,0} custom-call(%p0), custom_call_target="SomeUnknownTarget"
+  ROOT %t = (f32[16,512,64]{2,1,0}, f32[8,4,128]{2,1,0}, f32[16,512,64]{2,1,0}) tuple(%cc, %sc, %un)
+}
+"""
+
+
+def test_custom_call_flops_builtin_estimators():
+    """Flash-attention and rnn-scan custom calls get real FLOP
+    estimates (matched on the kernel function name in the op_name
+    metadata); unknown custom calls stay at 0 — compute_bound_pct no
+    longer under-counts kernel legs."""
+    fr = afusion.fusion_census(_CUSTOM_CALL_HLO)
+    by_name = {k.name: k for k in fr.kernels}
+    assert by_name["cc"].flops == 4 * 16 * 512 * 512 * 64
+    assert by_name["cc"].bound() == "compute"
+    assert by_name["sc"].flops == 2 * 8 * 4 * 512 * 128 \
+        + 10 * 8 * 4 * 512
+    assert by_name["un"].flops == 0
+    assert fr.compute_bound_pct > 0
+
+
+def test_register_custom_call_flops_hook():
+    """The public hook: a registered estimator applies by substring
+    match, re-registering a name replaces it, and an estimator that
+    raises degrades to 0 (a census must never die)."""
+    from mxnet_tpu.analysis.hlo import parse_hlo
+    mod = parse_hlo(_CUSTOM_CALL_HLO)
+    op = mod.ops["un"]
+    try:
+        afusion.register_custom_call_flops(
+            "my_kernel", lambda op, mod=None: 1234,
+            match="someunknowntarget")
+        assert afusion.op_flops(op, mod) == 1234
+        afusion.register_custom_call_flops(
+            "my_kernel", lambda op, mod=None: 5678,
+            match="someunknowntarget")
+        assert afusion.op_flops(op, mod) == 5678
+        afusion.register_custom_call_flops(
+            "my_kernel", lambda op, mod=None: 1 / 0,
+            match="someunknowntarget")
+        assert afusion.op_flops(op, mod) == 0
+    finally:
+        afusion._CUSTOM_CALL_FLOPS[:] = [
+            e for e in afusion._CUSTOM_CALL_FLOPS
+            if e[0] != "my_kernel"]

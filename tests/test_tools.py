@@ -144,6 +144,21 @@ def test_diagnose_fusion_section(capsys):
     assert "stranded ops : none above the" in out
 
 
+def test_diagnose_kernels_section(capsys):
+    """--kernels: the per-kernel dispatch table (path + reason for
+    every kernel the gate knows) and the interpret-vs-xla parity
+    probes, bit-exact on this backend."""
+    diagnose = _load("tools/diagnose.py", "diagnose5")
+    assert diagnose.main(["--kernels"]) == 0
+    out = capsys.readouterr().out
+    assert "Pallas Kernel Layer" in out
+    assert "MXNET_PALLAS=" in out
+    for name in ("rnn_scan", "opt_update", "layernorm", "bias_gelu",
+                 "flash_attention"):
+        assert name in out
+    assert out.count("bit-exact") == 2
+
+
 def test_diagnose_numerics_section(capsys, tmp_path, monkeypatch):
     """--numerics: the 10-step norm table prints with finite values and
     the simulated-divergence demo produces exactly one anomaly plus a

@@ -458,6 +458,65 @@ def check_fusion():
         print("fusion check failed:", repr(e))
 
 
+def check_kernels():
+    """Pallas kernel-layer health (docs/PERF_NOTES.md "Pallas kernel
+    layer"): the MXNET_PALLAS dispatch decision (path + reason) for
+    every kernel the gate knows, then an interpret-vs-XLA parity probe
+    on a tiny LSTM scan and LayerNorm — the kernel BODY runs (as plain
+    XLA ops) and its outputs diff against the reference path."""
+    print("----------Pallas Kernel Layer----------")
+    try:
+        import numpy as onp
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import kernels as K
+        from mxnet_tpu.ops.kernels import norm as knorm
+        from mxnet_tpu.ops.kernels import rnn_scan as krnn
+        from mxnet_tpu.ops.rnn import scan_reference
+
+        print(f"MXNET_PALLAS={K.pallas_mode()}  "
+              f"backend={jax.default_backend()}")
+        print(f"{'kernel':<18s}{'path':<11s}reason")
+        for name in K.KERNELS:
+            path, reason = K.dispatch(name)
+            print(f"{name:<18s}{path:<11s}{reason}")
+
+        onp.random.seed(0)
+        T, N, H = 6, 8, 128
+        xw = jnp.asarray(onp.random.randn(T, N, 4 * H)
+                         .astype("float32") * 0.4)
+        h0 = jnp.asarray(onp.random.randn(N, H).astype("float32"))
+        c0 = jnp.asarray(onp.random.randn(N, H).astype("float32"))
+        w = jnp.asarray((onp.random.randn(4 * H, H) * 0.3)
+                        .astype("float32"))
+        b = jnp.asarray((onp.random.randn(4 * H) * 0.1)
+                        .astype("float32"))
+        ys_r, _, _ = scan_reference(xw, h0, c0, w, b, "lstm")
+        ys_k = krnn._scan_lstm("lstm", True, xw, h0, c0, w, b)[0]
+        d = float(jnp.abs(ys_r - ys_k).max())
+        print(f"lstm scan  interpret-vs-xla max|delta| = {d:.3e}"
+              f"  ({'bit-exact' if d == 0.0 else 'nonzero'})")
+
+        x = jnp.asarray(onp.random.randn(16, 256).astype("float32"))
+        g = jnp.asarray(onp.random.randn(256).astype("float32"))
+        be = jnp.asarray(onp.random.randn(256).astype("float32"))
+
+        def ln_ref(x, g, be):       # the ops/nn.py reference recipe
+            from jax import lax
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            return (x - mean) * lax.rsqrt(var + 1e-5) * g + be
+
+        ref = jax.jit(ln_ref)(x, g, be)
+        ker = jax.jit(lambda x, g, be: knorm.layer_norm(
+            x, g, be, interpret=True))(x, g, be)
+        d = float(jnp.abs(ref - ker).max())
+        print(f"layernorm  interpret-vs-xla max|delta| = {d:.3e}"
+              f"  ({'bit-exact' if d == 0.0 else 'nonzero'})")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("kernel check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -538,6 +597,12 @@ def main(argv=None):
                         "tiny MLP and the LSTM-LM example: kernel "
                         "table (kind/ops/FLOPs/boundary bytes/bound "
                         "class) plus top stranded ops")
+    parser.add_argument("--kernels", action="store_true",
+                        help="also print the Pallas kernel layer's "
+                        "per-kernel dispatch decisions (pallas/"
+                        "interpret/xla + reason) and an interpret-vs-"
+                        "xla parity probe for a tiny LSTM scan and "
+                        "LayerNorm")
     parser.add_argument("--timeout", type=int, default=10)
     args = parser.parse_args(argv)
     check_python()
@@ -556,6 +621,8 @@ def main(argv=None):
         check_numerics()
     if args.fusion:
         check_fusion()
+    if args.kernels:
+        check_kernels()
     check_os()
     check_environment()
     if args.network:
