@@ -322,6 +322,22 @@ def run_framework_bench(tag, loop, x, y, warmup, steps):
     # numerics-domain fingerprint AFTER the snapshot: the probe runs
     # its own short loops and must not skew the timed-loop series
     telem["numerics"] = numerics_probe(tag, loop, x_nd, y_nd)
+    # elastic fingerprint (only when MXNET_ELASTIC is explicitly armed):
+    # recoveries the supervisor logged this process + their total
+    # downtime — a bench leg that silently recovered mid-timing must
+    # say so next to its throughput number
+    try:
+        from mxnet_tpu import elastic
+        if elastic.armed():
+            evs = elastic.recovery_log().events()
+            telem["elastic"] = {
+                "recoveries": len(evs),
+                "recovery_downtime_s": round(
+                    sum(e["downtime_s"] for e in evs), 3),
+            }
+    except Exception as e:  # pragma: no cover - defensive
+        log(f"bench[{tag}]: elastic stats unavailable "
+            f"({type(e).__name__}: {e})")
     log(f"bench[{tag}]: final loss={float(loss._data.mean()):.3f} "
         f"engine={engine} mfu_gauge={telem['mfu_gauge']} "
         f"anomalies={telem['anomalies']} "

@@ -34,7 +34,7 @@ _OPTIONAL_MODULES = [
     ("test_utils", None), ("amp", None), ("recordio", None),
     ("operator", None), ("rtc", None), ("contrib", None),
     ("subgraph", None), ("checkpoint", None), ("testing", None),
-    ("analysis", None), ("telemetry", None),
+    ("analysis", None), ("telemetry", None), ("elastic", None),
     ("library", None),
     ("inspector", None), ("visualization", None), ("visualization", "viz"),
     ("name", None), ("attribute", None), ("error", None), ("log", None),

@@ -89,6 +89,10 @@ class TrainCheckpointManager:
         self._m_errors = reg.counter(t.names.CHECKPOINT_ERRORS)
         self._m_capture = reg.histogram(t.names.CHECKPOINT_CAPTURE_SECONDS)
         self._m_write = reg.histogram(t.names.CHECKPOINT_SAVE_SECONDS)
+        self._m_restores = reg.counter(t.names.CHECKPOINT_RESTORES)
+        self._m_recovery = reg.histogram(
+            t.names.CHECKPOINT_RECOVERY_SECONDS)
+        self._last_restore: Optional[Dict[str, Any]] = None
 
     @property
     def directory(self) -> str:
@@ -105,6 +109,13 @@ class TrainCheckpointManager:
         state = capture_train_state(trainer=trainer, net=net, step=step,
                                     extra=extra)
         self._m_capture.observe(time.perf_counter() - t0)
+        if self._last_restore is not None:
+            # restore provenance rides every subsequent save: a
+            # post-mortem on this checkpoint can tell WHERE the run it
+            # belongs to came from (elastic reshard forensics)
+            state.meta.setdefault("resumed_from", {
+                k: self._last_restore[k]
+                for k in ("step", "resumed_from", "dp_from", "dp_to")})
         try:
             # the capture copies live until the background write drops
             # them — visible in the census `checkpoint` pool meanwhile
@@ -212,9 +223,26 @@ class TrainCheckpointManager:
         """Apply the newest valid checkpoint; returns its meta (incl.
         'step'), or None when the directory holds no valid checkpoint."""
         self.wait()
+        t0 = time.perf_counter()
         found = self._load_merged()
         if found is None:
             return None
+        return self._apply_found(found, trainer, net, strict, t0)
+
+    def restore_step(self, step: int, trainer=None, net=None,
+                     strict: bool = True) -> Dict[str, Any]:
+        """Apply ONE SPECIFIC retained checkpoint step (raises if it is
+        missing or corrupt) — the elastic reference-replay / planned
+        rollback path, where "newest" is not the state you want."""
+        self.wait()
+        t0 = time.perf_counter()
+        path = os.path.join(self._root, atomic.step_dir_name(step))
+        arrays, manifest = atomic.read_checkpoint(path)
+        return self._apply_found((step, arrays, manifest), trainer, net,
+                                 strict, t0)
+
+    def _apply_found(self, found, trainer, net, strict, t0):
+        """Shared restore tail: apply + restore metrics + provenance."""
         step, arrays, manifest = found
         array_meta = {k: v for k, v in manifest["arrays"].items()}
         state = TrainState(arrays, manifest.get("meta", {}),
@@ -224,4 +252,38 @@ class TrainCheckpointManager:
         _LOG.info("restored checkpoint step %d from %s", step, self._root)
         meta = dict(meta)
         meta.setdefault("step", step)
+        dt = time.perf_counter() - t0
+        self._m_restores.inc()
+        self._m_recovery.observe(dt)
+        dp_from = meta.get("dp_size")
+        dp_to = self._current_dp()
+        self._last_restore = {
+            "step": int(step),
+            "resumed_from": os.path.join(self._root,
+                                         atomic.step_dir_name(step)),
+            "dp_from": dp_from, "dp_to": dp_to,
+            "reshard": (f"dp{dp_from}->dp{dp_to}"
+                        if dp_from and dp_from != dp_to else None),
+            "duration_s": dt, "time_unix": time.time()}
+        if self._last_restore["reshard"]:
+            _LOG.info("restore reshards %s",
+                      self._last_restore["reshard"])
         return meta
+
+    @staticmethod
+    def _current_dp() -> Optional[int]:
+        try:
+            from ..parallel.mesh import current_mesh
+            m = current_mesh()
+            return int(m.shape.get("dp", 1)) if m is not None else 1
+        except Exception:        # pragma: no cover - defensive
+            return None
+
+    @property
+    def restore_provenance(self) -> Optional[Dict[str, Any]]:
+        """Where the current run's state came from: ``{step,
+        resumed_from, dp_from, dp_to, reshard, duration_s, time_unix}``
+        of the most recent restore through this manager (None before
+        any restore). ``reshard`` names a dp=N→dp=M layout change, the
+        elastic shrink/grow signature."""
+        return self._last_restore

@@ -200,6 +200,24 @@ def _capture_zero_states(plan, arrays, array_meta):
                 flat[:unit["sizes"][0]].reshape(unit["shapes"][0])
 
 
+def _current_dp(trainer) -> int:
+    """The data-parallel width the state was captured at — restore
+    provenance for the elastic reshard path (``dp_from`` in
+    ``TrainCheckpointManager.restore_provenance``). The on-disk format
+    itself stays layout-free; this is metadata only."""
+    plan = _live_zero_plan(trainer)
+    if plan is not None:
+        return int(plan.n_shards)
+    try:
+        from ..parallel.mesh import current_mesh
+        m = current_mesh()
+        if m is not None:
+            return int(m.shape.get("dp", 1))
+    except Exception:            # pragma: no cover - defensive
+        pass
+    return 1
+
+
 def _capture_updater_states(trainer, arrays):
     import jax
     for idx, st in trainer._updater.states.items():
@@ -227,6 +245,7 @@ def capture_train_state(trainer=None, net=None, step: int = 0,
             _host_copy(p._data, f"param/{name}", arrays, array_meta)
             names.append(name)
     meta["param_names"] = names
+    meta["dp_size"] = _current_dp(trainer)
 
     if trainer is not None:
         opt = trainer._optimizer

@@ -1,0 +1,49 @@
+"""mx.elastic — elastic, preemption-driven training supervision.
+
+Composes the checkpoint, dispatch-window, and telemetry subsystems into
+automatic recovery (docs/ROBUSTNESS.md "Elastic training"):
+
+- :mod:`.detect` — device-loss classification at the dispatch seams, the
+  ``device_lost`` anomaly kind on the watchdog channel, preemption
+  (SIGTERM) notices with a grace window, and the ``MXNET_ELASTIC*`` env
+  gates;
+- :mod:`.supervisor` — :class:`ElasticSupervisor`, which keeps a
+  ``gluon.TrainLoop`` run alive across device loss/preemption/transient
+  failures: drain+discard the window, re-form the mesh at the surviving
+  world, recompile, restore the newest valid atomic checkpoint
+  (dp=N→dp=M reshard), continue — with bounded retries and a structured
+  :class:`RecoveryLog` exported as ``mx_elastic_*`` telemetry.
+
+The chaos harness lives in ``mxnet_tpu/testing/faults.py`` (``revoke``/
+``restore`` actions + the ``step.dispatch``/``window.retire``/
+``prefetch.stage`` fault points).
+"""
+from . import detect                                    # noqa: F401
+from .detect import (is_device_lost, classify,          # noqa: F401
+                     maybe_record_device_lost, device_lost_guard,
+                     PreemptionNotice, notice, elastic_enabled, armed,
+                     max_retries, preemption_grace_sec)
+
+__all__ = ["detect", "is_device_lost", "classify",
+           "maybe_record_device_lost", "device_lost_guard",
+           "PreemptionNotice", "notice", "elastic_enabled", "armed",
+           "max_retries", "preemption_grace_sec",
+           # lazily resolved from .supervisor (needs gluon loaded):
+           "supervisor", "ElasticSupervisor", "ElasticResult",
+           "RecoveryLog", "StallEscalation", "recovery_log"]
+
+_LAZY = ("ElasticSupervisor", "ElasticResult", "RecoveryLog",
+         "StallEscalation", "recovery_log")
+
+
+def __getattr__(name):
+    # the supervisor half pulls in gluon; load it on first use so the
+    # lightweight detection half stays importable from the engine seams
+    # (import_module, not `from . import`, which would re-enter this
+    # __getattr__ through _handle_fromlist)
+    if name == "supervisor" or name in _LAZY:
+        import importlib
+        mod = importlib.import_module(".supervisor", __name__)
+        globals()["supervisor"] = mod
+        return mod if name == "supervisor" else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

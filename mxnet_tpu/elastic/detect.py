@@ -1,0 +1,314 @@
+"""Device-loss detection, failure classification, preemption notices.
+
+The three detection seams the elastic supervisor recovers from
+(docs/ROBUSTNESS.md "Elastic training"):
+
+1. **Runtime errors at the dispatch seams.** PjRt surfaces a lost or
+   preempted device as an ``XlaRuntimeError`` whose message carries one
+   of a small set of patterns ("device lost", "TPU is unhealthy", ...).
+   :func:`maybe_record_device_lost` classifies an escaping exception at
+   the fused-step call, the dispatch-window retire, and the device_put
+   staging carry — the same seams PR 7 instruments for OOM — and emits
+   exactly ONE ``device_lost`` anomaly per failure on the watchdog
+   channel, however nested the seams (the exception chain is marked,
+   the OOM-forensics discipline).
+2. **Preemption notices.** Spot/preemptible hosts get a SIGTERM (or a
+   maintenance-event signal) with a grace window before the hard kill.
+   :class:`PreemptionNotice` converts the signal into a flag the
+   supervisor polls each step, so the run drains its window and commits
+   an urgent final checkpoint inside ``MXNET_PREEMPTION_GRACE_SEC``.
+3. **Stall escalation.** A hung device often produces no error at all —
+   just a retire that never completes in time. The watchdog's ``stall``
+   anomalies reach the supervisor through the anomaly channel's
+   subscription callback (``telemetry.watchdog().subscribe``), and
+   repeated episodes escalate into a recovery.
+
+Everything here is import-light (telemetry + faults + jax) so the
+engine and fused-step seams can reach it lazily without cycles.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+__all__ = ["is_device_lost", "classify", "maybe_record_device_lost",
+           "device_lost_guard", "PreemptionNotice", "notice",
+           "elastic_enabled", "armed", "max_retries",
+           "preemption_grace_sec"]
+
+_LOG = logging.getLogger("mxnet_tpu.elastic")
+
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from .. import telemetry as _t
+        _TELEM = _t
+    return _TELEM
+
+
+# ---------------------------------------------------------------- env gates
+def elastic_enabled(default: bool = True) -> bool:
+    """``MXNET_ELASTIC``: whether an :class:`~mxnet_tpu.elastic
+    .ElasticSupervisor` auto-recovers (default yes once you built one);
+    ``0``/``off`` turns the supervisor into a plain runner that
+    propagates every failure — the A/B switch for chaos attribution."""
+    v = os.environ.get("MXNET_ELASTIC")
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "off", "false", "no")
+
+
+def armed() -> bool:
+    """Whether ``MXNET_ELASTIC`` is EXPLICITLY set truthy — the gate for
+    ambient integrations (bench legs attaching recovery stats) that
+    should stay silent unless the operator opted in."""
+    v = os.environ.get("MXNET_ELASTIC")
+    return v is not None and v.strip().lower() not in (
+        "", "0", "off", "false", "no")
+
+
+def max_retries(default: int = 3) -> int:
+    """``MXNET_ELASTIC_MAX_RETRIES``: consecutive recovery attempts
+    without forward progress before the supervisor gives up and
+    re-raises (progress — one retired step past the restored point —
+    resets the budget)."""
+    try:
+        v = int(os.environ.get("MXNET_ELASTIC_MAX_RETRIES", default))
+    except (TypeError, ValueError):
+        return default
+    return max(0, v)
+
+
+def preemption_grace_sec(default: float = 30.0) -> float:
+    """``MXNET_PREEMPTION_GRACE_SEC``: the budget between the preemption
+    notice and the hard kill — the urgent final checkpoint must commit
+    inside it (exceeding it is logged; the checkpoint is attempted
+    regardless)."""
+    try:
+        v = float(os.environ.get("MXNET_PREEMPTION_GRACE_SEC", default))
+    except (TypeError, ValueError):
+        return default
+    return v if v > 0 else default
+
+
+# ---------------------------------------------------------------- classify
+#: lowercase substrings of PjRt/XlaRuntimeError messages that mean the
+#: DEVICE (not the program) failed — curated from TPU/GPU runtime error
+#: strings; the chaos harness's DeviceRevokedError mimics the first
+_DEVICE_LOST_MARKERS = (
+    "device lost",
+    "device_lost",
+    "device is lost",
+    "tpu is unhealthy",
+    "chip has been removed",
+    "device has been removed",
+    "removed from the system",
+    "hardware failure",
+    "worker has been preempted",
+    "slice health check failed",
+    "failed to enumerate devices",
+    "device failed",
+    "halt requested",
+    "heartbeat timeout",
+)
+
+
+def _chain(exc):
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        yield exc
+        exc = exc.__cause__ or exc.__context__
+
+
+def is_device_lost(exc: BaseException) -> bool:
+    """Whether ``exc`` (or anything in its cause chain) is a device
+    loss/revocation — a failure of the HARDWARE world, recoverable by
+    re-forming the mesh at the surviving device count, as opposed to a
+    failure of the program (which would just fail again)."""
+    for e in _chain(exc):
+        if type(e).__name__ == "DeviceRevokedError":
+            return True
+        msg = str(e).lower()
+        if any(m in msg for m in _DEVICE_LOST_MARKERS):
+            return True
+    return False
+
+
+def classify(exc: BaseException) -> str:
+    """Failure taxonomy for the recovery decision:
+
+    - ``device_lost`` — the world shrank; re-form the mesh and restore;
+    - ``stall`` — escalated watchdog stall episodes (supervisor.py's
+      :class:`StallEscalation` marker);
+    - ``oom`` — allocation failure; NOT recovered by default (a smaller
+      world only raises per-device load — fix the budget instead);
+    - ``transient`` — an ``OSError``-family blip (IO hiccup, injected
+      fault) worth a bounded retry from the last checkpoint;
+    - ``fatal`` — everything else (a shape error re-fails forever).
+    """
+    if is_device_lost(exc):
+        return "device_lost"
+    for e in _chain(exc):
+        if type(e).__name__ == "StallEscalation":
+            return "stall"
+    t = _telemetry()
+    if t.memory.is_resource_exhausted(exc):
+        return "oom"
+    for e in _chain(exc):
+        if isinstance(e, OSError):
+            return "transient"
+    return "fatal"
+
+
+def maybe_record_device_lost(exc: BaseException, seam: str,
+                             step=None) -> bool:
+    """If ``exc`` is a device loss not already handled at an inner seam,
+    emit exactly one ``device_lost`` anomaly on the watchdog channel
+    (ring + ``mx_anomalies_total{kind=device_lost}`` + one JSON log
+    line + subscription callbacks). Returns True when the event fired.
+    Never raises — detection must not mask the original error."""
+    try:
+        if not is_device_lost(exc):
+            return False
+        for e in _chain(exc):
+            if getattr(e, "_mx_device_lost_handled", False):
+                return False
+        try:
+            exc._mx_device_lost_handled = True
+        except Exception:        # pragma: no cover - frozen exc types
+            pass
+        lost = _lost_device_count()
+        _telemetry().watchdog().report(
+            "device_lost", step, value=lost or None,
+            message=f"device loss at {seam}"
+                    + (f" (step {step})" if step is not None else "")
+                    + (f"; {lost} device(s) missing from the world"
+                       if lost else "")
+                    + f": {type(exc).__name__}: {exc}")
+        return True
+    except Exception:            # pragma: no cover - defensive
+        _LOG.warning("device-lost detection failed", exc_info=True)
+        return False
+
+
+def _lost_device_count() -> int:
+    try:
+        import jax
+        from ..parallel.dist import available_devices
+        return max(0, len(jax.devices()) - len(available_devices()))
+    except Exception:            # pragma: no cover - defensive
+        return 0
+
+
+@contextlib.contextmanager
+def device_lost_guard(seam: str, step=None):
+    """Wrap a dispatch seam: an escaping device loss gets its anomaly
+    recorded (once, however nested the seams) and propagates
+    unchanged — the companion of ``telemetry.memory.oom_guard``."""
+    try:
+        yield
+    except BaseException as e:
+        maybe_record_device_lost(e, seam, step=step)
+        raise
+
+
+# ---------------------------------------------------------------- preemption
+class PreemptionNotice:
+    """Signal-to-flag bridge for the preemption grace window.
+
+    ``install()`` (main thread) replaces the handlers of the given
+    signals with one that records the notice time and sets a flag — it
+    deliberately does NOT raise into the training loop: the supervisor
+    polls :meth:`requested` at its step boundary, where the dispatch
+    window can be drained and the final checkpoint committed cleanly.
+    ``trigger()`` raises the flag programmatically (tests, cloud
+    maintenance-event watchers that poll a metadata endpoint).
+    """
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._time: Optional[float] = None
+        self._prev: dict = {}
+        self._lock = threading.Lock()
+
+    def install(self, signals=(signal.SIGTERM,)):
+        """Arm the handlers; safe to call repeatedly. Off the main
+        thread (where signal.signal raises) installation is skipped
+        with a warning — :meth:`trigger` still works."""
+        for sig in signals:
+            with self._lock:
+                if sig in self._prev:
+                    continue
+            try:
+                prev = signal.signal(sig, self._handler)
+            except ValueError:   # not the main thread
+                _LOG.warning(
+                    "cannot install preemption handler for signal %s "
+                    "off the main thread; rely on trigger()", sig)
+                continue
+            with self._lock:
+                self._prev[sig] = prev
+
+    def uninstall(self):
+        """Restore the previous handlers and clear the flag."""
+        with self._lock:
+            prev, self._prev = dict(self._prev), {}
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+        self.clear()
+
+    def _handler(self, signum, frame):      # pragma: no cover - signal
+        self.trigger(signum)
+
+    def trigger(self, signum=None):
+        """Raise the preemption flag (what the signal handler does)."""
+        with self._lock:
+            if self._time is None:
+                self._time = time.time()
+        self._event.set()
+        _LOG.warning(
+            "preemption notice received (%s): requesting grace-window "
+            "final checkpoint (MXNET_PREEMPTION_GRACE_SEC=%.0fs)",
+            f"signal {signum}" if signum is not None else "programmatic",
+            preemption_grace_sec())
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def notice_time(self) -> Optional[float]:
+        return self._time
+
+    def remaining_grace(self) -> float:
+        """Seconds left in the grace window (full budget before any
+        notice)."""
+        grace = preemption_grace_sec()
+        if self._time is None:
+            return grace
+        return grace - (time.time() - self._time)
+
+    def clear(self):
+        self._event.clear()
+        with self._lock:
+            self._time = None
+
+
+_notice = PreemptionNotice()
+
+
+def notice() -> PreemptionNotice:
+    """The process-global preemption notice (one SIGTERM concerns every
+    supervisor in the process)."""
+    return _notice

@@ -1,0 +1,588 @@
+"""Elastic training supervisor: keep a run alive across device loss.
+
+``ElasticSupervisor`` wraps the ``gluon.TrainLoop`` pipeline in the
+recovery state machine a spot/preemptible fleet needs (TensorFlow's
+checkpoint/restore discipline, arXiv:1605.08695 §4.3; the MLPerf pod
+playbook, arXiv:1909.09756) — composed entirely from existing
+machinery: PR 3's layout-free atomic checkpoints (a dp=N save resumes
+on a dp=M mesh), PR 5's bounded dispatch window, and the PR 6-8
+watchdog/anomaly channel.
+
+State machine (one ``run()`` call)::
+
+        FORM ──────────► TRAIN ──────────► DONE
+          ▲      build+    │  step loop,     (final ckpt)
+          │      restore   │  probes
+          │                ├── preemption notice ──► GRACE SAVE ► exit
+          │                ├── world grew ──► planned re-form ─┐
+          │                └── device_lost / transient /       │
+          │                    stall escalation ──► RECOVER ───┤
+          └────────────────────────────────────────────────────┘
+               discard in-flight steps after the last retired one,
+               bounded retries + exponential backoff, re-form the mesh
+               at the surviving world, recompile, restore newest valid
+               checkpoint (dp=N→dp=M reshard), continue
+
+Every recovery produces one structured :class:`RecoveryLog` event
+``{cause, lost_devices, old_dp, new_dp, restored_step, downtime_s}``
+exported through the ``mx_elastic_*`` telemetry series.
+
+The hot loop stays sync-free: per-step losses are held as async
+handles and only read after the run leaves the transfer-guard hot
+region, so a supervised run passes ``MXNET_TRANSFER_GUARD=raise`` with
+zero unblessed syncs (the chaos test pins it).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..parallel import dist as _dist
+from ..parallel.mesh import make_mesh
+from . import detect
+
+__all__ = ["ElasticSupervisor", "ElasticResult", "RecoveryLog",
+           "StallEscalation", "recovery_log"]
+
+_LOG = logging.getLogger("mxnet_tpu.elastic")
+
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from .. import telemetry as _t
+        _TELEM = _t
+    return _TELEM
+
+
+class StallEscalation(MXNetError):
+    """Marker raised by the supervisor's own step loop when the
+    watchdog's ``stall`` anomalies crossed the escalation threshold —
+    routed through the same recovery path as a device loss
+    (``detect.classify`` maps it to cause ``stall``)."""
+
+
+# ---------------------------------------------------------------- log
+class RecoveryLog:
+    """Bounded ring of structured recovery events + their telemetry.
+
+    Each event: ``{cause, lost_devices, old_dp, new_dp, restored_step,
+    discarded_steps, downtime_s, step, time_unix}``; recording one
+    increments ``mx_elastic_recoveries_total{cause=}``, observes the
+    downtime histogram, updates the world-size gauge, and emits one
+    ``mx-recovery`` JSON log line.
+    """
+
+    def __init__(self, max_events: int = 256):
+        self._lock = threading.Lock()
+        self._events: "deque[dict]" = deque(maxlen=max_events)
+        t = _telemetry()
+        reg = t.registry()
+        self._c_rec = reg.counter(t.names.ELASTIC_RECOVERIES,
+                                  label_key="cause")
+        self._h_down = reg.histogram(t.names.ELASTIC_DOWNTIME_SECONDS)
+        self._g_world = reg.gauge(t.names.ELASTIC_WORLD_SIZE)
+
+    def record(self, cause: str, lost_devices: List[str], old_dp: int,
+               new_dp: int, restored_step: int, downtime_s: float,
+               discarded_steps: int = 0, step=None) -> dict:
+        evt = {"cause": cause, "lost_devices": list(lost_devices),
+               "old_dp": int(old_dp), "new_dp": int(new_dp),
+               "restored_step": int(restored_step),
+               "discarded_steps": int(discarded_steps),
+               "downtime_s": float(downtime_s), "step": step,
+               "time_unix": time.time()}
+        with self._lock:
+            self._events.append(evt)
+        self._c_rec.inc(label=cause)
+        self._h_down.observe(float(downtime_s))
+        self._g_world.set(new_dp)
+        _LOG.warning("mx-recovery %s", json.dumps(evt))
+        return evt
+
+    def set_world(self, n: int):
+        self._g_world.set(int(n))
+
+    def events(self, cause: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return evs if cause is None else [e for e in evs
+                                          if e["cause"] == cause]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def table(self) -> str:
+        """Human-readable event table (tools/diagnose.py --elastic)."""
+        evs = self.events()
+        if not evs:
+            return "(no recovery events)"
+        hdr = (f"{'cause':<12} {'lost':>4} {'dp':>7} {'restored':>8} "
+               f"{'discard':>7} {'downtime':>10}")
+        rows = [hdr, "-" * len(hdr)]
+        for e in evs:
+            rows.append(
+                f"{e['cause']:<12} {len(e['lost_devices']):>4} "
+                f"{e['old_dp']:>3}->{e['new_dp']:<3} "
+                f"{e['restored_step']:>8} {e['discarded_steps']:>7} "
+                f"{e['downtime_s']*1e3:>8.1f}ms")
+        return "\n".join(rows)
+
+
+_log: Optional[RecoveryLog] = None
+_log_lock = threading.Lock()
+
+
+def recovery_log() -> RecoveryLog:
+    """The process-global recovery log (what bench legs and diagnose
+    read; every supervisor records here unless given its own)."""
+    global _log
+    if _log is None:
+        with _log_lock:
+            if _log is None:
+                _log = RecoveryLog()
+    return _log
+
+
+# ---------------------------------------------------------------- result
+class ElasticResult:
+    """What one ``ElasticSupervisor.run`` produced."""
+
+    def __init__(self, losses: dict, events: List[dict], preempted: bool,
+                 final_step: int, world_size: int, retries: int):
+        self.losses = losses            # batch index -> summed host loss
+        self.events = events            # this run's RecoveryLog events
+        self.preempted = preempted
+        self.final_step = final_step
+        self.world_size = world_size
+        self.retries = retries
+
+    @property
+    def recoveries(self) -> int:
+        return len(self.events)
+
+    def __repr__(self):
+        return (f"ElasticResult(final_step={self.final_step}, "
+                f"world={self.world_size}, recoveries={self.recoveries},"
+                f" preempted={self.preempted})")
+
+
+# ---------------------------------------------------------------- supervisor
+class ElasticSupervisor:
+    """Keep a training run alive across device loss, preemption, and
+    transient step failures.
+
+    ::
+
+        def build():                       # deterministic!
+            mx.random.seed(7)
+            net = ...; net.initialize()
+            trainer = Trainer(net.collect_params(), "adam", {...})
+            return net, trainer, gloss.SoftmaxCrossEntropyLoss()
+
+        sup = mx.elastic.ElasticSupervisor(
+            build, checkpoint_dir="ckpts/run1",
+            mesh_axes={"dp": -1}, checkpoint_every=50)
+        result = sup.run(batch_fn, total_steps=10_000)
+
+    ``build()`` constructs a FRESH (net, trainer, loss) triple — it runs
+    once per mesh formation, and must be deterministic (seed inside):
+    the restored checkpoint overwrites params/optimizer state/RNG, so
+    recovery is bit-exact from the restored step at the new layout.
+    ``batch_fn(i)`` returns the step-``i`` batch tuple and must be
+    replayable by index — after a restore the supervisor re-requests
+    batches from the restored step.
+
+    Parameters beyond the obvious: ``mesh_axes`` (e.g. ``{"dp": -1}``,
+    sized to the surviving world at each formation; ``None`` = no mesh,
+    plain fused mode), ``max_retries``/``backoff_base`` (bounded
+    exponential backoff between recovery attempts; one retired step of
+    forward progress resets the budget), ``min_devices`` (below it the
+    world is unrecoverable), ``max_world`` (cap formation size),
+    ``grow``/``probe_every`` (re-form larger when ``parallel.dist
+    .world_changed`` sees devices return), ``stall_escalation`` (N
+    ``stall`` anomalies since the last recovery escalate into one;
+    0 = off), ``recover`` (default ``MXNET_ELASTIC``; False =
+    propagate every failure).
+    """
+
+    RECOVERABLE = ("device_lost", "transient", "stall")
+
+    def __init__(self, build: Callable, checkpoint_dir: str, *,
+                 mesh_axes: Optional[dict] = None, axis: str = "dp",
+                 checkpoint_every: Optional[int] = 10, keep_last: int = 3,
+                 max_retries: Optional[int] = None,
+                 backoff_base: float = 0.5, backoff_max: float = 30.0,
+                 min_devices: int = 1, max_world: Optional[int] = None,
+                 grow: bool = True, probe_every: int = 1,
+                 stall_escalation: int = 0,
+                 inflight: Optional[int] = None,
+                 record_losses: bool = True,
+                 final_checkpoint: bool = True,
+                 recover: Optional[bool] = None,
+                 log: Optional[RecoveryLog] = None):
+        self._build = build
+        self._dir = checkpoint_dir
+        self._mesh_axes = dict(mesh_axes) if mesh_axes else None
+        self._axis = axis
+        self._every = checkpoint_every
+        self._keep = keep_last
+        self._max_retries = detect.max_retries() if max_retries is None \
+            else max(0, int(max_retries))
+        self._backoff_base = float(backoff_base)
+        self._backoff_max = float(backoff_max)
+        self._min_devices = max(1, int(min_devices))
+        self._max_world = max_world
+        self._grow = grow
+        self._probe_every = max(0, int(probe_every))
+        self._stall_escalation = max(0, int(stall_escalation))
+        self._inflight = inflight
+        self._record_losses = record_losses
+        self._final_checkpoint = final_checkpoint
+        self._recover = detect.elastic_enabled() if recover is None \
+            else bool(recover)
+        self._log = log if log is not None else recovery_log()
+        self._preempt = detect.notice()
+
+        # run state
+        self._loop = None
+        self._mesh = None
+        self._world: List = []
+        self._loss_handles: dict = {}
+        self._pending: Optional[dict] = None   # recovery in progress
+        self._retries = 0
+        self._total_retries = 0
+        self._recovered_at = 0
+        self._stall_count = 0
+        self._escalate = False
+        self._events_before = 0
+
+    # ---------------- public surface ----------------
+    @property
+    def world_size(self) -> int:
+        """Devices in the currently-formed world (0 before formation)."""
+        return len(self._world)
+
+    @property
+    def dp_size(self) -> int:
+        """Data-parallel width of the current formation (1 = no mesh)."""
+        if self._mesh is not None:
+            return int(self._mesh.shape.get(self._axis, 1))
+        return 1 if self._world else 0
+
+    @property
+    def loop(self):
+        """The live TrainLoop (rebuilt at every formation; None between
+        a failure and the next formation)."""
+        return self._loop
+
+    @property
+    def recovery_log(self) -> RecoveryLog:
+        return self._log
+
+    @property
+    def preemption(self) -> detect.PreemptionNotice:
+        return self._preempt
+
+    # ---------------- run ----------------
+    def run(self, batch_fn: Callable, total_steps: int) -> ElasticResult:
+        """Drive the run to ``total_steps`` (or a graceful preemption
+        exit), recovering along the way. Returns an
+        :class:`ElasticResult`; raises when the failure is fatal, the
+        retry budget is exhausted, or recovery is disabled."""
+        wd = _telemetry().watchdog()
+        if self._stall_escalation:
+            wd.subscribe(self._on_anomaly)
+        self._preempt.install()
+        self._loss_handles = {}
+        self._retries = self._total_retries = 0
+        self._stall_count = 0
+        self._escalate = False
+        self._events_before = len(self._log)
+        preempted = False
+        try:
+            while True:
+                try:
+                    outcome = self._segment(batch_fn, total_steps)
+                except BaseException as e:
+                    cause = self._recoverable(e)
+                    if cause is None:
+                        raise
+                    self._begin_recovery(cause, e)
+                    continue
+                if outcome == "reform":
+                    continue
+                preempted = outcome == "preempted"
+                break
+        finally:
+            self._preempt.uninstall()
+            if self._stall_escalation:
+                wd.unsubscribe(self._on_anomaly)
+        final_step = self._loop.global_step if self._loop is not None \
+            else 0
+        return ElasticResult(
+            losses=self._finalize_losses(), preempted=preempted,
+            events=self._log.events()[self._events_before:],
+            final_step=final_step, world_size=self.world_size,
+            retries=self._total_retries)
+
+    # ---------------- the segment loop ----------------
+    def _segment(self, batch_fn, total_steps) -> str:
+        with contextlib.ExitStack() as stack:
+            self._form(stack)
+            loop = self._loop
+            start = loop.global_step
+            for i in range(start, total_steps):
+                if self._preempt.requested():
+                    self._graceful_preempt(loop)
+                    return "preempted"
+                if self._escalate:
+                    self._escalate = False
+                    raise StallEscalation(
+                        f"{self._stall_count} watchdog stall episode(s) "
+                        f"since the last recovery (threshold "
+                        f"{self._stall_escalation}): treating the world "
+                        "as unhealthy")
+                if self._grow and self._probe_every and i > start \
+                        and (i - start) % self._probe_every == 0 \
+                        and self._world_grew():
+                    self._planned_reform(loop)
+                    return "reform"
+                loss = loop.step(*batch_fn(i))
+                if self._record_losses:
+                    self._loss_handles[i] = loss
+                if self._retries and loop.global_step > self._recovered_at:
+                    self._retries = 0   # forward progress resets budget
+            self._finish(loop)
+            return "done"
+
+    def _form(self, stack):
+        """FORM: size the world from the surviving devices, build a
+        fresh (net, trainer, loss) on it, auto-resume from the newest
+        valid checkpoint, and (when a recovery is pending) complete the
+        RecoveryLog event with the restored step and downtime."""
+        from ..gluon.fused_step import TrainLoop
+        devs = self._target_devices()
+        if len(devs) < self._min_devices:
+            raise MXNetError(
+                f"elastic: only {len(devs)} device(s) survive, below "
+                f"min_devices={self._min_devices}; cannot re-form")
+        self._world = devs
+        mesh = None
+        if self._mesh_axes is not None and len(devs) >= 2:
+            mesh = make_mesh(dict(self._mesh_axes), devs)
+            stack.enter_context(mesh)
+        self._mesh = mesh
+        self._log.set_world(len(devs))
+        net, trainer, loss_blk = self._build()
+        self._loop = TrainLoop(
+            net, trainer, loss_blk, checkpoint_dir=self._dir,
+            checkpoint_every=self._every, keep_last=self._keep,
+            resume=True, inflight=self._inflight)
+        self._recovered_at = self._loop.global_step
+        if self._pending is not None:
+            p, self._pending = self._pending, None
+            restored = self._loop.global_step
+            # replayed steps overwrite their loss slots; drop handles
+            # of discarded in-flight work explicitly (their buffers may
+            # be donated away or poisoned)
+            for k in [k for k in self._loss_handles if k >= restored]:
+                del self._loss_handles[k]
+            self._log.record(
+                cause=p["cause"], lost_devices=p["lost"],
+                old_dp=p["old_dp"], new_dp=self.dp_size,
+                restored_step=restored,
+                discarded_steps=p["discarded"],
+                downtime_s=time.monotonic() - p["t0"], step=p["step"])
+            _LOG.warning(
+                "elastic: recovered (%s) at dp=%d, restored step %d",
+                p["cause"], self.dp_size, restored)
+
+    def _target_devices(self) -> List:
+        devs = _dist.available_devices()
+        if self._max_world is not None:
+            devs = devs[:self._max_world]
+        return devs
+
+    def _world_grew(self) -> bool:
+        if not _dist.world_changed(self._world):
+            return False
+        return len(self._target_devices()) > len(self._world)
+
+    # ---------------- recovery ----------------
+    def _recoverable(self, exc) -> Optional[str]:
+        """The cause string when recovery should run, else None."""
+        if not self._recover:
+            return None
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            return None
+        cause = detect.classify(exc)
+        if cause not in self.RECOVERABLE:
+            return None
+        return cause
+
+    def _begin_recovery(self, cause: str, exc: BaseException):
+        """RECOVER (first half): tear the failed formation down —
+        retire what completed, discard in-flight steps after the last
+        retired one — check the retry budget, back off, and leave a
+        pending event for ``_form`` to complete."""
+        t0 = time.monotonic()
+        old_dp = self.dp_size
+        old_world = list(self._world)
+        step = self._loop.global_step if self._loop is not None else None
+        # belt-and-braces anomaly: chain-marked, so when the failure
+        # already traversed an instrumented seam this is a no-op — a
+        # device loss surfacing through an un-instrumented path still
+        # gets its exactly-one device_lost event
+        if cause == "device_lost":
+            detect.maybe_record_device_lost(exc, "elastic supervisor",
+                                            step=step)
+        discarded = self._teardown(abandon=True)
+        alive = {d.id for d in _dist.available_devices()}
+        lost = [str(d) for d in old_world if d.id not in alive]
+        self._retries += 1
+        self._total_retries += 1
+        self._stall_count = 0
+        self._escalate = False
+        if self._retries > self._max_retries:
+            raise MXNetError(
+                f"elastic: recovery budget exhausted ({self._retries - 1}"
+                f" consecutive attempts, MXNET_ELASTIC_MAX_RETRIES="
+                f"{self._max_retries}) — last failure: "
+                f"{type(exc).__name__}: {exc}") from exc
+        delay = min(self._backoff_max,
+                    self._backoff_base * (2 ** (self._retries - 1)))
+        _LOG.warning(
+            "elastic: %s at step %s (%s: %s); recovery attempt %d/%d "
+            "in %.1fs", cause, step, type(exc).__name__, exc,
+            self._retries, self._max_retries, delay)
+        if delay > 0:
+            time.sleep(delay)
+        self._pending = {"cause": cause, "lost": lost, "old_dp": old_dp,
+                         "discarded": discarded, "step": step, "t0": t0}
+
+    def _planned_reform(self, loop):
+        """The world GREW back: drain the window, commit a checkpoint at
+        the current step, and re-form larger — a zero-discard recovery
+        with cause ``grow``."""
+        t0 = time.monotonic()
+        old_dp = self.dp_size
+        step = loop.global_step
+        _LOG.warning(
+            "elastic: world grew (%d -> %d available); re-forming",
+            len(self._world), len(self._target_devices()))
+        loop.synchronize()
+        loop.save_checkpoint(block=True)
+        loop.wait()
+        self._teardown(abandon=False)
+        self._pending = {"cause": "grow", "lost": [], "old_dp": old_dp,
+                         "discarded": 0, "step": step, "t0": t0}
+
+    def _teardown(self, abandon: bool) -> int:
+        """Dismantle the current formation; returns the number of
+        in-flight steps discarded."""
+        loop, self._loop = self._loop, None
+        self._mesh = None
+        discarded = 0
+        if loop is None:
+            return 0
+        try:
+            if abandon:
+                _retired, dropped = loop.discard_inflight()
+                discarded = len(dropped)
+            else:
+                loop.synchronize()
+        except Exception:        # pragma: no cover - defensive
+            _LOG.warning("elastic: window teardown failed", exc_info=True)
+        try:
+            # an async checkpoint write may be in flight — it is host-
+            # side work unaffected by device loss; let it publish so
+            # the restore sees the newest state
+            loop.wait()
+        except Exception as e:
+            _LOG.warning("elastic: in-flight checkpoint write failed "
+                         "during teardown: %s", e)
+        return discarded
+
+    # ---------------- graceful exits ----------------
+    def _graceful_preempt(self, loop):
+        """GRACE SAVE: the preemption notice arrived — drain the window
+        and commit the urgent final checkpoint inside the grace
+        window."""
+        t0 = time.monotonic()
+        grace = detect.preemption_grace_sec()
+        try:
+            loop.synchronize()
+        except Exception:
+            _LOG.warning("elastic: drain on preemption failed; "
+                         "abandoning in-flight steps", exc_info=True)
+            loop.discard_inflight()
+        loop.save_checkpoint(block=True)
+        loop.wait()
+        took = time.monotonic() - t0
+        t = _telemetry()
+        t.registry().counter(t.names.ELASTIC_PREEMPTIONS).inc()
+        if took > grace:
+            _LOG.error(
+                "elastic: grace-window save took %.1fs, EXCEEDING "
+                "MXNET_PREEMPTION_GRACE_SEC=%.1fs — raise the grace "
+                "window or lower checkpoint size", took, grace)
+        else:
+            _LOG.warning(
+                "elastic: preemption checkpoint committed at step %d "
+                "in %.1fs (%.1fs grace remaining)", loop.global_step,
+                took, grace - took)
+        self._log.record(
+            cause="preemption", lost_devices=[], old_dp=self.dp_size,
+            new_dp=self.dp_size, restored_step=loop.global_step,
+            downtime_s=took, step=loop.global_step)
+
+    def _finish(self, loop):
+        loop.synchronize()
+        if loop.checkpoint_manager is not None and self._final_checkpoint:
+            loop.save_checkpoint(block=True)
+        loop.wait()
+
+    # ---------------- anomaly subscription ----------------
+    def _on_anomaly(self, evt: dict):
+        """Watchdog-channel callback (telemetry.watchdog().subscribe):
+        counts ``stall`` episodes and raises the escalation flag the
+        step loop converts into a recovery."""
+        if evt.get("kind") != "stall":
+            return
+        self._stall_count += 1
+        if self._stall_count >= self._stall_escalation > 0:
+            self._escalate = True
+
+    # ---------------- loss finalize ----------------
+    def _finalize_losses(self) -> dict:
+        """Read the retained async loss handles — OUTSIDE the hot loop,
+        after everything retired, so the supervised run itself stays
+        sync-free under MXNET_TRANSFER_GUARD=raise."""
+        if not self._record_losses:
+            return {}
+        losses = {}
+        for i, h in sorted(self._loss_handles.items()):
+            try:
+                d = h._data if isinstance(h, NDArray) else h
+                losses[i] = float(onp.asarray(d).sum())
+            except Exception:    # a handle poisoned by the failure
+                _LOG.debug("loss handle for step %d unreadable", i,
+                           exc_info=True)
+        return losses

@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from .base import MXNetError, get_env
+from .testing.faults import fault_point
 
 # telemetry is imported lazily (the package initializes subsystems in
 # dependency order) and cached; the registry half is always-on, the
@@ -41,6 +42,19 @@ def _telemetry():
         from . import telemetry as _t
         _TELEM = _t
     return _TELEM
+
+
+# elastic device-loss detection (elastic/detect.py), lazily reached the
+# same way — it classifies failures escaping the retire seam
+_EDET = None
+
+
+def _edetect():
+    global _EDET
+    if _EDET is None:
+        from .elastic import detect as _d
+        _EDET = _d
+    return _EDET
 
 __all__ = ["Engine", "get", "set_bulk_size", "bulk", "DispatchWindow",
            "inflight_steps"]
@@ -192,6 +206,9 @@ class DispatchWindow:
         tag, payload, aux, t_push = self._pending.popleft()
         self._m_occupancy.set(len(self._pending))
         _tguard.count_sync("window_retire")
+        # chaos-harness seam: a revoked device surfaces exactly here in
+        # a pipelined run — at the blocking wait on an in-flight step
+        fault_point("window.retire", "before")
         t_wait = time.perf_counter()
         with _tguard.allow_transfers("dispatch-window retire"):
             try:
@@ -201,14 +218,20 @@ class DispatchWindow:
                 self._m_errors.inc()
                 _telemetry().memory.maybe_record_oom(
                     e, "dispatch-window retire", step=tag)
+                _edetect().maybe_record_device_lost(
+                    e, "dispatch-window retire", step=tag)
                 raise
             except Exception as e:
                 self.stats["errors"] += 1
                 self._m_errors.inc()
                 # a deferred RESOURCE_EXHAUSTED surfaces HERE, steps
                 # after the allocation that failed — write the ranked
-                # post-mortem before wrapping (telemetry/memory.py)
+                # post-mortem before wrapping (telemetry/memory.py);
+                # a deferred device loss likewise gets its device_lost
+                # anomaly (elastic/detect.py) before the wrap
                 _telemetry().memory.maybe_record_oom(
+                    e, "dispatch-window retire", step=tag)
+                _edetect().maybe_record_device_lost(
                     e, "dispatch-window retire", step=tag)
                 raise MXNetError(
                     f"async {self._what} "
@@ -221,6 +244,7 @@ class DispatchWindow:
             # NaN peek at the (already completed) payload is the one
             # designed device->host read telemetry adds
             self._observe_retire(tag, payload, aux, t_push, t_wait)
+        fault_point("window.retire", "after")
 
     def _observe_retire(self, tag, payload, aux, t_push, t_wait):
         """Step-timeline spans + watchdog feed for one retire — gated on
@@ -258,6 +282,40 @@ class DispatchWindow:
         deferred errors surface here attributed to their step."""
         while self._pending:
             self._retire_oldest()
+
+    def abandon(self) -> list:
+        """Discard every in-flight entry WITHOUT syncing — the recovery
+        path after a device loss, where waiting on work dispatched to a
+        dead device would only raise again. Returns the discarded tags
+        (the steps whose results are gone; the checkpoint is the source
+        of truth for them)."""
+        tags = [t for t, _p, _a, _ts in self._pending]
+        self._pending.clear()
+        self._m_occupancy.set(0)
+        self.stats["abandoned"] = self.stats.get("abandoned", 0) \
+            + len(tags)
+        return tags
+
+    def drain_partial(self):
+        """Recovery-drain: retire entries that still complete (in FIFO
+        order — work the device finished before it was lost), then
+        DISCARD everything after the first failure. Returns
+        ``(retired, discarded_tags)``. The first failure is logged, not
+        raised — the caller already holds the failure that started the
+        recovery."""
+        retired = 0
+        while self._pending:
+            try:
+                self._retire_oldest()
+                retired += 1
+            except Exception as e:
+                import logging
+                logging.getLogger("mxnet_tpu.engine").warning(
+                    "recovery drain: retire failed (%s: %s); discarding "
+                    "%d in-flight step(s)", type(e).__name__, e,
+                    len(self._pending))
+                return retired, self.abandon()
+        return retired, []
 
 
 _host_engine = None

@@ -137,6 +137,23 @@ class DevicePrefetcher:
             pass                 # kill the producer thread
         return staged
 
+    def _stage_batch(self, batch, ordinal):
+        """One whole batch through :meth:`_stage`, bracketed by the
+        chaos-harness ``prefetch.stage`` fault point (one hit per BATCH,
+        not per leaf — device_put staging is the third seam a mid-run
+        device revocation can land on) and the device-lost detector."""
+        from ...testing.faults import fault_point
+        fault_point("prefetch.stage", "before")
+        try:
+            staged = self._stage(batch)
+        except BaseException as e:
+            from ...elastic import detect as _edet
+            _edet.maybe_record_device_lost(e, "prefetch staging",
+                                           step=ordinal)
+            raise
+        fault_point("prefetch.stage", "after")
+        return staged
+
     def _stage(self, batch):
         """Recursively device_put a batch, preserving structure and
         handle types (NDArray stays NDArray). Each staged device buffer
@@ -179,7 +196,7 @@ class DevicePrefetcher:
                     batch = next(it)
                 except StopIteration:
                     return
-                staged = self._stage(batch)
+                staged = self._stage_batch(batch, n)
                 self._record_fetch(n, t0, time.perf_counter())
                 self.stats["prefetch_batches"] += 1
                 self._m_batches.inc()
@@ -200,7 +217,7 @@ class DevicePrefetcher:
                         batch = next(it)
                     except StopIteration:
                         break
-                    staged = self._stage(batch)
+                    staged = self._stage_batch(batch, n)
                     self._record_fetch(n, t0, time.perf_counter())
                     n += 1
                     while not stop.is_set():
@@ -241,10 +258,15 @@ class DevicePrefetcher:
                 if item is _DONE:
                     return
                 if isinstance(item, _Raised):
-                    # a device_put that exhausted HBM is carried here
-                    # from the producer thread — record the post-mortem
-                    # at the seam the user actually sees
+                    # a device_put that exhausted HBM (or lost its
+                    # device) is carried here from the producer thread —
+                    # record the post-mortem at the seam the user
+                    # actually sees (both records are chain-marked:
+                    # exactly one event however many seams re-raise)
                     _telemetry().memory.maybe_record_oom(
+                        item.exc, "prefetch staging", step=n)
+                    from ...elastic import detect as _edet
+                    _edet.maybe_record_device_lost(
                         item.exc, "prefetch staging", step=n)
                     raise item.exc
                 self.stats["prefetch_batches"] += 1

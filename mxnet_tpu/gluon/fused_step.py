@@ -78,6 +78,7 @@ from ..analysis import guard as _tguard
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..ndarray.random import next_key, push_trace_key, pop_trace_key
+from ..testing.faults import fault_point
 from .block import ParamBinding, _TRACED
 
 __all__ = ["CompiledTrainStep", "TrainLoop"]
@@ -93,6 +94,19 @@ def _telemetry():
         from .. import telemetry as _t
         _TELEM = _t
     return _TELEM
+
+
+# elastic device-loss detection (elastic/detect.py), lazily cached the
+# same way — classifies failures escaping the step-dispatch seam
+_EDET = None
+
+
+def _edetect():
+    global _EDET
+    if _EDET is None:
+        from ..elastic import detect as _d
+        _EDET = _d
+    return _EDET
 
 _ARRAY_TYPES = (NDArray, onp.ndarray, jax.Array)
 
@@ -656,7 +670,19 @@ class CompiledTrainStep:
         # a .asnumpy() in the loss_fn concretizing the trace, a silent
         # per-step sync on the eager fallback — logs its stack or raises
         with _tguard.hot_scope("CompiledTrainStep.step"):
-            out = self._guarded_call(args, kwargs, batch_size)
+            # device-lost seam (elastic/detect.py), alongside the OOM
+            # seams inside _guarded_call: an escaping PjRt device loss
+            # gets exactly one device_lost anomaly before it propagates
+            with _edetect().device_lost_guard(
+                    "CompiledTrainStep.step (compile/dispatch)",
+                    step=self._steps_done + 1):
+                # chaos-harness seam bracketing step dispatch — OUTSIDE
+                # the first-call eager fallback (_guarded_call's try),
+                # so an injected loss propagates to the elastic
+                # supervisor instead of demoting the program to eager
+                fault_point("step.dispatch", "before")
+                out = self._guarded_call(args, kwargs, batch_size)
+                fault_point("step.dispatch", "after")
         if self._analyze is not None and self._analysis_report is None:
             self._run_analysis(args, kwargs, batch_size)
         return out
@@ -1597,6 +1623,19 @@ class TrainLoop:
         return self._loss(out, label)
 
     def step(self, *batch, batch_size: Optional[int] = None):
+        try:
+            return self._step_impl(batch, batch_size)
+        except (KeyboardInterrupt, SystemExit) as intr:
+            # an interrupt mid-hot-loop used to abandon the dispatch
+            # window (in-flight steps and their deferred errors silently
+            # dropped) — drain it, surface the earliest faulted step's
+            # error, and leave a final checkpoint behind
+            fault = self._interrupt_cleanup()
+            if fault is not None:
+                raise fault from intr
+            raise
+
+    def _step_impl(self, batch, batch_size):
         # the WHOLE pipelined iteration is a transfer-guard hot region
         # (nested inside CompiledTrainStep's own scope this is a no-op):
         # the window retire below and the checkpoint snapshot are the
@@ -1634,12 +1673,47 @@ class TrainLoop:
 
     __call__ = step
 
+    def _interrupt_cleanup(self):
+        """KeyboardInterrupt/SIGTERM landed in the hot loop: drain the
+        window (a deferred async failure in it is the REAL story — the
+        earliest faulted step's error is returned for the caller to
+        propagate instead of the bare interrupt) and, when a checkpoint
+        manager is attached, commit a final checkpoint so the
+        interrupted run resumes from where it actually stopped."""
+        fault = None
+        try:
+            self._window.drain()
+        except BaseException as e:
+            fault = e
+            try:
+                self._window.abandon()
+            except Exception:    # pragma: no cover - defensive
+                pass
+        if self._manager is not None:
+            try:
+                with _tguard.allow_transfers("interrupt final checkpoint"):
+                    self._manager.save(self._global_step,
+                                       trainer=self._trainer,
+                                       net=self._net, block=True)
+            except Exception:
+                _LOG.warning("final checkpoint on interrupt failed",
+                             exc_info=True)
+        return fault
+
     # ---------------- async engine surface ----------------
     def synchronize(self):
         """Drain the in-flight dispatch window — ``WaitForVar`` on every
         outstanding step. Deferred async errors surface here attributed
         to the step that faulted."""
         self._window.drain()
+
+    def discard_inflight(self):
+        """Recovery-path window cleanup (``mx.elastic``): retire the
+        in-flight steps that still complete, then discard everything
+        after the first failure — their results died with the device;
+        the newest checkpoint is the source of truth. Returns
+        ``(retired, discarded_tags)``."""
+        return self._window.drain_partial()
 
     def prefetch(self, batches, depth: Optional[int] = None):
         """Wrap a host batch iterable in a device prefetcher staged with

@@ -18,7 +18,8 @@ import jax
 
 from ..base import MXNetError, get_env
 
-__all__ = ["initialize", "is_initialized", "rank", "size", "global_mesh"]
+__all__ = ["initialize", "is_initialized", "rank", "size", "global_mesh",
+           "available_devices", "world_changed"]
 
 _LOG = logging.getLogger("mxnet_tpu.dist")
 
@@ -114,3 +115,34 @@ def global_mesh(axes=None):
     from .mesh import make_mesh
     axes = axes or {"dp": -1}
     return make_mesh(axes, jax.devices())
+
+
+def available_devices(backend: Optional[str] = None) -> list:
+    """The SURVIVING device world, re-queried from the backend on every
+    call — never a list cached at import time. This is what elastic mesh
+    re-formation (``mx.elastic``) sizes the new mesh from after a device
+    loss: a preempted host's devices must not reappear because an old
+    module-level list still names them. Devices the chaos harness marked
+    revoked (``testing/faults.py`` ``revoke`` action) are excluded, so
+    shrink/grow cycles are testable on the virtual CPU mesh where real
+    revocation cannot happen."""
+    devs = list(jax.devices(backend)) if backend else list(jax.devices())
+    try:
+        from ..testing.faults import revoked_device_ids
+        revoked = revoked_device_ids()
+    except Exception:            # pragma: no cover - defensive
+        revoked = ()
+    if revoked:
+        devs = [d for d in devs if d.id not in revoked]
+    return devs
+
+
+def world_changed(devices) -> bool:
+    """Whether the currently-available world differs from ``devices`` —
+    a device list (or a ``DeviceMesh``) captured when the current mesh
+    was formed. True on loss AND on growth: the elastic supervisor
+    probes this to decide when to re-form."""
+    if hasattr(devices, "mesh"):          # a parallel.mesh.DeviceMesh
+        devices = list(devices.mesh.devices.flat)
+    cur = {d.id for d in available_devices()}
+    return cur != {d.id for d in devices}

@@ -44,6 +44,13 @@ class DeviceMesh:
     def size(self) -> int:
         return int(onp.prod(list(self.mesh.shape.values())))
 
+    @property
+    def devices(self) -> List:
+        """Flat device list in formation order — what the elastic
+        supervisor diffs against ``parallel.dist.available_devices()``
+        to detect a changed world."""
+        return list(self.mesh.devices.flat)
+
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 

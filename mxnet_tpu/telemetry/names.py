@@ -74,8 +74,18 @@ COMPILE_RETRACES = "mx_compile_retraces_total"
 # ---------------------------------------------------------------------------
 CHECKPOINT_SAVES = "mx_checkpoint_saves_total"
 CHECKPOINT_ERRORS = "mx_checkpoint_errors_total"
+CHECKPOINT_RESTORES = "mx_checkpoint_restores_total"
 CHECKPOINT_CAPTURE_SECONDS = "mx_checkpoint_capture_seconds"
 CHECKPOINT_SAVE_SECONDS = "mx_checkpoint_save_seconds"
+CHECKPOINT_RECOVERY_SECONDS = "mx_checkpoint_recovery_seconds"
+
+# ---------------------------------------------------------------------------
+# elastic training supervisor (elastic/supervisor.py)
+# ---------------------------------------------------------------------------
+ELASTIC_RECOVERIES = "mx_elastic_recoveries_total"
+ELASTIC_DOWNTIME_SECONDS = "mx_elastic_recovery_downtime_seconds"
+ELASTIC_WORLD_SIZE = "mx_elastic_world_size"
+ELASTIC_PREEMPTIONS = "mx_elastic_preemptions_total"
 
 # ---------------------------------------------------------------------------
 # step timeline (telemetry/timeline.py)
@@ -192,6 +202,31 @@ CATALOG = {
     CHECKPOINT_ERRORS: dict(
         kind="counter", label=None,
         help="failed checkpoint writes (surfaced on next save/wait)"),
+    CHECKPOINT_RESTORES: dict(
+        kind="counter", label=None,
+        help="checkpoints applied by TrainCheckpointManager (auto-"
+             "resume, elastic recovery, explicit restore)"),
+    CHECKPOINT_RECOVERY_SECONDS: dict(
+        kind="histogram", label=None,
+        help="load+verify+apply latency of one checkpoint restore "
+             "(the recovery-path critical section)"),
+    ELASTIC_RECOVERIES: dict(
+        kind="counter", label="cause",
+        help="elastic supervisor recoveries by cause (device_lost, "
+             "transient, stall, grow, preemption)"),
+    ELASTIC_DOWNTIME_SECONDS: dict(
+        kind="histogram", label=None,
+        help="failure-to-resumed downtime of one elastic recovery "
+             "(window discard + backoff + mesh re-form + recompile + "
+             "restore)"),
+    ELASTIC_WORLD_SIZE: dict(
+        kind="gauge", label=None,
+        help="devices in the currently-formed elastic world (shrinks "
+             "on device loss, grows back on restore)"),
+    ELASTIC_PREEMPTIONS: dict(
+        kind="counter", label=None,
+        help="preemption notices (SIGTERM/maintenance) that triggered "
+             "a grace-window final checkpoint"),
     CHECKPOINT_CAPTURE_SECONDS: dict(
         kind="histogram", label=None,
         help="device->host state capture latency (pauses training)"),
@@ -220,7 +255,8 @@ CATALOG = {
              "compares against"),
     ANOMALIES: dict(
         kind="counter", label="kind",
-        help="structured anomaly events by kind (nan_loss, stall)"),
+        help="structured anomaly events by kind (nan_loss, stall, oom, "
+             "memory_budget, device_lost, numerics divergence kinds)"),
     HBM_COMPILED_BYTES: dict(
         kind="gauge", label="component",
         help="compiled train-step memory_analysis bytes by component "

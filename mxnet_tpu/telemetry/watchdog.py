@@ -27,7 +27,16 @@ ONE blessed host sync — so it adds no sync of its own:
 Anomaly events are structured dicts ``{kind, step, message, value,
 time_unix}`` kept in a bounded ring (:meth:`Watchdog.anomalies`),
 counted in ``mx_anomalies_total{kind=}``, and logged as one JSON line
-on the ``mxnet_tpu.telemetry`` logger.
+on the ``mxnet_tpu.telemetry`` logger. Other subsystems publish their
+own kinds through :meth:`Watchdog.report`/:meth:`Watchdog.episode`:
+``oom`` and ``memory_budget`` (telemetry/memory.py), the
+``mx_numerics_*`` divergence kinds (telemetry/numerics.py), and
+``device_lost`` — a PjRt device-loss/preemption classified at the step
+or retire seam (elastic/detect.py), the signal the elastic training
+supervisor recovers from. Consumers that must REACT to anomalies (not
+just export counts) register a callback with :meth:`Watchdog.subscribe`
+— e.g. the elastic supervisor escalating repeated ``stall`` episodes
+into a recovery.
 
 Everything here is gated behind ``MXNET_TELEMETRY`` (telemetry.enabled)
 at the engine call site; when telemetry is off the watchdog never runs.
@@ -81,6 +90,8 @@ class Watchdog:
         self._stall_active = False
         # external episodic kinds (memory_budget, ...): kind -> active
         self._episode_active: dict = {}
+        # anomaly-channel subscribers: callback(event_dict)
+        self._subscribers: list = []
         self._flops: Optional[float] = None
         self._peak: Optional[float] = None
         reg = _default_registry()
@@ -195,11 +206,37 @@ class Watchdog:
                "value": value, "time_unix": time.time()}
         with self._lock:
             self._events.append(evt)
+            subs = list(self._subscribers)
         self._c_anom.inc(label=kind)
         _LOG.warning("mx-anomaly %s", json.dumps(evt))
+        for cb in subs:
+            try:
+                cb(evt)
+            except Exception:    # pragma: no cover - a subscriber must
+                _LOG.warning("anomaly subscriber %r failed", cb,
+                             exc_info=True)   # never kill the reporter
         return evt
 
     _anomaly = report
+
+    # ---------------- subscription ----------------
+    def subscribe(self, callback):
+        """Register ``callback(event_dict)`` to run on EVERY anomaly the
+        channel reports (whatever its source subsystem) — the reactive
+        half of the channel, e.g. the elastic supervisor escalating
+        stall episodes into a recovery. Callbacks run synchronously on
+        the reporting thread and must be cheap + non-raising (exceptions
+        are logged and swallowed). Returns ``callback`` for symmetric
+        :meth:`unsubscribe`."""
+        with self._lock:
+            if callback not in self._subscribers:
+                self._subscribers.append(callback)
+        return callback
+
+    def unsubscribe(self, callback):
+        with self._lock:
+            if callback in self._subscribers:
+                self._subscribers.remove(callback)
 
     def episode(self, kind: str, active: bool, step=None,
                 message: str = "", value=None) -> bool:
@@ -229,6 +266,7 @@ class Watchdog:
             self._nan_active = False
             self._stall_active = False
             self._episode_active.clear()
+            self._subscribers.clear()
             self._flops = None
             self._peak = None
 

@@ -7,6 +7,7 @@ by kill-9 tests instead of asserted in comments.
 """
 from . import faults                              # noqa: F401
 from .faults import (fault_point, FaultInjectedError,  # noqa: F401
-                     FaultRule)
+                     DeviceRevokedError, FaultRule)
 
-__all__ = ["faults", "fault_point", "FaultInjectedError", "FaultRule"]
+__all__ = ["faults", "fault_point", "FaultInjectedError",
+           "DeviceRevokedError", "FaultRule"]

@@ -12,6 +12,7 @@ env var::
     MXNET_FAULT_INJECT="checkpoint.commit:after=1"          # SIGKILL
     MXNET_FAULT_INJECT="checkpoint.stage:before=2:error"    # raise IO error
     MXNET_FAULT_INJECT="ndarray.save:before=1:delay:250"    # sleep 250ms
+    MXNET_FAULT_INJECT="step.dispatch:before=6:revoke:4"    # lose 4 devices
 
 Grammar (``;``-separated rules)::
 
@@ -21,10 +22,27 @@ Grammar (``;``-separated rules)::
     action := 'kill'                 # os.kill(SIGKILL) — hard preemption
             | 'error'                # raise FaultInjectedError (an OSError)
             | 'delay' ':' millis     # sleep, for overlap/race windows
+            | 'revoke' [':' count]   # mark `count` devices (default 1)
+                                     # revoked and raise DeviceRevokedError
+                                     # — a mid-run device loss
+            | 'restore'              # un-revoke every revoked device (the
+                                     # chaos "grow back"); does not raise
 
 Subprocess kill-9 tests (tests/test_checkpoint.py) set the env var,
 run a real training loop, get SIGKILLed mid-commit, and then prove the
 checkpoint directory still resumes bit-exactly.
+
+The ``revoke``/``restore`` pair is the elastic chaos harness
+(docs/ROBUSTNESS.md "Elastic training"): ``revoke`` marks the LAST
+``count`` still-alive devices revoked — ``parallel.dist
+.available_devices()`` excludes them, so the elastic supervisor's mesh
+re-formation sees a genuinely smaller world — and raises a
+:class:`DeviceRevokedError` whose message mimics the PjRt device-lost
+pattern the real hardware produces. ``restore`` clears the revoked set
+so a later ``world_changed()`` probe sees the world grow back. The
+fault points bracketing step dispatch (``step.dispatch``), window
+retire (``window.retire``) and device_put staging (``prefetch.stage``)
+are where mid-run revocations land.
 """
 from __future__ import annotations
 
@@ -35,8 +53,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["fault_point", "FaultInjectedError", "FaultRule", "configure",
-           "reset", "hit_counts"]
+__all__ = ["fault_point", "FaultInjectedError", "DeviceRevokedError",
+           "FaultRule", "configure", "reset", "hit_counts",
+           "revoked_device_ids", "restore_devices"]
 
 _LOG = logging.getLogger("mxnet_tpu.faults")
 
@@ -48,20 +67,29 @@ class FaultInjectedError(OSError):
     recovery paths are exercised exactly like a real disk error)."""
 
 
+class DeviceRevokedError(RuntimeError):
+    """The injected device loss: message mimics the PjRt/XlaRuntimeError
+    device-lost pattern, so ``elastic.detect.is_device_lost`` classifies
+    it exactly like the real thing (a ``RuntimeError`` because that is
+    what jaxlib surfaces for execution failures)."""
+
+
 class FaultRule:
-    __slots__ = ("point", "phase", "nth", "action", "delay_ms", "fired")
+    __slots__ = ("point", "phase", "nth", "action", "delay_ms", "count",
+                 "fired")
 
     def __init__(self, point: str, phase: str, nth: int, action: str,
-                 delay_ms: int = 0):
+                 delay_ms: int = 0, count: int = 1):
         if phase not in ("before", "after"):
             raise ValueError(f"fault phase must be before/after, got {phase!r}")
-        if action not in ("kill", "error", "delay"):
+        if action not in ("kill", "error", "delay", "revoke", "restore"):
             raise ValueError(f"unknown fault action {action!r}")
         self.point = point
         self.phase = phase
         self.nth = int(nth)
         self.action = action
         self.delay_ms = int(delay_ms)
+        self.count = max(1, int(count))
         self.fired = False
 
     def __repr__(self):
@@ -85,8 +113,10 @@ def _parse(spec: str) -> List[FaultRule]:
         action = parts[2] if len(parts) > 2 else "kill"
         delay_ms = int(parts[3]) if action == "delay" and len(parts) > 3 \
             else 0
+        count = int(parts[3]) if action == "revoke" and len(parts) > 3 \
+            else 1
         rules.append(FaultRule(point, phase.strip(), int(nth), action,
-                               delay_ms))
+                               delay_ms, count))
     return rules
 
 
@@ -118,11 +148,47 @@ def configure(spec: Optional[str]) -> List[FaultRule]:
 
 
 def reset():
-    """Disarm everything and forget hit counts (returns to env parsing)."""
+    """Disarm everything, forget hit counts, and restore revoked devices
+    (returns to env parsing)."""
     global _rules
     with _lock:
         _rules = None
         _counts.clear()
+        _revoked.clear()
+
+
+# ---------------------------------------------------------------- revocation
+# device ids the chaos harness marked lost; parallel.dist
+# .available_devices() excludes them so mesh re-formation sees the
+# surviving world
+_revoked: set = set()
+
+
+def revoked_device_ids() -> frozenset:
+    """Ids of devices a ``revoke`` fault marked lost (empty normally)."""
+    with _lock:
+        return frozenset(_revoked)
+
+
+def restore_devices(ids=None):
+    """Un-revoke devices (all of them by default) — the chaos-harness
+    "grow back"; also fired by the ``restore`` fault action."""
+    with _lock:
+        if ids is None:
+            _revoked.clear()
+        else:
+            _revoked.difference_update(ids)
+
+
+def _revoke_devices(count: int):
+    """Mark the LAST ``count`` still-alive devices revoked (at least one
+    device always survives) and return the lost ones."""
+    import jax
+    with _lock:
+        alive = [d for d in jax.devices() if d.id not in _revoked]
+        lost = alive[max(1, len(alive) - count):]
+        _revoked.update(d.id for d in lost)
+    return lost
 
 
 def hit_counts() -> Dict[Tuple[str, str], int]:
@@ -166,3 +232,14 @@ def _fire(rule: FaultRule):
             f"injected IO failure at {rule.point}:{rule.phase}")
     elif rule.action == "delay":
         time.sleep(rule.delay_ms / 1000.0)
+    elif rule.action == "revoke":
+        lost = _revoke_devices(rule.count)
+        names = ", ".join(str(d) for d in lost)
+        # the message mirrors what PjRt surfaces when a TPU host is
+        # preempted mid-execution, so detection pattern-matches reality
+        raise DeviceRevokedError(
+            f"INTERNAL: device lost: {names} removed from the system; "
+            f"execution aborted (injected revocation at "
+            f"{rule.point}:{rule.phase})")
+    elif rule.action == "restore":
+        restore_devices()

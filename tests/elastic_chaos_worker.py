@@ -1,0 +1,195 @@
+"""Subprocess worker for the elastic chaos tests (tests/test_elastic.py,
+markers ``chaos`` + ``slow``).
+
+Two modes:
+
+``chaos <ckpt_dir>``
+    Runs a dp=8 supervised TrainLoop under ``MXNET_TELEMETRY=1`` +
+    ``MXNET_TRANSFER_GUARD=raise`` with an in-process fault timeline —
+    revoke 4 devices before dispatch hit 6, restore them before hit 10
+    — so the run shrinks 8→4 and grows back 4→8. Then, in the same
+    process, SELF-VERIFIES loss-curve continuity: for each re-formation
+    it replays an uninterrupted reference run at the new layout,
+    restored from the exact checkpoint the supervisor restored
+    (``TrainCheckpointManager.restore_step``), and asserts the loss
+    trajectories are bit-exact. Prints one JSON verdict line prefixed
+    ``RESULT ``.
+
+``sigterm <ckpt_dir>``
+    Runs a long supervised loop, prints ``READY`` once steps are
+    flowing, and waits for the parent's SIGTERM. The supervisor's
+    preemption notice must drain the window and commit the grace-window
+    final checkpoint; the worker prints the JSON verdict and exits 0.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["MXNET_TELEMETRY"] = "1"
+os.environ["MXNET_TRANSFER_GUARD"] = "raise"
+
+import numpy as onp  # noqa: E402
+
+
+def _build_fn(seed=3):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.gluon import loss as gloss
+
+    def build():
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4, activation="relu"))
+        net.add(nn.Dense(3, in_units=8))
+        net.initialize()
+        trainer = Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+        return net, trainer, gloss.SoftmaxCrossEntropyLoss()
+
+    return build
+
+
+def _batch_fn(i, bs=8):
+    import mxnet_tpu as mx
+    rng = onp.random.RandomState(1000 + i)
+    return (mx.nd.array(rng.randn(bs, 4).astype("float32")),
+            mx.nd.array(rng.randint(0, 3, size=(bs,)).astype("int32")))
+
+
+def _reference_segment(ckpt_dir, restored_step, until_step, dp):
+    """Uninterrupted run at dp devices restored from the EXACT
+    checkpoint the supervisor restored; returns {i: summed loss}."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import TrainCheckpointManager
+    from mxnet_tpu.gluon import TrainLoop
+    from mxnet_tpu.parallel import make_mesh
+
+    build = _build_fn()
+    net, trainer, loss_blk = build()
+    with make_mesh({"dp": dp}, jax.devices()[:dp]):
+        mgr = TrainCheckpointManager(ckpt_dir)
+        mgr.restore_step(restored_step, trainer=trainer, net=net)
+        loop = TrainLoop(net, trainer, loss_blk)
+        handles = {}
+        for i in range(restored_step, until_step):
+            handles[i] = loop.step(*_batch_fn(i))
+        loop.synchronize()
+    return {i: float(h.asnumpy().sum()) for i, h in handles.items()}
+
+
+def run_chaos(ckpt_dir):
+    import mxnet_tpu as mx
+    from mxnet_tpu.testing import faults
+
+    total = 14
+    faults.configure("step.dispatch:before=6:revoke:4;"
+                     "step.dispatch:before=10:restore")
+    sup = mx.elastic.ElasticSupervisor(
+        _build_fn(), ckpt_dir, mesh_axes={"dp": -1},
+        checkpoint_every=2, keep_last=99, backoff_base=0.0,
+        log=mx.elastic.RecoveryLog())
+    try:
+        res = sup.run(_batch_fn, total)
+    finally:
+        faults.reset()
+
+    wd = mx.telemetry.watchdog()
+    verdict = {
+        "ok": True, "detail": [],
+        "final_step": res.final_step,
+        "world_size": res.world_size,
+        "preempted": res.preempted,
+        "events": res.events,
+        "device_lost_anomalies": len(wd.anomalies("device_lost")),
+        "recoveries_by_cause": {
+            c: len([e for e in res.events if e["cause"] == c])
+            for c in ("device_lost", "grow")},
+    }
+
+    def fail(msg):
+        verdict["ok"] = False
+        verdict["detail"].append(msg)
+
+    if res.final_step != total:
+        fail(f"final_step {res.final_step} != {total}")
+    if res.world_size != 8:
+        fail(f"did not grow back: world {res.world_size}")
+    if len(wd.anomalies("device_lost")) != 1:
+        fail(f"{len(wd.anomalies('device_lost'))} device_lost "
+             "anomalies, want exactly 1")
+    shrink = [e for e in res.events if e["cause"] == "device_lost"]
+    grow = [e for e in res.events if e["cause"] == "grow"]
+    if len(shrink) != 1 or len(grow) != 1:
+        fail(f"events: {len(shrink)} device_lost + {len(grow)} grow, "
+             "want exactly 1 + 1")
+    if verdict["ok"]:
+        s, g = shrink[0], grow[0]
+        if not (s["old_dp"] == 8 and s["new_dp"] == 4):
+            fail(f"shrink dp {s['old_dp']}->{s['new_dp']}, want 8->4")
+        if not (g["old_dp"] == 4 and g["new_dp"] == 8):
+            fail(f"grow dp {g['old_dp']}->{g['new_dp']}, want 4->8")
+        # loss-curve continuity: bit-exact from the restored step at
+        # the new layout, vs an uninterrupted run restored from the
+        # SAME checkpoint
+        r1, r2 = s["restored_step"], g["restored_step"]
+        ref4 = _reference_segment(ckpt_dir, r1, r2, dp=4)
+        for i, want in ref4.items():
+            if res.losses.get(i) != want:
+                fail(f"dp=4 segment step {i}: supervised "
+                     f"{res.losses.get(i)} != reference {want}")
+        ref8 = _reference_segment(ckpt_dir, r2, 14, dp=8)
+        for i, want in ref8.items():
+            if res.losses.get(i) != want:
+                fail(f"dp=8 segment step {i}: supervised "
+                     f"{res.losses.get(i)} != reference {want}")
+        verdict["dp4_segment"] = [r1, r2]
+        verdict["dp8_segment"] = [r2, 14]
+    print("RESULT " + json.dumps(verdict), flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+def run_sigterm(ckpt_dir):
+    import mxnet_tpu as mx
+
+    def batch_fn(i):
+        if i == 5:
+            print("READY", flush=True)
+        time.sleep(0.02)      # keep the process alive for the signal
+        return _batch_fn(i)
+
+    sup = mx.elastic.ElasticSupervisor(
+        _build_fn(), ckpt_dir, mesh_axes={"dp": -1},
+        checkpoint_every=2, backoff_base=0.0,
+        log=mx.elastic.RecoveryLog())
+    res = sup.run(batch_fn, 100_000)
+    mgr = sup.loop.checkpoint_manager
+    verdict = {
+        "preempted": res.preempted,
+        "final_step": res.final_step,
+        "latest_checkpoint": mgr.latest_step(),
+        "preemption_events": len(res.events),
+        "causes": [e["cause"] for e in res.events],
+    }
+    print("RESULT " + json.dumps(verdict), flush=True)
+    return 0 if res.preempted else 1
+
+
+def main():
+    mode, ckpt_dir = sys.argv[1], sys.argv[2]
+    if mode == "chaos":
+        return run_chaos(ckpt_dir)
+    if mode == "sigterm":
+        return run_sigterm(ckpt_dir)
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,722 @@
+"""Elastic training supervisor (ISSUE 11).
+
+Covers: device-lost classification at the dispatch seams (patterns,
+chained exceptions, exactly-one anomaly per episode), the chaos-harness
+``revoke``/``restore`` fault actions and the surviving-world helpers
+(``parallel.dist.available_devices``/``world_changed``), the watchdog
+anomaly-channel subscription, DispatchWindow abandon/partial drain, the
+TrainLoop interrupt path (drain + earliest faulted step's error + final
+checkpoint), checkpoint restore metrics/provenance/``restore_step``,
+preemption notices with grace-window saves, and the ElasticSupervisor
+recovery state machine — parametrized sgd-mom/adam × fused/zero parity
+proofs that post-recovery losses match an uninterrupted run restored at
+the same step. Marked ``chaos``+``slow``: subprocess tests driving the
+full dp=8→4→8 shrink/grow cycle (bit-exact continuity, zero unblessed
+syncs under MXNET_TRANSFER_GUARD=raise) and a SIGTERM kill whose
+grace-window checkpoint lands at the interrupted step.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import TrainCheckpointManager
+from mxnet_tpu.checkpoint.atomic import (read_checkpoint, step_dir_name)
+from mxnet_tpu.elastic import detect
+from mxnet_tpu.engine import DispatchWindow
+from mxnet_tpu.gluon import TrainLoop, Trainer, nn
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.parallel import dist, make_mesh
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import (DeviceRevokedError,
+                                      FaultInjectedError)
+
+NDEV = len(jax.devices())
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic():
+    faults.reset()
+    detect.notice().clear()
+    mx.telemetry.watchdog().reset()
+    yield
+    faults.reset()
+    detect.notice().clear()
+    mx.telemetry.watchdog().reset()
+
+
+# ---------------------------------------------------------------- helpers
+def _build_fn(seed=3):
+    def build():
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4, activation="relu"))
+        net.add(nn.Dense(3, in_units=8))
+        net.initialize()
+        trainer = Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+        return net, trainer, gloss.SoftmaxCrossEntropyLoss()
+    return build
+
+
+def _build_opt(opt, seed=3):
+    def build():
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4, activation="relu"))
+        net.add(nn.Dense(3, in_units=8))
+        net.initialize()
+        params = {"learning_rate": 0.05}
+        if opt == "sgd":
+            params["momentum"] = 0.9
+        trainer = Trainer(net.collect_params(), opt, params)
+        return net, trainer, gloss.SoftmaxCrossEntropyLoss()
+    return build
+
+
+def _batch(i, bs=8):
+    rng = onp.random.RandomState(1000 + i)
+    return (mx.nd.array(rng.randn(bs, 4).astype("float32")),
+            mx.nd.array(rng.randint(0, 3, size=(bs,)).astype("int32")))
+
+
+def _fresh_log():
+    return elastic.RecoveryLog()
+
+
+# ================================================================ detection
+def test_is_device_lost_patterns():
+    assert detect.is_device_lost(
+        RuntimeError("INTERNAL: device lost: TPU_3"))
+    assert detect.is_device_lost(RuntimeError("TPU is unhealthy"))
+    assert detect.is_device_lost(
+        RuntimeError("chip has been removed from the system"))
+    assert detect.is_device_lost(
+        DeviceRevokedError("INTERNAL: device lost: x removed"))
+    assert not detect.is_device_lost(ValueError("shape mismatch"))
+    assert not detect.is_device_lost(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory"))
+
+
+def test_is_device_lost_walks_the_chain():
+    inner = DeviceRevokedError("INTERNAL: device lost: TFRT_CPU_7")
+    outer = MXNetError("async train step 5 failed (deferred error)")
+    outer.__cause__ = inner
+    assert detect.is_device_lost(outer)
+    assert detect.classify(outer) == "device_lost"
+
+
+def test_classify_taxonomy():
+    assert detect.classify(DeviceRevokedError("device lost: x")) \
+        == "device_lost"
+    assert detect.classify(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory")) == "oom"
+    assert detect.classify(FaultInjectedError("disk blip")) \
+        == "transient"
+    assert detect.classify(OSError("connection reset")) == "transient"
+    assert detect.classify(ValueError("bad shape")) == "fatal"
+    from mxnet_tpu.elastic.supervisor import StallEscalation
+    assert detect.classify(StallEscalation("3 stalls")) == "stall"
+
+
+def test_device_lost_anomaly_exactly_once_across_seams():
+    wd = mx.telemetry.watchdog()
+    e = DeviceRevokedError("INTERNAL: device lost: TFRT_CPU_7 removed")
+    assert detect.maybe_record_device_lost(e, "inner seam", step=4)
+    wrapped = MXNetError("async step 4 failed")
+    wrapped.__cause__ = e
+    # the outer seam sees the SAME failure: chain-marked, no re-fire
+    assert not detect.maybe_record_device_lost(wrapped, "outer seam")
+    assert not detect.maybe_record_device_lost(e, "third seam")
+    evs = wd.anomalies("device_lost")
+    assert len(evs) == 1
+    assert evs[0]["step"] == 4
+    assert "inner seam" in evs[0]["message"]
+
+
+def test_non_device_errors_not_recorded():
+    wd = mx.telemetry.watchdog()
+    assert not detect.maybe_record_device_lost(
+        ValueError("nope"), "seam")
+    assert wd.anomalies("device_lost") == []
+
+
+def test_device_lost_guard_propagates_and_records():
+    wd = mx.telemetry.watchdog()
+    with pytest.raises(DeviceRevokedError):
+        with detect.device_lost_guard("guarded seam", step=7):
+            raise DeviceRevokedError("device lost: y")
+    assert len(wd.anomalies("device_lost")) == 1
+
+
+# ================================================================ faults
+def test_revoke_grammar():
+    rules = faults.configure("step.dispatch:before=6:revoke:4")
+    assert rules[0].action == "revoke" and rules[0].count == 4
+    rules = faults.configure("p:after=1:revoke")
+    assert rules[0].count == 1
+    rules = faults.configure("p:before=2:restore")
+    assert rules[0].action == "restore"
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.configure("p:before=1:explode")
+
+
+@pytest.mark.skipif(NDEV < 4, reason="needs virtual multi-device mesh")
+def test_revoke_shrinks_world_and_restore_grows_it_back():
+    n0 = len(dist.available_devices())
+    faults.configure("p:before=1:revoke:2")
+    with pytest.raises(DeviceRevokedError, match="device lost"):
+        faults.fault_point("p")
+    assert len(faults.revoked_device_ids()) == 2
+    assert len(dist.available_devices()) == n0 - 2
+    assert dist.world_changed(jax.devices())
+    faults.restore_devices()
+    assert len(dist.available_devices()) == n0
+    assert not dist.world_changed(jax.devices())
+
+
+def test_revoke_never_kills_the_last_device():
+    faults.configure("p:before=1:revoke:9999")
+    with pytest.raises(DeviceRevokedError):
+        faults.fault_point("p")
+    assert len(dist.available_devices()) >= 1
+
+
+def test_reset_restores_revoked_devices():
+    faults.configure("p:before=1:revoke:1")
+    with pytest.raises(DeviceRevokedError):
+        faults.fault_point("p")
+    assert faults.revoked_device_ids()
+    faults.reset()
+    assert not faults.revoked_device_ids()
+
+
+# ================================================================ dist
+def test_available_devices_requeries_backend(monkeypatch):
+    fake = [types.SimpleNamespace(id=0), types.SimpleNamespace(id=1),
+            types.SimpleNamespace(id=2)]
+    monkeypatch.setattr(jax, "devices",
+                        lambda backend=None: list(fake))
+    assert [d.id for d in dist.available_devices()] == [0, 1, 2]
+    lost = fake.pop()          # the backend world shrank AFTER import
+    assert [d.id for d in dist.available_devices()] == [0, 1]
+    assert dist.world_changed([types.SimpleNamespace(id=0),
+                               types.SimpleNamespace(id=1), lost])
+    assert not dist.world_changed(list(fake))
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs virtual multi-device mesh")
+def test_world_changed_accepts_a_mesh():
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    assert mesh.devices == jax.devices()[:2]
+    if NDEV > 2:
+        assert dist.world_changed(mesh)      # mesh < full world
+    assert not dist.world_changed(dist.available_devices())
+
+
+# ================================================================ watchdog
+def test_watchdog_subscription():
+    wd = mx.telemetry.watchdog()
+    seen = []
+    cb = wd.subscribe(seen.append)
+    wd.report("stall", 3, "slow step")
+    wd.report("device_lost", 4, "gone")
+    assert [e["kind"] for e in seen] == ["stall", "device_lost"]
+    wd.unsubscribe(cb)
+    wd.report("stall", 5, "again")
+    assert len(seen) == 2
+
+
+def test_watchdog_subscriber_exception_swallowed():
+    wd = mx.telemetry.watchdog()
+
+    def bad(evt):
+        raise RuntimeError("subscriber bug")
+
+    wd.subscribe(bad)
+    evt = wd.report("stall", 1, "x")     # must not raise
+    assert evt["kind"] == "stall"
+    assert len(wd.anomalies("stall")) == 1
+
+
+# ================================================================ window
+def test_window_abandon_discards_without_sync():
+    synced = []
+    w = DispatchWindow(max_inflight=5, sync_fn=synced.append)
+    for i in range(3):
+        w.push(onp.zeros(2), tag=i + 1)
+    assert len(w) == 3
+    tags = w.abandon()
+    assert tags == [1, 2, 3]
+    assert len(w) == 0 and synced == []
+    assert w.stats["abandoned"] == 3
+
+
+def test_window_drain_partial_discards_after_first_failure():
+    def sync(p):
+        if p == "bad":
+            raise RuntimeError("device lost: gone mid-flight")
+
+    w = DispatchWindow(max_inflight=5, sync_fn=sync)
+    w.push("ok", tag=1)
+    w.push("bad", tag=2)
+    w.push("late", tag=3)
+    retired, discarded = w.drain_partial()
+    assert retired == 1
+    assert discarded == [3]          # the faulted entry is consumed,
+    assert len(w) == 0               # everything after it discarded
+
+
+def test_window_drain_partial_clean():
+    w = DispatchWindow(max_inflight=5, sync_fn=lambda p: p)
+    w.push("a", tag=1)
+    w.push("b", tag=2)
+    assert w.drain_partial() == (2, [])
+
+
+# ================================================================ interrupt
+def test_interrupt_drains_window_and_writes_final_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    build = _build_fn()
+    net, trainer, loss_blk = build()
+    loop = TrainLoop(net, trainer, loss_blk, checkpoint_dir=d,
+                     inflight=4)
+    for i in range(3):
+        loop.step(*_batch(i))
+    assert loop.engine_stats()["pending"] == 3
+
+    def boom(*a, **k):
+        raise KeyboardInterrupt
+
+    loop._step = boom
+    with pytest.raises(KeyboardInterrupt):
+        loop.step(*_batch(3))
+    # the window was drained (not abandoned), and a final checkpoint
+    # landed at the interrupted step
+    assert loop.engine_stats()["pending"] == 0
+    assert loop.engine_stats()["retires"] == 3
+    mgr = TrainCheckpointManager(d)
+    assert mgr.latest_step() == 3
+
+
+def test_interrupt_propagates_earliest_faulted_step_error(tmp_path):
+    d = str(tmp_path / "ck")
+    net, trainer, loss_blk = _build_fn()()
+    loop = TrainLoop(net, trainer, loss_blk, checkpoint_dir=d,
+                     inflight=4)
+    for i in range(3):
+        loop.step(*_batch(i))
+    # the first retire during the interrupt drain faults: its error is
+    # the real story and must propagate instead of the bare interrupt
+    faults.configure("window.retire:before=1:error")
+
+    def boom(*a, **k):
+        raise KeyboardInterrupt
+
+    loop._step = boom
+    with pytest.raises(FaultInjectedError):
+        loop.step(*_batch(3))
+    assert loop.engine_stats()["pending"] == 0   # rest abandoned
+    # the final checkpoint still landed
+    assert TrainCheckpointManager(d).latest_step() == 3
+
+
+# ================================================================ manager
+def test_restore_metrics_and_provenance(tmp_path):
+    d = str(tmp_path / "ck")
+    net, trainer, loss_blk = _build_fn()()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+    mgr = TrainCheckpointManager(d, async_save=False)
+    for i in range(3):
+        step(*_batch(i))
+    mgr.save(3, trainer=trainer, net=net)
+    assert mgr.restore_provenance is None
+
+    c0 = mx.telemetry.value(mx.telemetry.names.CHECKPOINT_RESTORES)
+    net2, trainer2, _ = _build_fn()()
+    mgr2 = TrainCheckpointManager(d)
+    meta = mgr2.restore_latest(trainer=trainer2, net=net2)
+    assert meta["step"] == 3
+    c1 = mx.telemetry.value(mx.telemetry.names.CHECKPOINT_RESTORES)
+    assert c1 == c0 + 1
+    prov = mgr2.restore_provenance
+    assert prov["step"] == 3
+    assert prov["resumed_from"].endswith(step_dir_name(3))
+    assert prov["dp_from"] == 1 and prov["dp_to"] == 1
+    assert prov["reshard"] is None
+    assert prov["duration_s"] > 0
+
+
+def test_restore_step_targets_a_specific_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    net, trainer, loss_blk = _build_fn()()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+    mgr = TrainCheckpointManager(d, keep_last=5, async_save=False)
+    for i in range(4):
+        step(*_batch(i))
+        mgr.save(i + 1, trainer=trainer, net=net)
+    net2, trainer2, _ = _build_fn()()
+    mgr2 = TrainCheckpointManager(d, keep_last=5)
+    meta = mgr2.restore_step(2, trainer=trainer2, net=net2)
+    assert meta["step"] == 2
+    assert mgr2.restore_provenance["step"] == 2
+    with pytest.raises(Exception):      # missing step raises
+        mgr2.restore_step(9, trainer=trainer2, net=net2)
+
+
+def test_saves_after_restore_carry_provenance(tmp_path):
+    d = str(tmp_path / "ck")
+    net, trainer, loss_blk = _build_fn()()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+    mgr = TrainCheckpointManager(d, keep_last=5, async_save=False)
+    for i in range(2):
+        step(*_batch(i))
+    mgr.save(2, trainer=trainer, net=net)
+    net2, trainer2, _ = _build_fn()()
+    mgr2 = TrainCheckpointManager(d, keep_last=5, async_save=False)
+    mgr2.restore_latest(trainer=trainer2, net=net2)
+    mgr2.save(5, trainer=trainer2, net=net2)
+    _, manifest = read_checkpoint(os.path.join(d, step_dir_name(5)))
+    prov = manifest["meta"]["resumed_from"]
+    assert prov["step"] == 2
+    assert prov["resumed_from"].endswith(step_dir_name(2))
+
+
+@pytest.mark.skipif(NDEV < 4, reason="needs virtual multi-device mesh")
+def test_zero_restore_provenance_names_the_reshard(tmp_path):
+    d = str(tmp_path / "ck")
+    build = _build_fn()
+    net, trainer, loss_blk = build()
+    with make_mesh({"dp": 4}, jax.devices()[:4]):
+        step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+        for i in range(3):
+            step(*_batch(i))
+        assert step.zero_sharded
+        mgr = TrainCheckpointManager(d, async_save=False)
+        mgr.save(3, trainer=trainer, net=net)
+    net2, trainer2, _ = build()
+    with make_mesh({"dp": 2}, jax.devices()[:2]):
+        mgr2 = TrainCheckpointManager(d)
+        mgr2.restore_latest(trainer=trainer2, net=net2)
+    prov = mgr2.restore_provenance
+    assert prov["dp_from"] == 4 and prov["dp_to"] == 2
+    assert prov["reshard"] == "dp4->dp2"
+
+
+# ================================================================ preemption
+def test_preemption_notice_trigger_and_grace(monkeypatch):
+    n = detect.notice()
+    assert not n.requested()
+    monkeypatch.setenv("MXNET_PREEMPTION_GRACE_SEC", "45")
+    assert detect.preemption_grace_sec() == 45
+    assert n.remaining_grace() == 45
+    n.trigger()
+    assert n.requested()
+    assert n.remaining_grace() <= 45
+    n.clear()
+    assert not n.requested()
+
+
+def test_supervisor_graceful_preemption(tmp_path):
+    d = str(tmp_path / "ck")
+    c0 = mx.telemetry.value(mx.telemetry.names.ELASTIC_PREEMPTIONS) or 0
+
+    def batch_fn(i):
+        if i == 3:
+            detect.notice().trigger()
+        return _batch(i)
+
+    sup = elastic.ElasticSupervisor(
+        _build_fn(), d, mesh_axes=None, checkpoint_every=None,
+        backoff_base=0.0, log=_fresh_log())
+    res = sup.run(batch_fn, 10)
+    assert res.preempted
+    # the notice lands DURING step 4's batch; the check at the next
+    # iteration exits with the grace-window save at step 4
+    assert res.final_step == 4
+    assert TrainCheckpointManager(d).latest_step() == 4
+    assert [e["cause"] for e in res.events] == ["preemption"]
+    c1 = mx.telemetry.value(mx.telemetry.names.ELASTIC_PREEMPTIONS)
+    assert c1 == c0 + 1
+
+
+# ================================================================ supervisor
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+@pytest.mark.parametrize("mode", ["fused", "zero"])
+def test_recovery_losses_match_uninterrupted_restore(tmp_path, mode,
+                                                     opt):
+    """Post-recovery losses are BIT-EXACT vs an uninterrupted run
+    restored at the same step (at the new layout, for zero): the
+    recovery state machine composes drain/re-form/recompile/restore
+    without perturbing the training computation."""
+    if mode == "zero" and NDEV < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    d = str(tmp_path / "ck")
+    total = 8
+    build = _build_opt(opt)
+    if mode == "zero":
+        # a genuine device revocation: dp=8 shrinks to dp=4
+        faults.configure("step.dispatch:before=6:revoke:4")
+        mesh_axes, ref_dp = {"dp": -1}, 4
+    else:
+        # a transient failure: same world, restart from the checkpoint
+        faults.configure("step.dispatch:before=6:error")
+        mesh_axes, ref_dp = None, None
+    log = _fresh_log()
+    sup = elastic.ElasticSupervisor(
+        build, d, mesh_axes=mesh_axes, checkpoint_every=2,
+        keep_last=99, backoff_base=0.0, log=log)
+    res = sup.run(_batch, total)
+    faults.reset()
+
+    assert res.final_step == total
+    assert len(res.events) == 1          # exactly one RecoveryLog event
+    ev = res.events[0]
+    restored = ev["restored_step"]
+    assert restored == 4                 # newest checkpoint before the
+    assert ev["step"] == 5               # failure at step 5's dispatch
+    if mode == "zero":
+        assert ev["cause"] == "device_lost"
+        assert ev["old_dp"] == 8 and ev["new_dp"] == 4
+        assert len(ev["lost_devices"]) == 4
+        wd = mx.telemetry.watchdog()
+        assert len(wd.anomalies("device_lost")) == 1   # exactly one
+    else:
+        assert ev["cause"] == "transient"
+
+    # reference: fresh build, restore the SAME checkpoint at the new
+    # layout, run the same steps uninterrupted
+    net, trainer, loss_blk = build()
+    if ref_dp:
+        ctx = make_mesh({"dp": ref_dp}, jax.devices()[:ref_dp])
+    else:
+        import contextlib
+        ctx = contextlib.nullcontext()
+    with ctx:
+        mgr = TrainCheckpointManager(d, keep_last=99)
+        mgr.restore_step(restored, trainer=trainer, net=net)
+        loop = TrainLoop(net, trainer, loss_blk)
+        handles = {i: loop.step(*_batch(i))
+                   for i in range(restored, total)}
+        loop.synchronize()
+    ref = {i: float(h.asnumpy().sum()) for i, h in handles.items()}
+    for i in range(restored, total):
+        assert res.losses[i] == ref[i], f"step {i} diverged"
+
+
+def test_retry_budget_exhausted(tmp_path):
+    d = str(tmp_path / "ck")
+    faults.configure(";".join(
+        f"step.dispatch:before={n}:error" for n in range(1, 6)))
+    sup = elastic.ElasticSupervisor(
+        _build_fn(), d, mesh_axes=None, max_retries=2,
+        backoff_base=0.0, log=_fresh_log())
+    with pytest.raises(MXNetError, match="recovery budget exhausted"):
+        sup.run(_batch, 8)
+
+
+def test_forward_progress_resets_retry_budget(tmp_path):
+    d = str(tmp_path / "ck")
+    # three failures, but each recovery REPLAYS successfully past the
+    # restored step before the next one hits — the budget never trips
+    faults.configure("step.dispatch:before=3:error;"
+                     "step.dispatch:before=7:error;"
+                     "step.dispatch:before=10:error")
+    sup = elastic.ElasticSupervisor(
+        _build_fn(), d, mesh_axes=None, checkpoint_every=1,
+        max_retries=1, backoff_base=0.0, log=_fresh_log())
+    res = sup.run(_batch, 8)
+    assert res.final_step == 8
+    assert res.recoveries == 3
+
+
+def test_recovery_disabled_propagates(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_ELASTIC", "0")
+    d = str(tmp_path / "ck")
+    faults.configure("step.dispatch:before=3:error")
+    log = _fresh_log()
+    sup = elastic.ElasticSupervisor(_build_fn(), d, mesh_axes=None,
+                                    backoff_base=0.0, log=log)
+    with pytest.raises(FaultInjectedError):
+        sup.run(_batch, 8)
+    assert len(log) == 0
+
+
+def test_fatal_errors_propagate(tmp_path):
+    d = str(tmp_path / "ck")
+
+    def batch_fn(i):
+        if i == 2:
+            raise ValueError("a real bug, not the hardware")
+        return _batch(i)
+
+    sup = elastic.ElasticSupervisor(_build_fn(), d, mesh_axes=None,
+                                    backoff_base=0.0, log=_fresh_log())
+    with pytest.raises(ValueError, match="real bug"):
+        sup.run(batch_fn, 8)
+
+
+def test_stall_escalation_recovers(tmp_path):
+    d = str(tmp_path / "ck")
+    wd = mx.telemetry.watchdog()
+
+    def batch_fn(i):
+        if i == 3:
+            wd.report("stall", i, "synthetic stall episode")
+        return _batch(i)
+
+    sup = elastic.ElasticSupervisor(
+        _build_fn(), d, mesh_axes=None, checkpoint_every=2,
+        stall_escalation=1, backoff_base=0.0, log=_fresh_log())
+    res = sup.run(batch_fn, 8)
+    assert res.final_step == 8
+    assert [e["cause"] for e in res.events] == ["stall"]
+    assert res.events[0]["restored_step"] == 4
+
+
+@pytest.mark.skipif(NDEV < 8, reason="needs the 8-device virtual mesh")
+def test_window_retire_seam_recovers(tmp_path):
+    """A device loss surfacing at the WINDOW RETIRE (the pipelined
+    seam) recovers exactly like one at dispatch."""
+    d = str(tmp_path / "ck")
+    faults.configure("window.retire:before=5:revoke:4")
+    log = _fresh_log()
+    sup = elastic.ElasticSupervisor(
+        _build_fn(), d, mesh_axes={"dp": -1}, checkpoint_every=2,
+        backoff_base=0.0, log=log)
+    res = sup.run(_batch, 8)
+    faults.reset()
+    assert res.final_step == 8
+    assert len(res.events) == 1
+    assert res.events[0]["cause"] == "device_lost"
+    assert res.events[0]["new_dp"] == 4
+    assert len(mx.telemetry.watchdog().anomalies("device_lost")) == 1
+
+
+# ================================================================ log
+def test_recovery_log_schema_and_metrics():
+    log = _fresh_log()
+    c0 = mx.telemetry.value(mx.telemetry.names.ELASTIC_RECOVERIES,
+                            "device_lost") or 0
+    evt = log.record(cause="device_lost", lost_devices=["TPU_3"],
+                     old_dp=8, new_dp=4, restored_step=40,
+                     downtime_s=1.25, discarded_steps=2, step=42)
+    for k in ("cause", "lost_devices", "old_dp", "new_dp",
+              "restored_step", "discarded_steps", "downtime_s", "step",
+              "time_unix"):
+        assert k in evt
+    assert len(log) == 1
+    assert log.events("device_lost") == [evt]
+    assert log.events("grow") == []
+    c1 = mx.telemetry.value(mx.telemetry.names.ELASTIC_RECOVERIES,
+                            "device_lost")
+    assert c1 == c0 + 1
+    assert mx.telemetry.value(
+        mx.telemetry.names.ELASTIC_WORLD_SIZE) == 4
+    assert "device_lost" in log.table()
+    assert "8->4" in log.table().replace(" ", "")
+
+
+def test_env_gates(monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC", raising=False)
+    assert detect.elastic_enabled() and not detect.armed()
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    assert detect.elastic_enabled() and detect.armed()
+    monkeypatch.setenv("MXNET_ELASTIC", "0")
+    assert not detect.elastic_enabled() and not detect.armed()
+    monkeypatch.setenv("MXNET_ELASTIC_MAX_RETRIES", "7")
+    assert detect.max_retries() == 7
+    monkeypatch.setenv("MXNET_ELASTIC_MAX_RETRIES", "bogus")
+    assert detect.max_retries() == 3
+
+
+# ================================================================ chaos
+def _worker(mode, ckpt_dir, timeout=600):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable,
+           os.path.join(repo, "tests", "elastic_chaos_worker.py"),
+           mode, ckpt_dir]
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_INJECT", None)
+    return cmd, env, repo
+
+
+def _result_line(out):
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in worker output:\n{out}")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_shrink_grow_bit_exact(tmp_path):
+    """THE chaos acceptance test (subprocess; MXNET_TELEMETRY=1 +
+    MXNET_TRANSFER_GUARD=raise inside): a dp=8 supervised run survives
+    a mid-run 4-device revocation, re-forms at dp=4, restores the
+    newest atomic checkpoint, and its loss trajectory is bit-exact vs
+    an uninterrupted dp=4 run restored from the same checkpoint; the
+    world then grows back to dp=8 (also bit-exact from its re-form
+    checkpoint); exactly one device_lost anomaly and one RecoveryLog
+    event per episode; zero unblessed syncs (the guard would raise)."""
+    cmd, env, repo = _worker("chaos", str(tmp_path / "ck"))
+    r = subprocess.run(cmd, env=env, cwd=repo, capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, f"worker failed:\n{r.stdout}\n{r.stderr}"
+    v = _result_line(r.stdout)
+    assert v["ok"], v["detail"]
+    assert v["final_step"] == 14 and v["world_size"] == 8
+    assert v["device_lost_anomalies"] == 1
+    assert v["recoveries_by_cause"] == {"device_lost": 1, "grow": 1}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigterm_grace_window_save(tmp_path):
+    """Subprocess kill test: SIGTERM mid-run triggers the preemption
+    notice; the supervisor drains its window, commits the grace-window
+    final checkpoint at the interrupted step, and exits cleanly."""
+    d = str(tmp_path / "ck")
+    cmd, env, repo = _worker("sigterm", d)
+    p = subprocess.Popen(cmd, env=env, cwd=repo,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    try:
+        # wait for the worker to report steps flowing
+        deadline = time.time() + 300
+        ready = False
+        lines = []
+        while time.time() < deadline:
+            line = p.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if line.startswith("READY"):
+                ready = True
+                break
+        assert ready, "worker never became READY:\n" + "".join(lines)
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=300)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.communicate()
+    assert p.returncode == 0, f"worker exit {p.returncode}:\n{out}\n{err}"
+    v = _result_line("".join(lines) + out)
+    assert v["preempted"]
+    assert v["causes"] == ["preemption"]
+    # the grace-window save landed AT the step the run stopped on
+    assert v["latest_checkpoint"] == v["final_step"]
+    mgr = TrainCheckpointManager(d)
+    assert mgr.latest_step() == v["final_step"]

@@ -176,6 +176,24 @@ def test_diagnose_numerics_section(capsys, tmp_path, monkeypatch):
     telemetry.reset()
 
 
+def test_diagnose_elastic_section(capsys):
+    """--elastic: runs a tiny supervised TrainLoop, injects one mid-run
+    fault, and prints the RecoveryLog table (exactly one recovery) and
+    the restore provenance."""
+    from mxnet_tpu.testing import faults
+    diagnose = _load("tools/diagnose.py", "diagnose6")
+    try:
+        assert diagnose.main(["--elastic"]) == 0
+    finally:
+        faults.reset()
+    out = capsys.readouterr().out
+    assert "Elastic Supervisor" in out
+    assert "1 recovery(ies)" in out
+    assert "provenance   : restored step" in out
+    assert "-- recovery log --" in out
+    assert ("device_lost" in out) or ("transient" in out)
+
+
 # ---------------------------------------------------------------------------
 # launch.py graceful stop
 # ---------------------------------------------------------------------------
