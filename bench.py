@@ -685,6 +685,154 @@ def bench_ssd(dtype):
     return {"img_s": img_s, "tflops": tfs}
 
 
+def bench_serving(dtype):
+    """Inference serving leg (mx.serving, docs/SERVING.md): a 3-layer
+    MLP served through the AOT-compiled predictor, measured three ways —
+
+    - closed-loop UNBATCHED baseline: 8 concurrent clients, requests
+      served ONE AT A TIME (the device is an exclusive resource — one
+      program executes at a time; a lock models that on the CPU
+      backend, where concurrent XLA calls would otherwise borrow host
+      parallelism no accelerator offers) — the pre-serving-engine
+      posture;
+    - closed-loop through the DynamicBatcher: same 8 clients, requests
+      coalesced into shape buckets and pipelined through the dispatch
+      window — the acceptance bar is batched QPS > unbatched QPS;
+    - open-loop Poisson arrivals at ~30% of the batched closed-loop
+      capacity: the honest latency distribution without coordinated
+      omission (closed loops self-throttle and hide queueing).
+
+    Reports p50/p99 latency, QPS, batch-fill ratio, and the persistent
+    compile-cache hit rate next to the training legs, plus an INT8
+    variant probe through the post-training-quantization path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.runtime import compile_cache_stats
+    from mxnet_tpu.serving import loadgen
+
+    on_accel = jax.default_backend() != "cpu"
+    in_dim, hidden, classes = (1024, 4096, 1000) if on_accel \
+        else (256, 1024, 64)
+    requests = 512 if on_accel else 256
+    conc = 8
+    buckets = (1, 2, 4, 8, 16, 32)
+    log(f"bench[serving]: mlp {in_dim}->{hidden}x2->{classes} "
+        f"concurrency={conc} requests={requests} buckets={buckets}")
+
+    onp.random.seed(0)
+
+    def build_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="relu", in_units=in_dim),
+                nn.Dense(hidden, activation="relu", in_units=hidden),
+                nn.Dense(classes, in_units=hidden))
+        net.initialize()
+        net(mx.nd.array(onp.zeros((1, in_dim), "float32")))
+        return net
+
+    serve_dtype = "bfloat16" if dtype == "bf16" and on_accel \
+        else "float32"
+    pred = serving.predictor_for(build_net(), dtype=serve_dtype,
+                                 bucket_sizes=buckets)
+    telemetry.enable(True)
+    x1 = mx.nd.array(onp.random.randn(1, in_dim).astype("float32"))
+    t0 = time.perf_counter()
+    pred.warmup(x1)
+    t_warm = time.perf_counter() - t0
+    log(f"bench[serving]: warmup (AOT all buckets) {t_warm:.1f}s, "
+        f"programs={pred.n_traces}")
+    telemetry.reset()
+
+    X = onp.random.randn(requests, in_dim).astype("float32")
+
+    # one-request-at-a-time: the device executes one program at a time
+    # (a lock models the exclusive accelerator on the CPU backend)
+    import threading
+    device_lock = threading.Lock()
+
+    def issue_unbatched(i):
+        with device_lock:
+            out = pred.predict(
+                mx.nd.array(X[i % requests:i % requests + 1]))
+            jax.block_until_ready(out._data)
+
+    unbatched = loadgen.run_closed_loop(issue_unbatched, conc, requests)
+    log(f"bench[serving]: unbatched {unbatched}")
+
+    batcher = serving.DynamicBatcher(pred, max_batch=buckets[-1],
+                                     timeout_ms=2.0)
+    batched = loadgen.run_closed_loop(
+        lambda i: batcher.submit(
+            mx.nd.array(X[i % requests:i % requests + 1])).result(120),
+        conc, requests)
+    fill = batcher.batch_fill
+    bstats = dict(batcher.stats)
+    batcher.close()
+    log(f"bench[serving]: batched {batched} fill={fill} {bstats}")
+
+    open_rep = None
+    if batched.get("qps"):
+        batcher2 = serving.DynamicBatcher(pred, max_batch=buckets[-1],
+                                          timeout_ms=2.0)
+        open_rep = loadgen.run_open_loop(
+            lambda i: batcher2.submit(
+                mx.nd.array(X[i % requests:i % requests + 1])).result,
+            rate_qps=0.3 * batched["qps"],
+            requests=max(64, requests // 2))
+        batcher2.close()
+        log(f"bench[serving]: open-loop {open_rep}")
+
+    # INT8 serving variant through the post-training-quantization path
+    int8_probe = None
+    try:
+        calib = [mx.nd.array(X[i:i + 8]) for i in range(0, 32, 8)]
+        pred8 = serving.predictor_for(build_net(), dtype="int8",
+                                      calib_data=calib,
+                                      bucket_sizes=buckets)
+        pred8.warmup(x1, buckets=(1, buckets[-1]))
+        b8 = serving.DynamicBatcher(pred8, max_batch=buckets[-1],
+                                    timeout_ms=2.0)
+        int8_probe = loadgen.run_closed_loop(
+            lambda i: b8.submit(
+                mx.nd.array(X[i % requests:i % requests + 1])).result(120),
+            conc, max(64, requests // 4))
+        b8.close()
+        log(f"bench[serving]: int8 {int8_probe}")
+    except Exception as e:  # pragma: no cover - variant must not kill leg
+        log(f"bench[serving]: int8 probe failed ({type(e).__name__}: {e})")
+
+    cc = compile_cache_stats()
+    cache = {"enabled": cc["enabled"], "hits": cc["hits"],
+             "misses": cc["misses"],
+             "hit_rate": round(cc["hits"] / (cc["hits"] + cc["misses"]), 3)
+             if (cc["hits"] + cc["misses"]) else None}
+    speedup = round(batched["qps"] / unbatched["qps"], 2) \
+        if batched.get("qps") and unbatched.get("qps") else None
+    log(f"bench[serving]: batched-vs-unbatched QPS speedup {speedup}x "
+        f"cache={cache}")
+    return {
+        "qps": batched.get("qps"),
+        "p50_ms": batched.get("p50_ms"),
+        "p99_ms": batched.get("p99_ms"),
+        "concurrency": conc,
+        "batch_fill": round(fill, 3) if fill is not None else None,
+        "unbatched_qps": unbatched.get("qps"),
+        "unbatched_p50_ms": unbatched.get("p50_ms"),
+        "speedup_vs_unbatched": speedup,
+        "open_loop": open_rep,
+        "int8": int8_probe,
+        "compile_cache": cache,
+        "warmup_s": round(t_warm, 2),
+        "programs": pred.n_traces,
+        "dtype": serve_dtype,
+        "batcher": {k: bstats.get(k) for k in
+                    ("requests", "batches", "rows", "padded_rows",
+                     "flush_full", "flush_timeout", "flush_idle",
+                     "errors")},
+    }
+
+
 def main():
     model = os.environ.get("MXNET_BENCH_MODEL", "all")
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
@@ -805,6 +953,39 @@ def main():
             out[f"{name}_engine"] = r["engine"]
         if r.get("telemetry") is not None:
             out[f"{name}_telemetry"] = r["telemetry"]
+    if model in ("all", "serving"):
+        # the serving engine leg (mx.serving): isolate like the other
+        # secondary legs — a serving failure must not destroy the
+        # training metrics' JSON line
+        try:
+            s = bench_serving(dtype)
+        except Exception as e:
+            if model == "serving":
+                raise
+            log(f"bench[serving]: FAILED ({type(e).__name__}: {e}); "
+                "continuing without it")
+            s = None
+        if s is not None:
+            if model == "serving":
+                out.update({
+                    "metric": "serving_batched_qps",
+                    "value": s["qps"],
+                    "unit": "req/s",
+                    "vs_baseline": s["speedup_vs_unbatched"],
+                    "dtype": s["dtype"],
+                })
+            out.update({
+                "serving_qps": s["qps"],
+                "serving_p50_ms": s["p50_ms"],
+                "serving_p99_ms": s["p99_ms"],
+                "serving_batch_fill": s["batch_fill"],
+                "serving_unbatched_qps": s["unbatched_qps"],
+                "serving_speedup_vs_unbatched":
+                    s["speedup_vs_unbatched"],
+                "serving_cache_hit_rate":
+                    s["compile_cache"]["hit_rate"],
+                "serving_detail": s,
+            })
     try:
         roof = matmul_roofline()
     except Exception as e:

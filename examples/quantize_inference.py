@@ -1,7 +1,9 @@
 #!/usr/bin/env python
-"""INT8 post-training quantization (reference:
-example/quantization/imagenet_gen_qsym.py workflow): train briefly in f32,
-calibrate, swap in int8 MXU kernels, compare accuracy.
+"""INT8 post-training quantization served through the inference engine
+(reference: example/quantization/imagenet_gen_qsym.py workflow): train
+briefly in f32, calibrate, swap in int8 MXU kernels, and serve BOTH
+variants through ``mx.serving`` — the AOT-compiled bucketed predictor
+plus the dynamic batcher (docs/SERVING.md) — comparing accuracy.
 
 Run: python examples/quantize_inference.py
 """
@@ -11,8 +13,7 @@ _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)
 import numpy as onp
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon
-from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu import autograd, gluon, serving
 from mxnet_tpu.gluon import nn
 
 
@@ -37,15 +38,32 @@ def main():
         loss.backward()
         trainer.step(32)
 
-    def accuracy(model):
-        pred = model(mx.nd.array(x_all)).asnumpy().argmax(1)
-        return (pred == y_all).mean()
+    def accuracy(predictor):
+        """Serve the eval set through the dynamic batcher: concurrent
+        32-row requests coalesced into the predictor's shape buckets,
+        pipelined through the dispatch window — the production read
+        path, not an ad-hoc net(x) sweep."""
+        with serving.DynamicBatcher(predictor, max_batch=64,
+                                    timeout_ms=2.0) as batcher:
+            futs = [batcher.submit(mx.nd.array(x_all[i:i + 32]))
+                    for i in range(0, 512, 32)]
+            preds = onp.concatenate(
+                [f.result(60).asnumpy().argmax(1) for f in futs])
+        return (preds == y_all).mean()
 
-    fp32_acc = accuracy(net)
+    buckets = (32, 64)
+    fp32_pred = serving.CompiledPredictor(net, bucket_sizes=buckets)
+    fp32_pred.warmup(mx.nd.array(x_all[:1]), buckets=buckets)
+    fp32_acc = accuracy(fp32_pred)
+
     calib = [mx.nd.array(x_all[i:i + 32]) for i in range(0, 128, 32)]
-    q.quantize_net(net, calib, calib_mode="naive")
-    int8_acc = accuracy(net)
-    print(f"fp32 accuracy:  {fp32_acc:.4f}")
+    int8_pred = serving.predictor_for(net, dtype="int8",
+                                      calib_data=calib,
+                                      calib_mode="naive",
+                                      bucket_sizes=buckets)
+    int8_acc = accuracy(int8_pred)
+    print(f"fp32 accuracy:  {fp32_acc:.4f} "
+          f"(serving programs: {fp32_pred.n_traces})")
     print(f"int8 accuracy:  {int8_acc:.4f} "
           f"(layers now: {[type(b).__name__ for b in net]})")
     assert int8_acc > fp32_acc - 0.02

@@ -523,14 +523,26 @@ def expect_mode(report: ProgramReport, mode: Optional[str] = None,
                 severity="warn",
                 message=f"single-device fused step emits collectives "
                         f"({c.by_kind}) — unexpected partitioning"))
+    elif mode == "predict":
+        # serving programs (serving/predictor.py): single-device
+        # forward-only — a collective means the predictor was built
+        # against an unintended partitioning; a host transfer is a
+        # per-request round-trip (the findings below already flag it)
+        if c.ops:
+            report.add(Finding(
+                checker="program", rule="collective-mismatch",
+                severity="warn",
+                message=f"serving predict program emits collectives "
+                        f"({c.by_kind}) — unexpected partitioning for "
+                        "a single-device inference executable"))
     # fusion pack (every compiled mode): the optimized program must
     # have NO fusable elementwise/broadcast/convert op stranded between
     # two fusions above the size floor — each one is two avoidable HBM
     # round-trips per step the value-level tests cannot see
     # (arXiv:2301.13062; the fusion census produces the evidence)
     fr = report.fusion
-    if mode in ("fused", "fused-mesh", "zero") and fr is not None \
-            and fr.stranded:
+    if mode in ("fused", "fused-mesh", "zero", "predict") \
+            and fr is not None and fr.stranded:
         worst = fr.stranded[0]
         report.add(Finding(
             checker="fusion", rule="stranded-op",

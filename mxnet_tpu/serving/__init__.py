@@ -1,0 +1,36 @@
+"""mx.serving — production inference serving engine (docs/SERVING.md).
+
+The millions-of-users half of the north star: the training substrate
+(AOT lowering + ``MXNET_COMPILE_CACHE``, the dispatch window, the
+telemetry catalog, the program-lint gates) turned into a serving path.
+
+- :class:`CompiledPredictor` — AOT-compiled inference executables per
+  leading-dim shape bucket: taping suspended, params resident on
+  device, warm-started from the persistent compile cache, with the
+  same static-analysis gates (``analyze()``/``memory_report()``/
+  fusion census) as the training step.
+- :class:`DynamicBatcher` — bounded-queue request coalescing into the
+  bucketed shapes the compile cache keys on (pad-to-bucket with a
+  valid-row mask; ``MXNET_SERVING_MAX_BATCH`` /
+  ``MXNET_SERVING_BATCH_TIMEOUT_MS``), dispatched pipelined through a
+  :class:`~mxnet_tpu.engine.DispatchWindow` so the device never idles
+  between micro-batches.
+- :func:`predictor_for` — bf16/fp16/int8 serving variants through the
+  existing AMP and post-training-quantization paths.
+- :mod:`.loadgen` — closed-/open-loop load generation with exact
+  p50/p99 (the ``serving`` bench leg in bench.py).
+
+Observability: ``mx_serving_*`` series in the telemetry catalog —
+queue depth, in-flight micro-batches, batch occupancy, request-latency
+histogram (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+from .predictor import CompiledPredictor, DEFAULT_BUCKETS, predictor_for
+from .batcher import (DynamicBatcher, ServingFuture, batch_timeout_s,
+                      max_batch_rows, queue_depth)
+from . import loadgen
+
+__all__ = ["CompiledPredictor", "DynamicBatcher", "ServingFuture",
+           "predictor_for", "DEFAULT_BUCKETS", "loadgen",
+           "max_batch_rows", "batch_timeout_s", "queue_depth"]

@@ -1,0 +1,483 @@
+"""Dynamic request batching (``serving.DynamicBatcher``).
+
+The request-scheduler half of the serving engine (the dispatch
+discipline of arXiv:1605.08695 applied to inference): concurrent
+single-request traffic is coalesced into the bucketed batch shapes the
+compile cache keys on, so N clients hit one compiled program per bucket
+instead of N one-row dispatches.
+
+Mechanics:
+
+- **Bounded queue.** ``submit()`` enqueues a request (any leading-dim
+  row count) into a bounded queue (``MXNET_SERVING_QUEUE_DEPTH``) and
+  returns a :class:`ServingFuture`; a full queue blocks the caller —
+  backpressure, not unbounded memory.
+- **Coalesce until full or stale.** The dispatcher gathers requests
+  until ``MXNET_SERVING_MAX_BATCH`` rows are waiting or the OLDEST
+  waiting request has aged ``MXNET_SERVING_BATCH_TIMEOUT_MS`` — the
+  classic batching-delay/latency trade. The coalesced rows are padded
+  to the predictor's next shape bucket (zero rows; the valid-row count
+  is the mask) and dispatched as ONE program call.
+- **Pipelined decode.** Each micro-batch's async outputs ride a
+  bounded :class:`~mxnet_tpu.engine.DispatchWindow` — the host keeps
+  forming + dispatching batch N+1 while the device runs batch N, and
+  only blocks on the OLDEST in-flight batch when the window fills; the
+  device never idles between micro-batches. The window retire is the
+  ONE blessed host sync of the serving hot loop (request latency is
+  recorded there); client-side ``future.result()`` reads are the
+  response sync, outside the hot region.
+- **Observability.** ``mx_serving_*`` series through the telemetry
+  catalog: requests/batches counters, queue-depth and in-flight
+  gauges, batch-occupancy and request-latency histograms
+  (docs/OBSERVABILITY.md).
+
+Deterministic testing: inject ``clock=`` and construct with
+``start=False``, then drive :meth:`process_once` by hand — the
+timeout/full flush decisions consult only the injected clock
+(tests/test_serving.py pins the semantics with a fake clock).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from functools import partial
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..analysis import guard as _tguard
+from ..base import MXNetError
+from ..engine import DispatchWindow
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DynamicBatcher", "ServingFuture", "max_batch_rows",
+           "batch_timeout_s", "queue_depth"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving")
+
+_TELEM = None
+
+
+def _telemetry():
+    global _TELEM
+    if _TELEM is None:
+        from .. import telemetry as _t
+        _TELEM = _t
+    return _TELEM
+
+
+def max_batch_rows(default: int = 32) -> int:
+    """``MXNET_SERVING_MAX_BATCH``: max coalesced rows per dispatch."""
+    try:
+        v = int(os.environ.get("MXNET_SERVING_MAX_BATCH", str(default)))
+    except ValueError:
+        return default
+    return max(1, v)
+
+
+def batch_timeout_s(default_ms: float = 2.0) -> float:
+    """``MXNET_SERVING_BATCH_TIMEOUT_MS`` (milliseconds) as seconds:
+    how long the oldest waiting request may age before a partial batch
+    flushes."""
+    try:
+        v = float(os.environ.get("MXNET_SERVING_BATCH_TIMEOUT_MS",
+                                 str(default_ms)))
+    except ValueError:
+        v = default_ms
+    return max(0.0, v) / 1e3
+
+
+def queue_depth(default: int = 1024) -> int:
+    """``MXNET_SERVING_QUEUE_DEPTH``: bounded request-queue capacity
+    (a full queue blocks ``submit`` — backpressure)."""
+    try:
+        v = int(os.environ.get("MXNET_SERVING_QUEUE_DEPTH", str(default)))
+    except ValueError:
+        return default
+    return max(1, v)
+
+
+@partial(jax.jit, static_argnums=2)
+def _row_slice(x, off, n):
+    """One compiled slicer per (shape, n): the offset is traced, so
+    slicing responses out of a batch costs no per-offset compiles."""
+    return jax.lax.dynamic_slice_in_dim(x, off, n, axis=0)
+
+
+def _build_response(out_leaves, out_tree, off, rows, bucket):
+    """Client-side response materialization (``ServingFuture.result``):
+    block on the micro-batch's outputs — the response sync, on the
+    client's own thread — then slice this request's rows out. Leaves
+    without the batch's leading dim (scalars, per-model aux) pass
+    through whole."""
+    jax.block_until_ready([l._data for l in out_leaves
+                           if isinstance(l, NDArray)])
+    sliced = [
+        NDArray(_row_slice(l._data, off, rows))
+        if isinstance(l, NDArray) and getattr(l._data, "ndim", 0) >= 1
+        and int(l._data.shape[0]) == bucket else l
+        for l in out_leaves]
+    return jax.tree_util.tree_unflatten(out_tree, sliced)
+
+
+class ServingFuture:
+    """Handle for one submitted request's result.
+
+    Resolves when its micro-batch DISPATCHES (with a lazy builder over
+    the batch's async outputs); :meth:`result` blocks until the device
+    finished the batch — the response-side sync, on the client's
+    thread, outside the serving hot region — then slices this
+    request's rows out. The per-request slice dispatch happens on the
+    CLIENT thread, keeping the dispatcher's hot loop to one program
+    call per micro-batch."""
+
+    __slots__ = ("_ev", "_build", "_out", "_err")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._build = None
+        self._out = None
+        self._err = None
+
+    def _resolve(self, build):
+        self._build = build
+        self._ev.set()
+
+    def _fail(self, err):
+        self._err = err
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the response is computed and return it (the
+        net's output structure, NDArray leaves, this request's rows
+        only). Raises the dispatch error if its batch failed."""
+        if not self._ev.wait(timeout):
+            raise MXNetError(
+                f"serving request not completed within {timeout}s "
+                "(batcher stopped? queue saturated?)")
+        if self._err is not None:
+            raise self._err
+        if self._out is None:
+            self._out = self._build()
+        return self._out
+
+
+class _Request:
+    __slots__ = ("args", "rows", "t_submit", "future")
+
+    def __init__(self, args, rows, t_submit, future):
+        self.args = args
+        self.rows = rows
+        self.t_submit = t_submit
+        self.future = future
+
+
+class DynamicBatcher:
+    """Coalesce concurrent requests into one predictor's shape buckets.
+
+        pred = mx.serving.CompiledPredictor(net)
+        with mx.serving.DynamicBatcher(pred) as b:
+            futs = [b.submit(x_i) for x_i in requests]
+            outs = [f.result() for f in futs]
+
+    Thread-safe ``submit``; one background dispatcher thread owns the
+    hot loop (``start=False`` for manual :meth:`process_once` driving).
+    """
+
+    def __init__(self, predictor, max_batch: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 depth: Optional[int] = None,
+                 inflight: Optional[int] = None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 start: bool = True):
+        self._predictor = predictor
+        self.max_batch = max_batch_rows() if max_batch is None \
+            else max(1, int(max_batch))
+        if self.max_batch > predictor.bucket_sizes[-1]:
+            raise MXNetError(
+                f"max_batch={self.max_batch} exceeds the predictor's "
+                f"largest shape bucket ({predictor.bucket_sizes[-1]})")
+        self._timeout_s = batch_timeout_s() if timeout_ms is None \
+            else max(0.0, float(timeout_ms)) / 1e3
+        self._clock = clock
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=queue_depth() if depth is None else max(1, int(depth)))
+        self._forming: List[_Request] = []
+        self._inflight: dict = {}   # tag -> (futures, t_submits)
+        self._window = DispatchWindow(max_inflight=inflight,
+                                      what="serving micro-batch",
+                                      sync_fn=self._retire_sync)
+        self._batch_no = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self.stats = {"requests": 0, "batches": 0, "rows": 0,
+                      "padded_rows": 0, "flush_full": 0,
+                      "flush_timeout": 0, "flush_idle": 0,
+                      "flush_force": 0, "errors": 0}
+        t = _telemetry()
+        reg = t.registry()
+        self._m_requests = reg.counter(t.names.SERVING_REQUESTS)
+        self._m_batches = reg.counter(t.names.SERVING_BATCHES)
+        self._m_queue = reg.gauge(t.names.SERVING_QUEUE_DEPTH)
+        self._m_inflight = reg.gauge(t.names.SERVING_INFLIGHT)
+        self._m_occupancy = reg.histogram(t.names.SERVING_OCCUPANCY)
+        self._m_latency = reg.histogram(t.names.SERVING_LATENCY)
+        if start:
+            self._thread = threading.Thread(
+                target=self._serve_loop, name="mx-serving-batcher",
+                daemon=True)
+            self._thread.start()
+
+    # ---------------- client surface ----------------
+    def submit(self, *args, timeout: float = 120.0) -> ServingFuture:
+        """Enqueue one request (array leaves with a leading row dim,
+        typically one row) and return its future. Blocks when the
+        bounded queue is full (backpressure)."""
+        if self._stop.is_set():
+            raise MXNetError("DynamicBatcher is closed")
+        rows = self._rows_of(args)
+        if rows > self.max_batch:
+            raise MXNetError(
+                f"request of {rows} rows exceeds max_batch="
+                f"{self.max_batch} (MXNET_SERVING_MAX_BATCH)")
+        fut = ServingFuture()
+        req = _Request(args, rows, self._clock(), fut)
+        try:
+            self._queue.put(req, timeout=timeout)
+        except queue.Full:
+            raise MXNetError(
+                f"serving queue saturated ({self._queue.maxsize} "
+                "requests) — the service is overloaded "
+                "(MXNET_SERVING_QUEUE_DEPTH)")
+        self.stats["requests"] += 1
+        self._m_requests.inc()
+        self._m_queue.set(self._queue.qsize() + len(self._forming))
+        return fut
+
+    @property
+    def batch_fill(self) -> Optional[float]:
+        """Valid rows / dispatched bucket rows — the padding waste
+        ratio (1.0 = every dispatched row was a real request)."""
+        total = self.stats["rows"] + self.stats["padded_rows"]
+        return self.stats["rows"] / total if total else None
+
+    def flush(self):
+        """Dispatch whatever is waiting (regardless of age/size) and
+        retire every in-flight micro-batch."""
+        while self.process_once(force=True):
+            pass
+        self._window.drain()
+        self._m_inflight.set(0)
+
+    def close(self):
+        """Stop the dispatcher thread, flush remaining requests, drain
+        the window. Idempotent."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ---------------- batching core ----------------
+    @staticmethod
+    def _rows_of(args) -> int:
+        for l in jax.tree_util.tree_leaves(
+                args, is_leaf=lambda t: isinstance(t, NDArray)):
+            d = l._data if isinstance(l, NDArray) else l
+            if getattr(d, "ndim", 0) >= 1:
+                return int(d.shape[0])
+        raise MXNetError("serving request has no array leaf with a "
+                         "leading batch dim")
+
+    def _forming_rows(self) -> int:
+        return sum(r.rows for r in self._forming)
+
+    def _drain_queue(self, cap: Optional[int] = None):
+        while cap is None or self._forming_rows() < cap:
+            try:
+                self._forming.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+
+    def _take_batch(self) -> List[_Request]:
+        batch, rows = [], 0
+        while self._forming and rows + self._forming[0].rows \
+                <= self.max_batch:
+            r = self._forming.pop(0)
+            batch.append(r)
+            rows += r.rows
+        return batch
+
+    def process_once(self, force: bool = False) -> bool:
+        """Manual-drive: pull waiting requests and dispatch ONE batch
+        if the flush condition holds (>= max_batch rows waiting, the
+        oldest request older than the batch timeout, or ``force``).
+        Returns whether a batch was dispatched. Uses only the injected
+        clock — fake-clock tests drive the semantics deterministically."""
+        self._drain_queue()
+        if not self._forming:
+            return False
+        reason = None
+        if self._forming_rows() >= self.max_batch:
+            reason = "full"
+        elif self._clock() - self._forming[0].t_submit >= self._timeout_s:
+            reason = "timeout"
+        elif force:
+            reason = "force"
+        if reason is None:
+            return False
+        self._dispatch(self._take_batch(), reason)
+        return True
+
+    def _serve_loop(self):
+        """Work-conserving coalescing: requests gather until the batch
+        is full or the oldest waiting request has aged past the
+        timeout — but an IDLE device short-circuits the linger (when
+        nothing is queued and nothing is in flight, batching delay
+        buys no occupancy, it only adds latency), and the linger
+        itself is spent draining the in-flight window, so the device
+        never idles between micro-batches."""
+        idle_poll = max(self._timeout_s, 0.005)
+        while not self._stop.is_set():
+            try:
+                if not self._forming:
+                    # idle: retire finished in-flight batches so their
+                    # latencies are recorded and errors surface, then
+                    # block for the next request
+                    if len(self._window):
+                        self._window.drain()
+                        self._m_inflight.set(0)
+                    try:
+                        self._forming.append(
+                            self._queue.get(timeout=idle_poll))
+                    except queue.Empty:
+                        continue
+                # coalesce until full, stale, or device-idle
+                deadline = self._forming[0].t_submit + self._timeout_s
+                while self._forming_rows() < self.max_batch:
+                    try:
+                        self._forming.append(self._queue.get_nowait())
+                        continue
+                    except queue.Empty:
+                        pass
+                    if not len(self._window):
+                        break    # device idle: ship what we have NOW
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        break
+                    # the device is busy with an in-flight batch: spend
+                    # the linger retiring it (the retire IS the wait)
+                    self._window.drain()
+                    self._m_inflight.set(0)
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        break
+                    try:
+                        self._forming.append(
+                            self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+                if self._forming_rows() >= self.max_batch:
+                    reason = "full"
+                elif self._clock() - self._forming[0].t_submit \
+                        >= self._timeout_s:
+                    reason = "timeout"
+                else:
+                    reason = "idle"   # device idle cut the linger short
+                self._dispatch(self._take_batch(), reason)
+            except Exception as e:   # keep serving after a bad batch
+                _LOG.warning("serving dispatch failed (%s: %s)",
+                             type(e).__name__, e, exc_info=True)
+                self.stats["errors"] += 1
+
+    # ---------------- dispatch ----------------
+    def _dispatch(self, reqs: List[_Request], reason: str):
+        """One micro-batch: concatenate + pad to bucket, ONE predictor
+        call, resolve each request's future with its (lazy) row slice,
+        push the async outputs into the pipeline window. The whole body
+        is a transfer-guard hot region — nothing in here may sync; the
+        window retire is the one blessed wait."""
+        if not reqs:
+            return
+        try:
+            with _tguard.hot_scope("DynamicBatcher.dispatch"):
+                self._dispatch_inner(reqs, reason)
+        except BaseException as e:
+            for r in reqs:
+                if not r.future.done():
+                    r.future._fail(e)
+            raise
+
+    def _dispatch_inner(self, reqs: List[_Request], reason: str):
+        pred = self._predictor
+        rows = sum(r.rows for r in reqs)
+        bucket = pred.bucket_for(rows)
+        n_pos = len(reqs[0].args)
+        if any(len(r.args) != n_pos for r in reqs):
+            raise MXNetError("coalesced requests disagree on argument "
+                             "count — one model signature per batcher")
+        batch_args = tuple(
+            self._concat_pad([r.args[i] for r in reqs], rows, bucket)
+            for i in range(n_pos))
+        outs = pred.predict(*batch_args)
+        out_leaves, out_tree = jax.tree_util.tree_flatten(
+            outs, is_leaf=lambda t: isinstance(t, NDArray))
+        off = 0
+        for r in reqs:
+            r.future._resolve(partial(
+                _build_response, out_leaves, out_tree, off, r.rows,
+                bucket))
+            off += r.rows
+        self._batch_no += 1
+        tag = self._batch_no
+        self._inflight[tag] = tuple(r.t_submit for r in reqs)
+        payload = (tag, tuple(l._data for l in out_leaves
+                              if isinstance(l, NDArray)))
+        self.stats["batches"] += 1
+        self.stats["rows"] += rows
+        self.stats["padded_rows"] += bucket - rows
+        self.stats["flush_" + reason] += 1
+        self._m_batches.inc()
+        self._m_occupancy.observe(rows / bucket)
+        self._window.push(payload, tag=tag)
+        self._m_inflight.set(len(self._window))
+        self._m_queue.set(self._queue.qsize() + len(self._forming))
+
+    @staticmethod
+    def _concat_pad(leaves, rows: int, bucket: int):
+        """Concatenate one argument position across requests and pad
+        to the bucket — async device ops only, no host sync."""
+        datas = [l._data if isinstance(l, NDArray) else jnp.asarray(l)
+                 for l in leaves]
+        if bucket > rows:
+            datas.append(jnp.zeros((bucket - rows,)
+                                   + tuple(datas[0].shape[1:]),
+                                   datas[0].dtype))
+        out = datas[0] if len(datas) == 1 else jnp.concatenate(datas,
+                                                               axis=0)
+        return NDArray(out)
+
+    def _retire_sync(self, payload):
+        """Window sync hook: block on the micro-batch's outputs (the
+        blessed retire), then record each rider request's end-to-end
+        latency."""
+        tag, datas = payload
+        jax.block_until_ready(list(datas))
+        t_submits = self._inflight.pop(tag, ())
+        now = self._clock()
+        for t0 in t_submits:
+            self._m_latency.observe(max(0.0, now - t0))

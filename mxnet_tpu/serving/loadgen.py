@@ -1,0 +1,146 @@
+"""Serving load generator: closed- and open-loop traffic with p50/p99.
+
+The two canonical load shapes for latency benchmarking:
+
+- **closed loop** (:func:`run_closed_loop`): C concurrent clients, each
+  issuing its next request the moment the previous one completes —
+  measures sustainable throughput (QPS) under a fixed concurrency and
+  the latency the system settles into at that load.
+- **open loop** (:func:`run_open_loop`): requests arrive on a Poisson
+  process at a target rate regardless of completions — the honest
+  latency distribution under un-coordinated traffic (closed loops hide
+  queueing spikes by self-throttling: coordinated omission).
+
+Both return a report dict with QPS and exact p50/p99 latency computed
+from the raw per-request samples (no histogram interpolation —
+bench.py puts these next to the training legs in the BENCH json;
+``mx_serving_request_seconds`` carries the live-histogram view).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as onp
+
+__all__ = ["run_closed_loop", "run_open_loop", "percentiles"]
+
+
+def percentiles(latencies) -> dict:
+    """{p50_ms, p99_ms, mean_ms} from raw per-request seconds."""
+    if not len(latencies):
+        return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+    a = onp.asarray(latencies, dtype="float64") * 1e3
+    return {"p50_ms": round(float(onp.percentile(a, 50)), 3),
+            "p99_ms": round(float(onp.percentile(a, 99)), 3),
+            "mean_ms": round(float(a.mean()), 3)}
+
+
+def run_closed_loop(issue: Callable[[int], None], concurrency: int,
+                    requests: int) -> dict:
+    """C worker threads; each calls ``issue(i)`` (submit AND wait for
+    one request) back-to-back until ``requests`` total are done.
+    Latency is the full ``issue`` wall time per request."""
+    latencies: list = []
+    errors = [0]
+    lock = threading.Lock()
+    counter = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= requests:
+                    return
+                counter[0] += 1
+            t0 = time.perf_counter()
+            try:
+                issue(i)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    out = {"mode": "closed", "concurrency": int(concurrency),
+           "requests": int(len(latencies)), "errors": int(errors[0]),
+           "wall_s": round(wall, 4),
+           "qps": round(len(latencies) / wall, 2) if wall > 0 else None}
+    out.update(percentiles(latencies))
+    return out
+
+
+def run_open_loop(submit: Callable[[int], Callable[[], None]],
+                  rate_qps: float, requests: int,
+                  seed: int = 0,
+                  timeout: Optional[float] = 120.0) -> dict:
+    """Poisson arrivals at ``rate_qps``: ``submit(i)`` must enqueue
+    request ``i`` WITHOUT waiting and return a zero-arg wait callable
+    (e.g. ``DynamicBatcher.submit(...).result``). Arrival jitter is
+    deterministic per ``seed``. Latency = arrival (scheduled submit)
+    to completion — queueing included, no coordinated omission."""
+    import queue as _queue
+    rng = onp.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / max(rate_qps, 1e-9), size=requests)
+    latencies: list = []
+    errors = [0]
+    lock = threading.Lock()
+    # a waiter pool records each completion AS IT HAPPENS — waiting
+    # sequentially after the arrival phase would inflate every early
+    # request's latency by the remaining arrival time
+    work: "_queue.Queue" = _queue.Queue()
+
+    def waiter():
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            t0, wait = item
+            try:
+                try:
+                    wait() if timeout is None else wait(timeout)
+                except TypeError:
+                    wait()
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+
+    n_waiters = min(32, max(4, requests // 8))
+    threads = [threading.Thread(target=waiter, daemon=True)
+               for _ in range(n_waiters)]
+    for t in threads:
+        t.start()
+    t_start = time.perf_counter()
+    next_t = t_start
+    for i in range(requests):
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        work.put((time.perf_counter(), submit(i)))
+        next_t += gaps[i]
+    for _ in threads:
+        work.put(None)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    out = {"mode": "open", "rate_qps": float(rate_qps),
+           "requests": int(len(latencies)), "errors": int(errors[0]),
+           "wall_s": round(wall, 4),
+           "qps": round(len(latencies) / wall, 2) if wall > 0 else None}
+    out.update(percentiles(latencies))
+    return out

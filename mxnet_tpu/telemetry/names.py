@@ -142,6 +142,16 @@ FUSION_COMPUTE_BOUND = "mx_fusion_compute_bound_ratio"
 KERNEL_DISPATCH = "mx_kernel_dispatch_total"
 
 # ---------------------------------------------------------------------------
+# inference serving engine (serving/batcher.py)
+# ---------------------------------------------------------------------------
+SERVING_REQUESTS = "mx_serving_requests_total"
+SERVING_BATCHES = "mx_serving_batches_total"
+SERVING_QUEUE_DEPTH = "mx_serving_queue_depth"
+SERVING_INFLIGHT = "mx_serving_inflight_batches"
+SERVING_OCCUPANCY = "mx_serving_batch_occupancy_ratio"
+SERVING_LATENCY = "mx_serving_request_seconds"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -352,6 +362,28 @@ CATALOG = {
              "(pallas = compiled TPU kernel, interpret = kernel body "
              "under pallas interpret mode, xla = reference fallback; "
              "MXNET_PALLAS gate, docs/PERF_NOTES.md)"),
+    SERVING_REQUESTS: dict(
+        kind="counter", label=None,
+        help="inference requests submitted to any DynamicBatcher"),
+    SERVING_BATCHES: dict(
+        kind="counter", label=None,
+        help="coalesced serving micro-batches dispatched"),
+    SERVING_QUEUE_DEPTH: dict(
+        kind="gauge", label=None,
+        help="requests waiting to be coalesced (bounded queue + the "
+             "forming batch; MXNET_SERVING_QUEUE_DEPTH caps it)"),
+    SERVING_INFLIGHT: dict(
+        kind="gauge", label=None,
+        help="serving micro-batches in flight on the device (the "
+             "batcher's DispatchWindow occupancy)"),
+    SERVING_OCCUPANCY: dict(
+        kind="histogram", label=None,
+        help="per-micro-batch fill ratio: coalesced request rows / "
+             "dispatched bucket rows (1.0 = no padding waste)"),
+    SERVING_LATENCY: dict(
+        kind="histogram", label=None,
+        help="end-to-end request latency: submit to micro-batch "
+             "retire (queueing + coalescing delay + compute)"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

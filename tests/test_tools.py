@@ -176,6 +176,22 @@ def test_diagnose_numerics_section(capsys, tmp_path, monkeypatch):
     telemetry.reset()
 
 
+def test_diagnose_serving_section(capsys):
+    """--serving: AOT-compiles the tiny bucketed predictor, runs a
+    concurrent closed-loop burst through the dynamic batcher, and
+    prints the stats table plus the p50/p99 latency probe."""
+    diagnose = _load("tools/diagnose.py", "diagnose7")
+    assert diagnose.main(["--serving"]) == 0
+    out = capsys.readouterr().out
+    assert "Inference Serving" in out
+    assert "4 programs" in out            # one per shape bucket
+    assert "throughput   :" in out and "req/s" in out
+    assert "latency      : p50" in out and "p99" in out
+    assert "batch fill" in out
+    assert "errors        0" in out
+    assert "compile cache:" in out
+
+
 def test_diagnose_elastic_section(capsys):
     """--elastic: runs a tiny supervised TrainLoop, injects one mid-run
     fault, and prints the RecoveryLog table (exactly one recovery) and

@@ -594,6 +594,80 @@ def check_kernels():
         print("kernel check failed:", repr(e))
 
 
+def check_serving():
+    """Serving-engine health (docs/SERVING.md): AOT-compile a tiny
+    predictor across its shape buckets, push a concurrent closed-loop
+    burst through the dynamic batcher, and print the batcher stats
+    table plus a p50/p99 latency probe — queue/coalescing/pipelining
+    misconfiguration (zero batching, saturated queue, padding waste)
+    is visible without a load rig."""
+    print("----------Inference Serving----------")
+    try:
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import serving, telemetry
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.runtime import compile_cache_stats
+        from mxnet_tpu.serving import loadgen
+
+        import time
+        onp.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu", in_units=32),
+                nn.Dense(8, in_units=64))
+        net.initialize()
+        x1 = mx.nd.array(onp.zeros((1, 32), "float32"))
+        net(x1)
+        buckets = (1, 2, 4, 8)
+        pred = serving.CompiledPredictor(net, bucket_sizes=buckets)
+        t0 = time.time()
+        pred.warmup(x1)
+        print("buckets      :", buckets,
+              f"(AOT-compiled in {time.time() - t0:.2f}s, "
+              f"{pred.n_traces} programs)")
+        X = onp.random.randn(64, 32).astype("float32")
+        requests, conc = 64, 4
+        batcher = serving.DynamicBatcher(pred, max_batch=buckets[-1],
+                                         timeout_ms=2.0)
+        rep = loadgen.run_closed_loop(
+            lambda i: batcher.submit(
+                mx.nd.array(X[i % 64:i % 64 + 1])).result(60),
+            conc, requests)
+        fill = batcher.batch_fill
+        stats = dict(batcher.stats)
+        batcher.close()
+        print(f"closed loop  : concurrency={conc} requests={requests}")
+        print(f"throughput   : {rep['qps']} req/s")
+        print(f"latency      : p50 {rep['p50_ms']} ms, "
+              f"p99 {rep['p99_ms']} ms")
+        print("-- batcher stats --")
+        print(f"{'batches':<14s}{stats['batches']}")
+        print(f"{'rows':<14s}{stats['rows']}")
+        print(f"{'padded rows':<14s}{stats['padded_rows']}")
+        print(f"{'batch fill':<14s}"
+              f"{round(fill, 3) if fill is not None else None}")
+        print(f"{'flush full':<14s}{stats['flush_full']}")
+        print(f"{'flush timeout':<14s}{stats['flush_timeout']}")
+        print(f"{'flush idle':<14s}{stats['flush_idle']}")
+        print(f"{'errors':<14s}{stats['errors']}")
+        lat = telemetry.registry().get(
+            telemetry.names.SERVING_LATENCY)
+        if lat is not None and lat.count():
+            print(f"retire hist  : n={lat.count()} "
+                  f"p50={lat.percentile(50) * 1e3:.2f} ms "
+                  f"p99={lat.percentile(99) * 1e3:.2f} ms "
+                  "(mx_serving_request_seconds)")
+        cc = compile_cache_stats()
+        if cc["enabled"]:
+            print("compile cache:", cc["dir"],
+                  f"hits={cc['hits']} misses={cc['misses']}")
+        else:
+            print("compile cache: off (set MXNET_COMPILE_CACHE=<dir> "
+                  "to warm-start serving executables)")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("serving check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -680,6 +754,11 @@ def main(argv=None):
                         "interpret/xla + reason) and an interpret-vs-"
                         "xla parity probe for a tiny LSTM scan and "
                         "LayerNorm")
+    parser.add_argument("--serving", action="store_true",
+                        help="also AOT-compile a tiny bucketed "
+                        "predictor, run a concurrent burst through the "
+                        "dynamic batcher, and print the batcher stats "
+                        "table plus a p50/p99 latency probe")
     parser.add_argument("--elastic", action="store_true",
                         help="also run a tiny supervised TrainLoop, "
                         "inject one mid-run fault (device revocation / "
@@ -705,6 +784,8 @@ def main(argv=None):
         check_fusion()
     if args.kernels:
         check_kernels()
+    if args.serving:
+        check_serving()
     if args.elastic:
         check_elastic()
     check_os()
