@@ -151,6 +151,12 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
            # census"): the pending hardware re-capture records these
            # as the per-leg baselines the regression gate bands around
            "fusion": d["fusion"],
+           # sharding posture (docs/ANALYSIS.md "Sharding analysis"):
+           # {implicit_reshards, reshard_bytes, comm_cost_est_s,
+           # sharding_table_digest} — a perf regression on a sharded
+           # leg ships with its reshard diff, and the digest pins
+           # whether two captures laid buffers out identically
+           "sharding": d["sharding"],
            # which implementation produced this number: per-kernel
            # MXNET_PALLAS dispatch (pallas/interpret/xla) — a perf
            # delta between captures must name its kernel path

@@ -11,6 +11,12 @@ Four cooperating checkers (docs/ANALYSIS.md):
   stranded elementwise ops, HBM boundary materializations, per-kernel
   arithmetic intensity, and a checked-in per-leg regression gate
   (``MXNET_FUSION_BASELINE``).  ``mx.analysis.fusion_census(hlo)``.
+- **sharding analysis** (:mod:`.sharding`): GSPMD sharding-flow audit
+  (the per-buffer sharding table), implicit-reshard detection, the
+  per-mesh-axis communication cost model, declarative ``expect_spec``
+  invariant packs for every parallelism path, and a checked-in per-leg
+  reshard regression gate (``MXNET_SHARDING_BASELINE``).
+  ``mx.analysis.audit_sharding(hlo, mesh=...)``.
 - **source lint** (:mod:`.lint`): AST pass over HybridBlock forwards /
   loss functions for jit-unsafe Python (``.asnumpy()``, tracer-dependent
   ``if``, unkeyed randomness).  ``python -m mxnet_tpu.analysis.lint``.
@@ -31,25 +37,37 @@ __all__ = [
     "DonationAudit", "FusionReport",
     "analyze_step", "analyze_lowered", "collective_census",
     "donation_audit", "host_transfer_scan", "dtype_drift_scan",
-    "expect_mode", "explain_signature_diff",
+    "expect_mode", "mode_spec_pack", "explain_signature_diff",
     "fusion_census", "check_baseline", "load_baselines",
     "lint_source", "lint_path", "lint_module", "lint_function",
     "load_allowlist", "filter_allowed",
     "transfer_guard", "hot_scope", "allow_transfers",
+    "OpSharding", "ShardingTable", "ShardingAudit", "SpecPack",
+    "CollectiveRule", "audit_sharding", "sharding_table",
+    "implicit_reshards", "comm_cost", "bandwidth_profile",
+    "expect_spec", "register_spec_pack", "get_spec_pack", "spec_packs",
 ]
 
 _LAZY = {
     "analyze_step": "program", "analyze_lowered": "program",
     "collective_census": "program", "donation_audit": "program",
     "host_transfer_scan": "program", "dtype_drift_scan": "program",
-    "expect_mode": "program", "explain_signature_diff": "program",
+    "expect_mode": "program", "mode_spec_pack": "program",
+    "explain_signature_diff": "program",
     "fusion_census": "fusion", "check_baseline": "fusion",
     "load_baselines": "fusion", "FusionReport": "fusion",
     "lint_source": "lint", "lint_path": "lint", "lint_module": "lint",
     "lint_function": "lint", "load_allowlist": "lint",
     "filter_allowed": "lint",
+    "OpSharding": "sharding", "ShardingTable": "sharding",
+    "ShardingAudit": "sharding", "SpecPack": "sharding",
+    "CollectiveRule": "sharding", "audit_sharding": "sharding",
+    "sharding_table": "sharding", "implicit_reshards": "sharding",
+    "comm_cost": "sharding", "bandwidth_profile": "sharding",
+    "expect_spec": "sharding", "register_spec_pack": "sharding",
+    "get_spec_pack": "sharding", "spec_packs": "sharding",
     "program": None, "lint": None, "guard": None, "hlo": None,
-    "report": None, "fusion": None,
+    "report": None, "fusion": None, "sharding": None,
 }
 
 

@@ -36,6 +36,13 @@ FLOP numbers are *estimates* from shapes (2·M·K·N dots, window-sized
 convs, element-count elementwise) — good for ranking and bound
 classification, not for billing. Boundary bytes inside while bodies
 count once, not per trip (trip counts are not in the HLO text).
+Sharded programs: a partitioned module (entry ``*_spmd``) already has
+per-shard shapes and counts unchanged; an UNpartitioned
+``num_partitions>1`` module still carries global shapes with
+``sharding=`` annotations, and every annotated op's FLOPs/bytes are
+divided by its tile factor so bound classification and the census
+totals the MFU gauge sanity-checks against stay per-shard
+(:func:`_shard_divisors`).
 """
 from __future__ import annotations
 
@@ -490,6 +497,40 @@ def _resolve_through(mod: HloModule, name: str, downstream: bool,
     return out
 
 
+def _shard_divisors(mod: HloModule):
+    """Per-op byte/FLOP divisor for SPMD-sharded modules.
+
+    The optimized HLO of a partitioned program (entry ``*_spmd``)
+    already has PER-SHARD shapes — divisor 1 everywhere.  A
+    ``num_partitions>1`` module the partitioner has NOT rewritten
+    (pre-partitioning dumps, Shardy-style annotated modules, canned
+    test programs) still carries GLOBAL logical shapes with
+    ``sharding=`` annotations: counting those at face value overcounts
+    FLOPs and boundary bytes by the tile factor, misclassifies
+    memory-bound kernels as compute-bound, and inflates the census
+    totals the MFU gauge is sanity-checked against.  Here every
+    annotated op contributes its ``shard_count``; unannotated ops stay
+    at 1 (conservative — only provably-sharded work is scaled)."""
+    if mod.num_partitions <= 1 or mod.spmd_partitioned:
+        return lambda op: 1
+    from .sharding import parse_op_sharding
+    cache: Dict[str, int] = {}
+
+    def divisor(op: HloOp) -> int:
+        f = cache.get(op.name)
+        if f is not None:
+            return f
+        f = 1
+        if op.sharding:
+            sh = parse_op_sharding(op.sharding)
+            if sh is not None and sh.kind == "tiled":
+                f = max(1, sh.shard_count)
+        cache[op.name] = f
+        return f
+
+    return divisor
+
+
 def _kernel_of(mod: HloModule, op: HloOp) -> Optional[str]:
     """The kernel an op's data lives in at a schedulable level: the op
     itself when it IS a kernel (fusion / standalone compute), else
@@ -517,10 +558,15 @@ def fusion_census(hlo: Union[str, HloModule],
     sched = {c.name for c in mod.schedulable_computations()}
     if not sched:      # headerless canned snippets: treat all as entry
         sched = {None}
+    shard_div = _shard_divisors(mod)
 
     for op in mod.ops.values():
         if op.computation not in sched and sched != {None}:
             continue
+        # per-shard correction: global-shape sharded modules divide by
+        # the op's tile factor (partitioned modules divide by 1)
+        div = shard_div(op)
+        op_bytes = op.bytes // div
         # --- kernel nodes: fusions + standalone compute ops ----------
         if op.opcode == "fusion":
             body = mod.fused_ops(op)
@@ -536,8 +582,8 @@ def fusion_census(hlo: Union[str, HloModule],
                 name=op.name, kind=op.fusion_kind or "loop",
                 computation=op.computation or "?",
                 n_ops=sum(census.values()), op_census=census,
-                flops=op_flops(op, mod), bytes_in=bytes_in,
-                bytes_out=op.bytes))
+                flops=op_flops(op, mod) // div, bytes_in=bytes_in // div,
+                bytes_out=op_bytes))
         elif op.opcode in _KERNEL_OPCODES:
             bytes_in = 0
             for i in range(len(op.operands)):
@@ -548,8 +594,8 @@ def fusion_census(hlo: Union[str, HloModule],
                 else op.opcode,
                 computation=op.computation or "?",
                 n_ops=1, op_census={op.opcode: 1},
-                flops=op_flops(op, mod), bytes_in=bytes_in,
-                bytes_out=op.bytes))
+                flops=op_flops(op, mod) // div, bytes_in=bytes_in // div,
+                bytes_out=op_bytes))
 
         # --- boundary materializations -------------------------------
         if op.opcode in _NON_MATERIAL_OPCODES or op.bytes == 0:
@@ -559,15 +605,15 @@ def fusion_census(hlo: Union[str, HloModule],
         if not consumers or op.is_root:
             continue             # module/computation output, not a
             # boundary between two kernels
-        report.boundary_bytes += op.bytes
+        report.boundary_bytes += op_bytes
         report.boundaries.append(Boundary(
-            name=op.name, opcode=op.opcode, bytes=op.bytes,
+            name=op.name, opcode=op.opcode, bytes=op_bytes,
             consumers=[c.name for c in consumers],
             computation=op.computation or "?"))
 
         # --- stranded fusable ops ------------------------------------
         if op.opcode in _FUSABLE_OPCODES and \
-                op.bytes >= stranded_floor_bytes:
+                op_bytes >= stranded_floor_bytes:
             producers = _resolve_through(mod, op.name, False)
             fused_prod = [p for p in producers
                           if p.opcode == "fusion"]
@@ -575,7 +621,7 @@ def fusion_census(hlo: Union[str, HloModule],
                           if c.opcode == "fusion"]
             if fused_prod and fused_cons:
                 report.stranded.append(StrandedOp(
-                    name=op.name, opcode=op.opcode, bytes=op.bytes,
+                    name=op.name, opcode=op.opcode, bytes=op_bytes,
                     producer=fused_prod[0].name,
                     consumers=[c.name for c in fused_cons],
                     computation=op.computation or "?"))

@@ -23,7 +23,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["HloOp", "HloComputation", "HloModule", "parse_hlo",
-           "parse_shape_elements", "parse_replica_groups"]
+           "parse_shape_elements", "parse_replica_groups",
+           "parse_source_target_pairs"]
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
@@ -101,6 +102,12 @@ class HloOp:
     operand_types: List[Optional[str]] = field(default_factory=list)
     #: True for a computation's ROOT op (its output, not a boundary)
     is_root: bool = False
+    #: raw ``sharding={...}`` attribute text (braces included) — the
+    #: GSPMD sharding annotation, parsed by analysis/sharding.py
+    sharding: Optional[str] = None
+    #: ``metadata={op_name="..."}`` — the jax-level name (parameter
+    #: label, or the producing primitive's path)
+    op_name: Optional[str] = None
 
     def operand_bytes(self, i: int) -> Optional[int]:
         """Bytes of operand ``i``, from its typed mention on this line
@@ -138,6 +145,16 @@ class HloModule:
     num_partitions: int = 1
     computations: Dict[str, HloComputation] = field(default_factory=dict)
     entry: Optional[str] = None
+
+    @property
+    def spmd_partitioned(self) -> bool:
+        """True when the SPMD partitioner has already run over this
+        module — its shapes are PER-SHARD (XLA renames the entry with
+        an ``_spmd`` suffix).  A ``num_partitions>1`` module WITHOUT
+        the suffix still carries global logical shapes annotated with
+        ``sharding=`` attrs (pre-partitioning dumps, canned programs) —
+        byte/FLOP accounting must divide those by the tile factor."""
+        return bool(self.entry and self.entry.endswith("_spmd"))
 
     def consumers(self, name: str) -> List[HloOp]:
         return [self.ops[u] for u in self.uses.get(name, [])
@@ -219,6 +236,41 @@ def parse_replica_groups(line: str, num_devices: int) \
     return None
 
 
+def parse_source_target_pairs(line: str) \
+        -> Optional[List[Tuple[int, ...]]]:
+    """``source_target_pairs={{0,1},{1,2},...}`` of a collective-permute,
+    folded into replica-group-shaped device sets: the connected
+    components of the permutation graph (a ring over one mesh axis
+    becomes one group spanning that axis — which is exactly what the
+    census's per-axis attribution needs)."""
+    m = re.search(r"source_target_pairs=\{\{([\d,{}\s]*)\}\}", line)
+    if not m:
+        return None
+    edges = []
+    for pair in re.findall(r"(\d+)\s*,\s*(\d+)", m.group(1)):
+        edges.append((int(pair[0]), int(pair[1])))
+    if not edges:
+        return None
+    # union-find over the permutation edges
+    parent: Dict[int, int] = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in edges:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+    groups: Dict[int, List[int]] = {}
+    for n in parent:
+        groups.setdefault(find(n), []).append(n)
+    return [tuple(sorted(g)) for g in groups.values()]
+
+
 def _balanced_braces(text: str, start: int) -> str:
     """The ``{...}`` block starting at ``start`` (which must point at a
     ``{``), contents only, handling nesting."""
@@ -284,10 +336,21 @@ def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
                       "all-reduce-start", "all-gather-start",
                       "reduce-scatter-start"):
             op.replica_groups = parse_replica_groups(line, num_devices)
+            if op.replica_groups is None and \
+                    opcode.startswith("collective-permute"):
+                op.replica_groups = parse_source_target_pairs(line)
         if opcode == "custom-call":
             tm = re.search(r'custom_call_target="([^"]+)"', line)
             if tm:
                 op.custom_call_target = tm.group(1)
+        sh_at = line.find("sharding=")
+        if sh_at >= 0 and line[sh_at + len("sharding="):].lstrip()[:1] \
+                == "{":
+            brace = line.index("{", sh_at)
+            op.sharding = "{" + _balanced_braces(line, brace) + "}"
+        nm = re.search(r'op_name="([^"]*)"', line)
+        if nm:
+            op.op_name = nm.group(1)
         if opcode == "fusion":
             km = _FUSION_KIND_RE.search(rest)
             if km:

@@ -27,6 +27,16 @@ MXA005 Python ``for`` loop over a tracer/tensor dimension — ``for i
        ``gluon.rnn``'s fused layers — or vectorize).  Literal
        ``range(<const>)`` loops are not flagged; intentionally-small
        dynamic loops are blessed via the allowlist
+MXA006 sharding-opaque placement / raw collectives —
+       ``jax.device_put(x)`` or ``place_on_mesh(...)`` inside a
+       forward WITHOUT an explicit sharding/axis bakes whatever device
+       layout trace time happened to see into the compiled program
+       (the sharding analysis cannot attribute it to a declared spec);
+       and raw ``lax`` collectives (``lax.psum``/``all_gather``/
+       ``ppermute``/``all_to_all``/...) anywhere outside
+       ``parallel/collectives.py`` bypass the version-compat shims and
+       the spec packs that bless the framework's collective patterns —
+       route them through ``mxnet_tpu.parallel.collectives``
 ====== =====================================================
 
 Scope: ``forward`` / ``hybrid_forward`` method bodies (and functions
@@ -67,6 +77,15 @@ _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "stype", "context",
                  "ctx", "device", "name", "dtype_name"}
 _SAFE_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
                "range", "enumerate", "zip"}
+# raw lax collectives (MXA006): communication primitives that must
+# route through parallel/collectives.py (version-compat shims + the
+# spec-pack blessing surface)
+_LAX_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                    "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                    "pgather", "pbroadcast", "pvary", "pcast"}
+#: path suffix exempt from the raw-collective rule — the one module
+#: whose JOB is wrapping lax collectives
+_COLLECTIVES_HOME = "parallel/collectives.py"
 
 
 def _allow_marker(line: str) -> Optional[Set[str]]:
@@ -295,6 +314,44 @@ class _ForwardLint(ast.NodeVisitor):
                            f"`{fn.id}()` of a non-literal inside a "
                            "forward — if the argument derives from a "
                            "traced array this concretizes it",
+                           severity="warn")
+        # MXA006: sharding-opaque placement — device_put/place_on_mesh
+        # without an explicit sharding/destination
+        if isinstance(fn, (ast.Attribute, ast.Name)):
+            callee = fn.attr if isinstance(fn, ast.Attribute) else fn.id
+            kwnames = {k.arg for k in node.keywords}
+            if callee == "device_put" and len(node.args) < 2 and \
+                    not kwnames & {"device", "dst", "sharding"}:
+                self._flag(node, "MXA006",
+                           "`device_put` without an explicit sharding "
+                           "inside a forward bakes trace-time placement "
+                           "into the compiled program — pass a "
+                           "NamedSharding (or use parallel.mesh."
+                           "place_on_mesh with mesh+axis) so the "
+                           "sharding analysis can attribute the layout")
+            elif callee == "place_on_mesh" and len(node.args) < 3 and \
+                    not kwnames & {"axis"}:
+                self._flag(node, "MXA006",
+                           "`place_on_mesh` without an explicit "
+                           "mesh+axis inside a forward hides the "
+                           "intended layout from the compiled program "
+                           "and the sharding analysis")
+        # MXA006: raw lax collectives outside parallel/collectives.py
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in _LAX_COLLECTIVES:
+            base = fn.value
+            is_lax = (isinstance(base, ast.Name) and base.id == "lax") \
+                or (isinstance(base, ast.Attribute)
+                    and base.attr == "lax")
+            norm = self.filename.replace(os.sep, "/")
+            if is_lax and not norm.endswith(_COLLECTIVES_HOME):
+                self._flag(node, "MXA006",
+                           f"raw `lax.{fn.attr}` inside a forward "
+                           "bypasses parallel/collectives.py (the "
+                           "version-compat shims and the spec packs "
+                           "that bless the framework's collective "
+                           "patterns) — route it through "
+                           "mxnet_tpu.parallel.collectives",
                            severity="warn")
         # unkeyed randomness: numpy.random.* / random.*
         if isinstance(fn, ast.Attribute):

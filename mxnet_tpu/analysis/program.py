@@ -389,6 +389,15 @@ def analyze_lowered(lowered, mesh=None, expected_donated=None,
         except Exception:           # pragma: no cover - defensive
             report.memory = None
     report.collectives = collective_census(hlo_text, mesh=mesh)
+    if hlo_text:
+        try:
+            from . import sharding as _sharding
+            report.sharding = _sharding.audit_sharding(
+                hlo_text, census=report.collectives, mesh=mesh,
+                stablehlo=stablehlo)
+            _sharding.publish(report.sharding)
+        except Exception:       # pragma: no cover - defensive
+            _LOG.debug("sharding audit failed", exc_info=True)
     report.donation = donation_audit(stablehlo, hlo_text, mem,
                                      expected=expected_donated)
     report.host_transfers = host_transfer_scan(jaxpr, hlo_text)
@@ -468,73 +477,103 @@ def analyze_step(step, *args, batch_size=None, **kwargs) -> ProgramReport:
 # mode expectations (the tier-1 contract)
 # ---------------------------------------------------------------------------
 
+def mode_spec_pack(mode: str, axis: Optional[str] = None,
+                   unit_sizes=()) -> Optional["object"]:
+    """The declarative :class:`~.sharding.SpecPack` behind one compiled
+    mode's historical expectations — ``expect_mode`` is now a thin
+    dispatcher over these (docs/ANALYSIS.md "Sharding analysis"):
+
+    - ``zero``: >=1 reduce_scatter and >=1 all_gather on the dp axis,
+      ZERO all-reduces carrying exactly one shard unit's gradient (a
+      unit-sized all-reduce means the reduce-scatter transformation of
+      arXiv:2004.13336 regressed to replicate-everywhere), weight
+      re-replication gathers declared by their padded unit sizes so
+      any OTHER big gather is an implicit reshard.
+    - ``fused-mesh``: the dp gradient reduction must exist.
+    - ``fused`` dp=1 / ``predict``: no collectives at all (warn).
+    """
+    from . import sharding as _sharding
+    R = _sharding.CollectiveRule
+    units = frozenset(int(u) for u in (unit_sizes or ()))
+    if mode == "zero":
+        rules = [
+            R("reduce_scatter", axis=axis, min_count=1,
+              rule_id="collective-mismatch"),
+            R("all_gather", axis=axis, min_count=1,
+              rule_id="collective-mismatch"),
+        ]
+        if units:
+            rules.append(R("all_reduce", axis=axis, max_count=0,
+                           elements=units,
+                           rule_id="per-param-allreduce"))
+        return _sharding.SpecPack(
+            name="zero-dp",
+            description="ZeRO-1 sharded update (reduce-scatter grads, "
+                        "shard-local update, all-gather weights)",
+            axes=(axis,) if axis else (),
+            rules=tuple(rules),
+            declared=(
+                # the batch/loss psums and the numerics-stat psums are
+                # reductions the step declares
+                R("all_reduce", axis=axis),
+                # weight re-replication: all-gathers whose payload is a
+                # padded shard unit
+                R("all_gather", axis=axis, elements=units or None),
+            ),
+            # reshards surface as warnings + the baseline gate; no hard
+            # budget — XLA legitimately gathers small activations
+            # instead of psumming weight grads when that moves less
+            max_reshard_bytes=None,
+            state_axis=axis)
+    if mode == "fused-mesh":
+        return _sharding.SpecPack(
+            name="fused-mesh-dp",
+            description="mesh-aware fused step (replicated params, "
+                        "dp-sharded batch, in-program grad psum)",
+            axes=(axis,) if axis else (),
+            rules=(R(("all_reduce", "reduce_scatter"), axis=axis,
+                     min_count=1, rule_id="collective-mismatch"),),
+            declared=(R("all_reduce", axis=axis),
+                      R("reduce_scatter", axis=axis)),
+            max_reshard_bytes=None)
+    if mode in ("fused", "predict"):
+        what = "single-device fused step" if mode == "fused" \
+            else "serving predict program"
+        return _sharding.SpecPack(
+            name=f"{mode}-single",
+            description=f"{what} (no partitioning expected)",
+            rules=(R("*", max_count=0, rule_id="collective-mismatch",
+                     severity="warn"),))
+    return None
+
+
 def expect_mode(report: ProgramReport, mode: Optional[str] = None,
                 axis: Optional[str] = None) -> ProgramReport:
     """Append the per-mode structural invariants as findings.
 
-    - ``zero``: >=1 reduce_scatter and >=1 all_gather on the dp axis,
-      and ZERO all-reduces carrying exactly one shard unit's gradient
-      (a unit-sized all-reduce means the reduce-scatter transformation
-      of arXiv:2004.13336 regressed to replicate-everywhere).
-    - ``fused`` on a mesh: the batch psum must exist (>=1 all_reduce).
-    - ``fused`` dp=1: no collectives at all.
-    - every mode: all declared donations aliased, no host transfers.
+    The historical fused/zero/predict expectations are now declarative
+    :class:`~.sharding.SpecPack` s (:func:`mode_spec_pack`) enforced
+    through :func:`~.sharding.expect_spec` — which also runs the
+    implicit-reshard audit against the pack's declared collectives and
+    the sharded-state byte budget, and re-checks the
+    ``MXNET_SHARDING_BASELINE`` regression gate.  Every mode: all
+    declared donations aliased, no host transfers.
     """
+    from . import sharding as _sharding
     mode = mode or report.mode
     axis = axis or report.meta.get("axis")
-    c = report.collectives
-    if mode == "zero":
-        if c.count("reduce_scatter", axis=axis) < 1:
-            report.add(Finding(
-                checker="program", rule="collective-mismatch",
-                message="zero-sharded step has NO reduce-scatter on the "
-                        f"{axis!r} axis — the gradient reduction "
-                        "regressed to replicated all-reduce "
-                        f"(census: {c.by_kind})"))
-        if c.count("all_gather", axis=axis) < 1:
-            report.add(Finding(
-                checker="program", rule="collective-mismatch",
-                message="zero-sharded step has NO all-gather on the "
-                        f"{axis!r} axis — updated weights are not being "
-                        "re-replicated in-program"))
-        unit_sizes = report.meta.get("unit_sizes") or ()
-        per_param = c.matching("all_reduce", unit_sizes)
-        if per_param:
-            report.add(Finding(
-                checker="program", rule="per-param-allreduce",
-                message=f"{len(per_param)} all-reduce(s) carry exactly a "
-                        "shard unit's gradient "
-                        f"({sorted(set(o.elements for o in per_param))} "
-                        "elements) — the sharded update is paying "
-                        "replicated reductions",
-                where=", ".join(o.name for o in per_param[:4])))
-    elif mode == "fused-mesh":
-        if c.count("all_reduce", axis=axis) + \
-                c.count("reduce_scatter", axis=axis) < 1:
-            report.add(Finding(
-                checker="program", rule="collective-mismatch",
-                message="mesh-aware fused step emits no gradient "
-                        "reduction on the dp axis — dp replicas are "
-                        "diverging silently"))
-    elif mode == "fused":
-        if c.ops:
-            report.add(Finding(
-                checker="program", rule="collective-mismatch",
-                severity="warn",
-                message=f"single-device fused step emits collectives "
-                        f"({c.by_kind}) — unexpected partitioning"))
-    elif mode == "predict":
-        # serving programs (serving/predictor.py): single-device
-        # forward-only — a collective means the predictor was built
-        # against an unintended partitioning; a host transfer is a
-        # per-request round-trip (the findings below already flag it)
-        if c.ops:
-            report.add(Finding(
-                checker="program", rule="collective-mismatch",
-                severity="warn",
-                message=f"serving predict program emits collectives "
-                        f"({c.by_kind}) — unexpected partitioning for "
-                        "a single-device inference executable"))
+    pack = mode_spec_pack(mode, axis=axis,
+                          unit_sizes=report.meta.get("unit_sizes") or ())
+    if pack is not None:
+        _sharding.expect_spec(report, pack)
+    audit = report.sharding
+    if audit is not None:
+        env = _sharding.baseline_from_env()
+        if env is not None:
+            baselines, leg = env
+            report.findings.extend(_sharding.check_baseline(
+                audit, baselines, leg or mode))
+        _sharding.publish(audit)
     # fusion pack (every compiled mode): the optimized program must
     # have NO fusable elementwise/broadcast/convert op stranded between
     # two fusions above the size floor — each one is two avoidable HBM

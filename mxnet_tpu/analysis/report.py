@@ -169,6 +169,10 @@ class ProgramReport:
     #: fusion census of the optimized program (analysis.fusion
     #: .FusionReport) — None where there was no HLO text to audit
     fusion: Optional[Any] = None
+    #: SPMD sharding audit (analysis.sharding.ShardingAudit): the
+    #: per-buffer sharding table, implicit reshards, and per-axis comm
+    #: cost — None where there was no HLO text to audit
+    sharding: Optional[Any] = None
 
     def add(self, finding: Finding):
         self.findings.append(finding)
@@ -217,6 +221,8 @@ class ProgramReport:
             "memory": self.memory,
             "fusion": self.fusion.brief() if self.fusion is not None
             else None,
+            "sharding": self.sharding.brief()
+            if self.sharding is not None else None,
             "findings": [str(f) for f in self.all_findings()],
         }
 
@@ -245,6 +251,9 @@ class ProgramReport:
                          f"donated={m['donated_bytes']})")
         if self.fusion is not None:
             lines.append("  fusion      : " + self.fusion.summary_line())
+        if self.sharding is not None:
+            lines.append("  sharding    : "
+                         + self.sharding.summary_line())
         n_bless = len(self.host_transfers) + len(self.dtype_drift) \
             - len(self._unblessed(self.host_transfers)) \
             - len(self._unblessed(self.dtype_drift))

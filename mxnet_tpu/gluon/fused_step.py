@@ -1317,6 +1317,18 @@ class CompiledTrainStep:
         report = self.analyze(*args, batch_size=batch_size, **kwargs)
         return getattr(report, "fusion", None)
 
+    def sharding_report(self, *args, batch_size: Optional[int] = None,
+                        **kwargs):
+        """SPMD sharding audit of this batch bucket's OPTIMIZED program
+        (:class:`~mxnet_tpu.analysis.sharding.ShardingAudit`): the
+        per-buffer sharding table, implicit reshards ranked by wire
+        bytes against this mode's spec pack, and the per-mesh-axis
+        communication cost estimate (docs/ANALYSIS.md "Sharding
+        analysis").  ``None`` on the eager path.  Cached with the
+        bucket's :meth:`analyze` report."""
+        report = self.analyze(*args, batch_size=batch_size, **kwargs)
+        return getattr(report, "sharding", None)
+
     def lower_entry(self, *args, batch_size: Optional[int] = None,
                     **kwargs):
         """Lower this batch bucket's program for static analysis.

@@ -715,3 +715,49 @@ def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
     from ..parallel.collectives import shard_map as _shard_map
     return _shard_map(lambda a, b_, c: fn(a, b_, c), m,
                       (spec, spec, spec), spec)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# sharding spec packs (analysis/sharding.py expect_spec)
+# ---------------------------------------------------------------------------
+# The invariant packs for the two attention parallelism paths, declared
+# NEXT TO the implementations they describe so a change to the
+# collective pattern and its contract land in the same review:
+#
+# - tensor-parallel attention ("tp-attention"): per-head QKV projections
+#   column-sharded over 'tp', the output projection row-sharded — the
+#   Megatron signature is exactly ONE all-reduce (the output psum) per
+#   application; any all-gather above the floor means an activation
+#   silently left the head-sharded layout.
+# - sequence-parallel ring attention ("sp-ring-attention"): K and V
+#   shards rotate the ring with lax.ppermute — >= 2 collective-permutes
+#   (K and V; the backward adds reverse hops) and NOTHING ELSE: a
+#   gather here means the sequence dimension was materialized on one
+#   device, the exact failure ring attention exists to avoid.
+try:
+    from ..analysis import sharding as _asharding
+
+    TP_ATTENTION_SPEC_PACK = _asharding.register_spec_pack(
+        _asharding.SpecPack(
+            name="tp-attention",
+            description="tensor-parallel attention (Megatron split: "
+                        "column-sharded QKV, row-sharded output proj, "
+                        "one output all-reduce)",
+            axes=("tp",),
+            rules=(_asharding.CollectiveRule(
+                "all_reduce", axis="tp", min_count=1),),
+            declared=(_asharding.CollectiveRule(
+                "reduce_scatter", axis="tp"),),
+            state_axis="tp"))
+
+    RING_ATTENTION_SPEC_PACK = _asharding.register_spec_pack(
+        _asharding.SpecPack(
+            name="sp-ring-attention",
+            description="sequence-parallel ring attention (K/V shards "
+                        "rotate via ppermute, online-softmax merge)",
+            axes=("sp",),
+            rules=(_asharding.CollectiveRule(
+                "collective_permute", axis="sp", min_count=2),),
+            declared=()))
+except Exception:                        # pragma: no cover - defensive
+    pass

@@ -134,3 +134,33 @@ def moe_ffn(x, gate_w, w1, w2, top_k: int = 2, capacity_factor: float = 1.25,
     # aux is computed from local stats; average across shards
     aux = lax.pmean(aux, axis_name)
     return out, aux
+
+
+# ---------------------------------------------------------------------------
+# sharding spec pack (analysis/sharding.py expect_spec)
+# ---------------------------------------------------------------------------
+# Expert parallelism's contract, declared next to the implementation:
+# exactly the two all-to-alls above (dispatch out, combine back) per
+# application on the 'ep' axis — a THIRD exchange or any all-gather
+# above the floor means tokens or expert weights are leaving the
+# expert-sharded layout; the aux-loss pmean is a declared reduction;
+# and the expert weights (w1/w2, leading dim 'ep'-sharded) must
+# actually live at ~1/ep per device (the state-budget check over the
+# sharding table).
+try:
+    from ..analysis import sharding as _asharding
+
+    MOE_EP_SPEC_PACK = _asharding.register_spec_pack(
+        _asharding.SpecPack(
+            name="ep-moe",
+            description="expert-parallel MoE FFN (dispatch/combine "
+                        "all-to-all pair over 'ep', GShard/Switch "
+                        "capacity-bounded routing)",
+            axes=("ep",),
+            rules=(_asharding.CollectiveRule(
+                "all_to_all", axis="ep", min_count=2),),
+            declared=(_asharding.CollectiveRule("all_reduce",
+                                                axis="ep"),),
+            state_axis="ep"))
+except Exception:                        # pragma: no cover - defensive
+    pass

@@ -101,3 +101,35 @@ def run_pipeline(stage_fn, stacked_params, x, num_microbatches, mesh,
         shard_fn, mesh,
         (P(axis_name), P()), P())(stacked_params, micro)
     return out.reshape(b, *out.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# sharding spec pack (analysis/sharding.py expect_spec)
+# ---------------------------------------------------------------------------
+# The GPipe schedule's contract, declared next to the implementation:
+# microbatches hop the ring with lax.ppermute (>= 1 collective-permute
+# on 'pp' — XLA fuses the scan body's hop into one op) and the last
+# stage's outputs broadcast back with ONE psum (>= 1 all-reduce); the
+# stage weights (leading dim 'pp'-sharded by run_pipeline) must live at
+# ~1/pp per device.  An all-gather above the floor means a stage pulled
+# another stage's weights or activations — the cross-stage
+# materialization pipelining exists to avoid.
+try:
+    from ..analysis import sharding as _asharding
+
+    PIPELINE_SPEC_PACK = _asharding.register_spec_pack(
+        _asharding.SpecPack(
+            name="pp-gpipe",
+            description="GPipe microbatch pipeline (ppermute ring hops "
+                        "+ one last-stage psum broadcast)",
+            axes=("pp",),
+            rules=(
+                _asharding.CollectiveRule("collective_permute",
+                                          axis="pp", min_count=1),
+                _asharding.CollectiveRule("all_reduce", axis="pp",
+                                          min_count=1),
+            ),
+            declared=(),
+            state_axis="pp"))
+except Exception:                        # pragma: no cover - defensive
+    pass

@@ -137,6 +137,14 @@ FUSION_BOUNDARY_BYTES = "mx_fusion_boundary_bytes"
 FUSION_COMPUTE_BOUND = "mx_fusion_compute_bound_ratio"
 
 # ---------------------------------------------------------------------------
+# SPMD sharding analysis (analysis/sharding.py)
+# ---------------------------------------------------------------------------
+SHARDING_RESHARDS = "mx_sharding_implicit_reshards"
+SHARDING_RESHARD_BYTES = "mx_sharding_reshard_bytes"
+SHARDING_COMM_COST = "mx_sharding_comm_cost_seconds"
+SHARDING_COLLECTIVE_BYTES = "mx_sharding_collective_bytes"
+
+# ---------------------------------------------------------------------------
 # Pallas kernel layer (ops/kernels dispatch gate)
 # ---------------------------------------------------------------------------
 KERNEL_DISPATCH = "mx_kernel_dispatch_total"
@@ -356,6 +364,24 @@ CATALOG = {
         kind="gauge", label=None,
         help="FLOP-weighted share (0-1) of kernels whose arithmetic "
              "intensity clears the measured roofline ridge point"),
+    SHARDING_RESHARDS: dict(
+        kind="gauge", label=None,
+        help="SPMD-partitioner-inserted collectives in the last-"
+             "analyzed program not implied by the declared spec, above "
+             "the reshard byte floor (analysis/sharding.py)"),
+    SHARDING_RESHARD_BYTES: dict(
+        kind="gauge", label=None,
+        help="wire bytes per step moved by implicit reshards of the "
+             "last-analyzed program"),
+    SHARDING_COMM_COST: dict(
+        kind="gauge", label="axis",
+        help="estimated per-step collective communication seconds by "
+             "mesh axis (ring model over the MXNET_SHARDING_BANDWIDTH "
+             "profile; '?' = unattributed groups)"),
+    SHARDING_COLLECTIVE_BYTES: dict(
+        kind="gauge", label="axis",
+        help="ring-model wire bytes per step moved by collectives, by "
+             "mesh axis"),
     KERNEL_DISPATCH: dict(
         kind="counter", label="path",
         help="Pallas kernel-layer dispatch decisions by path taken "

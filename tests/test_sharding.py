@@ -1,0 +1,696 @@
+"""SPMD sharding static analysis (mx.analysis.sharding, PR 13):
+OpSharding grammar golden cases, mesh-axis resolution, the
+sharding-flow table over the real zero-sharded step, implicit-reshard
+detection (planted mismatched-PartitionSpec program with correct byte
+counts), the per-axis ring-model communication cost, bandwidth-profile
+parsing, the expect_spec invariant packs (zero / tp-attention /
+sp-ring-attention here; ep-moe / pp-gpipe in test_moe_pipeline.py),
+the sharding baseline regression gate (tier-1 ``lint``-marked sweep at
+the bottom + analyze='raise' injected-regression), the SPMD per-shard
+fusion-census accounting, and the MXA006 source-lint rule.
+"""
+import json
+import os
+import textwrap
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+from mxnet_tpu.analysis import sharding as asharding
+from mxnet_tpu.analysis.fusion import fusion_census
+from mxnet_tpu.analysis.hlo import parse_hlo, parse_source_target_pairs
+from mxnet_tpu.analysis.lint import lint_source
+from mxnet_tpu.analysis.program import (analyze_lowered,
+                                        collective_census, expect_mode,
+                                        mode_spec_pack)
+from mxnet_tpu.analysis.report import CollectiveOp, CollectiveStats
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.parallel import make_mesh, shard_batch
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+BASELINES = os.path.join(FIXTURES, "sharding_baselines.json")
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+DP = 4
+
+
+# ---------------------------------------------------------------------------
+# OpSharding grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_replicated_manual_maximal():
+    assert asharding.parse_op_sharding("{replicated}").kind == \
+        "replicated"
+    assert asharding.parse_op_sharding("{manual}").kind == "manual"
+    m = asharding.parse_op_sharding("{maximal device=3}")
+    assert m.kind == "maximal" and m.maximal_device == 3
+    for sh in (asharding.parse_op_sharding("{replicated}"),
+               asharding.parse_op_sharding("{manual}")):
+        assert sh.shard_count == 1
+        assert sh.local_shape((8, 4)) == (8, 4)
+
+
+def test_parse_iota_tiled():
+    sh = asharding.parse_op_sharding("{devices=[4,1]<=[4]}")
+    assert sh.kind == "tiled"
+    assert sh.tile_dims == (4, 1)
+    assert sh.device_order == (0, 1, 2, 3)
+    assert sh.shard_count == 4
+    assert sh.local_shape((8, 16)) == (2, 16)
+    assert sh.global_shape((2, 16)) == (8, 16)
+    # ceil-divide on uneven dims, as GSPMD pads
+    assert sh.local_shape((7, 16)) == (2, 16)
+
+
+def test_parse_iota_transposed():
+    sh = asharding.parse_op_sharding("{devices=[2,2]<=[2,2]T(1,0)}")
+    # arange(4).reshape(2,2).T.flatten() == [0, 2, 1, 3]
+    assert sh.device_order == (0, 2, 1, 3)
+
+
+def test_parse_explicit_device_list():
+    sh = asharding.parse_op_sharding("{devices=[2,2]0,2,1,3}")
+    assert sh.kind == "tiled" and sh.device_order == (0, 2, 1, 3)
+    # wrong-arity explicit list degrades to no order, not an exception
+    bad = asharding.parse_op_sharding("{devices=[2,2]0,1}")
+    assert bad.device_order is None
+
+
+def test_parse_partial_replication():
+    sh = asharding.parse_op_sharding(
+        "{devices=[2,1,2]<=[4] last_tile_dim_replicate}")
+    assert sh.n_subgroup_dims == 1
+    assert sh.data_tile_dims == (2, 1)
+    assert sh.shard_count == 2
+    assert sh.local_shape((8, 4)) == (4, 4)
+
+
+def test_parse_tuple_sharding():
+    sh = asharding.parse_op_sharding(
+        "{{replicated}, {devices=[4]<=[4]}}")
+    assert sh.kind == "tuple" and len(sh.parts) == 2
+    assert sh.parts[0].kind == "replicated"
+    assert sh.parts[1].shard_count == 4
+
+
+def test_parse_garbage_degrades():
+    assert asharding.parse_op_sharding(None) is None
+    assert asharding.parse_op_sharding("") is None
+    assert asharding.parse_op_sharding("{what=even}").kind == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis resolution
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_resolve_1d_dp():
+    mesh = make_mesh({"dp": DP}, jax.devices()[:DP])
+    sh = asharding.parse_op_sharding("{devices=[4,1]<=[4]}")
+    assert sh.resolve(mesh) == ("dp", None)
+    assert sh.describe() == "P(dp, -)"
+
+
+@needs_mesh
+def test_resolve_2d_and_transposed():
+    mesh = make_mesh({"dp": 2, "tp": 2}, jax.devices()[:4])
+    sh = asharding.parse_op_sharding("{devices=[2,2]<=[4]}")
+    assert sh.resolve(mesh) == ("dp", "tp")
+    tr = asharding.parse_op_sharding("{devices=[2,2]<=[2,2]T(1,0)}")
+    assert tr.resolve(mesh) == ("tp", "dp")
+
+
+@needs_mesh
+def test_resolve_foreign_world_is_unresolved():
+    """An annotation naming device ids outside the mesh resolves to
+    None, never raises."""
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    sh = asharding.parse_op_sharding("{devices=[4,1]<=[4]}")
+    assert sh.resolve(mesh) is None
+
+
+def test_source_target_pairs_connected_components():
+    groups = parse_source_target_pairs(
+        "x, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+    assert groups == [(0, 1, 2, 3)]
+    two = parse_source_target_pairs(
+        "x, source_target_pairs={{0,1},{1,0},{2,3},{3,2}}")
+    assert sorted(two) == [(0, 1), (2, 3)]
+
+
+# ---------------------------------------------------------------------------
+# sharding table (canned partitioned module + StableHLO global shapes)
+# ---------------------------------------------------------------------------
+
+_CANNED_SPMD = textwrap.dedent("""\
+HloModule jit_f, is_scheduled=true, entry_computation_layout={(f32[2,16]{1,0}, f32[16,8]{1,0})->f32[2,8]{1,0}}, num_partitions=4
+
+ENTRY %main.5_spmd (param: f32[2,16], param.1: f32[16,8]) -> f32[2,8] {
+  %param = f32[2,16]{1,0} parameter(0), sharding={devices=[4,1]<=[4]}, metadata={op_name="x"}
+  %param.1 = f32[16,8]{1,0} parameter(1), sharding={replicated}, metadata={op_name="w"}
+  ROOT %dot = f32[2,8]{1,0} dot(f32[2,16]{1,0} %param, f32[16,8]{1,0} %param.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+""")
+
+_CANNED_STABLEHLO = (
+    'func.func public @main(%arg0: tensor<8x16xf32> {mhlo.sharding = '
+    '"{devices=[4,1]<=[4]}"}, %arg1: tensor<16x8xf32> {mhlo.sharding = '
+    '"{replicated}"}) -> tensor<8x8xf32>')
+
+
+@needs_mesh
+def test_sharding_table_canned():
+    mesh = make_mesh({"dp": DP}, jax.devices()[:DP])
+    tbl = asharding.sharding_table(_CANNED_SPMD, mesh=mesh,
+                                   stablehlo=_CANNED_STABLEHLO)
+    assert tbl.num_partitions == 4
+    x, w = tbl.params
+    assert x.name == "x" and x.local_shape == (2, 16)
+    assert x.global_shape == (8, 16)            # from the StableHLO side
+    assert x.sharding.spec == ("dp", None)
+    assert x.bytes_local == 2 * 16 * 4
+    assert x.bytes_global == 8 * 16 * 4
+    assert w.sharding.kind == "replicated"
+    assert w.global_shape == (16, 8)
+    assert "P(dp, -)" in tbl.table_str()
+
+
+def test_sharding_table_without_mesh_or_stablehlo():
+    """No mesh, no StableHLO: global shape = local x tile dims, spec
+    unresolved — degraded, never raised."""
+    tbl = asharding.sharding_table(_CANNED_SPMD)
+    x = tbl.params[0]
+    assert x.global_shape == (8, 16)           # local (2,16) x tile 4
+    assert x.sharding.spec is None
+
+
+def test_stablehlo_shardings_parse():
+    got = asharding.stablehlo_shardings(_CANNED_STABLEHLO)
+    assert got[0][0] == (8, 16) and got[0][1] == "f32"
+    assert got[0][2].shard_count == 4
+    assert got[1][0] == (16, 8)
+    assert got[1][2].kind == "replicated"
+
+
+def test_table_digest_is_stable_and_layout_sensitive():
+    a = asharding.sharding_table(_CANNED_SPMD)
+    b = asharding.sharding_table(_CANNED_SPMD)
+    assert a.digest() == b.digest()
+    mutated = _CANNED_SPMD.replace("{devices=[4,1]<=[4]}",
+                                   "{replicated}")
+    assert asharding.sharding_table(mutated).digest() != a.digest()
+
+
+# ---------------------------------------------------------------------------
+# communication cost model
+# ---------------------------------------------------------------------------
+
+def _cop(kind, elements, group=4, dtype="f32", decomposed=False,
+         axes=("dp",), name="c"):
+    return CollectiveOp(kind=kind, name=name, elements=elements,
+                        dtype=dtype, axes=axes, group_size=group,
+                        decomposed=decomposed)
+
+
+def test_wire_bytes_ring_formulas():
+    wb = asharding.collective_wire_bytes
+    # all_reduce: 2(n-1)/n x payload
+    assert wb(_cop("all_reduce", 1024)) == 2 * 4096 * 3 // 4
+    # all_gather: result is the full buffer -> (n-1)/n x result
+    assert wb(_cop("all_gather", 1024)) == 4096 * 3 // 4
+    # native reduce_scatter: result is the shard -> (n-1) x result
+    assert wb(_cop("reduce_scatter", 256)) == 1024 * 3
+    # decomposed RS records the FULL all-reduce payload
+    assert wb(_cop("reduce_scatter", 1024, decomposed=True)) == \
+        4096 * 3 // 4
+    # permute: one hop, whole payload
+    assert wb(_cop("collective_permute", 1024)) == 4096
+    # single-participant groups move nothing
+    assert wb(_cop("all_gather", 1024, group=1)) == 0
+
+
+def test_comm_cost_per_axis():
+    census = CollectiveStats(ops=[
+        _cop("all_reduce", 1024, axes=("dp",)),
+        _cop("collective_permute", 512, axes=("pp",)),
+        _cop("all_gather", 2048, axes=()),
+    ])
+    prof = asharding.BandwidthProfile(10.0, {"pp": 1.0}, name="test")
+    cost = asharding.comm_cost(census, profile=prof)
+    assert set(cost.per_axis_s) == {"dp", "pp", "?"}
+    # permute: 2048 B over 1 GB/s
+    assert cost.per_axis_s["pp"] == pytest.approx(2048 / 1e9)
+    assert cost.per_axis_bytes["dp"] == 2 * 4096 * 3 // 4
+    assert cost.total_bytes == sum(cost.per_axis_bytes.values())
+    assert cost.total_s == pytest.approx(sum(cost.per_axis_s.values()))
+    # ranked per-op table
+    assert cost.per_op[0]["seconds"] >= cost.per_op[-1]["seconds"]
+
+
+def test_bandwidth_profile_parsing(monkeypatch):
+    p = asharding.BandwidthProfile.parse("dcn")
+    assert p.default_gbps == asharding.DCN_BANDWIDTH_GBPS
+    p = asharding.BandwidthProfile.parse("42.5")
+    assert p.default_gbps == 42.5
+    p = asharding.BandwidthProfile.parse("dp=ici,pp=dcn,default=7")
+    assert p.gbps(("dp",)) == asharding.ICI_BANDWIDTH_GBPS
+    assert p.gbps(("pp",)) == asharding.DCN_BANDWIDTH_GBPS
+    assert p.gbps(("ep",)) == 7.0
+    monkeypatch.setenv("MXNET_SHARDING_BANDWIDTH", "dp=3")
+    env = asharding.bandwidth_profile()
+    assert env.gbps(("dp",)) == 3.0
+    monkeypatch.delenv("MXNET_SHARDING_BANDWIDTH")
+    assert asharding.bandwidth_profile().default_gbps == \
+        asharding.CPU_BANDWIDTH_GBPS      # cpu backend default
+
+
+# ---------------------------------------------------------------------------
+# implicit-reshard detection
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_planted_mismatched_spec_yields_ranked_reshard():
+    """The acceptance case: a P('dp', None) input whose output layout
+    forces the partitioner to gather it to replicated — the audit must
+    produce a ranked implicit-reshard finding with the gather's correct
+    byte count and the producing op named."""
+    mesh = make_mesh({"dp": DP}, jax.devices()[:DP])
+    xs = NamedSharding(mesh.mesh, P("dp", None))
+    rs = NamedSharding(mesh.mesh, P())
+    x = jax.device_put(jnp.ones((64, 128), jnp.float32), xs)
+    w = jax.device_put(jnp.ones((128, 32), jnp.float32), rs)
+    lowered = jax.jit(lambda a, b: jnp.tanh(a @ b),
+                      in_shardings=(xs, rs),
+                      out_shardings=rs).lower(x, w)
+    report = analyze_lowered(lowered, mesh=mesh)
+    pack = asharding.SpecPack(name="pure-dp",
+                              description="dp batch-sharded forward")
+    findings = asharding.expect_spec(report, pack)
+    reshards = report.sharding.reshards
+    assert len(reshards) == 1
+    r = reshards[0]
+    assert r.kind == "all_gather"
+    # the gathered output is the full (64, 32) f32 buffer
+    assert r.payload_bytes == 64 * 32 * 4
+    assert r.wire_bytes == 64 * 32 * 4 * (DP - 1) // DP
+    assert r.producer                       # producing op is named
+    # budget 0 -> an error-severity finding fails analyze='raise'
+    errs = [f for f in findings
+            if f.rule == "implicit-reshard" and f.severity == "error"]
+    assert errs and str(r.payload_bytes) in errs[0].message
+    assert not report.ok
+
+
+def test_declared_rules_bless_reshards():
+    census = CollectiveStats(ops=[
+        _cop("all_gather", 4096, name="ag.weights"),
+        _cop("all_to_all", 4096, name="a2a.stray"),
+    ])
+    blessed = asharding.implicit_reshards(
+        census,
+        declared=[asharding.CollectiveRule(
+            "all_gather", elements=frozenset([4096]))])
+    assert [r.name for r in blessed] == ["a2a.stray"]
+    # below the floor nothing fires
+    assert asharding.implicit_reshards(
+        CollectiveStats(ops=[_cop("all_gather", 64)])) == []
+    # ranked by wire bytes
+    ranked = asharding.implicit_reshards(CollectiveStats(ops=[
+        _cop("all_gather", 2048, name="small"),
+        _cop("all_gather", 65536, name="big")]))
+    assert [r.name for r in ranked] == ["big", "small"]
+
+
+# ---------------------------------------------------------------------------
+# expect_spec packs
+# ---------------------------------------------------------------------------
+
+def _zero_setup(hidden=(8, 5, 3), bs=8, seed=3):
+    """The canonical zero-sharded MLP of tests/test_zero_shard.py."""
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden[0], in_units=4, activation="relu"))
+    net.add(nn.Dense(hidden[1], in_units=hidden[0], activation="relu"))
+    net.add(nn.Dense(hidden[2], in_units=hidden[1]))
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loss_blk = gloss.SoftmaxCrossEntropyLoss()
+    step = trainer.compile_step(lambda a, b: loss_blk(net(a), b))
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.randn(bs, 4).astype("float32"))
+    y = nd.array(rng.randint(0, 3, size=(bs,)).astype("int32"))
+    return step, x, y
+
+
+@pytest.fixture(scope="module")
+def zero_report():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    step, x, y = _zero_setup()
+    with make_mesh({"dp": DP}, jax.devices()[:DP]) as mesh:
+        xs, ys = shard_batch(x, mesh), shard_batch(y, mesh)
+        step(xs, ys)
+        return step.analyze(xs, ys)
+
+
+@needs_mesh
+def test_zero_pack_passes_on_real_program(zero_report):
+    """The zero-dp spec pack over the real ZeRO step: collective
+    signature present, ZERO implicit reshards above the floor, state
+    shards at ~1/dp — and the audit riding the ProgramReport."""
+    rep = zero_report
+    assert rep.ok, rep.summary()
+    audit = rep.sharding
+    assert audit is not None and audit.pack == "zero-dp"
+    assert audit.reshards == []
+    assert audit.brief()["implicit_reshards"] == 0
+    # the momentum shard is in the table, P(dp), at exactly 1/dp
+    shards = [r for r in audit.table.params
+              if r.sharding is not None
+              and r.sharding.spec == ("dp",) and "sts" in r.name]
+    assert shards, audit.table.table_str()
+    for s in shards:
+        assert s.bytes_global == s.bytes_local * DP
+    # the batch input resolved as P(dp, -)
+    batch = [r for r in audit.table.params
+             if r.sharding is not None
+             and r.sharding.spec == ("dp", None)]
+    assert batch
+    # comm cost attributed entirely to the dp axis
+    assert set(audit.cost.per_axis_s) == {"dp"}
+    assert audit.cost.total_s > 0
+
+
+@needs_mesh
+def test_tp_attention_pack():
+    """Megatron-split attention (column-sharded QKV, row-sharded output
+    proj): exactly the one output all-reduce on tp, zero reshards."""
+    from mxnet_tpu.ops.attention import flash_attention
+    mesh = make_mesh({"tp": DP}, jax.devices()[:DP])
+    B, S, D, HD = 4, 16, 64, 8
+
+    def tp_attn(x, wq, wk, wv, wo):
+        def split(t):
+            return t.reshape(B, S, D // HD, HD).transpose(0, 2, 1, 3)
+        q, k, v = split(x @ wq), split(x @ wk), split(x @ wv)
+        o = flash_attention(q, k, v)
+        return o.transpose(0, 2, 1, 3).reshape(B, S, D) @ wo
+
+    col = NamedSharding(mesh.mesh, P(None, "tp"))
+    row = NamedSharding(mesh.mesh, P("tp", None))
+    rep0 = NamedSharding(mesh.mesh, P())
+    x = jnp.ones((B, S, D), jnp.float32)
+    w = jnp.ones((D, D), jnp.float32) * 0.02
+    lowered = jax.jit(tp_attn,
+                      in_shardings=(rep0, col, col, col, row)) \
+        .lower(x, w, w, w, w)
+    report = analyze_lowered(lowered, mesh=mesh)
+    findings = asharding.expect_spec(report, "tp-attention")
+    assert findings == [], [str(f) for f in findings]
+    assert report.collectives.count("all_reduce", axis="tp") == 1
+    assert report.sharding.reshards == []
+    # the tp-sharded projection weights sit at 1/tp per device
+    loc, glob = report.sharding.table.sharded_bytes("tp")
+    assert glob == loc * DP
+
+
+@needs_mesh
+def test_ring_attention_pack():
+    """Sequence-parallel ring attention: K/V ppermute ring hops on sp,
+    nothing gathered."""
+    from mxnet_tpu.ops.attention import ring_attention_sharded
+    mesh = make_mesh({"sp": DP}, jax.devices()[:DP])
+    q = jnp.ones((2, 2, 32, 8), jnp.float32)
+    lowered = jax.jit(
+        lambda a, b, c: ring_attention_sharded(a, b, c, mesh,
+                                               axis="sp")) \
+        .lower(q, q, q)
+    report = analyze_lowered(lowered, mesh=mesh)
+    findings = asharding.expect_spec(report, "sp-ring-attention")
+    assert findings == [], [str(f) for f in findings]
+    assert report.collectives.count("collective_permute",
+                                    axis="sp") >= 2
+    assert report.sharding.reshards == []
+    baselines = asharding.load_baselines(BASELINES)
+    assert asharding.check_baseline(report.sharding, baselines,
+                                    "sp-ring-attention") == []
+
+
+def test_pack_violation_fires_spec_mismatch():
+    """A census without the pack's required collective yields an
+    error-severity finding naming the pack."""
+    census = CollectiveStats(ops=[_cop("all_reduce", 128)])
+    pack = asharding.SpecPack(
+        name="wants-rs", description="test",
+        rules=(asharding.CollectiveRule("reduce_scatter", axis="dp",
+                                        min_count=1),))
+    findings = asharding.expect_spec(census, pack)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "spec-mismatch" and f.severity == "error"
+    assert "wants-rs" in f.message
+
+
+def test_pack_max_count_and_wildcard():
+    census = CollectiveStats(ops=[_cop("all_gather", 128),
+                                  _cop("all_reduce", 128)])
+    pack = asharding.SpecPack(
+        name="none-allowed", description="test",
+        rules=(asharding.CollectiveRule("*", max_count=0,
+                                        severity="warn"),))
+    findings = asharding.expect_spec(census, pack)
+    assert len(findings) == 1 and findings[0].severity == "warn"
+
+
+def test_state_budget_violation():
+    """A pack with a state axis over a table whose 'sharded' buffers
+    secretly hold full copies must fire the state-budget finding."""
+    repl = asharding.OpSharding(kind="tiled", tile_dims=(4,),
+                                device_order=(0, 1, 2, 3))
+    repl.spec = ("dp",)
+    table = asharding.ShardingTable(params=[asharding.ParamSharding(
+        index=0, name="sts[0]", role="parameter",
+        local_shape=(1024,), global_shape=(1024,), dtype="f32",
+        bytes_local=4096, bytes_global=4096, sharding=repl)])
+
+    class _Rep:
+        collectives = CollectiveStats()
+        sharding = asharding.ShardingAudit(table=table)
+        findings = []
+
+        def add(self, f):
+            self.findings.append(f)
+
+    mesh = make_mesh({"dp": min(4, len(jax.devices()))},
+                     jax.devices()[:min(4, len(jax.devices()))]) \
+        if len(jax.devices()) >= 4 else None
+    if mesh is None:
+        pytest.skip("needs >=4 devices")
+    pack = asharding.SpecPack(name="budget", description="test",
+                              state_axis="dp")
+    rep = _Rep()
+    findings = asharding.expect_spec(rep, pack, mesh=mesh)
+    assert any(f.rule == "state-budget" for f in findings)
+
+
+def test_mode_pack_zero_keeps_historical_rules():
+    """The declarative zero pack preserves expect_mode's historical
+    finding vocabulary (the tier-1 fixtures assert these rule ids)."""
+    pack = mode_spec_pack("zero", axis="dp", unit_sizes=[1024])
+    ids = {r.rule_id for r in pack.rules}
+    assert ids == {"collective-mismatch", "per-param-allreduce"}
+    assert pack.max_reshard_bytes is None
+    assert mode_spec_pack("fused").rules[0].severity == "warn"
+    assert mode_spec_pack("predict") is not None
+    assert mode_spec_pack("split") is None
+
+
+# ---------------------------------------------------------------------------
+# baseline regression gate
+# ---------------------------------------------------------------------------
+
+def _audit(n_reshards=0, bytes_each=8192):
+    a = asharding.ShardingAudit()
+    for i in range(n_reshards):
+        a.reshards.append(asharding.Reshard(
+            name=f"ag.{i}", kind="all_gather", axes=("dp",),
+            group_size=4, elements=bytes_each // 4, dtype="f32",
+            payload_bytes=bytes_each,
+            wire_bytes=bytes_each * 3 // 4, seconds=1e-6))
+    return a
+
+
+def test_check_baseline_pass_and_regress():
+    baselines = {"leg": {"implicit_reshards": 1,
+                         "reshard_bytes": 8192, "tol_pct": 25}}
+    assert asharding.check_baseline(_audit(1), baselines, "leg") == []
+    worse = asharding.check_baseline(_audit(3), baselines, "leg")
+    assert [f.rule for f in worse] == ["sharding-regression"] * 2
+    assert all(f.severity == "error" for f in worse)
+    missing = asharding.check_baseline(_audit(0), baselines, "nope")
+    assert missing[0].severity == "warn"
+
+
+def test_baseline_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("MXNET_SHARDING_BASELINE", raising=False)
+    assert asharding.baseline_from_env() is None
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"zero": {"implicit_reshards": 0}}))
+    monkeypatch.setenv("MXNET_SHARDING_BASELINE", str(p))
+    got = asharding.baseline_from_env()
+    assert got == ({"zero": {"implicit_reshards": 0}}, None)
+    monkeypatch.setenv("MXNET_SHARDING_BASELINE", f"{p}:zero")
+    assert asharding.baseline_from_env()[1] == "zero"
+    monkeypatch.setenv("MXNET_SHARDING_BASELINE", "/nope/missing.json")
+    assert asharding.baseline_from_env() is None
+
+
+@needs_mesh
+def test_analyze_raise_fails_fast_on_injected_regression(monkeypatch,
+                                                         tmp_path):
+    """The acceptance case: MXNET_SHARDING_BASELINE + analyze='raise'
+    must fail the FIRST step when the program's reshard posture exceeds
+    the armed baseline.  Injection mirrors the fusion gate's tight.json
+    approach — a baseline demanding strictly fewer reshards than the
+    program has (the partitioner chooses its gather-vs-psum strategy by
+    size, so a model-shape injection would pin XLA internals instead of
+    the gate)."""
+    p = tmp_path / "tight.json"
+    p.write_text(json.dumps(
+        {"zero": {"implicit_reshards": -1, "reshard_bytes": -1,
+                  "tol_pct": 0}}))
+    monkeypatch.setenv("MXNET_SHARDING_BASELINE", f"{p}:zero")
+    step, x, y = _zero_setup(seed=11)
+    step._analyze = "raise"
+    with make_mesh({"dp": DP}, jax.devices()[:DP]) as mesh:
+        xs, ys = shard_batch(x, mesh), shard_batch(y, mesh)
+        with pytest.raises(MXNetError, match="sharding-regression"):
+            step(xs, ys)
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_sharding_gauges_published(zero_report):
+    names = telemetry.names
+    reg = telemetry.registry()
+    g = reg.get(names.SHARDING_RESHARDS)
+    assert g is not None and g.values()[""] == 0.0
+    cost = reg.get(names.SHARDING_COMM_COST)
+    assert cost is not None and cost.values().get("dp", 0) > 0
+    b = reg.get(names.SHARDING_COLLECTIVE_BYTES)
+    assert b is not None and b.values().get("dp", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# SPMD fusion-census accounting (satellite)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_fusion_census_stays_per_shard_at_dp4(zero_report):
+    """The dp=4 census pin: the partitioned module's shapes are already
+    per-shard, so the census FLOP total of the dp=4 program must come
+    in well BELOW the dp=1 program of the same logical model — global
+    logical shapes would put it at >= the dp=1 total."""
+    step1, x, y = _zero_setup(seed=3)
+    step1(x, y)
+    rep1 = step1.analyze(x, y)
+    f1 = rep1.fusion.total_flops
+    f4 = zero_report.fusion.total_flops
+    assert f4 < f1, (f4, f1)
+
+
+def test_fusion_census_divides_global_shape_sharded_module():
+    """An UNpartitioned num_partitions=4 module (no _spmd entry) still
+    carries global shapes + sharding annotations: the census must
+    divide annotated ops' FLOPs/bytes by their tile factor."""
+    tmpl = textwrap.dedent("""\
+    HloModule jit_g, is_scheduled=true, entry_computation_layout={(f32[64,64]{1,0})->f32[64,64]{1,0}}, num_partitions=4
+
+    ENTRY %main.9 (p0: f32[64,64]) -> f32[64,64] {
+      %p0 = f32[64,64]{1,0} parameter(0)
+      ROOT %dot.1 = f32[64,64]{1,0} dot(f32[64,64]{1,0} %p0, f32[64,64]{1,0} %p0), lhs_contracting_dims={1}, rhs_contracting_dims={0}%SHARD%
+    }
+    """)
+    plain = fusion_census(tmpl.replace("%SHARD%", ""))
+    sharded = fusion_census(tmpl.replace(
+        "%SHARD%", ", sharding={devices=[4,1]<=[4]}"))
+    [k0] = plain.kernels
+    [k4] = sharded.kernels
+    assert k4.flops == k0.flops // 4
+    assert k4.bytes_out == k0.bytes_out // 4
+    # a PARTITIONED module (entry *_spmd) is never rescaled
+    part = fusion_census(_CANNED_SPMD)
+    dot = [k for k in part.kernels if k.kind == "dot"][0]
+    assert dot.flops == 2 * 2 * 8 * 16        # the per-shard dot as-is
+
+
+# ---------------------------------------------------------------------------
+# MXA006 source lint (satellite)
+# ---------------------------------------------------------------------------
+
+_MXA006_SRC = textwrap.dedent("""\
+class Net:
+    def forward(self, x):
+        import jax
+        from jax import lax
+        a = jax.device_put(x)
+        b = place_on_mesh(x)
+        c = lax.psum(x, "dp")
+        d = jax.device_put(x, some_sharding)
+        e = place_on_mesh(mesh, "dp", x)
+        f = lax.all_gather(x, "dp")  # mx-lint: allow=MXA006
+        return a + b + c + d + e + f
+""")
+
+
+def test_mxa006_rules():
+    findings = [f for f in lint_source(_MXA006_SRC, "pkg/net.py")
+                if f.rule == "MXA006"]
+    by_line = {int(f.where.rsplit(":", 1)[1]): f for f in findings}
+    assert set(by_line) == {5, 6, 7, 10}
+    assert by_line[5].severity == "error"      # bare device_put
+    assert by_line[6].severity == "error"      # bare place_on_mesh
+    assert by_line[7].severity == "warn"       # raw lax collective
+    assert by_line[10].blessed                 # inline blessing
+    # explicit sharding / mesh+axis forms (lines 8-9) are clean
+    assert 8 not in by_line and 9 not in by_line
+
+
+def test_mxa006_exempts_collectives_home():
+    findings = lint_source(_MXA006_SRC,
+                           "mxnet_tpu/parallel/collectives.py")
+    raw = [f for f in findings if f.rule == "MXA006"
+           and "lax." in f.message]
+    assert raw == []
+
+
+# ---------------------------------------------------------------------------
+# tier-1 baseline sweep (lint-marked, like the fusion gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+@needs_mesh
+def test_sharding_baseline_sweep(zero_report):
+    """The checked-in reshard posture of the canonical zero-sharded
+    MLP: every collective implied by the spec pack, zero implicit
+    reshards — enforced against tests/fixtures/sharding_baselines.json
+    on every tier-1 run."""
+    baselines = asharding.load_baselines(BASELINES)
+    findings = asharding.check_baseline(zero_report.sharding,
+                                        baselines, "zero")
+    assert findings == [], [str(f) for f in findings]

@@ -45,6 +45,16 @@ def test_examples_are_jit_safe(lint_allowlist):
     _sweep("examples", lint_allowlist)
 
 
+def test_ops_and_parallel_forwards_are_jit_safe(lint_allowlist):
+    """The MXA006 surface: ops/ and parallel/ hold the framework's
+    collective patterns — any NEW forward that calls raw lax
+    collectives (instead of parallel/collectives.py) or places data
+    without an explicit sharding fails here (parallel/collectives.py
+    itself is exempt by rule)."""
+    _sweep(os.path.join("mxnet_tpu", "ops"), lint_allowlist)
+    _sweep(os.path.join("mxnet_tpu", "parallel"), lint_allowlist)
+
+
 def test_allowlist_entries_all_still_hit(lint_allowlist):
     """Every allowlist entry must still match a real finding — dead
     entries hide future violations at the same path."""

@@ -144,6 +144,21 @@ def test_diagnose_fusion_section(capsys):
     assert "stranded ops : none above the" in out
 
 
+def test_diagnose_sharding_section(capsys):
+    """--sharding: the zero-sharded MLP's sharding-flow table (buffers
+    with resolved layouts), the implicit-reshard verdict, and the
+    per-axis communication cost table."""
+    diagnose = _load("tools/diagnose.py", "diagnose_sh")
+    assert diagnose.main(["--sharding"]) == 0
+    out = capsys.readouterr().out
+    assert "Sharding Analysis" in out
+    assert "pack=zero-dp" in out
+    assert "P(dp)" in out                       # resolved state shard
+    assert "implicit reshards: none above the" in out
+    assert "axis 'dp':" in out                  # per-axis cost line
+    assert "table digest:" in out
+
+
 def test_diagnose_kernels_section(capsys):
     """--kernels: the per-kernel dispatch table (path + reason for
     every kernel the gate knows) and the interpret-vs-xla parity

@@ -535,6 +535,79 @@ def check_fusion():
         print("fusion check failed:", repr(e))
 
 
+def check_sharding():
+    """SPMD sharding-analysis health (docs/ANALYSIS.md "Sharding
+    analysis"): compile the zero-sharded MLP on the virtual dp mesh
+    and print its sharding-flow table (what layout every entry buffer
+    actually got), the top implicit reshards, and the per-mesh-axis
+    communication cost estimate."""
+    print("----------Sharding Analysis----------")
+    try:
+        import numpy as onp
+        import jax
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon import Trainer, nn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+        from mxnet_tpu.parallel import make_mesh, shard_batch
+        from mxnet_tpu.analysis import sharding as asharding
+
+        ndev = min(4, len(jax.devices()))
+        if ndev < 2:
+            print(f"only {ndev} device(s) — sharding analysis needs a "
+                  ">=2-device mesh (virtual CPU mesh: "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+            return
+        onp.random.seed(0)
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, in_units=16, activation="relu"),
+                nn.Dense(8, in_units=32))
+        net.initialize()
+        loss = SoftmaxCrossEntropyLoss()
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          kvstore=None)
+        step = trainer.compile_step(lambda a, b: loss(net(a), b))
+        x = mx.nd.array(onp.random.randn(8, 16).astype("float32"))
+        y = mx.nd.array(onp.random.randint(0, 8, size=(8,))
+                        .astype("int32"))
+        with make_mesh({"dp": ndev}, jax.devices()[:ndev]) as mesh:
+            xs, ys = shard_batch(x, mesh), shard_batch(y, mesh)
+            step(xs, ys)
+            report = step.analyze(xs, ys)
+        audit = report.sharding
+        if audit is None or audit.table is None:
+            print("no sharding audit available (eager path?)")
+            return
+        prof = asharding.bandwidth_profile()
+        print(f"mode={report.mode} dp={ndev} pack={audit.pack} "
+              f"profile={prof.name} ({prof.default_gbps} GB/s)")
+        print()
+        print("sharding-flow table (entry buffers):")
+        print(audit.table.table_str(top=16))
+        print()
+        if audit.reshards:
+            print("top implicit reshards (not implied by the spec):")
+            for r in audit.reshards[:5]:
+                print(f"  {r.name:<28s} {r.kind:<18s} "
+                      f"{r.payload_bytes:>9d} B payload "
+                      f"{r.wire_bytes:>9d} B wire  ~{r.seconds:.2e} s  "
+                      f"(from `{r.producer or '?'}`)")
+        else:
+            print("implicit reshards: none above the "
+                  f"{audit.reshard_floor} B floor — every collective "
+                  "is implied by the declared spec")
+        print()
+        print("per-axis communication cost (ring model):")
+        if audit.cost is not None:
+            print(audit.cost.table_str(top=8))
+        print()
+        print(f"table digest: {audit.table.digest()}  "
+              f"(pins layout identity across captures)")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("sharding check failed:", repr(e))
+
+
 def check_kernels():
     """Pallas kernel-layer health (docs/PERF_NOTES.md "Pallas kernel
     layer"): the MXNET_PALLAS dispatch decision (path + reason) for
@@ -748,6 +821,11 @@ def main(argv=None):
                         "tiny MLP and the LSTM-LM example: kernel "
                         "table (kind/ops/FLOPs/boundary bytes/bound "
                         "class) plus top stranded ops")
+    parser.add_argument("--sharding", action="store_true",
+                        help="also compile the zero-sharded MLP on the "
+                        "virtual dp mesh and print its sharding-flow "
+                        "table, top implicit reshards, and per-axis "
+                        "communication cost estimate")
     parser.add_argument("--kernels", action="store_true",
                         help="also print the Pallas kernel layer's "
                         "per-kernel dispatch decisions (pallas/"
@@ -782,6 +860,8 @@ def main(argv=None):
         check_numerics()
     if args.fusion:
         check_fusion()
+    if args.sharding:
+        check_sharding()
     if args.kernels:
         check_kernels()
     if args.serving:
