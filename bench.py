@@ -161,6 +161,15 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
            # MXNET_PALLAS dispatch (pallas/interpret/xla) — a perf
            # delta between captures must name its kernel path
            "kernel_path": _kernel_path()}
+    # autotune posture (docs/PERF_NOTES.md "Autotuner"): the legs run
+    # under MXNET_AUTOTUNE=cached, so a capture records WHICH tuned
+    # config (if any) produced its numbers, how many trials it cost
+    # (0 on replay), and the tuner's estimated win over the defaults —
+    # the next hardware re-capture ships its tuning provenance
+    at = getattr(loop.compiled_step, "autotune_result", None)
+    out.update(at.bench_dict() if at is not None else
+               {"autotune_config": None, "autotune_trials": None,
+                "autotune_delta_pct": None})
     log(f"bench[{tag}]: analysis {out}")
     return out
 
@@ -836,6 +845,12 @@ def bench_serving(dtype):
                     ("requests", "batches", "rows", "padded_rows",
                      "flush_full", "flush_timeout", "flush_idle",
                      "errors")},
+        # serving-scope autotune posture (tuned batcher knobs replayed
+        # from MXNET_AUTOTUNE_CACHE, or the defaults on a miss)
+        **(pred.autotune_result.bench_dict()
+           if getattr(pred, "autotune_result", None) is not None else
+           {"autotune_config": None, "autotune_trials": None,
+            "autotune_delta_pct": None}),
     }
 
 
@@ -844,6 +859,12 @@ def main():
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
     if dtype not in ("bf16", "fp32"):
         raise SystemExit(f"MXNET_BENCH_DTYPE must be bf16|fp32, got {dtype}")
+    # every leg runs under the autotune REPLAY gate: a tuned config
+    # persisted by an offline MXNET_AUTOTUNE=on pass is applied with
+    # zero trials, a miss runs the shipped defaults — the leg's
+    # {autotune_config, autotune_trials, autotune_delta_pct} fields
+    # record which happened (an explicit MXNET_AUTOTUNE wins)
+    os.environ.setdefault("MXNET_AUTOTUNE", "cached")
 
     # first-contact watchdog: a wedged accelerator tunnel hangs inside
     # PJRT init/dispatch with no Python-level timeout; fail fast with a

@@ -144,11 +144,12 @@ def _head_group(bh: int, block_q: int, block_k: int,
     overwrites it), 4 for the fused backward (s, p, dp, ds) — budgeting
     the backward as a single tile oversizes G and fails Mosaic lowering
     at large blocks."""
-    from .kernels import VMEM_TILE_BUDGET_BYTES
+    from .kernels import vmem_tile_budget
+    budget = vmem_tile_budget()
     g = 1
     while (g * 2 <= 8 and bh % (g * 2) == 0
            and g * 2 * block_q * block_k * 4 * n_tiles
-           <= VMEM_TILE_BUDGET_BYTES):
+           <= budget):
         g *= 2
     return g
 

@@ -38,15 +38,69 @@ import os
 from typing import Dict, Optional, Tuple
 
 __all__ = ["pallas_mode", "dispatch", "decisions", "dispatch_table",
-           "KERNELS", "VMEM_TILE_BUDGET_BYTES", "VMEM_BYTES_PER_CORE"]
+           "KERNELS", "VMEM_TILE_BUDGET_BYTES", "VMEM_BYTES_PER_CORE",
+           "vmem_tile_budget"]
 
 #: VMEM ceiling one kernel's CONCURRENT working-set tiles may claim —
 #: the budget ops.attention._head_group sizes head groups against, and
 #: the one rnn_scan sizes its timestep block against. ~16 MiB/core is
 #: the physical VMEM (v5e); 4 MiB leaves room for Mosaic's own double
-#: buffering of the streamed operands.
+#: buffering of the streamed operands. The DEFAULT: every kernel reads
+#: the live value through :func:`vmem_tile_budget` (env/autotune
+#: overridable), never this constant directly.
 VMEM_BYTES_PER_CORE = 16 * 1024 * 1024
 VMEM_TILE_BUDGET_BYTES = 4 * 1024 * 1024
+
+
+def vmem_tile_budget() -> int:
+    """THE tile-budget accessor — rnn_scan's timestep-block sizer,
+    attention's ``_head_group``, and the norm/opt_update row-block caps
+    all size against this one number, resolved as
+
+        autotune override > ``MXNET_VMEM_TILE_BUDGET`` > the default
+
+    (``tuning/space.py`` precedence), clamped to the physical
+    per-core VMEM. Hand-tuners and the autotuner turn the same knob."""
+    from ...tuning import space as _tspace
+    try:
+        v = int(_tspace.value("kernels.vmem_tile_budget",
+                              VMEM_TILE_BUDGET_BYTES))
+    except (TypeError, ValueError):
+        v = VMEM_TILE_BUDGET_BYTES
+    return max(64 * 1024, min(v, VMEM_BYTES_PER_CORE))
+
+
+def _register_tunables():
+    """Kernel-layer tunables, declared next to the constants they make
+    sweepable (docs/PERF_NOTES.md \"Autotuner\")."""
+    from ...tuning.space import Tunable, register
+    mib = 1024 * 1024
+    register(Tunable(
+        "kernels.vmem_tile_budget", default=VMEM_TILE_BUDGET_BYTES,
+        grid=(1 * mib, 2 * mib, 4 * mib, 8 * mib),
+        env="MXNET_VMEM_TILE_BUDGET", parse=lambda s: int(float(s)),
+        valid=lambda v, _c: 64 * 1024 <= int(v) <= VMEM_BYTES_PER_CORE,
+        seam="ops.kernels.vmem_tile_budget() -> rnn_scan block_t, "
+             "attention _head_group, norm/opt_update row blocks",
+        scope="train", affects_program=True,
+        doc="VMEM bytes one kernel's concurrent working-set tiles may "
+            "claim (<= physical VMEM/core)"))
+    register(Tunable(
+        "kernels.rnn_block_t", default=0,
+        grid=(0, 1, 2, 4, 8, 16),
+        valid=lambda v, _c: 0 <= int(v) <= 16,
+        seam="ops.kernels.rnn_scan._block_t() timesteps per grid step "
+             "(0 = auto-size against the VMEM budget)",
+        scope="train", affects_program=True,
+        doc="timesteps one Pallas rnn_scan grid step walks"))
+
+
+try:
+    _register_tunables()
+except Exception:    # pragma: no cover - tuning must never break ops
+    import logging
+    logging.getLogger("mxnet_tpu.tuning").debug(
+        "kernel tunable registration failed", exc_info=True)
 
 #: the kernel names the dispatch gate knows (bench/diagnose vocabulary)
 KERNELS = ("rnn_scan", "opt_update", "layernorm", "bias_gelu",

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import dispatch
+from . import dispatch, vmem_tile_budget
 
 __all__ = ["layer_norm", "bias_gelu", "norm_supported"]
 
@@ -41,6 +41,17 @@ _BLOCK_ROWS = 256
 
 def _pad_to(n, m):
     return -(-n // m) * m
+
+
+def _budget_rows(cp: int, n_tiles: int = 4) -> int:
+    """Row-block cap from the SHARED VMEM tile budget
+    (ops/kernels.vmem_tile_budget — the same accessor rnn_scan and
+    attention size against): ``n_tiles`` concurrent (rows, cp) f32
+    tiles (x, dy, dx + the output) must fit. At the default 4 MiB
+    budget this only binds for very wide feature axes — the 256-row
+    Mosaic-program cap stays the usual limit."""
+    rows = vmem_tile_budget() // max(1, n_tiles * cp * 4)
+    return max(8, (rows // 8) * 8)
 
 
 def norm_supported(x, c: int) -> "str | None":
@@ -62,7 +73,8 @@ def _rows_layout(x, c):
         r *= int(d)
     cp = _pad_to(c, _LANES)
     sub = 16 if x.dtype == jnp.bfloat16 else 8
-    block_r = min(_BLOCK_ROWS, _pad_to(max(r, 1), sub))
+    block_r = min(_BLOCK_ROWS, max(sub, _budget_rows(cp)),
+                  _pad_to(max(r, 1), sub))
     rp = _pad_to(max(r, 1), block_r)
     x2 = jnp.pad(x.reshape(r, c), ((0, rp - r), (0, cp - c)))
     return x2, r, rp, cp, block_r

@@ -30,12 +30,22 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from . import dispatch
+from . import dispatch, vmem_tile_budget
 
 __all__ = ["kernel_step_fn", "unit_update", "opt_kernel_kind"]
 
 _LANES = 128
 _BLOCK_ROWS = 256            # (256, 128) f32 blocks = 128 KiB per ref
+
+
+def _block_rows_cap() -> int:
+    """Row-block cap from the SHARED VMEM tile budget (the accessor
+    rnn_scan/attention/norm also size against): up to ~8 concurrent
+    (rows, 128) f32 tiles live at once (w, g, m, v, the outputs, the
+    per-element hparam vectors). At the default 4 MiB budget the
+    256-row Mosaic cap stays the binding limit."""
+    rows = vmem_tile_budget() // max(1, 8 * _LANES * 4)
+    return min(_BLOCK_ROWS, max(8, (rows // 8) * 8))
 
 
 def _pad2d(flat, rows, dtype=None, fill=0):
@@ -145,7 +155,7 @@ def unit_update(kind: str, cfg: dict, w, g, lr, wd, t, rescale, clip,
 
     p = int(w.shape[0])
     rows = -(-p // _LANES)
-    block_r = min(_BLOCK_ROWS, -(-rows // 8) * 8)
+    block_r = min(_block_rows_cap(), -(-rows // 8) * 8)
     rows = -(-rows // block_r) * block_r
     grid = rows // block_r
     vec = getattr(lr, "ndim", 0) >= 1

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import VMEM_TILE_BUDGET_BYTES, dispatch
+from . import dispatch, vmem_tile_budget
 
 __all__ = ["rnn_scan", "scan_supported"]
 
@@ -60,20 +60,30 @@ def _pad_to(n: int, m: int) -> int:
 
 def _block_t(seq: int, np_: int, g: int, hp: int, itemsize: int,
              interpret: bool) -> int:
-    """Timesteps per grid step. On TPU: sized so the CONCURRENT
-    per-step tiles (xw in, ys/cs out, plus the backward's
+    """Timesteps per grid step. On TPU: the ``kernels.rnn_block_t``
+    tunable when set (autotune override; 0 = auto), else sized so the
+    CONCURRENT per-step tiles (xw in, ys/cs out, plus the backward's
     dys/dxw/hprev set — budgeted as ~2 gate-wide + 6 hidden-wide
-    tiles) fit the shared VMEM tile budget ops.attention._head_group
-    also sizes against. In interpret mode: 1, so the grid loop mirrors
-    the lax.scan reference's one-step body structure — that is what
-    makes the fp32 forward BIT-identical (XLA re-fuses a multi-step
-    unrolled body differently, which costs a ulp)."""
+    tiles) fit the shared VMEM tile budget (``vmem_tile_budget()`` —
+    ops.attention._head_group sizes against the same accessor). In
+    interpret mode: 1, so the grid loop mirrors the lax.scan
+    reference's one-step body structure — that is what makes the fp32
+    forward BIT-identical (XLA re-fuses a multi-step unrolled body
+    differently, which costs a ulp)."""
     if _FORCE_BLOCK_T is not None:
         return int(min(_FORCE_BLOCK_T, max(1, seq)))
     if interpret:
         return 1
+    from ...tuning import space as _tspace
+    tuned = _tspace.value("kernels.rnn_block_t", 0)
+    try:
+        tuned = int(tuned)
+    except (TypeError, ValueError):
+        tuned = 0
+    if tuned > 0:
+        return int(min(tuned, _MAX_BLOCK_T, max(1, seq)))
     per_step = np_ * (2 * g * hp + 6 * hp) * itemsize
-    bt = max(1, VMEM_TILE_BUDGET_BYTES // max(1, per_step))
+    bt = max(1, vmem_tile_budget() // max(1, per_step))
     return int(min(bt, _MAX_BLOCK_T, max(1, seq)))
 
 

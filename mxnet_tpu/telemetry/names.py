@@ -150,6 +150,14 @@ SHARDING_COLLECTIVE_BYTES = "mx_sharding_collective_bytes"
 KERNEL_DISPATCH = "mx_kernel_dispatch_total"
 
 # ---------------------------------------------------------------------------
+# self-tuning performance autopilot (tuning/)
+# ---------------------------------------------------------------------------
+AUTOTUNE_TRIALS = "mx_autotune_trials_total"
+AUTOTUNE_CACHE_HITS = "mx_autotune_cache_hits_total"
+AUTOTUNE_CACHE_MISSES = "mx_autotune_cache_misses_total"
+AUTOTUNE_ACTIVE = "mx_autotune_active_config"
+
+# ---------------------------------------------------------------------------
 # inference serving engine (serving/batcher.py)
 # ---------------------------------------------------------------------------
 SERVING_REQUESTS = "mx_serving_requests_total"
@@ -388,6 +396,25 @@ CATALOG = {
              "(pallas = compiled TPU kernel, interpret = kernel body "
              "under pallas interpret mode, xla = reference fallback; "
              "MXNET_PALLAS gate, docs/PERF_NOTES.md)"),
+    AUTOTUNE_TRIALS: dict(
+        kind="counter", label="backend",
+        help="autotune candidate measurements by backend (timed = "
+             "live warmup+measured executions, analytical = "
+             "cost_analysis/memory model scoring; docs/PERF_NOTES.md "
+             "\"Autotuner\")"),
+    AUTOTUNE_CACHE_HITS: dict(
+        kind="counter", label=None,
+        help="autotune config-DB hits: a persisted winner replayed "
+             "with zero trials (MXNET_AUTOTUNE_CACHE)"),
+    AUTOTUNE_CACHE_MISSES: dict(
+        kind="counter", label=None,
+        help="autotune config-DB misses (mode=on searches; "
+             "mode=cached falls back to the shipped defaults)"),
+    AUTOTUNE_ACTIVE: dict(
+        kind="gauge", label="tunable",
+        help="active tuned-config info gauge: one series per applied "
+             "tunable override (numeric values verbatim, choice "
+             "values as their grid index)"),
     SERVING_REQUESTS: dict(
         kind="counter", label=None,
         help="inference requests submitted to any DynamicBatcher"),

@@ -1,0 +1,320 @@
+"""mx.tuning — the self-tuning performance autopilot.
+
+Every hot-path tunable PRs 1-12 shipped as a hand-picked constant — the
+Pallas VMEM tile budget and rnn timestep block, the in-flight window
+depth, the ZeRO bucket floor, the serving coalescing knobs — is now a
+declared :class:`~mxnet_tpu.tuning.space.Tunable` with a candidate
+grid, a validity predicate, and the consumer seam it feeds. This
+package closes the loop the observability stack (PRs 6-9) made
+possible: *measure* each candidate (live step timing on hardware,
+``cost_analysis``/``memory_analysis``-based scoring on CPU/CI),
+*search* the joint space (budget-bounded coordinate descent with
+successive halving, faulting candidates scored infeasible through the
+PR 11 taxonomy), *persist* winners keyed by the program's compile-
+cache-style signature so a restarted job replays its tuned config with
+zero trials.
+
+Gating — ``MXNET_AUTOTUNE``:
+
+- ``off`` (default): nothing happens; every seam resolves env > the
+  shipped default, exactly as before this package existed;
+- ``cached``: cached winners replay (0 trials); a cache miss falls
+  back to the defaults WITHOUT searching — the production setting
+  (and the bench default): pay trials on the tuning box, never in the
+  serving/training fleet;
+- ``on``: cache miss runs the search (≤ ``MXNET_AUTOTUNE_BUDGET_
+  TRIALS`` measurements), persists the winner to
+  ``MXNET_AUTOTUNE_CACHE``, applies it.
+
+Entry points: ``Trainer.compile_step(autotune=...)`` tunes on the
+first step call (when a real batch pins the shape bucket);
+``CompiledPredictor.warmup(autotune=...)`` tunes before AOT-compiling
+the buckets. Both default the flag to the env gate, so arming
+``MXNET_AUTOTUNE`` ambiently covers TrainLoop/bench/serving without
+code changes.
+
+Tunables never change numerics — only speed. The timed backend
+snapshots and restores the full train state around its trials, the
+analytical backend never executes the program at all, and
+tests/test_tuning.py pins tuned-vs-default loss bit-exactness.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import time as _time
+from typing import Any, Dict, Optional
+
+from . import cache, measure, search, space
+from .cache import (AutotuneCache, cache_path, default_cache,
+                    predictor_signature, signature_key, step_signature)
+from .measure import (AnalyticalPredictorBackend, AnalyticalStepBackend,
+                      MeasureResult, TimedPredictorBackend,
+                      TimedStepBackend, backend_mode)
+from .search import SearchResult, Trial, coordinate_search
+from .space import SearchSpace, Tunable
+
+__all__ = ["space", "measure", "search", "cache", "Tunable",
+           "SearchSpace", "MeasureResult", "SearchResult", "Trial",
+           "AutotuneCache", "AutotuneOutcome", "autotune_mode",
+           "budget_trials", "tune_step", "tune_predictor",
+           "outcomes", "last_outcome", "coordinate_search",
+           "step_signature", "predictor_signature", "signature_key",
+           "cache_path", "default_cache", "backend_mode"]
+
+_LOG = logging.getLogger("mxnet_tpu.tuning")
+
+
+def autotune_mode(explicit: Optional[str] = None) -> str:
+    """Normalized gate: ``off`` | ``cached`` | ``on``. ``explicit``
+    (the ``autotune=`` kwarg) wins over ``MXNET_AUTOTUNE``."""
+    v = explicit if explicit is not None \
+        else os.environ.get("MXNET_AUTOTUNE", "")
+    if isinstance(v, bool):
+        return "on" if v else "off"
+    v = str(v).strip().lower()
+    if v in ("on", "1", "true", "yes", "search"):
+        return "on"
+    if v in ("cached", "cache", "replay"):
+        return "cached"
+    return "off"
+
+
+def budget_trials(default: int = 32) -> int:
+    """``MXNET_AUTOTUNE_BUDGET_TRIALS`` — total measurements one
+    search may spend (the default-config baseline is trial #1)."""
+    try:
+        v = int(os.environ.get("MXNET_AUTOTUNE_BUDGET_TRIALS",
+                               str(default)))
+    except (TypeError, ValueError):
+        return default
+    return max(1, v)
+
+
+class AutotuneOutcome:
+    """What one entry-point invocation did — the record bench/diagnose
+    attach next to the kernel/fusion posture."""
+
+    def __init__(self, mode: str, source: str, key: Optional[str] = None,
+                 backend: Optional[str] = None,
+                 config: Optional[Dict[str, Any]] = None,
+                 trials: int = 0, delta_pct: Optional[float] = None,
+                 score: Optional[float] = None,
+                 default_score: Optional[float] = None):
+        self.mode = mode          # off | cached | on
+        self.source = source      # off | cache | default | search
+        self.key = key
+        self.backend = backend
+        self.config = dict(config or {})   # the applied NON-default slice
+        self.trials = int(trials)
+        self.delta_pct = delta_pct
+        self.score = score
+        self.default_score = default_score
+
+    def to_dict(self) -> dict:
+        return {"mode": self.mode, "source": self.source,
+                "key": self.key, "backend": self.backend,
+                "config": self.config, "trials": self.trials,
+                "delta_pct": self.delta_pct}
+
+    def bench_dict(self) -> dict:
+        """The three fields the BENCH json carries per leg."""
+        return {"autotune_config": self.config,
+                "autotune_trials": self.trials,
+                "autotune_delta_pct": self.delta_pct}
+
+    def __repr__(self):
+        return (f"AutotuneOutcome({self.source}, trials={self.trials}, "
+                f"config={self.config})")
+
+
+_OUTCOMES: list = []
+
+
+def outcomes() -> list:
+    """Every AutotuneOutcome this process produced, oldest first."""
+    return list(_OUTCOMES)
+
+
+def last_outcome() -> Optional[AutotuneOutcome]:
+    return _OUTCOMES[-1] if _OUTCOMES else None
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def _telemetry():
+    from .. import telemetry as _t
+    return _t
+
+
+def _publish_active(config: Dict[str, Any]):
+    """``mx_autotune_active_config{tunable}`` info gauge: numeric
+    values verbatim, non-numeric ones as their grid index (the gauge
+    says WHICH candidate is live; the cache record holds the value)."""
+    try:
+        t = _telemetry()
+        g = t.registry().gauge(t.names.AUTOTUNE_ACTIVE,
+                               label_key="tunable")
+        for name, v in config.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                tn = space.get(name)
+                try:
+                    v = tn.grid.index(v) if tn else 1
+                except ValueError:
+                    v = -1
+            g.set(float(v), label=name)
+    except Exception:            # pragma: no cover - telemetry guard
+        _LOG.debug("active-config gauge publish failed", exc_info=True)
+
+
+def _count(counter_name: str, label: Optional[str] = None, n: int = 1):
+    try:
+        t = _telemetry()
+        c = t.registry().counter(
+            counter_name,
+            label_key="backend" if label is not None else None)
+        c.inc(n, label=label) if label is not None else c.inc(n)
+    except Exception:            # pragma: no cover - telemetry guard
+        _LOG.debug("autotune counter failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def _tune(scope: str, key: str, make_backend, mode: str,
+          budget: Optional[int], db: Optional[AutotuneCache],
+          snapshot_state=None) -> AutotuneOutcome:
+    t = _telemetry()
+    db = db or default_cache()
+    rec = db.get(key)
+    if rec is not None and isinstance(rec.get("config"), dict):
+        _count(t.names.AUTOTUNE_CACHE_HITS)
+        config = dict(rec["config"])
+        space.apply_config(config)
+        _publish_active(config)
+        out = AutotuneOutcome(mode, "cache", key=key,
+                              backend=rec.get("backend"),
+                              config=config, trials=0,
+                              delta_pct=rec.get("delta_pct"),
+                              score=rec.get("score"),
+                              default_score=rec.get("default_score"))
+        _OUTCOMES.append(out)
+        _LOG.info("autotune[%s]: cache HIT %s -> %r", scope, key[:12],
+                  config)
+        return out
+    _count(t.names.AUTOTUNE_CACHE_MISSES)
+    if mode != "on":
+        # cached-mode miss: the defaults run, zero trials — production
+        # never pays measurement cost it did not opt into
+        out = AutotuneOutcome(mode, "default", key=key, trials=0)
+        _OUTCOMES.append(out)
+        _LOG.info("autotune[%s]: cache MISS %s (mode=cached; defaults)",
+                  scope, key[:12])
+        return out
+    backend = make_backend()
+    tunables = space.tunables(scope)
+    budget = budget if budget is not None else budget_trials()
+
+    def on_trial(trial):
+        _count(t.names.AUTOTUNE_TRIALS, label=backend.name)
+
+    state = None
+    if snapshot_state is not None and not backend.deterministic:
+        state = snapshot_state()
+    try:
+        result = coordinate_search(tunables, backend, budget,
+                                   on_trial=on_trial)
+    finally:
+        if state is not None:
+            state()
+    tuned = result.tuned_overrides()
+    db.put(key, {
+        "config": tuned, "score":
+            None if not math.isfinite(result.best_score)
+            else result.best_score,
+        "default_score":
+            None if not math.isfinite(result.default_score)
+            else result.default_score,
+        "delta_pct": result.delta_pct, "trials": result.n_trials,
+        "backend": backend.name, "scope": scope,
+        "space": space.space_signature(scope),
+        "created": _time.time(),
+        "trial_log": [tr.to_dict() for tr in result.trials],
+    })
+    space.apply_config(tuned)
+    _publish_active(tuned)
+    out = AutotuneOutcome(mode, "search", key=key,
+                          backend=backend.name, config=tuned,
+                          trials=result.n_trials,
+                          delta_pct=result.delta_pct,
+                          score=result.best_score,
+                          default_score=result.default_score)
+    _OUTCOMES.append(out)
+    _LOG.info("autotune[%s]: searched %d trials, tuned=%r "
+              "(delta %s%%), persisted %s", scope, result.n_trials,
+              tuned, result.delta_pct, key[:12])
+    return out
+
+
+def tune_step(step, args, kwargs=None, batch_size: Optional[int] = None,
+              mode: Optional[str] = None, budget: Optional[int] = None,
+              db: Optional[AutotuneCache] = None) -> AutotuneOutcome:
+    """Tune one ``CompiledTrainStep`` for the shape bucket ``args``
+    pins. Called by the step itself on its first ``__call__`` when
+    ``compile_step(autotune=)``/``MXNET_AUTOTUNE`` arms it; callable
+    directly for explicit offline tuning. Applies (and, after a
+    search, persists) the winning config as tuned overrides; returns
+    the :class:`AutotuneOutcome`."""
+    mode = autotune_mode(mode)
+    if mode == "off":
+        return AutotuneOutcome("off", "off")
+    space.ensure_registered()
+    kwargs = kwargs or {}
+    key = step_signature(step, args, kwargs)
+    tunables = space.tunables("train")
+
+    def make_backend():
+        return measure.select_step_backend(
+            step, args, kwargs, batch_size=batch_size,
+            tunables=tunables)
+
+    def snapshot_state():
+        # timed trials EXECUTE real steps: capture the full train
+        # state (params, fused/zero optimizer state, counters, RNG)
+        # and hand back the restore thunk — tuning must not move the
+        # model (docs/PERF_NOTES.md "Autotuner")
+        from ..checkpoint.state import (apply_train_state,
+                                        capture_train_state)
+        st = capture_train_state(trainer=step._trainer)
+
+        def restore():
+            apply_train_state(st, trainer=step._trainer)
+        return restore
+
+    return _tune("train", key, make_backend, mode, budget, db,
+                 snapshot_state=snapshot_state)
+
+
+def tune_predictor(pred, example, mode: Optional[str] = None,
+                   budget: Optional[int] = None,
+                   db: Optional[AutotuneCache] = None) -> AutotuneOutcome:
+    """Tune one ``CompiledPredictor`` deployment's serving knobs from
+    an example request. Called by ``warmup(autotune=)``; the tuned
+    overrides govern any :class:`~mxnet_tpu.serving.DynamicBatcher`
+    constructed afterwards."""
+    mode = autotune_mode(mode)
+    if mode == "off":
+        return AutotuneOutcome("off", "off")
+    space.ensure_registered()
+    key = predictor_signature(pred, example)
+    tunables = space.tunables("serving")
+
+    def make_backend():
+        return measure.select_predictor_backend(pred, example,
+                                                tunables=tunables)
+
+    return _tune("serving", key, make_backend, mode, budget, db)

@@ -1,0 +1,214 @@
+"""Persistent autotune-config DB — winners keyed by program identity.
+
+A tuned config is only worth its trials if a *restarted* job replays it
+for free: the DB maps a signature key — compile-cache-style program
+identity (param/input shapes+dtypes, step mode), mesh shape, jax
+backend, and the tunable-space version — to the winning config plus its
+provenance (trial count, score vs default, backend, timestamp). Keys
+are content hashes, so any drift in what was tuned (a model edit, a
+different dp size, a grid change in the space) is a MISS, never a
+silently-wrong replay.
+
+Storage is one JSON file (``MXNET_AUTOTUNE_CACHE``) written atomically
+(tmp + fsync + rename — the checkpoint stack's
+:func:`~mxnet_tpu.checkpoint.atomic.atomic_write_bytes`); with the env
+unset the DB is process-local memory, which still de-duplicates
+repeated tuning inside one job. Concurrent writers last-write-win at
+file granularity — each ``put`` re-reads, merges, and rewrites, so two
+jobs tuning DIFFERENT programs into one shared file both land.
+"""
+from __future__ import annotations
+
+import json
+import hashlib
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["AutotuneCache", "cache_path", "default_cache",
+           "signature_key", "step_signature", "predictor_signature",
+           "CACHE_SCHEMA"]
+
+_LOG = logging.getLogger("mxnet_tpu.tuning")
+
+CACHE_SCHEMA = 1
+
+
+def cache_path() -> Optional[str]:
+    """``MXNET_AUTOTUNE_CACHE`` — path of the persistent config DB
+    (None = in-memory only)."""
+    p = os.environ.get("MXNET_AUTOTUNE_CACHE", "").strip()
+    return p or None
+
+
+class AutotuneCache:
+    """Atomic JSON config DB. ``path=None`` = memory-only."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._mem: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # ------------- file half -------------
+    def _read_file(self) -> Dict[str, dict]:
+        if not self.path or not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("schema") != CACHE_SCHEMA:
+                _LOG.warning("autotune cache %s: schema %r != %d; "
+                             "ignoring", self.path, doc.get("schema"),
+                             CACHE_SCHEMA)
+                return {}
+            entries = doc.get("entries")
+            return entries if isinstance(entries, dict) else {}
+        except (OSError, ValueError) as e:
+            # a corrupt/truncated DB costs a re-tune, never a crash
+            _LOG.warning("autotune cache %s unreadable (%s: %s); "
+                         "treating as empty", self.path,
+                         type(e).__name__, e)
+            return {}
+
+    def _write_file(self, entries: Dict[str, dict]):
+        from ..checkpoint.atomic import atomic_write_bytes
+        data = json.dumps({"schema": CACHE_SCHEMA, "entries": entries},
+                          indent=1, sort_keys=True).encode()
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        atomic_write_bytes(self.path, data, fault="autotune.cache")
+
+    # ------------- API -------------
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            if key in self._mem:
+                return dict(self._mem[key])
+        rec = self._read_file().get(key)
+        if rec is not None:
+            with self._lock:
+                self._mem[key] = dict(rec)
+            return dict(rec)
+        return None
+
+    def put(self, key: str, record: dict):
+        """Persist one winner (read-merge-rewrite when file-backed)."""
+        rec = dict(record)
+        with self._lock:
+            self._mem[key] = dict(rec)
+        if not self.path:
+            return
+        with self._lock:
+            entries = self._read_file()
+            entries[key] = rec
+            try:
+                self._write_file(entries)
+            except OSError as e:   # pragma: no cover - fs-dependent
+                _LOG.warning("autotune cache write failed (%s: %s); "
+                             "config kept in-memory only",
+                             type(e).__name__, e)
+
+    def keys(self):
+        entries = self._read_file()
+        with self._lock:
+            return sorted(set(entries) | set(self._mem))
+
+
+_DEFAULT: Optional[AutotuneCache] = None
+_DEFAULT_PATH: Optional[str] = None
+_DLOCK = threading.Lock()
+
+
+def default_cache() -> AutotuneCache:
+    """Process-default cache bound to the CURRENT
+    ``MXNET_AUTOTUNE_CACHE`` (re-bound when the env changes — tests
+    monkeypatch it per case)."""
+    global _DEFAULT, _DEFAULT_PATH
+    p = cache_path()
+    with _DLOCK:
+        if _DEFAULT is None or p != _DEFAULT_PATH:
+            _DEFAULT = AutotuneCache(p)
+            _DEFAULT_PATH = p
+    return _DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# signature keys
+# ---------------------------------------------------------------------------
+
+def signature_key(program_sig: str, mesh_shape: Any, backend: str,
+                  space_sig: str) -> str:
+    """The DB key: (compile-cache-style program signature, mesh shape,
+    jax backend, tunable-space version) content-hashed."""
+    raw = f"{program_sig}|mesh={mesh_shape!r}|{backend}|{space_sig}"
+    return hashlib.sha1(raw.encode()).hexdigest()
+
+
+def _backend_and_mesh(mesh=None):
+    import jax
+    backend = jax.default_backend()
+    shape = None
+    if mesh is not None:
+        try:
+            shape = tuple(sorted(dict(mesh.shape).items()))
+        except Exception:
+            shape = repr(getattr(mesh, "shape", None))
+    return backend, shape
+
+
+def step_signature(step, args, kwargs=None, scope: str = "train") -> str:
+    """Stable-across-processes identity of one ``CompiledTrainStep``
+    program + its input-shape bucket: every parameter's (shape, dtype)
+    in binding order, the traced input leaves' (shape, dtype), the
+    train/numerics/zero configuration, mesh shape and jax backend, and
+    the space signature. Anything that would compile a different
+    program (or change which seams exist) changes the key."""
+    from . import space as _space
+    kwargs = kwargs or {}
+    parts = ["step"]
+    for p in step._all_params:
+        d = p._data._data if p._data is not None else None
+        parts.append(f"p:{None if d is None else (tuple(d.shape), str(d.dtype))}")
+    try:
+        traced, _treedef, static_spec, _mask = step._flatten(args, kwargs)
+        for l in traced:
+            d = l._data if hasattr(l, "_data") else l
+            parts.append(f"x:{tuple(d.shape)}:{d.dtype}")
+        parts.append(f"static:{static_spec!r}")
+    except Exception:            # pragma: no cover - defensive
+        parts.append(f"x:<unflattenable:{len(args)},{sorted(kwargs)}>")
+    parts.append(f"train:{step._train}")
+    parts.append(f"numerics:{step._numerics}")
+    parts.append(f"zero:{step._zero_requested}:{step._zero_axis}")
+    opt = step._trainer._optimizer
+    parts.append(f"opt:{type(opt).__name__}")
+    mesh = step._zero_mesh
+    if mesh is None:
+        try:
+            from ..parallel.mesh import current_mesh
+            mesh = current_mesh()
+        except Exception:        # pragma: no cover - defensive
+            mesh = None
+    backend, mesh_shape = _backend_and_mesh(mesh)
+    return signature_key("|".join(parts), mesh_shape, backend,
+                         _space.space_signature(scope))
+
+
+def predictor_signature(pred, example, scope: str = "serving") -> str:
+    """Identity of one ``CompiledPredictor`` deployment: param
+    (shape, dtype)s, the example request's leaf shapes (minus the
+    bucketed leading dim), the bucket ladder, backend, space."""
+    from . import space as _space
+    parts = ["predict"]
+    for p in pred._params:
+        d = p._data._data
+        parts.append(f"p:{tuple(d.shape)}:{d.dtype}")
+    for l in example:
+        d = getattr(l, "_data", l)
+        shp = tuple(getattr(d, "shape", ()))
+        parts.append(f"x:{shp[1:] if shp else ()}:"
+                     f"{getattr(d, 'dtype', type(l).__name__)}")
+    parts.append(f"buckets:{pred.bucket_sizes}")
+    backend, mesh_shape = _backend_and_mesh(None)
+    return signature_key("|".join(parts), mesh_shape, backend,
+                         _space.space_signature(scope))

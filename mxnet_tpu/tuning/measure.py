@@ -1,0 +1,471 @@
+"""Measurement backends — how one candidate config becomes one score.
+
+Two backends behind one interface (``measure(config, fidelity) ->
+MeasureResult``, score = estimated/measured SECONDS per step or per
+request row, lower is better):
+
+- **timed** (:class:`TimedStepBackend` / :class:`TimedPredictorBackend`)
+  — hardware truth: apply the candidate, run K warmup + N measured
+  executions of the real compiled program through a real
+  :class:`~mxnet_tpu.engine.DispatchWindow`, read the wall clock at the
+  drain (the same retire-to-retire quantity the
+  ``mx_step_time_seconds`` watchdog gauges). ``fidelity`` scales N —
+  the successive-halving rungs re-measure survivors longer.
+- **analytical** (:class:`AnalyticalStepBackend` /
+  :class:`AnalyticalPredictorBackend`) — CPU/CI truth: score candidates
+  from the compiled program's ``cost_analysis`` FLOPs and
+  ``memory_analysis`` traffic against the checked-in roofline
+  (analysis/fusion.py), plus closed-form models of the knobs the
+  program itself cannot express — dispatch-overhead amortization over
+  the in-flight window, per-collective latency over the ZeRO unit
+  count, coalescing delay over the serving batch knobs. Deterministic:
+  the same space always picks the same winner, which is what lets
+  tier-1 exercise the full closed loop bit-reproducibly.
+
+A candidate that FAILS — OOM, device loss, Mosaic lowering error — is
+scored infeasible (``feasible=False``, score=inf) via the PR 11 failure
+taxonomy (``elastic.detect.classify``) instead of killing the search;
+the ``autotune.trial`` fault point brackets every measurement so the
+chaos harness can inject exactly that.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Dict, Optional
+
+from . import space as _space
+from ..testing.faults import fault_point
+
+__all__ = ["MeasureResult", "TimedStepBackend", "AnalyticalStepBackend",
+           "TimedPredictorBackend", "AnalyticalPredictorBackend",
+           "backend_mode", "select_step_backend",
+           "select_predictor_backend", "HOST_DISPATCH_S",
+           "COLLECTIVE_LAT_S"]
+
+_LOG = logging.getLogger("mxnet_tpu.tuning")
+
+#: per-step host dispatch overhead the in-flight window amortizes
+#: (PR 1 measured the fused CPU MLP step at ~0.27 ms host-side; the
+#: window overlaps it with device compute: overhead / (1 + W))
+HOST_DISPATCH_S = 300e-6
+
+#: fixed launch latency per collective op (ring setup, not wire bytes —
+#: those are in the program's memory traffic already); the ZeRO bucket
+#: floor trades this count against update-fusion granularity
+COLLECTIVE_LAT_S = 5e-6
+
+INFEASIBLE = float("inf")
+
+
+class MeasureResult:
+    """One trial's verdict: ``score`` seconds (lower is better; inf
+    when infeasible), the feasibility flag + reason, and the backend's
+    term breakdown for the BENCH/diagnose provenance."""
+
+    def __init__(self, score: float, feasible: bool = True,
+                 reason: str = "", detail: Optional[dict] = None):
+        self.score = float(score)
+        self.feasible = bool(feasible)
+        self.reason = reason
+        self.detail = detail or {}
+
+    @classmethod
+    def infeasible(cls, reason: str) -> "MeasureResult":
+        return cls(INFEASIBLE, feasible=False, reason=reason)
+
+    def __repr__(self):
+        if not self.feasible:
+            return f"MeasureResult(infeasible: {self.reason})"
+        return f"MeasureResult({self.score:.3e}s)"
+
+
+def _classify(exc: BaseException) -> str:
+    try:
+        from ..elastic import detect as _d
+        return _d.classify(exc)
+    except Exception:            # pragma: no cover - defensive
+        return "fatal"
+
+
+def guarded_measure(backend, config: Dict[str, Any],
+                    fidelity: int = 1) -> MeasureResult:
+    """Run one measurement with the full fault discipline: the
+    ``autotune.trial`` chaos seam brackets it, and ANY failure becomes
+    an infeasible score tagged with the PR 11 failure class — an OOM
+    or device-lost candidate must never kill the search (the NEXT
+    candidate may be fine; that is the point of searching)."""
+    try:
+        fault_point("autotune.trial", "before")
+        out = backend.measure(config, fidelity=fidelity)
+        fault_point("autotune.trial", "after")
+        return out
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:
+        kind = _classify(e)
+        _LOG.warning("autotune: candidate %r infeasible (%s: %s: %s)",
+                     config, kind, type(e).__name__, e)
+        return MeasureResult.infeasible(
+            f"{kind}: {type(e).__name__}: {e}")
+
+
+# ---------------------------------------------------------------------------
+# shared analytical constants
+# ---------------------------------------------------------------------------
+
+def _roofline():
+    from ..analysis import fusion as _f
+    return (_f.BENCH_ROOFLINE_TFLOPS * 1e12,
+            _f.HBM_BANDWIDTH_GBPS * 1e9)
+
+
+def _cfg_value(config: Dict[str, Any], name: str):
+    if name in config:
+        return config[name]
+    t = _space.get(name)
+    return t.resolve() if t is not None else None
+
+
+# ---------------------------------------------------------------------------
+# train-step backends
+# ---------------------------------------------------------------------------
+
+class _FreshPrograms:
+    """Build trial programs in a scratch bucket cache: snapshot the
+    step's compiled-bucket state, clear it so the next lower sees the
+    TRIAL config, restore everything on exit — an autotune probe is not
+    a training retrace and must not leave trial programs (or their
+    signatures) behind."""
+
+    def __init__(self, step):
+        self._step = step
+
+    def __enter__(self):
+        s = self._step
+        self._saved = (s._lru, set(s._trace_signatures),
+                       list(s._sig_history), s._n_traces)
+        from collections import OrderedDict
+        s._lru = OrderedDict()
+        return self
+
+    def __exit__(self, *exc):
+        s = self._step
+        (s._lru, s._trace_signatures, s._sig_history,
+         s._n_traces) = self._saved
+        return False
+
+
+def _program_key(config: Dict[str, Any], tunables) -> tuple:
+    """The program-affecting slice of a candidate — probes are cached
+    per distinct value of this (knobs that cannot change the compiled
+    program on this backend share one probe)."""
+    return tuple((t.name, config.get(t.name, t.default))
+                 for t in tunables if t.affects_program)
+
+
+class AnalyticalStepBackend:
+    """Deterministic score for one ``CompiledTrainStep`` bucket:
+
+    ``max(flops/F, traffic/B)``  (the program on the roofline)
+    ``+ HOST_DISPATCH_S / (1 + inflight)``  (window amortization)
+    ``+ n_zero_units(min_size) * COLLECTIVE_LAT_S``  (collective count)
+
+    The program term comes from ONE lower+compile per distinct
+    program-affecting config slice (``cost_analysis`` FLOPs +
+    ``memory_analysis`` argument/output/temp bytes), probed inside a
+    :class:`_FreshPrograms` scratch so trials never pollute the live
+    bucket cache."""
+
+    name = "analytical"
+    deterministic = True
+
+    def __init__(self, step, args, kwargs=None,
+                 batch_size: Optional[int] = None, tunables=()):
+        self._step = step
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._batch_size = batch_size
+        self._tunables = tuple(tunables)
+        self._probes: Dict[tuple, dict] = {}
+
+    def _probe(self, config: Dict[str, Any]) -> dict:
+        key = _program_key(config, self._tunables)
+        hit = self._probes.get(key)
+        if hit is not None:
+            return hit
+        step = self._step
+        with _space.trial(config), _FreshPrograms(step):
+            info = step.lower_entry(*self._args,
+                                    batch_size=self._batch_size,
+                                    **self._kwargs)
+            if info is None:
+                # eager path: no program to score — every candidate
+                # ties, the defaults win, which is the right answer
+                probe = {"flops": 0.0, "traffic_bytes": 0.0}
+            else:
+                compiled = info["lowered"].compile()
+                flops = 0.0
+                try:
+                    ca = compiled.cost_analysis()
+                    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                    flops = float(ca.get("flops", 0.0))
+                except Exception:   # pragma: no cover - backend-dep
+                    pass
+                traffic = 0.0
+                try:
+                    from ..telemetry.memory import MemoryReport
+                    rep = MemoryReport.from_compiled(compiled)
+                    traffic = float(rep.argument_bytes
+                                    + rep.output_bytes
+                                    + rep.temp_bytes)
+                except Exception:   # pragma: no cover - backend-dep
+                    pass
+                probe = {"flops": flops, "traffic_bytes": traffic}
+        self._probes[key] = probe
+        return probe
+
+    def _zero_units(self, min_size) -> int:
+        """Reduce-scatter/all-gather unit count under a candidate
+        bucket floor — pure host math over the trainable param sizes
+        (mirrors _ZeroShardPlan's solo-vs-bucketed split)."""
+        step = self._step
+        if step._zero is None and step._zero_ok is None:
+            return 0
+        try:
+            min_size = int(min_size)
+        except (TypeError, ValueError):
+            return 0
+        solo = 0
+        bucket_dtypes = set()
+        for p in step._trainer._params:
+            d = p._data._data if p._data is not None else None
+            if d is None:
+                continue
+            if int(d.size) >= min_size:
+                solo += 1
+            else:
+                bucket_dtypes.add(str(d.dtype))
+        return solo + len(bucket_dtypes)
+
+    def measure(self, config: Dict[str, Any],
+                fidelity: int = 1) -> MeasureResult:
+        probe = self._probe(config)
+        F, B = _roofline()
+        t_program = max(probe["flops"] / F,
+                        probe["traffic_bytes"] / B)
+        w = _cfg_value(config, "engine.inflight_steps")
+        w = 0 if w is None else max(0, int(w))
+        t_host = HOST_DISPATCH_S / (1.0 + w)
+        n_units = self._zero_units(
+            _cfg_value(config, "zero.shard_min_size"))
+        t_coll = 2 * n_units * COLLECTIVE_LAT_S   # RS + AG per unit
+        score = t_program + t_host + t_coll
+        if not math.isfinite(score):
+            return MeasureResult.infeasible("non-finite analytical score")
+        return MeasureResult(score, detail={
+            "t_program": t_program, "t_host": t_host,
+            "t_collective": t_coll, "flops": probe["flops"],
+            "traffic_bytes": probe["traffic_bytes"],
+            "zero_units": n_units})
+
+
+class TimedStepBackend:
+    """Hardware truth for one ``CompiledTrainStep`` bucket: apply the
+    candidate, run ``warmup`` + ``steps * fidelity`` real steps through
+    a fresh :class:`~mxnet_tpu.engine.DispatchWindow` (so the
+    ``engine.inflight_steps`` candidate actually governs the pipeline
+    being timed), and score seconds/step at the drain.
+
+    Trials EXECUTE the train step, so the orchestrator snapshots and
+    restores the full train state around the search
+    (``checkpoint.state.capture_train_state``) — tuning must never move
+    the model. A candidate whose program-affecting knobs differ from
+    the last measured one drops the step's bucket cache first (the
+    recompile is the cost of measuring it — that is what
+    ``MXNET_AUTOTUNE_BUDGET_TRIALS`` bounds)."""
+
+    name = "timed"
+    deterministic = False
+
+    def __init__(self, step, args, kwargs=None,
+                 batch_size: Optional[int] = None, tunables=(),
+                 warmup: int = 2, steps: int = 4):
+        self._step = step
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._batch_size = batch_size
+        self._tunables = tuple(tunables)
+        self._warmup = max(1, int(warmup))
+        self._steps = max(1, int(steps))
+        self._last_key: Optional[tuple] = None
+
+    def measure(self, config: Dict[str, Any],
+                fidelity: int = 1) -> MeasureResult:
+        import jax
+        from ..engine import DispatchWindow
+        step = self._step
+        with _space.trial(config):
+            key = _program_key(config, self._tunables)
+            if self._last_key is not None and key != self._last_key:
+                step._lru.clear()
+            self._last_key = key
+            n = self._steps * max(1, int(fidelity))
+            window = DispatchWindow(what="autotune trial step")
+            for _ in range(self._warmup):
+                window.push(step(*self._args,
+                                 batch_size=self._batch_size,
+                                 **self._kwargs)._data)
+            window.drain()
+            t0 = time.perf_counter()
+            for i in range(n):
+                window.push(step(*self._args,
+                                 batch_size=self._batch_size,
+                                 **self._kwargs)._data, tag=i)
+            window.drain()
+            dt = time.perf_counter() - t0
+        return MeasureResult(dt / n, detail={
+            "steps": n, "wall_s": dt,
+            "inflight": window.max_inflight})
+
+
+# ---------------------------------------------------------------------------
+# predictor backends
+# ---------------------------------------------------------------------------
+
+class AnalyticalPredictorBackend:
+    """Deterministic per-request-row latency model for one
+    ``CompiledPredictor`` + ``DynamicBatcher`` deployment:
+
+    ``t_bucket(max_batch)/max_batch``  (compute amortized over rows)
+    ``+ HOST_DISPATCH_S / max_batch``  (one dispatch per micro-batch)
+    ``+ batch_timeout/2``              (mean coalescing delay)
+
+    ``t_bucket`` comes from the AOT flop count of the bucket
+    ``max_batch`` pads into (the probe compiles it exactly as
+    ``warmup()`` would — nothing is wasted)."""
+
+    name = "analytical"
+    deterministic = True
+
+    def __init__(self, pred, example, tunables=()):
+        self._pred = pred
+        self._example = tuple(example)
+        self._tunables = tuple(tunables)
+        self._flops: Dict[int, float] = {}
+
+    def _bucket_flops(self, bucket: int) -> float:
+        hit = self._flops.get(bucket)
+        if hit is not None:
+            return hit
+        from ..serving.predictor import (_ARRAY_TYPES, _data_of,
+                                         _pad_rows)
+        padded = tuple(
+            _pad_rows(l, bucket) if isinstance(l, _ARRAY_TYPES)
+            and getattr(_data_of(l), "ndim", 0) >= 1 else l
+            for l in self._example)
+        flops = self._pred.aot_compile(*padded) or 0.0
+        self._flops[bucket] = float(flops)
+        return self._flops[bucket]
+
+    def measure(self, config: Dict[str, Any],
+                fidelity: int = 1) -> MeasureResult:
+        m = _cfg_value(config, "serving.max_batch")
+        m = 1 if m is None else max(1, int(m))
+        timeout_ms = _cfg_value(config, "serving.batch_timeout_ms")
+        timeout_ms = 0.0 if timeout_ms is None else float(timeout_ms)
+        with _space.trial(config):
+            bucket = self._pred.bucket_for(m)   # raises -> infeasible
+            flops = self._bucket_flops(bucket)
+        F, _B = _roofline()
+        t_bucket = flops / F
+        score = (t_bucket + HOST_DISPATCH_S) / m + timeout_ms / 2e3
+        return MeasureResult(score, detail={
+            "bucket": bucket, "t_bucket": t_bucket,
+            "max_batch": m, "timeout_ms": timeout_ms})
+
+
+class TimedPredictorBackend:
+    """Measured per-row latency: pad the example to the candidate
+    ``serving.max_batch``'s bucket and time ``steps * fidelity``
+    dispatches of the real compiled program (plus the candidate's mean
+    coalescing delay as an additive term — the linger is policy, not
+    program, so it is modeled, not slept)."""
+
+    name = "timed"
+    deterministic = False
+
+    def __init__(self, pred, example, tunables=(), warmup: int = 2,
+                 steps: int = 8):
+        self._pred = pred
+        self._example = tuple(example)
+        self._warmup = max(1, int(warmup))
+        self._steps = max(1, int(steps))
+
+    def measure(self, config: Dict[str, Any],
+                fidelity: int = 1) -> MeasureResult:
+        import jax
+        from ..serving.predictor import (_ARRAY_TYPES, _data_of,
+                                         _pad_rows)
+        m = _cfg_value(config, "serving.max_batch")
+        m = 1 if m is None else max(1, int(m))
+        timeout_ms = _cfg_value(config, "serving.batch_timeout_ms")
+        timeout_ms = 0.0 if timeout_ms is None else float(timeout_ms)
+        with _space.trial(config):
+            bucket = self._pred.bucket_for(m)
+            padded = tuple(
+                _pad_rows(l, bucket) if isinstance(l, _ARRAY_TYPES)
+                and getattr(_data_of(l), "ndim", 0) >= 1 else l
+                for l in self._example)
+            n = self._steps * max(1, int(fidelity))
+            for _ in range(self._warmup):
+                out = self._pred.predict(*padded)
+            jax.tree_util.tree_map(
+                lambda a: jax.block_until_ready(
+                    getattr(a, "_data", a)), out)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = self._pred.predict(*padded)
+            jax.tree_util.tree_map(
+                lambda a: jax.block_until_ready(
+                    getattr(a, "_data", a)), out)
+            dt = time.perf_counter() - t0
+        score = dt / n / m + timeout_ms / 2e3
+        return MeasureResult(score, detail={
+            "bucket": bucket, "dispatches": n, "wall_s": dt})
+
+
+# ---------------------------------------------------------------------------
+# backend selection
+# ---------------------------------------------------------------------------
+
+def backend_mode() -> str:
+    """``MXNET_AUTOTUNE_BACKEND``: ``auto`` (timed on accelerators,
+    analytical on CPU — CI stays deterministic) | ``timed`` |
+    ``analytical``."""
+    import os
+    v = os.environ.get("MXNET_AUTOTUNE_BACKEND", "auto").strip().lower()
+    return v if v in ("timed", "analytical") else "auto"
+
+
+def _pick(kind: str) -> str:
+    mode = backend_mode()
+    if mode != "auto":
+        return mode
+    import jax
+    return "timed" if jax.default_backend() != "cpu" else "analytical"
+
+
+def select_step_backend(step, args, kwargs=None, batch_size=None,
+                        tunables=()):
+    cls = (TimedStepBackend if _pick("step") == "timed"
+           else AnalyticalStepBackend)
+    return cls(step, args, kwargs, batch_size=batch_size,
+               tunables=tunables)
+
+
+def select_predictor_backend(pred, example, tunables=()):
+    cls = (TimedPredictorBackend if _pick("predict") == "timed"
+           else AnalyticalPredictorBackend)
+    return cls(pred, example, tunables=tunables)

@@ -1,0 +1,294 @@
+"""Declarative tunable registry — the autotuner's search-space half.
+
+Every hot-path tunable the framework ships is a :class:`Tunable`
+registered HERE, next to the constant it replaces (the constant becomes
+the *default*, never a removal): the Pallas VMEM tile budget and rnn
+timestep block (``ops/kernels``), the dispatch-window depth
+(``engine.inflight_steps``), the ZeRO bucket floor
+(``gluon/fused_step``), the serving coalescing knobs
+(``serving/batcher``). Each declaration names its candidate grid, a
+validity predicate (e.g. block bytes <= the physical VMEM, window
+>= 0), and the *seam* that consumes it — the accessor call site hand-
+tuners and the autotuner share.
+
+Value resolution at every consumer seam is
+
+    tuned override  >  env var  >  registered default
+
+so a hand-set env var still works standalone, and an applied autotune
+config (a trial candidate or a cached winner) wins while it is active.
+Overrides are process-global and cheap to read — consumers resolve at
+each use site, never at import.
+
+This module is import-light by design (stdlib only): consumer modules
+(``engine``, ``ops/kernels``) register at import time without pulling
+jax or telemetry.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+__all__ = ["Tunable", "SearchSpace", "register", "get", "table",
+           "tunables", "value", "set_override", "get_override",
+           "clear_overrides", "overrides", "apply_config", "trial",
+           "space_signature", "ensure_registered", "SPACE_VERSION"]
+
+#: bumped when the *semantics* of the space change incompatibly; the
+#: per-content hash in :func:`space_signature` catches grid/default
+#: edits — together they version the cache key.
+SPACE_VERSION = 1
+
+
+class Tunable:
+    """One declared tunable: a named knob with a candidate grid.
+
+    - ``name``: dotted ``<group>.<knob>`` (group = the owning layer:
+      ``kernels``, ``engine``, ``zero``, ``serving``);
+    - ``default``: the shipped constant (what ``MXNET_AUTOTUNE=off``
+      and every un-tuned run uses);
+    - ``grid``: the candidate values the search sweeps;
+    - ``env``: the env var hand-tuners use for the same knob (resolved
+      between override and default), with ``parse`` applied to it;
+    - ``valid(value, config)``: candidate feasibility against the FULL
+      candidate config (cross-knob constraints allowed); invalid
+      candidates are filtered before measurement, not scored;
+    - ``seam``: human-readable consumer call site (the diagnose table);
+    - ``scope``: ``'train'`` | ``'serving'`` | ``'both'`` — which entry
+      point sweeps it;
+    - ``affects_program``: whether changing it changes the COMPILED
+      program on the current backend (the analytical backend re-probes
+      per distinct program-affecting subset and reuses its baseline
+      probe for everything else).
+    """
+
+    def __init__(self, name: str, default: Any, grid: Sequence[Any],
+                 seam: str, env: Optional[str] = None,
+                 parse: Callable[[str], Any] = None,
+                 valid: Optional[Callable[[Any, dict], bool]] = None,
+                 scope: str = "train", affects_program: bool = False,
+                 doc: str = ""):
+        if "." not in name:
+            raise ValueError(
+                f"tunable name {name!r} must be '<group>.<knob>'")
+        if scope not in ("train", "serving", "both"):
+            raise ValueError(f"tunable {name!r}: bad scope {scope!r}")
+        self.name = name
+        self.default = default
+        self.grid = tuple(grid)
+        self.seam = seam
+        self.env = env
+        self.parse = parse or (lambda s: s)
+        self._valid = valid
+        self.scope = scope
+        self.affects_program = bool(affects_program)
+        self.doc = doc
+
+    def valid(self, value: Any, config: Optional[dict] = None) -> bool:
+        """Whether ``value`` is a feasible setting under ``config``
+        (the full candidate config; defaults where unspecified)."""
+        if self._valid is None:
+            return True
+        try:
+            return bool(self._valid(value, config or {}))
+        except Exception:
+            return False
+
+    def resolve(self) -> Any:
+        """Current effective value at this knob's consumer seam:
+        override > env > default."""
+        found, v = get_override(self.name)
+        if found:
+            return v
+        if self.env:
+            raw = os.environ.get(self.env)
+            if raw is not None and raw.strip() != "":
+                try:
+                    return self.parse(raw)
+                except (TypeError, ValueError):
+                    pass
+        return self.default
+
+    def __repr__(self):
+        return (f"Tunable({self.name!r}, default={self.default!r}, "
+                f"grid={self.grid!r}, scope={self.scope!r})")
+
+
+_LOCK = threading.Lock()
+_REGISTRY: "Dict[str, Tunable]" = {}
+_OVERRIDES: "Dict[str, Any]" = {}
+
+
+def register(t: Tunable) -> Tunable:
+    """Register (or re-register — module reloads are idempotent) one
+    tunable. Returns it, so consumers can write
+    ``_T = space.register(Tunable(...))``."""
+    if t.default not in t.grid:
+        # the default must be sweepable: search starts from it and the
+        # off/cached-miss paths fall back to it
+        t.grid = (t.default,) + t.grid
+    with _LOCK:
+        _REGISTRY[t.name] = t
+    return t
+
+
+def get(name: str) -> Optional[Tunable]:
+    return _REGISTRY.get(name)
+
+
+def tunables(scope: Optional[str] = None) -> Tuple[Tunable, ...]:
+    """Registered tunables, name-sorted; ``scope`` filters to the ones
+    an entry point sweeps ('train'/'serving' each include 'both')."""
+    out = [t for _, t in sorted(_REGISTRY.items())]
+    if scope is not None:
+        out = [t for t in out if t.scope in (scope, "both")]
+    return tuple(out)
+
+
+def table() -> Tuple[dict, ...]:
+    """The diagnose/docs view: one row per registered tunable."""
+    return tuple({"name": t.name, "default": t.default,
+                  "grid": t.grid, "scope": t.scope,
+                  "current": t.resolve(), "seam": t.seam}
+                 for t in tunables())
+
+
+# ---------------------------------------------------------------------------
+# overrides — what the autotuner (trials and applied winners) sets
+# ---------------------------------------------------------------------------
+
+def value(name: str, default: Any = None) -> Any:
+    """Resolved value for ``name`` (override > env > registered
+    default); ``default`` when the tunable is unknown. THE consumer-
+    seam read — e.g. ``engine.inflight_steps`` resolves through
+    here."""
+    t = _REGISTRY.get(name)
+    if t is None:
+        found, v = get_override(name)
+        return v if found else default
+    return t.resolve()
+
+
+def set_override(name: str, v: Any):
+    with _LOCK:
+        _OVERRIDES[name] = v
+
+
+def get_override(name: str) -> Tuple[bool, Any]:
+    """(found, value) — distinguishes 'override set to None/0' from
+    'no override'."""
+    with _LOCK:
+        if name in _OVERRIDES:
+            return True, _OVERRIDES[name]
+    return False, None
+
+
+def clear_overrides(names: Optional[Sequence[str]] = None):
+    with _LOCK:
+        if names is None:
+            _OVERRIDES.clear()
+        else:
+            for n in names:
+                _OVERRIDES.pop(n, None)
+
+
+def overrides() -> Dict[str, Any]:
+    with _LOCK:
+        return dict(_OVERRIDES)
+
+
+def apply_config(config: Dict[str, Any]):
+    """Install a (partial) config as overrides — the 'make this the
+    active tuned config' operation for cached winners."""
+    for k, v in config.items():
+        set_override(k, v)
+
+
+class trial:
+    """Context manager applying a candidate config for the duration of
+    one measurement, restoring the prior overrides on exit (including
+    removal of keys the trial introduced)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self._config = dict(config)
+        self._saved: Optional[Dict[str, Any]] = None
+
+    def __enter__(self):
+        with _LOCK:
+            self._saved = dict(_OVERRIDES)
+            _OVERRIDES.update(self._config)
+        return self
+
+    def __exit__(self, *exc):
+        with _LOCK:
+            _OVERRIDES.clear()
+            _OVERRIDES.update(self._saved or {})
+        return False
+
+
+class SearchSpace:
+    """A scoped view over the registered tunables — what one search
+    sweeps. The process-global registry is the universe;
+    ``SearchSpace('train')`` / ``SearchSpace('serving')`` are the two
+    entry-point slices."""
+
+    def __init__(self, scope: Optional[str] = None):
+        self.scope = scope
+
+    @property
+    def tunables(self) -> Tuple[Tunable, ...]:
+        return tunables(self.scope)
+
+    def defaults(self) -> Dict[str, Any]:
+        return {t.name: t.default for t in self.tunables}
+
+    def current(self) -> Dict[str, Any]:
+        """Effective values at every seam right now (override > env >
+        default)."""
+        return {t.name: t.resolve() for t in self.tunables}
+
+    def valid(self, config: Dict[str, Any]) -> bool:
+        """Whether a full candidate config satisfies every tunable's
+        predicate."""
+        return all(t.valid(config.get(t.name, t.default), config)
+                   for t in self.tunables)
+
+    def signature(self) -> str:
+        return space_signature(self.scope)
+
+    def __len__(self):
+        return len(self.tunables)
+
+    def __iter__(self):
+        return iter(self.tunables)
+
+
+# ---------------------------------------------------------------------------
+# space identity (cache-key component)
+# ---------------------------------------------------------------------------
+
+def space_signature(scope: Optional[str] = None) -> str:
+    """Content hash of the registered space: name, default, grid and
+    scope of every tunable (+ :data:`SPACE_VERSION`). A grid or
+    default edit in any consumer module invalidates cached winners —
+    a stale config for a space that no longer exists must never
+    replay."""
+    parts = [f"v{SPACE_VERSION}"]
+    for t in tunables(scope):
+        parts.append(f"{t.name}={t.default!r}:{t.grid!r}:{t.scope}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def ensure_registered():
+    """Import every consumer module that registers tunables, so
+    :func:`table` and the search see the full space regardless of what
+    the process has touched so far."""
+    import importlib
+    for mod in ("mxnet_tpu.engine", "mxnet_tpu.ops.kernels",
+                "mxnet_tpu.gluon.fused_step", "mxnet_tpu.serving.batcher"):
+        try:
+            importlib.import_module(mod)
+        except Exception:        # pragma: no cover - partial installs
+            pass

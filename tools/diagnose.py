@@ -667,6 +667,76 @@ def check_kernels():
         print("kernel check failed:", repr(e))
 
 
+def check_autotune():
+    """Self-tuning autopilot health (docs/PERF_NOTES.md "Autotuner"):
+    the registered tunable table (name, default, grid, consumer seam),
+    then a 3-trial analytical sweep over a tiny MLP train step — shown
+    twice against a scratch config DB so the report demonstrates BOTH
+    halves of the loop: the cache MISS that searches + persists, and
+    the cache HIT that replays the winner with zero trials."""
+    print("----------Self-Tuning Autopilot----------")
+    import tempfile
+    try:
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import tuning
+        from mxnet_tpu.gluon import Trainer, nn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        tuning.space.ensure_registered()
+        print(f"MXNET_AUTOTUNE={tuning.autotune_mode()}  "
+              f"backend={tuning.measure.backend_mode()}  "
+              f"budget={tuning.budget_trials()}  "
+              f"cache={tuning.cache_path() or '<memory>'}")
+        print(f"{'tunable':<26s}{'default':>10s}  grid / seam")
+        for row in tuning.space.table():
+            print(f"{row['name']:<26s}{str(row['default']):>10s}  "
+                  f"{list(row['grid'])}")
+            print(f"{'':<38s}-> {row['seam']}")
+
+        def build_step():
+            onp.random.seed(0)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+            net.initialize()
+            x = mx.nd.array(onp.random.randn(8, 16).astype("float32"))
+            y = mx.nd.array(onp.random.randint(0, 8, size=(8,))
+                            .astype("int32"))
+            net(x)
+            loss = SoftmaxCrossEntropyLoss()
+            trainer = Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9},
+                              kvstore=None)
+            step = trainer.compile_step(lambda a, b: loss(net(a), b))
+            return step, x, y
+
+        db = tuning.AutotuneCache(
+            os.path.join(tempfile.mkdtemp(prefix="mx_autotune_"),
+                         "autotune.json"))
+        saved = tuning.space.overrides()
+        try:
+            backend = None
+            for label in ("first run ", "second run"):
+                step, x, y = build_step()
+                out = tuning.tune_step(step, (x, y), mode="on",
+                                       budget=3, db=db)
+                backend = out.backend or backend
+                hitmiss = ("HIT (replayed, 0 trials)"
+                           if out.source == "cache"
+                           else "MISS -> searched + persisted")
+                print(f"{label}: cache {hitmiss}  trials={out.trials}"
+                      f"  config={out.config or '{defaults}'}"
+                      + (f"  delta={out.delta_pct}%"
+                         if out.delta_pct is not None else ""))
+            print(f"winning config: {out.config or '{defaults}'} "
+                  f"(backend={backend}, 3-trial budget)")
+        finally:
+            tuning.space.clear_overrides()
+            tuning.space.apply_config(saved)
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("autotune check failed:", repr(e))
+
+
 def check_serving():
     """Serving-engine health (docs/SERVING.md): AOT-compile a tiny
     predictor across its shape buckets, push a concurrent closed-loop
@@ -832,6 +902,11 @@ def main(argv=None):
                         "interpret/xla + reason) and an interpret-vs-"
                         "xla parity probe for a tiny LSTM scan and "
                         "LayerNorm")
+    parser.add_argument("--autotune", action="store_true",
+                        help="also print the registered tunable table "
+                        "and run a 3-trial analytical autotune sweep "
+                        "on a tiny MLP, showing the winning config and "
+                        "the cache miss->hit round trip")
     parser.add_argument("--serving", action="store_true",
                         help="also AOT-compile a tiny bucketed "
                         "predictor, run a concurrent burst through the "
@@ -864,6 +939,8 @@ def main(argv=None):
         check_sharding()
     if args.kernels:
         check_kernels()
+    if args.autotune:
+        check_autotune()
     if args.serving:
         check_serving()
     if args.elastic:
