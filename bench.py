@@ -817,6 +817,117 @@ def bench_serving(dtype):
     except Exception as e:  # pragma: no cover - variant must not kill leg
         log(f"bench[serving]: int8 probe failed ({type(e).__name__}: {e})")
 
+    # resilience probes (docs/SERVING.md "Resilient serving"):
+    # (a) overload A/B — open-loop Poisson at ~2x the measured batched
+    # capacity with a per-request deadline. The unshedded baseline
+    # accepts everything and its p99 blows past the deadline as the
+    # queue grows; MXNET_SERVING_SHED=deadline rejects at admission
+    # (typed Overloaded) so the ACCEPTED requests keep their p99.
+    # Both runs land in the BENCH json.
+    overload = None
+    saved_shed = os.environ.get("MXNET_SERVING_SHED")
+    try:
+        if batched.get("qps"):
+            rate = 2.0 * batched["qps"]
+            deadline_ms = max(25.0, 4.0 * (batched.get("p50_ms") or 5.0))
+            n_over = max(96, requests // 4)
+            overload = {"rate_qps": round(rate, 1),
+                        "deadline_ms": round(deadline_ms, 1)}
+            # baseline: no shedding, no deadline — the honest p99 of
+            # an overloaded FIFO queue
+            os.environ["MXNET_SERVING_SHED"] = "off"
+            b_off = serving.DynamicBatcher(pred, max_batch=buckets[-1],
+                                           timeout_ms=2.0)
+            rep_off = loadgen.run_open_loop(
+                lambda i: b_off.submit(
+                    mx.nd.array(X[i % requests:i % requests + 1]),
+                    deadline_ms=0).result,
+                rate_qps=rate, requests=n_over)
+            b_off.close()
+            overload["shed_off"] = {
+                k: rep_off.get(k) for k in
+                ("qps", "goodput_qps", "p50_ms", "p99_ms",
+                 "reject_rate", "deadline_miss_rate", "outcomes")}
+            miss_base = (rep_off.get("p99_ms") or 0) > deadline_ms
+            # shed=deadline: same traffic, per-request deadline armed
+            os.environ["MXNET_SERVING_SHED"] = "deadline"
+            b_on = serving.DynamicBatcher(pred, max_batch=buckets[-1],
+                                          timeout_ms=2.0)
+            rep_on = loadgen.run_open_loop(
+                lambda i: b_on.submit(
+                    mx.nd.array(X[i % requests:i % requests + 1]),
+                    deadline_ms=deadline_ms).result,
+                rate_qps=rate, requests=n_over,
+                deadline_s=deadline_ms / 1e3)
+            b_on.close()
+            overload["shed_deadline"] = {
+                k: rep_on.get(k) for k in
+                ("qps", "goodput_qps", "p50_ms", "p99_ms",
+                 "reject_rate", "deadline_miss_rate", "outcomes")}
+            overload["baseline_missed_deadline"] = bool(miss_base)
+            overload["shed_kept_p99_in_deadline"] = bool(
+                (rep_on.get("p99_ms") or 1e9) <= deadline_ms)
+            log(f"bench[serving]: overload A/B @ {rate:.0f} req/s "
+                f"deadline={deadline_ms:.0f}ms — off p99="
+                f"{rep_off.get('p99_ms')}ms goodput="
+                f"{rep_off.get('goodput_qps')} | deadline p99="
+                f"{rep_on.get('p99_ms')}ms goodput="
+                f"{rep_on.get('goodput_qps')} reject_rate="
+                f"{rep_on.get('reject_rate')}")
+    except Exception as e:  # pragma: no cover - probe must not kill leg
+        log(f"bench[serving]: overload probe failed "
+            f"({type(e).__name__}: {e})")
+    finally:
+        if saved_shed is None:
+            os.environ.pop("MXNET_SERVING_SHED", None)
+        else:
+            os.environ["MXNET_SERVING_SHED"] = saved_shed
+
+    # (b) device-loss recovery — a small supervised burst with one
+    # injected revocation: {recoveries, recovery_downtime_s} prove the
+    # ServingSupervisor's rebuild path end to end (a dedicated probe
+    # net keeps the rebuild cheap; the machinery, not the model, is
+    # under test)
+    resilience = None
+    try:
+        from mxnet_tpu.testing import faults
+
+        def build_probe():
+            mx.random.seed(11)
+            pnet = nn.HybridSequential()
+            pnet.add(nn.Dense(64, activation="relu", in_units=32),
+                     nn.Dense(8, in_units=64))
+            pnet.initialize()
+            pnet(mx.nd.array(onp.zeros((1, 32), "float32")))
+            return serving.CompiledPredictor(pnet,
+                                             bucket_sizes=(1, 2, 4))
+
+        xp = mx.nd.array(onp.zeros((1, 32), "float32"))
+        Xp = onp.random.randn(32, 32).astype("float32")
+        sup = serving.ServingSupervisor(build_probe, example=(xp,),
+                                        max_batch=4, timeout_ms=2.0)
+        faults.configure("serving.dispatch:before=2:revoke:1")
+        try:
+            rep_r = loadgen.run_closed_loop(
+                lambda i: sup.submit(
+                    mx.nd.array(Xp[i % 32:i % 32 + 1])).result(60),
+                concurrency=4, requests=48)
+        finally:
+            faults.reset()
+            sup.close()
+        resilience = {
+            "recoveries": sup.stats["recoveries"],
+            "recovery_downtime_s": round(
+                sup.stats["recovery_downtime_s"], 3),
+            "requeued": sup.stats["requeued"],
+            "breaker": [s for s, _t, _c in sup.breaker.transitions],
+            "outcomes": rep_r.get("outcomes"),
+        }
+        log(f"bench[serving]: recovery probe {resilience}")
+    except Exception as e:  # pragma: no cover - probe must not kill leg
+        log(f"bench[serving]: recovery probe failed "
+            f"({type(e).__name__}: {e})")
+
     cc = compile_cache_stats()
     cache = {"enabled": cc["enabled"], "hits": cc["hits"],
              "misses": cc["misses"],
@@ -837,6 +948,16 @@ def bench_serving(dtype):
         "speedup_vs_unbatched": speedup,
         "open_loop": open_rep,
         "int8": int8_probe,
+        # resilience posture (docs/SERVING.md "Resilient serving")
+        "goodput_qps": batched.get("goodput_qps"),
+        "reject_rate": batched.get("reject_rate"),
+        "deadline_miss_rate": batched.get("deadline_miss_rate"),
+        "overload": overload,
+        "resilience": resilience,
+        "recoveries": resilience["recoveries"]
+        if resilience is not None else None,
+        "recovery_downtime_s": resilience["recovery_downtime_s"]
+        if resilience is not None else None,
         "compile_cache": cache,
         "warmup_s": round(t_warm, 2),
         "programs": pred.n_traces,
@@ -1011,6 +1132,13 @@ def main():
                     s["speedup_vs_unbatched"],
                 "serving_cache_hit_rate":
                     s["compile_cache"]["hit_rate"],
+                "serving_goodput_qps": s.get("goodput_qps"),
+                "serving_reject_rate": s.get("reject_rate"),
+                "serving_deadline_miss_rate":
+                    s.get("deadline_miss_rate"),
+                "serving_recoveries": s.get("recoveries"),
+                "serving_recovery_downtime_s":
+                    s.get("recovery_downtime_s"),
                 "serving_detail": s,
             })
     try:

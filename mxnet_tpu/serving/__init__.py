@@ -14,23 +14,43 @@ telemetry catalog, the program-lint gates) turned into a serving path.
   valid-row mask; ``MXNET_SERVING_MAX_BATCH`` /
   ``MXNET_SERVING_BATCH_TIMEOUT_MS``), dispatched pipelined through a
   :class:`~mxnet_tpu.engine.DispatchWindow` so the device never idles
-  between micro-batches.
+  between micro-batches — now with per-request deadlines
+  (``submit(deadline_ms=)``), admission control/load shedding
+  (``MXNET_SERVING_SHED``), graceful drain, and typed failures
+  (an accepted request never hangs).
+- :mod:`.resilience` — :class:`ServingSupervisor` (device-loss
+  recovery riding the elastic seams: classify via
+  ``elastic.detect.classify``, rebuild over ``available_devices()``
+  with cache-warm AOT buckets, re-enqueue in-flight requests exactly
+  once), :class:`CircuitBreaker`, and the typed error taxonomy
+  (:class:`DeadlineExceeded` / :class:`Overloaded` /
+  :class:`ServingShutdown`).
 - :func:`predictor_for` — bf16/fp16/int8 serving variants through the
   existing AMP and post-training-quantization paths.
-- :mod:`.loadgen` — closed-/open-loop load generation with exact
-  p50/p99 (the ``serving`` bench leg in bench.py).
+- :mod:`.loadgen` — closed-/open-loop load generation with per-request
+  outcome census {ok, rejected, deadline_missed, error}, goodput vs
+  raw QPS, and exact p50/p99 (the ``serving`` bench leg in bench.py).
 
 Observability: ``mx_serving_*`` series in the telemetry catalog —
 queue depth, in-flight micro-batches, batch occupancy, request-latency
-histogram (docs/OBSERVABILITY.md).
+histogram, rejected/deadline-missed/retries/recoveries counters,
+breaker state, drain duration (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
+from .resilience import (CircuitBreaker, DeadlineExceeded, Overloaded,
+                         ServingShutdown, ServingSupervisor,
+                         default_deadline_ms, queue_timeout_s, shed_mode,
+                         transient_retries)
 from .predictor import CompiledPredictor, DEFAULT_BUCKETS, predictor_for
 from .batcher import (DynamicBatcher, ServingFuture, batch_timeout_s,
                       max_batch_rows, queue_depth)
 from . import loadgen
+from . import resilience
 
 __all__ = ["CompiledPredictor", "DynamicBatcher", "ServingFuture",
-           "predictor_for", "DEFAULT_BUCKETS", "loadgen",
-           "max_batch_rows", "batch_timeout_s", "queue_depth"]
+           "predictor_for", "DEFAULT_BUCKETS", "loadgen", "resilience",
+           "max_batch_rows", "batch_timeout_s", "queue_depth",
+           "CircuitBreaker", "ServingSupervisor", "DeadlineExceeded",
+           "Overloaded", "ServingShutdown", "default_deadline_ms",
+           "queue_timeout_s", "shed_mode", "transient_retries"]

@@ -10,8 +10,19 @@ Mechanics:
 
 - **Bounded queue.** ``submit()`` enqueues a request (any leading-dim
   row count) into a bounded queue (``MXNET_SERVING_QUEUE_DEPTH``) and
-  returns a :class:`ServingFuture`; a full queue blocks the caller —
-  backpressure, not unbounded memory.
+  returns a :class:`ServingFuture`; a full queue blocks the caller up
+  to ``MXNET_SERVING_QUEUE_TIMEOUT_MS`` and then sheds with a typed
+  :class:`~mxnet_tpu.serving.Overloaded` — backpressure, not unbounded
+  memory, and never a bare ``queue.Full``.
+- **Deadlines + admission control.** ``submit(deadline_ms=)`` (default
+  ``MXNET_SERVING_DEADLINE_MS``) rides the queue with the request;
+  expired requests are dropped AT DEQUEUE (never padded/dispatched)
+  with a typed :class:`~mxnet_tpu.serving.DeadlineExceeded`, and under
+  ``MXNET_SERVING_SHED=deadline`` a request whose projected queue wait
+  (EWMA micro-batch service time x batches ahead) already exceeds its
+  deadline is rejected at ``submit`` — accepted requests keep their
+  p99 instead of everyone timing out (docs/SERVING.md "Resilient
+  serving").
 - **Coalesce until full or stale.** The dispatcher gathers requests
   until ``MXNET_SERVING_MAX_BATCH`` rows are waiting or the OLDEST
   waiting request has aged ``MXNET_SERVING_BATCH_TIMEOUT_MS`` — the
@@ -26,15 +37,25 @@ Mechanics:
   ONE blessed host sync of the serving hot loop (request latency is
   recorded there); client-side ``future.result()`` reads are the
   response sync, outside the hot region.
+- **Failure containment.** A dispatch or retire failure reaches the
+  ``on_batch_failure`` hook (a :class:`~mxnet_tpu.serving
+  .ServingSupervisor` classifies and recovers — device loss rebuilds
+  the predictor and re-enqueues the affected requests exactly once);
+  without a handler the affected futures fail with the error. A dead
+  dispatcher thread or a ``close()`` with requests still pending fails
+  every pending future with a typed :class:`~mxnet_tpu.serving
+  .ServingShutdown` — an accepted request NEVER hangs. :meth:`drain`
+  is the graceful path: reject new, flush forming + in-flight, close.
 - **Observability.** ``mx_serving_*`` series through the telemetry
-  catalog: requests/batches counters, queue-depth and in-flight
-  gauges, batch-occupancy and request-latency histograms
-  (docs/OBSERVABILITY.md).
+  catalog: requests/batches/rejected/deadline-missed counters,
+  queue-depth and in-flight gauges, batch-occupancy/request-latency/
+  drain-duration histograms (docs/OBSERVABILITY.md).
 
 Deterministic testing: inject ``clock=`` and construct with
 ``start=False``, then drive :meth:`process_once` by hand — the
-timeout/full flush decisions consult only the injected clock
-(tests/test_serving.py pins the semantics with a fake clock).
+timeout/full flush decisions AND the deadline/admission arithmetic
+consult only the injected clock (tests/test_serving.py and
+tests/test_serving_resilience.py pin the semantics with a fake clock).
 """
 from __future__ import annotations
 
@@ -53,6 +74,9 @@ from ..analysis import guard as _tguard
 from ..base import MXNetError
 from ..engine import DispatchWindow
 from ..ndarray.ndarray import NDArray
+from ..testing.faults import fault_point
+from .resilience import (DeadlineExceeded, Overloaded, ServingShutdown,
+                         default_deadline_ms, queue_timeout_s, shed_mode)
 
 __all__ = ["DynamicBatcher", "ServingFuture", "max_batch_rows",
            "batch_timeout_s", "queue_depth"]
@@ -137,7 +161,8 @@ except Exception:    # pragma: no cover - tuning must never break serving
 
 def queue_depth(default: int = 1024) -> int:
     """``MXNET_SERVING_QUEUE_DEPTH``: bounded request-queue capacity
-    (a full queue blocks ``submit`` — backpressure)."""
+    (a full queue blocks ``submit`` up to the queue timeout, then
+    sheds — backpressure)."""
     try:
         v = int(os.environ.get("MXNET_SERVING_QUEUE_DEPTH", str(default)))
     except ValueError:
@@ -177,50 +202,134 @@ class ServingFuture:
     thread, outside the serving hot region — then slices this
     request's rows out. The per-request slice dispatch happens on the
     CLIENT thread, keeping the dispatcher's hot loop to one program
-    call per micro-batch."""
+    call per micro-batch.
 
-    __slots__ = ("_ev", "_build", "_out", "_err")
+    Under a :class:`~mxnet_tpu.serving.ServingSupervisor` the future
+    is RE-ARMABLE: when the request's micro-batch is lost to a device
+    failure, recovery re-enqueues the request and the future resolves
+    again against the re-dispatched batch (the ``_epoch`` counter
+    disambiguates); a client already blocked in :meth:`result` rides
+    through the recovery instead of observing the poisoned buffers.
+    Terminal failures arrive as typed errors — never a hang.
+    """
+
+    __slots__ = ("_cv", "_build", "_out", "_err", "_done", "_epoch",
+                 "_supervised")
 
     def __init__(self):
-        self._ev = threading.Event()
+        self._cv = threading.Condition()
         self._build = None
         self._out = None
         self._err = None
+        self._done = False
+        self._epoch = 0
+        self._supervised = False
 
     def _resolve(self, build):
-        self._build = build
-        self._ev.set()
+        with self._cv:
+            self._build, self._err, self._done = build, None, True
+            self._cv.notify_all()
 
     def _fail(self, err):
-        self._err = err
-        self._ev.set()
+        with self._cv:
+            if self._done and self._err is None and self._out is not None:
+                return           # a delivered result is final
+            self._err, self._done = err, True
+            self._cv.notify_all()
+
+    def _rearm(self):
+        """Recovery: put the future back in flight (pending its
+        re-dispatched micro-batch)."""
+        with self._cv:
+            self._build = self._err = self._out = None
+            self._done = False
+            self._epoch += 1
+            self._cv.notify_all()
 
     def done(self) -> bool:
-        return self._ev.is_set()
+        with self._cv:
+            return self._done
+
+    def _cv_wait(self, deadline) -> bool:
+        """One bounded wait tick under the cv; False when the client
+        timeout passed."""
+        if deadline is None:
+            self._cv.wait()
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        self._cv.wait(remaining)
+        return True
 
     def result(self, timeout: Optional[float] = None):
         """Block until the response is computed and return it (the
         net's output structure, NDArray leaves, this request's rows
-        only). Raises the dispatch error if its batch failed."""
-        if not self._ev.wait(timeout):
-            raise MXNetError(
-                f"serving request not completed within {timeout}s "
-                "(batcher stopped? queue saturated?)")
-        if self._err is not None:
-            raise self._err
-        if self._out is None:
-            self._out = self._build()
-        return self._out
+        only). Raises the typed serving error
+        (:class:`~mxnet_tpu.serving.DeadlineExceeded` /
+        :class:`~mxnet_tpu.serving.Overloaded` /
+        :class:`~mxnet_tpu.serving.ServingShutdown`) or the dispatch
+        error if its batch failed terminally."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cv:
+                while not self._done:
+                    if not self._cv_wait(deadline):
+                        raise MXNetError(
+                            f"serving request not completed within "
+                            f"{timeout}s (batcher stopped? queue "
+                            "saturated?)")
+                if self._err is not None:
+                    raise self._err
+                if self._out is not None:
+                    return self._out
+                epoch, build = self._epoch, self._build
+            try:
+                out = build()
+            except BaseException as e:
+                if self._await_redispatch(epoch, e, deadline):
+                    continue
+                raise
+            with self._cv:
+                if self._epoch == epoch and self._err is None:
+                    self._out = out
+            return out
+
+    def _await_redispatch(self, epoch, exc, deadline) -> bool:
+        """The resolved response's builder failed on the client thread.
+        When the batcher is supervised and the failure is
+        recovery-class, the supervisor is seeing the SAME failure at
+        the retire seam — wait (bounded by the client timeout) for it
+        to either re-arm this future or fail it typed, instead of
+        surfacing the poisoned-buffer error."""
+        if not self._supervised:
+            return False
+        try:
+            from ..elastic import detect
+            if detect.classify(exc) not in ("device_lost", "transient"):
+                return False
+        except Exception:        # pragma: no cover - defensive
+            return False
+        with self._cv:
+            while self._epoch == epoch and self._done \
+                    and self._err is None:
+                if not self._cv_wait(deadline):
+                    return False
+            return True
 
 
 class _Request:
-    __slots__ = ("args", "rows", "t_submit", "future")
+    __slots__ = ("args", "rows", "t_submit", "future", "deadline",
+                 "retries", "requeues")
 
-    def __init__(self, args, rows, t_submit, future):
+    def __init__(self, args, rows, t_submit, future, deadline=None):
         self.args = args
         self.rows = rows
         self.t_submit = t_submit
         self.future = future
+        self.deadline = deadline   # absolute, on the batcher clock
+        self.retries = 0           # transient re-dispatches so far
+        self.requeues = 0          # device-loss re-enqueues so far
 
 
 class DynamicBatcher:
@@ -233,6 +342,15 @@ class DynamicBatcher:
 
     Thread-safe ``submit``; one background dispatcher thread owns the
     hot loop (``start=False`` for manual :meth:`process_once` driving).
+
+    Resilience hooks (wired by :class:`~mxnet_tpu.serving
+    .ServingSupervisor`; all default off): ``breaker`` (a
+    :class:`~mxnet_tpu.serving.CircuitBreaker` consulted at admission),
+    ``on_batch_failure(reqs, exc, seam) -> bool`` (classify + recover;
+    True = requests were re-enqueued/failed by the handler),
+    ``on_batch_retired()`` (success feedback closing a half-open
+    breaker), ``drain_check()`` (polled by the dispatch loop; True
+    initiates a graceful drain — the preemption-notice bridge).
     """
 
     def __init__(self, predictor, max_batch: Optional[int] = None,
@@ -254,17 +372,28 @@ class DynamicBatcher:
         self._queue: "queue.Queue[_Request]" = queue.Queue(
             maxsize=queue_depth() if depth is None else max(1, int(depth)))
         self._forming: List[_Request] = []
-        self._inflight: dict = {}   # tag -> (futures, t_submits)
+        self._inflight: dict = {}   # tag -> (requests, t_dispatch)
         self._window = DispatchWindow(max_inflight=inflight,
                                       what="serving micro-batch",
                                       sync_fn=self._retire_sync)
         self._batch_no = 0
         self._stop = threading.Event()
+        self._drain_now = threading.Event()
         self._thread = None
+        self._draining = False
+        self._dead: Optional[BaseException] = None
+        self._ewma_service: Optional[float] = None
+        # resilience hooks (ServingSupervisor wires these)
+        self.breaker = None
+        self.on_batch_failure = None
+        self.on_batch_retired = None
+        self.drain_check = None
         self.stats = {"requests": 0, "batches": 0, "rows": 0,
                       "padded_rows": 0, "flush_full": 0,
                       "flush_timeout": 0, "flush_idle": 0,
-                      "flush_force": 0, "errors": 0}
+                      "flush_force": 0, "errors": 0, "rejected": 0,
+                      "deadline_missed": 0, "requeued": 0,
+                      "recovered_batches": 0, "shutdown_failed": 0}
         t = _telemetry()
         reg = t.registry()
         self._m_requests = reg.counter(t.names.SERVING_REQUESTS)
@@ -273,6 +402,10 @@ class DynamicBatcher:
         self._m_inflight = reg.gauge(t.names.SERVING_INFLIGHT)
         self._m_occupancy = reg.histogram(t.names.SERVING_OCCUPANCY)
         self._m_latency = reg.histogram(t.names.SERVING_LATENCY)
+        self._m_rejected = reg.counter(t.names.SERVING_REJECTED,
+                                       label_key="reason")
+        self._m_deadline = reg.counter(t.names.SERVING_DEADLINE_MISSED)
+        self._m_drain = reg.histogram(t.names.SERVING_DRAIN_SECONDS)
         if start:
             self._thread = threading.Thread(
                 target=self._serve_loop, name="mx-serving-batcher",
@@ -280,30 +413,100 @@ class DynamicBatcher:
             self._thread.start()
 
     # ---------------- client surface ----------------
-    def submit(self, *args, timeout: float = 120.0) -> ServingFuture:
+    def _reject(self, reason: str, msg: str):
+        self.stats["rejected"] += 1
+        self._m_rejected.inc(label=reason)
+        raise Overloaded(msg, reason=reason)
+
+    def submit(self, *args, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None) -> ServingFuture:
         """Enqueue one request (array leaves with a leading row dim,
-        typically one row) and return its future. Blocks when the
-        bounded queue is full (backpressure)."""
+        typically one row) and return its future.
+
+        ``deadline_ms`` — this request's latency budget (default
+        ``MXNET_SERVING_DEADLINE_MS``; <= 0 disables): expired-in-queue
+        requests fail with :class:`~mxnet_tpu.serving.DeadlineExceeded`
+        and are never dispatched, and ``MXNET_SERVING_SHED=deadline``
+        sheds at admission when the projected wait already exceeds it.
+        ``timeout`` — max blocking wait on a full queue (default
+        ``MXNET_SERVING_QUEUE_TIMEOUT_MS``); a still-full queue sheds
+        with :class:`~mxnet_tpu.serving.Overloaded` (reason
+        ``queue``). Never raises a bare ``queue.Full``."""
+        fault_point("serving.admit", "before")
+        if self._dead is not None:
+            raise ServingShutdown(
+                f"serving dispatcher thread died "
+                f"({type(self._dead).__name__}: {self._dead}); "
+                "the batcher cannot accept requests")
         if self._stop.is_set():
-            raise MXNetError("DynamicBatcher is closed")
+            raise ServingShutdown("DynamicBatcher is closed")
+        if self._draining:
+            self._reject("draining",
+                         "serving drain in progress (preemption/"
+                         "shutdown) — new requests are rejected while "
+                         "accepted ones flush")
+        if self.breaker is not None and not self.breaker.allow():
+            self._reject("breaker",
+                         "serving circuit breaker is open (recovery in "
+                         "progress) — fast-failing instead of queueing "
+                         "into a dead device")
         rows = self._rows_of(args)
         if rows > self.max_batch:
             raise MXNetError(
                 f"request of {rows} rows exceeds max_batch="
                 f"{self.max_batch} (MXNET_SERVING_MAX_BATCH)")
+        if deadline_ms is None:
+            deadline_ms = default_deadline_ms()
+        elif deadline_ms <= 0:
+            deadline_ms = None
+        now = self._clock()
+        deadline = None if deadline_ms is None \
+            else now + deadline_ms / 1e3
+        mode = shed_mode()
+        if mode == "deadline" and deadline is not None:
+            est = self.estimated_wait_s(rows)
+            if est is not None and now + est > deadline:
+                self._reject(
+                    "deadline",
+                    f"projected queue wait {est * 1e3:.1f} ms exceeds "
+                    f"the request deadline ({deadline_ms:.0f} ms) — "
+                    "shedding at admission so accepted requests keep "
+                    "their p99 (MXNET_SERVING_SHED=deadline)")
         fut = ServingFuture()
-        req = _Request(args, rows, self._clock(), fut)
+        fut._supervised = self.on_batch_failure is not None
+        req = _Request(args, rows, now, fut, deadline=deadline)
+        block_s = queue_timeout_s() if timeout is None \
+            else max(0.0, float(timeout))
         try:
-            self._queue.put(req, timeout=timeout)
+            if mode == "queue" or block_s <= 0:
+                self._queue.put_nowait(req)
+            else:
+                self._queue.put(req, timeout=block_s)
         except queue.Full:
-            raise MXNetError(
+            self._reject(
+                "queue",
                 f"serving queue saturated ({self._queue.maxsize} "
                 "requests) — the service is overloaded "
-                "(MXNET_SERVING_QUEUE_DEPTH)")
+                "(MXNET_SERVING_QUEUE_DEPTH / "
+                "MXNET_SERVING_QUEUE_TIMEOUT_MS)")
         self.stats["requests"] += 1
         self._m_requests.inc()
         self._m_queue.set(self._queue.qsize() + len(self._forming))
         return fut
+
+    def estimated_wait_s(self, rows: int = 0) -> Optional[float]:
+        """Projected wait until a request submitted NOW would retire:
+        (waiting rows incl. its own, bucketed at ``max_batch``) plus
+        the in-flight micro-batches, times the EWMA micro-batch
+        service time. None before the first retire (no estimate —
+        admit; the queue bound still protects memory)."""
+        ewma = self._ewma_service
+        if ewma is None:
+            return None
+        waiting = self._queue.qsize() + self._forming_rows() + rows
+        batches = (waiting + self.max_batch - 1) // self.max_batch \
+            + len(self._window)
+        return batches * ewma
 
     @property
     def batch_fill(self) -> Optional[float]:
@@ -320,14 +523,58 @@ class DynamicBatcher:
         self._window.drain()
         self._m_inflight.set(0)
 
+    def drain(self):
+        """Graceful shutdown: flip to drain mode (new submits shed with
+        :class:`~mxnet_tpu.serving.Overloaded` reason ``draining``),
+        flush every forming + in-flight request, then close — no
+        accepted request is silently lost. The flush runs on the
+        dispatcher thread when one exists (single owner of the forming
+        list); duration lands in ``mx_serving_drain_seconds``.
+        Idempotent."""
+        t0 = self._clock()
+        self._draining = True
+        if self._thread is not None:
+            self._drain_now.set()
+            self._thread.join(timeout=60.0)
+            self._thread = None
+            self._stop.set()
+            # the in-loop drain flushed + failed leftovers + observed
+            # the histogram; this is the belt-and-braces pass for a
+            # thread that exited through a non-drain path
+            self._fail_pending(ServingShutdown(
+                "serving drained before this request could be "
+                "dispatched"))
+            return
+        if self._stop.is_set():
+            return               # already closed
+        try:
+            while self.process_once(force=True):
+                pass
+            self._window.drain()
+            self._m_inflight.set(0)
+        finally:
+            self._stop.set()
+            self._fail_pending(ServingShutdown(
+                "serving drained before this request could be "
+                "dispatched"))
+            self._m_drain.observe(max(0.0, self._clock() - t0))
+
     def close(self):
         """Stop the dispatcher thread, flush remaining requests, drain
-        the window. Idempotent."""
+        the window; anything still undispatchable fails with a typed
+        :class:`~mxnet_tpu.serving.ServingShutdown` (never a hung
+        future). Idempotent."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
-        self.flush()
+        try:
+            if self._dead is None:
+                self.flush()
+        finally:
+            self._fail_pending(ServingShutdown(
+                "DynamicBatcher closed with this request still "
+                "pending (dispatch failed or dispatcher unavailable)"))
 
     def __enter__(self):
         return self
@@ -357,6 +604,72 @@ class DynamicBatcher:
             except queue.Empty:
                 break
 
+    def _expire_forming(self):
+        """Drop requests whose deadline already expired while they
+        queued: each fails with a typed ``DeadlineExceeded`` and is
+        NEVER padded into a bucket or dispatched — the device's work
+        all lands inside someone's budget."""
+        if not self._forming:
+            return
+        now = self._clock()
+        kept = []
+        for r in self._forming:
+            if r.deadline is not None and now >= r.deadline:
+                self.stats["deadline_missed"] += 1
+                self._m_deadline.inc()
+                r.future._fail(DeadlineExceeded(
+                    f"request deadline expired after "
+                    f"{(now - r.t_submit) * 1e3:.1f} ms in queue — "
+                    "dropped at dequeue, never dispatched "
+                    "(MXNET_SERVING_DEADLINE_MS / submit(deadline_ms=))"))
+            else:
+                kept.append(r)
+        self._forming = kept
+
+    def _fail_pending(self, err: BaseException):
+        """Fail every request still waiting (queue + forming) with a
+        typed error — the anti-hang guarantee on shutdown/dispatcher
+        death."""
+        self._drain_queue()
+        pending, self._forming = self._forming, []
+        for r in pending:
+            if not r.future.done():
+                self.stats["shutdown_failed"] += 1
+                r.future._fail(err)
+        self._m_queue.set(0)
+
+    def requeue(self, reqs: List[_Request]):
+        """Re-enqueue recovered requests at the FRONT of the forming
+        list (supervisor recovery path, dispatcher thread). Original
+        submit times are preserved, so the age-based flush re-dispatches
+        them promptly; original deadlines still apply."""
+        if not reqs:
+            return
+        self._forming[0:0] = list(reqs)
+        self.stats["requeued"] += len(reqs)
+        self._m_queue.set(self._queue.qsize() + len(self._forming))
+
+    def rebind(self, predictor):
+        """Swap in a rebuilt predictor (supervisor recovery); the
+        coalescing cap must still fit the new bucket ladder."""
+        if self.max_batch > predictor.bucket_sizes[-1]:
+            raise MXNetError(
+                f"max_batch={self.max_batch} exceeds the rebuilt "
+                f"predictor's largest shape bucket "
+                f"({predictor.bucket_sizes[-1]})")
+        self._predictor = predictor
+
+    def abandon_inflight(self) -> List[_Request]:
+        """Discard every in-flight micro-batch WITHOUT syncing (work
+        dispatched to a lost device would only raise again) and return
+        the requests that rode them — the supervisor re-enqueues or
+        fails each exactly once."""
+        self._window.abandon()
+        recs = list(self._inflight.values())
+        self._inflight.clear()
+        self._m_inflight.set(0)
+        return [r for reqs, _t in recs for r in reqs]
+
     def _take_batch(self) -> List[_Request]:
         batch, rows = [], 0
         while self._forming and rows + self._forming[0].rows \
@@ -367,12 +680,14 @@ class DynamicBatcher:
         return batch
 
     def process_once(self, force: bool = False) -> bool:
-        """Manual-drive: pull waiting requests and dispatch ONE batch
-        if the flush condition holds (>= max_batch rows waiting, the
-        oldest request older than the batch timeout, or ``force``).
-        Returns whether a batch was dispatched. Uses only the injected
-        clock — fake-clock tests drive the semantics deterministically."""
+        """Manual-drive: pull waiting requests, drop expired ones, and
+        dispatch ONE batch if the flush condition holds (>= max_batch
+        rows waiting, the oldest request older than the batch timeout,
+        or ``force``). Returns whether a batch was dispatched. Uses
+        only the injected clock — fake-clock tests drive the semantics
+        deterministically."""
         self._drain_queue()
+        self._expire_forming()
         if not self._forming:
             return False
         reason = None
@@ -388,6 +703,27 @@ class DynamicBatcher:
         return True
 
     def _serve_loop(self):
+        """Dispatcher thread body: the work-conserving coalescing loop,
+        wrapped so the thread CANNOT die silently — an escaping error
+        fails every pending future with a typed ``ServingShutdown``
+        instead of leaving clients blocked forever."""
+        try:
+            self._serve_loop_inner()
+        except BaseException as e:   # noqa: BLE001 - anti-hang contract
+            self._dead = e
+            _LOG.error(
+                "serving dispatcher thread DIED (%s: %s); failing "
+                "pending futures with ServingShutdown",
+                type(e).__name__, e, exc_info=True)
+            try:
+                self._fail_pending(ServingShutdown(
+                    f"serving dispatcher thread died: "
+                    f"{type(e).__name__}: {e}"))
+            except Exception:    # pragma: no cover - defensive
+                _LOG.warning("failing pending futures failed",
+                             exc_info=True)
+
+    def _serve_loop_inner(self):
         """Work-conserving coalescing: requests gather until the batch
         is full or the oldest waiting request has aged past the
         timeout — but an IDLE device short-circuits the linger (when
@@ -397,6 +733,9 @@ class DynamicBatcher:
         never idles between micro-batches."""
         idle_poll = max(self._timeout_s, 0.005)
         while not self._stop.is_set():
+            if self._drain_now.is_set() or self._wants_drain():
+                self._drain_in_loop()
+                return
             try:
                 if not self._forming:
                     # idle: retire finished in-flight batches so their
@@ -442,13 +781,72 @@ class DynamicBatcher:
                     reason = "timeout"
                 else:
                     reason = "idle"   # device idle cut the linger short
+                self._expire_forming()
+                if not self._forming:
+                    continue
                 self._dispatch(self._take_batch(), reason)
             except Exception as e:   # keep serving after a bad batch
+                # a deferred failure surfacing at a window drain (not
+                # inside _retire_sync's own guard) still reaches the
+                # recovery handler: the in-flight records know which
+                # requests rode the poisoned batches
+                if self._handle_batch_failure([], e, "dispatcher"):
+                    continue
                 _LOG.warning("serving dispatch failed (%s: %s)",
                              type(e).__name__, e, exc_info=True)
                 self.stats["errors"] += 1
 
+    def _wants_drain(self) -> bool:
+        """Poll the drain hook (the ServingSupervisor's preemption-
+        notice bridge) — never lets a hook error kill the loop."""
+        if self.drain_check is None or self._draining:
+            return False
+        try:
+            return bool(self.drain_check())
+        except Exception:        # pragma: no cover - defensive
+            return False
+
+    def _drain_in_loop(self):
+        """Preemption-notice drain, on the dispatcher thread: reject
+        new, flush forming + in-flight, fail anything undispatchable
+        typed, stop."""
+        t0 = self._clock()
+        self._draining = True
+        _LOG.warning(
+            "serving: drain requested — rejecting new requests and "
+            "flushing %d waiting + %d in-flight",
+            self._queue.qsize() + len(self._forming), len(self._window))
+        try:
+            while self.process_once(force=True):
+                pass
+            self._window.drain()
+            self._m_inflight.set(0)
+        except Exception:        # pragma: no cover - defensive
+            _LOG.warning("serving drain flush failed", exc_info=True)
+        self._fail_pending(ServingShutdown(
+            "serving drained (preemption) before this request could "
+            "be dispatched"))
+        self._stop.set()
+        self._m_drain.observe(max(0.0, self._clock() - t0))
+
     # ---------------- dispatch ----------------
+    def _handle_batch_failure(self, reqs, exc, seam: str) -> bool:
+        """Route a batch failure to the resilience handler (the
+        ServingSupervisor). True = the requests were re-enqueued or
+        failed by the handler; False = apply the default path."""
+        handler = self.on_batch_failure
+        if handler is None:
+            return False
+        try:
+            handled = bool(handler(reqs, exc, seam))
+        except Exception:        # pragma: no cover - defensive
+            _LOG.error("serving failure handler raised; falling back "
+                       "to failing the batch", exc_info=True)
+            return False
+        if handled:
+            self.stats["recovered_batches"] += 1
+        return handled
+
     def _dispatch(self, reqs: List[_Request], reason: str):
         """One micro-batch: concatenate + pad to bucket, ONE predictor
         call, resolve each request's future with its (lazy) row slice,
@@ -461,6 +859,8 @@ class DynamicBatcher:
             with _tguard.hot_scope("DynamicBatcher.dispatch"):
                 self._dispatch_inner(reqs, reason)
         except BaseException as e:
+            if self._handle_batch_failure(reqs, e, "dispatch"):
+                return
             for r in reqs:
                 if not r.future.done():
                     r.future._fail(e)
@@ -477,6 +877,9 @@ class DynamicBatcher:
         batch_args = tuple(
             self._concat_pad([r.args[i] for r in reqs], rows, bucket)
             for i in range(n_pos))
+        # chaos-harness seam: a revoked device surfaces here when the
+        # loss hits at dispatch time (testing/faults.py)
+        fault_point("serving.dispatch", "before")
         outs = pred.predict(*batch_args)
         out_leaves, out_tree = jax.tree_util.tree_flatten(
             outs, is_leaf=lambda t: isinstance(t, NDArray))
@@ -488,7 +891,7 @@ class DynamicBatcher:
             off += r.rows
         self._batch_no += 1
         tag = self._batch_no
-        self._inflight[tag] = tuple(r.t_submit for r in reqs)
+        self._inflight[tag] = (list(reqs), self._clock())
         payload = (tag, tuple(l._data for l in out_leaves
                               if isinstance(l, NDArray)))
         self.stats["batches"] += 1
@@ -518,10 +921,34 @@ class DynamicBatcher:
     def _retire_sync(self, payload):
         """Window sync hook: block on the micro-batch's outputs (the
         blessed retire), then record each rider request's end-to-end
-        latency."""
+        latency and fold the batch's service time into the EWMA the
+        admission controller projects from. A retire FAILURE carries
+        its riders to the resilience handler — device loss re-enqueues
+        them through recovery instead of poisoning their futures."""
         tag, datas = payload
-        jax.block_until_ready(list(datas))
-        t_submits = self._inflight.pop(tag, ())
+        try:
+            # chaos-harness seam: a deferred device loss surfaces at
+            # the blocking wait on the in-flight micro-batch
+            fault_point("serving.retire", "before")
+            jax.block_until_ready(list(datas))
+        except BaseException as e:
+            rec = self._inflight.pop(tag, None)
+            if rec is not None and \
+                    self._handle_batch_failure(rec[0], e, "retire"):
+                return           # riders re-enqueued; failure handled
+            raise
+        rec = self._inflight.pop(tag, None)
         now = self._clock()
-        for t0 in t_submits:
-            self._m_latency.observe(max(0.0, now - t0))
+        if rec is not None:
+            reqs, t_dispatch = rec
+            dt = max(0.0, now - t_dispatch)
+            self._ewma_service = dt if self._ewma_service is None \
+                else 0.3 * dt + 0.7 * self._ewma_service
+            for r in reqs:
+                self._m_latency.observe(max(0.0, now - r.t_submit))
+        if self.on_batch_retired is not None:
+            try:
+                self.on_batch_retired()
+            except Exception:    # pragma: no cover - defensive
+                _LOG.warning("serving retire hook failed", exc_info=True)
+        fault_point("serving.retire", "after")

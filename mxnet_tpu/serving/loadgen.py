@@ -11,9 +11,19 @@ The two canonical load shapes for latency benchmarking:
   latency distribution under un-coordinated traffic (closed loops hide
   queueing spikes by self-throttling: coordinated omission).
 
-Both return a report dict with QPS and exact p50/p99 latency computed
-from the raw per-request samples (no histogram interpolation —
-bench.py puts these next to the training legs in the BENCH json;
+Both record each request's TERMINAL STATE — one of ``ok`` (completed;
+within the deadline when one is given), ``rejected`` (shed at
+admission: a typed :class:`~mxnet_tpu.serving.Overloaded`),
+``deadline_missed`` (a typed :class:`~mxnet_tpu.serving
+.DeadlineExceeded`, or a completion that arrived after ``deadline_s``),
+or ``error`` (anything else) — and report **goodput** (ok/s) separately
+from raw QPS: under overload with shedding armed, goodput is the honest
+capacity number; raw QPS flatters a service that answers late.
+
+Reports carry QPS, goodput_qps, reject_rate, deadline_miss_rate, and
+exact p50/p99 latency computed from the raw per-request samples of the
+``ok`` population (no histogram interpolation — bench.py puts these
+next to the training legs in the BENCH json;
 ``mx_serving_request_seconds`` carries the live-histogram view).
 """
 from __future__ import annotations
@@ -24,7 +34,28 @@ from typing import Callable, Optional
 
 import numpy as onp
 
-__all__ = ["run_closed_loop", "run_open_loop", "percentiles"]
+__all__ = ["run_closed_loop", "run_open_loop", "percentiles",
+           "classify_outcome"]
+
+OUTCOMES = ("ok", "rejected", "deadline_missed", "error")
+
+
+def classify_outcome(exc: BaseException) -> str:
+    """Map a request failure to its terminal state: a typed
+    ``Overloaded`` (anywhere in the cause chain) is ``rejected``, a
+    typed ``DeadlineExceeded`` is ``deadline_missed``, anything else
+    is ``error``."""
+    from .resilience import DeadlineExceeded, Overloaded
+    seen = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, Overloaded):
+            return "rejected"
+        if isinstance(e, DeadlineExceeded):
+            return "deadline_missed"
+        e = e.__cause__ or e.__context__
+    return "error"
 
 
 def percentiles(latencies) -> dict:
@@ -37,13 +68,41 @@ def percentiles(latencies) -> dict:
             "mean_ms": round(float(a.mean()), 3)}
 
 
+def _report(mode: str, outcomes: dict, ok_lat, wall: float,
+            extra: dict) -> dict:
+    total = sum(outcomes.values())
+    done = outcomes["ok"] + outcomes["deadline_missed"] \
+        + outcomes["error"]
+    out = dict(extra)
+    out.update({
+        "mode": mode,
+        "requests": int(outcomes["ok"]),
+        "issued": int(total),
+        "errors": int(outcomes["error"]),
+        "outcomes": dict(outcomes),
+        "wall_s": round(wall, 4),
+        "qps": round(done / wall, 2) if wall > 0 else None,
+        "goodput_qps": round(outcomes["ok"] / wall, 2)
+        if wall > 0 else None,
+        "reject_rate": round(outcomes["rejected"] / total, 4)
+        if total else None,
+        "deadline_miss_rate": round(outcomes["deadline_missed"] / total,
+                                    4) if total else None,
+    })
+    out.update(percentiles(ok_lat))
+    return out
+
+
 def run_closed_loop(issue: Callable[[int], None], concurrency: int,
-                    requests: int) -> dict:
+                    requests: int,
+                    deadline_s: Optional[float] = None) -> dict:
     """C worker threads; each calls ``issue(i)`` (submit AND wait for
-    one request) back-to-back until ``requests`` total are done.
-    Latency is the full ``issue`` wall time per request."""
-    latencies: list = []
-    errors = [0]
+    one request) back-to-back until ``requests`` total are issued.
+    Latency is the full ``issue`` wall time per request; with
+    ``deadline_s`` a completion slower than it counts as
+    ``deadline_missed``, not ``ok`` (goodput is ok/s)."""
+    outcomes = {k: 0 for k in OUTCOMES}
+    ok_lat: list = []
     lock = threading.Lock()
     counter = [0]
 
@@ -57,13 +116,17 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
             t0 = time.perf_counter()
             try:
                 issue(i)
-            except Exception:
+            except Exception as e:
                 with lock:
-                    errors[0] += 1
+                    outcomes[classify_outcome(e)] += 1
                 continue
             dt = time.perf_counter() - t0
             with lock:
-                latencies.append(dt)
+                if deadline_s is not None and dt > deadline_s:
+                    outcomes["deadline_missed"] += 1
+                else:
+                    outcomes["ok"] += 1
+                    ok_lat.append(dt)
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(1, concurrency))]
@@ -73,28 +136,28 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    out = {"mode": "closed", "concurrency": int(concurrency),
-           "requests": int(len(latencies)), "errors": int(errors[0]),
-           "wall_s": round(wall, 4),
-           "qps": round(len(latencies) / wall, 2) if wall > 0 else None}
-    out.update(percentiles(latencies))
-    return out
+    return _report("closed", outcomes, ok_lat, wall,
+                   {"concurrency": int(concurrency)})
 
 
 def run_open_loop(submit: Callable[[int], Callable[[], None]],
                   rate_qps: float, requests: int,
                   seed: int = 0,
-                  timeout: Optional[float] = 120.0) -> dict:
+                  timeout: Optional[float] = 120.0,
+                  deadline_s: Optional[float] = None) -> dict:
     """Poisson arrivals at ``rate_qps``: ``submit(i)`` must enqueue
     request ``i`` WITHOUT waiting and return a zero-arg wait callable
     (e.g. ``DynamicBatcher.submit(...).result``). Arrival jitter is
     deterministic per ``seed``. Latency = arrival (scheduled submit)
-    to completion — queueing included, no coordinated omission."""
+    to completion — queueing included, no coordinated omission. A
+    ``submit`` that raises (admission-control shedding) is recorded as
+    that request's terminal state — the arrival clock keeps ticking,
+    exactly like real un-coordinated traffic."""
     import queue as _queue
     rng = onp.random.RandomState(seed)
     gaps = rng.exponential(1.0 / max(rate_qps, 1e-9), size=requests)
-    latencies: list = []
-    errors = [0]
+    outcomes = {k: 0 for k in OUTCOMES}
+    ok_lat: list = []
     lock = threading.Lock()
     # a waiter pool records each completion AS IT HAPPENS — waiting
     # sequentially after the arrival phase would inflate every early
@@ -112,13 +175,17 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
                     wait() if timeout is None else wait(timeout)
                 except TypeError:
                     wait()
-            except Exception:
+            except Exception as e:
                 with lock:
-                    errors[0] += 1
+                    outcomes[classify_outcome(e)] += 1
                 continue
             dt = time.perf_counter() - t0
             with lock:
-                latencies.append(dt)
+                if deadline_s is not None and dt > deadline_s:
+                    outcomes["deadline_missed"] += 1
+                else:
+                    outcomes["ok"] += 1
+                    ok_lat.append(dt)
 
     n_waiters = min(32, max(4, requests // 8))
     threads = [threading.Thread(target=waiter, daemon=True)
@@ -131,16 +198,19 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
         now = time.perf_counter()
         if next_t > now:
             time.sleep(next_t - now)
-        work.put((time.perf_counter(), submit(i)))
+        t0 = time.perf_counter()
+        try:
+            waitfn = submit(i)
+        except Exception as e:       # shed at admission
+            with lock:
+                outcomes[classify_outcome(e)] += 1
+        else:
+            work.put((t0, waitfn))
         next_t += gaps[i]
     for _ in threads:
         work.put(None)
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    out = {"mode": "open", "rate_qps": float(rate_qps),
-           "requests": int(len(latencies)), "errors": int(errors[0]),
-           "wall_s": round(wall, 4),
-           "qps": round(len(latencies) / wall, 2) if wall > 0 else None}
-    out.update(percentiles(latencies))
-    return out
+    return _report("open", outcomes, ok_lat, wall,
+                   {"rate_qps": float(rate_qps)})

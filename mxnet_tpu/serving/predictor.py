@@ -70,6 +70,20 @@ def _telemetry():
     return _TELEM
 
 
+# elastic device-loss detection (elastic/detect.py), lazily reached so
+# a lost device escaping the predictor call gets its exactly-one
+# device_lost anomaly (the ServingSupervisor's recovery trigger)
+_EDET = None
+
+
+def _edetect():
+    global _EDET
+    if _EDET is None:
+        from ..elastic import detect as _d
+        _EDET = _d
+    return _EDET
+
+
 #: default leading-dim shape buckets: powers of two up to 64 — small
 #: enough that a replica compiles them all at startup, coarse enough
 #: that the compile cache keys on a handful of programs
@@ -259,7 +273,8 @@ class CompiledPredictor:
         here (the transfer guard enforces it when armed). Inputs must
         already be bucket-shaped; pair with :meth:`pad_to_bucket` or
         the :class:`~mxnet_tpu.serving.DynamicBatcher`."""
-        with _tguard.hot_scope("CompiledPredictor.predict"):
+        with _tguard.hot_scope("CompiledPredictor.predict"), \
+                _edetect().device_lost_guard("CompiledPredictor.predict"):
             if self._mode is None:
                 self._mode = "fused"
             if self._mode == "eager":

@@ -168,6 +168,16 @@ SERVING_OCCUPANCY = "mx_serving_batch_occupancy_ratio"
 SERVING_LATENCY = "mx_serving_request_seconds"
 
 # ---------------------------------------------------------------------------
+# resilient serving (serving/resilience.py + batcher.py admission control)
+# ---------------------------------------------------------------------------
+SERVING_REJECTED = "mx_serving_rejected_total"
+SERVING_DEADLINE_MISSED = "mx_serving_deadline_missed_total"
+SERVING_RETRIES = "mx_serving_retries_total"
+SERVING_RECOVERIES = "mx_serving_recoveries_total"
+SERVING_BREAKER_STATE = "mx_serving_breaker_state"
+SERVING_DRAIN_SECONDS = "mx_serving_drain_seconds"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -437,6 +447,39 @@ CATALOG = {
         kind="histogram", label=None,
         help="end-to-end request latency: submit to micro-batch "
              "retire (queueing + coalescing delay + compute)"),
+    SERVING_REJECTED: dict(
+        kind="counter", label="reason",
+        help="requests shed at admission by reason (queue = bounded "
+             "queue full, deadline = projected wait exceeds the "
+             "request deadline, breaker = circuit breaker open during "
+             "recovery, draining = graceful shutdown in progress; "
+             "MXNET_SERVING_SHED, docs/SERVING.md)"),
+    SERVING_DEADLINE_MISSED: dict(
+        kind="counter", label=None,
+        help="accepted requests dropped at dequeue because their "
+             "deadline expired while queued (failed with typed "
+             "DeadlineExceeded, never padded/dispatched)"),
+    SERVING_RETRIES: dict(
+        kind="counter", label="cause",
+        help="serving requests re-enqueued by the ServingSupervisor "
+             "after a classified failure (device_lost = in-flight "
+             "work re-dispatched post-recovery, transient = bounded "
+             "backoff retry)"),
+    SERVING_RECOVERIES: dict(
+        kind="counter", label="cause",
+        help="ServingSupervisor predictor rebuilds by failure cause "
+             "(device_lost: re-formed over available_devices with AOT "
+             "buckets warm-started from MXNET_COMPILE_CACHE)"),
+    SERVING_BREAKER_STATE: dict(
+        kind="gauge", label=None,
+        help="serving circuit-breaker state: 0 closed (normal), 1 "
+             "half-open (post-recovery probe), 2 open (fast-failing "
+             "new submits while recovery runs)"),
+    SERVING_DRAIN_SECONDS: dict(
+        kind="histogram", label=None,
+        help="graceful-drain duration: reject-new to queue flushed + "
+             "in-flight retired + batcher closed (SIGTERM/preemption "
+             "workflow, docs/SERVING.md)"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

@@ -43,6 +43,16 @@ so a later ``world_changed()`` probe sees the world grow back. The
 fault points bracketing step dispatch (``step.dispatch``), window
 retire (``window.retire``) and device_put staging (``prefetch.stage``)
 are where mid-run revocations land.
+
+The SERVING chaos seams (docs/SERVING.md "Resilient serving") mirror
+them on the inference path: ``serving.admit`` (inside
+``DynamicBatcher.submit``, before admission control),
+``serving.dispatch`` (just before the coalesced micro-batch's
+predictor call) and ``serving.retire`` (inside the window-retire sync
+on the micro-batch's outputs). A ``revoke`` at either of the last two
+is what the :class:`~mxnet_tpu.serving.ServingSupervisor`'s
+device-loss recovery is tested against (tests/
+test_serving_resilience.py).
 """
 from __future__ import annotations
 
@@ -234,7 +244,10 @@ def _fire(rule: FaultRule):
         time.sleep(rule.delay_ms / 1000.0)
     elif rule.action == "revoke":
         lost = _revoke_devices(rule.count)
-        names = ", ".join(str(d) for d in lost)
+        # a single-device world has nothing to revoke (>= 1 always
+        # survives) but the failure is still injected — name it so
+        names = ", ".join(str(d) for d in lost) \
+            or "<none revocable: single-device world>"
         # the message mirrors what PjRt surfaces when a TPU host is
         # preempted mid-execution, so detection pattern-matches reality
         raise DeviceRevokedError(

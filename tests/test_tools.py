@@ -219,7 +219,9 @@ def test_diagnose_numerics_section(capsys, tmp_path, monkeypatch):
 def test_diagnose_serving_section(capsys):
     """--serving: AOT-compiles the tiny bucketed predictor, runs a
     concurrent closed-loop burst through the dynamic batcher, and
-    prints the stats table plus the p50/p99 latency probe."""
+    prints the stats table plus the p50/p99 latency probe — then the
+    resilience panel: one injected revocation under a burst with
+    breaker transitions, recovery downtime, and the outcome census."""
     diagnose = _load("tools/diagnose.py", "diagnose7")
     assert diagnose.main(["--serving"]) == 0
     out = capsys.readouterr().out
@@ -230,6 +232,12 @@ def test_diagnose_serving_section(capsys):
     assert "batch fill" in out
     assert "errors        0" in out
     assert "compile cache:" in out
+    # resilience panel: exactly one recovery, breaker round trip
+    assert "resilience (1 injected revocation under burst)" in out
+    assert "recoveries   : 1" in out
+    assert "closed -> open -> half_open -> closed" in out
+    assert "outcomes     :" in out
+    assert "shed policy  : MXNET_SERVING_SHED=" in out
 
 
 def test_diagnose_elastic_section(capsys):

@@ -807,6 +807,60 @@ def check_serving():
         else:
             print("compile cache: off (set MXNET_COMPILE_CACHE=<dir> "
                   "to warm-start serving executables)")
+
+        # resilience panel: one injected device revocation under a
+        # small burst, served through the ServingSupervisor — breaker
+        # transitions, recovery downtime, and the outcome census show
+        # whether device-loss recovery is wired (docs/SERVING.md
+        # "Resilient serving")
+        print("-- resilience (1 injected revocation under burst) --")
+        from mxnet_tpu.testing import faults
+
+        def build():
+            mx.random.seed(11)
+            net2 = nn.HybridSequential()
+            net2.add(nn.Dense(64, activation="relu", in_units=32),
+                     nn.Dense(8, in_units=64))
+            net2.initialize()
+            net2(x1)
+            return serving.CompiledPredictor(net2,
+                                             bucket_sizes=(1, 2, 4))
+
+        sup = serving.ServingSupervisor(build, example=(x1,),
+                                        max_batch=4, timeout_ms=2.0)
+        outcomes = {"ok": 0, "rejected": 0, "deadline_missed": 0,
+                    "error": 0}
+        try:
+            faults.configure("serving.dispatch:before=2:revoke:1")
+            futs = []
+            for i in range(24):
+                try:
+                    futs.append(sup.submit(
+                        mx.nd.array(X[i % 64:i % 64 + 1])))
+                except Exception as e:
+                    futs.append(None)
+                    outcomes[loadgen.classify_outcome(e)] += 1
+            for f in futs:
+                if f is None:
+                    continue
+                try:
+                    f.result(60)
+                    outcomes["ok"] += 1
+                except Exception as e:
+                    outcomes[loadgen.classify_outcome(e)] += 1
+        finally:
+            faults.reset()
+            sup.close()
+        print("breaker      :",
+              " -> ".join(s for s, _t, _c in sup.breaker.transitions))
+        print(f"recoveries   : {sup.stats['recoveries']} "
+              f"(downtime {sup.stats['recovery_downtime_s']:.2f} s, "
+              f"requeued {sup.stats['requeued']})")
+        print("outcomes     :", outcomes)
+        dl = serving.default_deadline_ms()
+        print("shed policy  : MXNET_SERVING_SHED="
+              f"{serving.shed_mode()} deadline="
+              + (f"{dl:.0f} ms" if dl is not None else "unset"))
     except Exception as e:  # pragma: no cover - env-dependent
         print("serving check failed:", repr(e))
 
