@@ -975,6 +975,76 @@ def bench_serving(dtype):
     }
 
 
+def bench_decode(dtype):
+    """Continuous-batching decode leg (mx.serving.decode,
+    docs/SERVING.md "Continuous batching"): the reference decoder
+    served over a heavy-tailed request mix (mostly short decodes, a
+    few long ones — the shape that makes whole-batch scheduling bleed)
+    two ways with IDENTICAL compiled programs:
+
+    - **static**: the classic whole-batch baseline — fill every slot,
+      prefill all prompts, decode until the LAST member finishes;
+    - **continuous**: iteration-level scheduling — finished slots
+      refilled between steps, chunked prefill interleaved with decode.
+
+    The acceptance bar is continuous token throughput >= 2x static at
+    this mix, with lower short-request TTFT. Reports
+    decode_tokens_per_sec, exact TTFT/TPOT percentiles, KV page
+    utilization, and the kernel dispatch posture."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.ops import kernels as _kern
+
+    on_accel = jax.default_backend() != "cpu"
+    vocab, d_model, heads = (256, 128, 4) if on_accel else (64, 32, 2)
+    n_req = 32 if on_accel else 16
+    ladder = (1, 2, 4, 8) if on_accel else (1, 2, 4)
+    page_size = 16 if on_accel else 8
+    rng = onp.random.RandomState(7)
+    model = serving.TinyDecoder(vocab=vocab, d_model=d_model,
+                                num_heads=heads, seed=0)
+    prompts, mns = [], []
+    for i in range(n_req):
+        prompts.append(rng.randint(0, vocab,
+                                   size=int(rng.randint(2, 12))))
+        mns.append(48 if i % 8 == 0 else int(rng.randint(2, 6)))
+    log(f"bench[decode]: {n_req} requests, ladder={ladder}, "
+        f"page_size={page_size}, mix=heavy-tail "
+        f"(len {min(mns)}..{max(mns)})")
+    cont = serving.run_decode(model, prompts, mns, ladder=ladder,
+                              page_size=page_size)
+    stat = serving.run_decode(model, prompts, mns, ladder=ladder,
+                              page_size=page_size, static=True)
+    speedup = round(cont["decode_tokens_per_sec"]
+                    / stat["decode_tokens_per_sec"], 2) \
+        if cont.get("decode_tokens_per_sec") and \
+        stat.get("decode_tokens_per_sec") else None
+    log(f"bench[decode]: continuous {cont['decode_tokens_per_sec']} "
+        f"tok/s (ttft p99 {cont['ttft_p99_ms']}ms) vs static "
+        f"{stat['decode_tokens_per_sec']} tok/s (ttft p99 "
+        f"{stat['ttft_p99_ms']}ms) — speedup {speedup}x")
+    return {
+        "decode_tokens_per_sec": cont.get("decode_tokens_per_sec"),
+        "ttft_p50_ms": cont.get("ttft_p50_ms"),
+        "ttft_p99_ms": cont.get("ttft_p99_ms"),
+        "tpot_p50_ms": cont.get("tpot_p50_ms"),
+        "tpot_p99_ms": cont.get("tpot_p99_ms"),
+        "kv_page_util": cont.get("kv_page_util"),
+        "speedup_vs_static": speedup,
+        "static_tokens_per_sec": stat.get("decode_tokens_per_sec"),
+        "static_ttft_p99_ms": stat.get("ttft_p99_ms"),
+        "tokens": cont.get("tokens"),
+        "requests": n_req,
+        "steps": cont.get("steps"),
+        "static_steps": stat.get("steps"),
+        "prefill_chunks": cont.get("prefill_chunks"),
+        "slot_ladder": list(ladder),
+        "page_size": page_size,
+        "kernel_path": _kern.dispatch_table().get("rnn_decode_step"),
+        "continuous_detail": cont,
+        "static_detail": stat,
+    }
+
+
 def main():
     model = os.environ.get("MXNET_BENCH_MODEL", "all")
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
@@ -1140,6 +1210,35 @@ def main():
                 "serving_recovery_downtime_s":
                     s.get("recovery_downtime_s"),
                 "serving_detail": s,
+            })
+    if model in ("all", "decode"):
+        # continuous-batching decode leg: isolated like the other
+        # secondary legs
+        try:
+            d = bench_decode(dtype)
+        except Exception as e:
+            if model == "decode":
+                raise
+            log(f"bench[decode]: FAILED ({type(e).__name__}: {e}); "
+                "continuing without it")
+            d = None
+        if d is not None:
+            if model == "decode":
+                out.update({
+                    "metric": "decode_tokens_per_sec",
+                    "value": d["decode_tokens_per_sec"],
+                    "unit": "tok/s",
+                    "vs_baseline": d["speedup_vs_static"],
+                    "dtype": dtype,
+                })
+            out.update({
+                "decode_tokens_per_sec": d["decode_tokens_per_sec"],
+                "decode_ttft_p50_ms": d["ttft_p50_ms"],
+                "decode_ttft_p99_ms": d["ttft_p99_ms"],
+                "decode_tpot_p50_ms": d["tpot_p50_ms"],
+                "decode_kv_page_util": d["kv_page_util"],
+                "decode_speedup_vs_static": d["speedup_vs_static"],
+                "decode_detail": d,
             })
     try:
         roof = matmul_roofline()

@@ -178,7 +178,11 @@ def donation_audit(stablehlo_text: str, compiled_text: str,
     # lowered StableHLO marks donated args per-parameter:
     #   %arg0: tensor<..> {jax.buffer_donor = true}   (jax >= 0.4.30)
     #   %arg1: tensor<..> {tf.aliasing_output = 1}    (pre-decided alias)
-    for m in re.finditer(r"%arg(\d+):[^)]*?(jax\.buffer_donor = true"
+    # the annotation block belongs to ONE argument — stop the match at
+    # the next argument (comma) so a donor deep in the list is never
+    # credited to an earlier undonated arg
+    for m in re.finditer(r"%arg(\d+): [^,{]*\{[^{}]*?"
+                         r"(jax\.buffer_donor = true"
                          r"|tf\.aliasing_output = \d+)",
                          stablehlo_text or ""):
         declared_params.append(int(m.group(1)))

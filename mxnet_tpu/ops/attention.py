@@ -33,8 +33,8 @@ from jax import lax
 
 from ..base import MXNetError
 
-__all__ = ["flash_attention", "ring_attention", "ring_attention_sharded",
-           "attention_reference"]
+__all__ = ["flash_attention", "paged_decode_attention", "ring_attention",
+           "ring_attention_sharded", "attention_reference"]
 
 _NEG_INF = -1e30  # finite mask value: keeps exp() NaN-free for masked rows
 
@@ -642,6 +642,44 @@ def flash_attention(q, k, v, causal: bool = False,
         # backward kernels (dq + dkv) off the saved log-sum-exp
         return _flash_tpu(q, k, v, causal, float(sm_scale), False)
     return _flash(q, k, v, causal, float(sm_scale))
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention: the single-token serving read path
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           sm_scale: Optional[float] = None):
+    """One query token per batch slot attending over K/V held in a
+    paged cache (serving/kvcache.py) — the decode path through the
+    flash-attention kernel, reading keys through page-table
+    indirection.
+
+    - ``q``: (S, H, D) — the current token's query per slot;
+    - ``k_pages``/``v_pages``: (P, page_size, H, D) — the pooled page
+      arrays of one layer;
+    - ``page_table``: (S, max_pages) int32 — slot → page ids, padded
+      with the null page 0 past each slot's allocation;
+    - ``lengths``: (S,) — valid key count per slot (the token just
+      written included).
+
+    The page gather is a shape-stable XLA gather (the compiled program
+    never depends on which pages a slot holds), and the attention runs
+    as ``flash_attention(..., valid_length=lengths)`` so padding pages
+    and unwritten tail positions are masked exactly (never a NaN, never
+    a contribution from another request's freed pages). Returns
+    (S, H, D).
+    """
+    s, h, d = q.shape
+    ps = k_pages.shape[1]
+    t = page_table.shape[1] * ps
+    # (S, max_pages, page_size, H, D) -> (S, H, T, D): slot s's key at
+    # position p lives at flat index p because pages fill in order
+    k = k_pages[page_table].reshape(s, t, h, d).transpose(0, 2, 1, 3)
+    v = v_pages[page_table].reshape(s, t, h, d).transpose(0, 2, 1, 3)
+    out = flash_attention(q[:, :, None, :], k, v, causal=False,
+                          sm_scale=sm_scale, valid_length=lengths)
+    return out[:, :, 0, :]
 
 
 # ---------------------------------------------------------------------------

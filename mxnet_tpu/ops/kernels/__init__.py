@@ -103,8 +103,8 @@ except Exception:    # pragma: no cover - tuning must never break ops
         "kernel tunable registration failed", exc_info=True)
 
 #: the kernel names the dispatch gate knows (bench/diagnose vocabulary)
-KERNELS = ("rnn_scan", "opt_update", "layernorm", "bias_gelu",
-           "flash_attention")
+KERNELS = ("rnn_scan", "rnn_decode_step", "opt_update", "layernorm",
+           "bias_gelu", "flash_attention")
 
 # last decision per kernel name: {kernel: (path, reason)}
 _DECISIONS: Dict[str, Tuple[str, str]] = {}

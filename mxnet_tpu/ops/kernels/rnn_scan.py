@@ -39,7 +39,7 @@ from jax import lax
 
 from . import dispatch, vmem_tile_budget
 
-__all__ = ["rnn_scan", "scan_supported"]
+__all__ = ["rnn_scan", "rnn_decode_step", "scan_supported"]
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 _MAX_BLOCK_T = 16      # unrolled in-kernel; bounds Mosaic program size
@@ -476,6 +476,103 @@ def _scan_noc_bwd(mode, interpret, res, dys):
 
 
 _scan_noc.defvjp(_scan_noc_fwd, _scan_noc_bwd)
+
+
+# ---------------------------------------------------------------------------
+# single-step decode kernel (the T=1 / block_t=1 variant)
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(mode, *refs):
+    lstm = mode == "lstm"
+    if lstm:
+        xw_ref, h0_ref, c0_ref, w_ref, b_ref, hy_ref, cy_ref = refs
+    else:
+        xw_ref, h0_ref, w_ref, b_ref, hy_ref = refs
+        c0_ref = cy_ref = None
+    # everything VMEM-resident for the whole call: h (and c), the h2h
+    # weights and bias — one matmul + gate fusion, zero HBM round-trips
+    # between them (the per-token analogue of the scan kernel's block)
+    h = h0_ref[...]
+    w = w_ref[...]
+    b = b_ref[...]
+    hw = lax.dot_general(h, w, (((1,), (1,)), ((), ())))
+    h_new, c_new = _fwd_step(mode, xw_ref[...], h,
+                             c0_ref[...] if lstm else None, hw, b)
+    hy_ref[...] = h_new
+    if lstm:
+        cy_ref[...] = c_new
+
+
+def _decode_pallas(xw, h, c, w_hh, b_hh, mode, interpret):
+    from jax.experimental import pallas as pl
+    n, gh = xw.shape
+    g = _GATES[mode]
+    hdim = gh // g
+    hp = _pad_to(hdim, 128)
+    np_ = _pad_to(n, _sublane(xw.dtype))
+    xw_p = _pad_gated(jnp.pad(xw, ((0, np_ - n), (0, 0))),
+                      g, hdim, hp, axis=1)
+    w_p = jnp.pad(w_hh.reshape(g, hdim, hdim),
+                  ((0, 0), (0, hp - hdim),
+                   (0, hp - hdim))).reshape(g * hp, hp)
+    b_p = _pad_gated(b_hh, g, hdim, hp, axis=0).reshape(1, g * hp)
+    h_p = jnp.pad(h, ((0, np_ - n), (0, hp - hdim)))
+    lstm = mode == "lstm"
+    dt = xw.dtype
+    full = lambda shape: pl.BlockSpec(shape, lambda: (0, 0))
+    in_specs = [full((np_, g * hp)), full((np_, hp))]
+    operands = [xw_p, h_p]
+    if lstm:
+        in_specs.append(full((np_, hp)))
+        operands.append(jnp.pad(c, ((0, np_ - n), (0, hp - hdim))))
+    in_specs += [full((g * hp, hp)), full((1, g * hp))]
+    operands += [w_p, b_p]
+    out_specs = [full((np_, hp))] + ([full((np_, hp))] if lstm else [])
+    out_shape = [jax.ShapeDtypeStruct((np_, hp), dt)] * (2 if lstm
+                                                         else 1)
+    outs = pl.pallas_call(
+        functools.partial(_decode_kernel, mode),
+        in_specs=in_specs, out_specs=out_specs, out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+    h_new = outs[0][:n, :hdim]
+    c_new = outs[1][:n, :hdim] if lstm else None
+    return h_new, c_new
+
+
+def decode_supported(xw, h, c, mode: str) -> Optional[str]:
+    """None when the decode-step kernel covers this call, else the
+    fallback reason."""
+    if mode not in _GATES:
+        return f"unknown mode {mode!r}"
+    if xw.dtype not in (jnp.float32, jnp.bfloat16):
+        return f"dtype {xw.dtype} not kernelized (f32/bf16 only)"
+    if xw.ndim != 2:
+        return "expects (N, G*H) — one timestep per call"
+    return None
+
+
+def rnn_decode_step(xw, h, c, w_hh, b_hh, mode: str):
+    """ONE recurrence step from a precomputed input projection ``xw``
+    (N, G*H) — the autoregressive-decode variant of :func:`rnn_scan`
+    (T = 1, block_t = 1): h (and c for LSTM) plus the h2h weights live
+    in VMEM for the whole call, so a decode iteration costs one fused
+    kernel instead of a scan prologue over a length-1 sequence.
+
+    Dispatches through the shared MXNET_PALLAS gate; the XLA reference
+    path is the SAME ``_fwd_step`` gate math the scan reference uses,
+    so a token decoded step-by-step is bit-identical to the same token
+    position inside a full :func:`rnn_scan` (tier-1 pins it). Returns
+    ``(h_new, c_new|None)``; no VJP — decode is inference-only.
+    """
+    why = decode_supported(xw, h, c, mode)
+    path, _ = dispatch("rnn_decode_step", supported=why is None,
+                       reason=why)
+    if path == "xla":
+        hw = lax.dot_general(h, w_hh, (((1,), (1,)), ((), ())))
+        return _fwd_step(mode, xw, h, c, hw, b_hh)
+    return _decode_pallas(xw, h, c, w_hh, b_hh, mode,
+                          path == "interpret")
 
 
 # ---------------------------------------------------------------------------

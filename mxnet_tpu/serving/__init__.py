@@ -45,12 +45,22 @@ from .resilience import (CircuitBreaker, DeadlineExceeded, Overloaded,
 from .predictor import CompiledPredictor, DEFAULT_BUCKETS, predictor_for
 from .batcher import (DynamicBatcher, ServingFuture, batch_timeout_s,
                       max_batch_rows, queue_depth)
+from .kvcache import KV_PAGE_SIZE, PagedKVCache, pages_needed
+from .decode import (DecodeEngine, DecodeStream, TinyDecoder,
+                     kv_page_size, prefill_chunk, run_decode,
+                     slot_ladder)
 from . import loadgen
 from . import resilience
+from . import decode
+from . import kvcache
 
 __all__ = ["CompiledPredictor", "DynamicBatcher", "ServingFuture",
            "predictor_for", "DEFAULT_BUCKETS", "loadgen", "resilience",
            "max_batch_rows", "batch_timeout_s", "queue_depth",
            "CircuitBreaker", "ServingSupervisor", "DeadlineExceeded",
            "Overloaded", "ServingShutdown", "default_deadline_ms",
-           "queue_timeout_s", "shed_mode", "transient_retries"]
+           "queue_timeout_s", "shed_mode", "transient_retries",
+           "decode", "kvcache", "DecodeEngine", "DecodeStream",
+           "TinyDecoder", "PagedKVCache", "KV_PAGE_SIZE",
+           "pages_needed", "run_decode", "slot_ladder", "kv_page_size",
+           "prefill_chunk"]

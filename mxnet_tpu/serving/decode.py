@@ -1,0 +1,1128 @@
+"""Continuous-batching autoregressive decode engine (docs/SERVING.md).
+
+Request-level batching (DynamicBatcher) is the wrong granularity for
+autoregressive decode: requests retire after wildly different numbers
+of steps, and a whole-batch scheduler holds every finished slot hostage
+to the longest member (the Orca observation — iteration-level
+scheduling, arXiv via vLLM/Orca lineage). This module schedules at the
+STEP boundary instead:
+
+- **Iteration-level scheduling.** Requests join and leave the running
+  batch BETWEEN decode steps. The compiled step is shape-stable over a
+  fixed ladder of slot-count buckets (``decode.slot_ladder`` /
+  ``MXNET_DECODE_SLOTS``; AOT-compiled, warm-started from
+  ``MXNET_COMPILE_CACHE``) with a per-slot active mask; a slot freed by
+  EOS/max-tokens is refilled from the queue on the next iteration.
+- **Paged KV cache.** K/V history lives in :class:`~mxnet_tpu.serving
+  .kvcache.PagedKVCache` pages behind a (slots, max_pages) page-table
+  indirection, so admission control is simply "are there free pages" —
+  a request that cannot reserve its worst-case pages is shed with a
+  typed ``Overloaded(reason="kvcache")`` (composing the PR 15 EWMA/
+  deadline shedder, which still applies first).
+- **Chunked prefill.** Long prompts are consumed ``decode.prefill_chunk``
+  tokens at a time, strictly alternating with decode iterations when
+  both kinds of work exist — a long prompt can never starve the
+  running batch, and a short request's TTFT never waits on a long
+  prompt ahead of it.
+- **Single-step decode kernel.** The per-token recurrence runs through
+  :func:`~mxnet_tpu.ops.kernels.rnn_scan.rnn_decode_step` (the
+  block_t=1 rnn_scan variant behind the shared ``MXNET_PALLAS`` gate)
+  and attention reads K/V through the page table via
+  :func:`~mxnet_tpu.ops.attention.paged_decode_attention`.
+
+Pipelining discipline: every step is dispatched async and pushed into a
+:class:`~mxnet_tpu.engine.DispatchWindow`; the retire of a step is the
+ONE blessed host sync, and that is where its tokens are read back and
+streamed to the per-request :class:`DecodeStream` futures. Next-step
+inputs chain DEVICE-side (the sampled-token array feeds the next
+iteration without a host round trip), so the hot loop stays clean under
+``MXNET_TRANSFER_GUARD=raise`` — a tier-1 test pins zero unblessed
+syncs over a streamed multi-request run.
+
+Slot-reuse safety: an in-flight step dispatched before a retire
+discovered EOS writes one garbage token into the finished request's
+(now freed) pages. That is safe by stream order — the device executes
+steps in dispatch order, so the garbage write always lands BEFORE the
+next occupant's prefill overwrites those pages — and it is budgeted:
+admission reserves ``pages_needed(prompt + max_new + inflight)``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..analysis import guard as _tguard
+from ..engine import DispatchWindow
+from ..ops.attention import paged_decode_attention
+from ..ops.kernels.rnn_scan import rnn_decode_step
+from .kvcache import KV_PAGE_SIZE, PagedKVCache, pages_needed
+from .resilience import (DeadlineExceeded, Overloaded, ServingShutdown,
+                         default_deadline_ms, shed_mode)
+from .batcher import queue_depth
+
+__all__ = ["DecodeEngine", "DecodeStream", "TinyDecoder", "run_decode",
+           "slot_ladder", "kv_page_size", "prefill_chunk",
+           "DECODE_SLOT_LADDER", "PREFILL_CHUNK"]
+
+#: shipped slot-count ladder (``decode.slot_ladder`` / ``MXNET_DECODE_SLOTS``)
+DECODE_SLOT_LADDER = (1, 2, 4, 8)
+#: shipped prompt-chunk width (``decode.prefill_chunk`` /
+#: ``MXNET_DECODE_PREFILL_CHUNK``)
+PREFILL_CHUNK = 16
+
+
+def _parse_ladder(v) -> Tuple[int, ...]:
+    """'1,2,4,8' (or an int sequence) -> sorted unique positive tuple."""
+    if isinstance(v, (tuple, list)):
+        vals = tuple(sorted({int(x) for x in v}))
+    else:
+        vals = tuple(sorted({int(x) for x in
+                             str(v).replace(" ", "").split(",") if x}))
+    if not vals or vals[0] < 1:
+        raise ValueError(f"bad slot ladder {v!r}")
+    return vals
+
+
+def slot_ladder() -> Tuple[int, ...]:
+    """THE slot-ladder accessor: autotune override >
+    ``MXNET_DECODE_SLOTS`` > the default (tuning/space.py precedence)."""
+    from ..tuning import space as _tspace
+    v = _tspace.value("decode.slot_ladder",
+                      ",".join(str(x) for x in DECODE_SLOT_LADDER))
+    try:
+        return _parse_ladder(v)
+    except (TypeError, ValueError):
+        return DECODE_SLOT_LADDER
+
+
+def kv_page_size() -> int:
+    """Tokens per KV page — autotune override >
+    ``MXNET_DECODE_KV_PAGE_SIZE`` > ``kvcache.KV_PAGE_SIZE``."""
+    from ..tuning import space as _tspace
+    try:
+        return max(1, int(_tspace.value("decode.kv_page_size",
+                                        KV_PAGE_SIZE)))
+    except (TypeError, ValueError):
+        return KV_PAGE_SIZE
+
+
+def prefill_chunk() -> int:
+    """Prompt tokens one prefill iteration consumes — autotune override
+    > ``MXNET_DECODE_PREFILL_CHUNK`` > the default."""
+    from ..tuning import space as _tspace
+    try:
+        return max(1, int(_tspace.value("decode.prefill_chunk",
+                                        PREFILL_CHUNK)))
+    except (TypeError, ValueError):
+        return PREFILL_CHUNK
+
+
+def _page_size_valid(v, _config) -> bool:
+    """A candidate page size is valid when a nominal full cache (the
+    shipped ladder's worst slot count at a 256-token context, f32,
+    2 heads x 16 dims x 1 layer) stays inside ``MXNET_MEMORY_BUDGET``
+    — engines re-check their REAL geometry at construction."""
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        return False
+    if not 1 <= v <= 4096:
+        return False
+    try:
+        from ..telemetry.memory import memory_budget
+        budget = memory_budget()
+    except Exception:           # pragma: no cover - defensive
+        return True
+    if budget is None:
+        return True
+    slots = DECODE_SLOT_LADDER[-1]
+    page_bytes = 2 * 1 * v * 2 * 16 * 4       # K+V, 1 layer, 2x16 f32
+    pages = 1 + slots * pages_needed(256, v)
+    return pages * page_bytes <= budget
+
+
+def _register_tunables():
+    """Decode-engine tunables, declared next to the constants they make
+    sweepable (docs/PERF_NOTES.md "Autotuner")."""
+    from ..tuning.space import Tunable, register
+    register(Tunable(
+        "decode.slot_ladder",
+        default=",".join(str(x) for x in DECODE_SLOT_LADDER),
+        grid=("1,2,4", "1,2,4,8", "1,2,4,8,16", "1,4,16"),
+        env="MXNET_DECODE_SLOTS", parse=str,
+        valid=lambda v, _c: bool(_parse_ladder(v)),
+        seam="serving.decode.slot_ladder() -> DecodeEngine AOT "
+             "slot-count buckets",
+        scope="serving", affects_program=True,
+        doc="slot-count buckets the decode step is compiled for "
+            "(comma list; largest = physical slots)"))
+    register(Tunable(
+        "decode.kv_page_size", default=KV_PAGE_SIZE,
+        grid=(8, 16, 32, 64),
+        env="MXNET_DECODE_KV_PAGE_SIZE", parse=int,
+        valid=_page_size_valid,
+        seam="serving.decode.kv_page_size() -> PagedKVCache page "
+             "geometry + page-table width",
+        scope="serving", affects_program=True,
+        doc="tokens per KV page (pages x page_bytes must fit "
+            "MXNET_MEMORY_BUDGET)"))
+    register(Tunable(
+        "decode.prefill_chunk", default=PREFILL_CHUNK,
+        grid=(8, 16, 32, 64, 128),
+        env="MXNET_DECODE_PREFILL_CHUNK", parse=int,
+        valid=lambda v, _c: 1 <= int(v) <= 4096,
+        seam="serving.decode.prefill_chunk() -> chunked-prefill "
+             "program width",
+        scope="serving", affects_program=True,
+        doc="prompt tokens one prefill iteration consumes (smaller = "
+            "better decode-batch latency, larger = better prefill "
+            "throughput)"))
+
+
+try:
+    _register_tunables()
+except Exception:    # pragma: no cover - tuning must never break serving
+    import logging
+    logging.getLogger("mxnet_tpu.tuning").debug(
+        "decode tunable registration failed", exc_info=True)
+
+
+def _telemetry():
+    from .. import telemetry
+    return telemetry
+
+
+# ---------------------------------------------------------------------------
+# reference model
+# ---------------------------------------------------------------------------
+
+class TinyDecoder:
+    """The reference autoregressive decode model — one LSTM cell through
+    :func:`rnn_decode_step` plus one attention layer reading K/V through
+    the page table — small enough for CPU tier-1 yet exercising BOTH
+    decode kernels and the full paged-cache read/write path.
+
+    Any model driving :class:`DecodeEngine` implements this protocol:
+    ``params`` (a pytree), ``num_layers``/``num_heads``/``head_dim``/
+    ``d_model``, :meth:`init_state`, :meth:`decode_step` and
+    :meth:`prefill_chunk` (both pure functions of their inputs — the
+    engine jits and AOT-compiles them per slot bucket).
+    """
+
+    num_layers = 1
+
+    def __init__(self, vocab: int = 64, d_model: int = 32,
+                 num_heads: int = 2, seed: int = 0):
+        if d_model % num_heads:
+            raise MXNetError(f"d_model={d_model} not divisible by "
+                             f"num_heads={num_heads}")
+        self.vocab = int(vocab)
+        self.d_model = int(d_model)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.d_model // self.num_heads
+        rng = onp.random.RandomState(seed)
+        H = self.d_model
+
+        def mat(*shape, scale=0.3):
+            return jnp.asarray(
+                rng.normal(0.0, scale, shape).astype("float32"))
+
+        self.params = {
+            "embed": mat(self.vocab, H, scale=0.5),
+            "w_ih": mat(4 * H, H), "b_ih": jnp.zeros((4 * H,), "float32"),
+            "w_hh": mat(4 * H, H), "b_hh": jnp.zeros((4 * H,), "float32"),
+            "wq": mat(H, H), "wk": mat(H, H), "wv": mat(H, H),
+            "wo": mat(H, H),
+        }
+
+    def init_state(self, slots: int):
+        H = self.d_model
+        return (jnp.zeros((slots, H), "float32"),
+                jnp.zeros((slots, H), "float32"))
+
+    # -- one fused sub-step shared by decode and prefill (parity by
+    #    construction: a token is processed by the same math either way)
+    def _cell(self, params, tokens, h, c):
+        emb = params["embed"][tokens]
+        xw = emb @ params["w_ih"].T + params["b_ih"]
+        return rnn_decode_step(xw, h, c, params["w_hh"], params["b_hh"],
+                               "lstm")
+
+    def _qkv(self, params, h2):
+        S = h2.shape[0]
+        nH, hd = self.num_heads, self.head_dim
+        q = (h2 @ params["wq"]).reshape(S, nH, hd)
+        k = (h2 @ params["wk"]).reshape(S, nH, hd)
+        v = (h2 @ params["wv"]).reshape(S, nH, hd)
+        return q, k, v
+
+    def _logits(self, params, h2, attn):
+        out = h2 + attn.reshape(h2.shape) @ params["wo"]
+        return out @ params["embed"].T
+
+    def decode_step(self, params, tokens, h, c, k_pages, v_pages,
+                    pidx, poff, table, lengths, active):
+        """One iteration over every slot: consume ``tokens`` (each
+        slot's last token), write this position's K/V through the page
+        table, attend over the slot's history, emit the next greedy
+        token. Inactive slots are bit-preserved (masked carry) and
+        their writes land on the null page."""
+        h2, c2 = self._cell(params, tokens, h, c)
+        act = active[:, None]
+        h_new = jnp.where(act, h2, h)
+        c_new = jnp.where(act, c2, c)
+        q, k, v = self._qkv(params, h2)
+        pidx = jnp.where(active, pidx, 0)
+        poff = jnp.where(active, poff, 0)
+        k_pages = k_pages.at[0, pidx, poff].set(k.astype(k_pages.dtype))
+        v_pages = v_pages.at[0, pidx, poff].set(v.astype(v_pages.dtype))
+        attn = paged_decode_attention(q, k_pages[0], v_pages[0],
+                                      table, lengths)
+        nxt = jnp.argmax(self._logits(params, h2, attn),
+                         axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, tokens)
+        return nxt, h_new, c_new, k_pages, v_pages
+
+    def prefill_chunk(self, params, tokens, h, c, k_pages, v_pages,
+                      start_len, n_valid, reset, active, table,
+                      page_size: int):
+        """Consume up to ``tokens.shape[1]`` prompt tokens for the
+        active slot(s): scan the SAME per-token cell, writing each
+        position's K/V through the page table; the returned token is
+        the greedy continuation of the last valid position (meaningful
+        on a prompt's final chunk — the request's first token)."""
+        S, C = tokens.shape
+        h = jnp.where(reset[:, None], 0.0, h)
+        c = jnp.where(reset[:, None], 0.0, c)
+
+        def body(carry, t):
+            h, c, kp, vp = carry
+            tok = tokens[:, t]
+            valid = active & (t < n_valid)
+            h2, c2 = self._cell(params, tok, h, c)
+            vm = valid[:, None]
+            h = jnp.where(vm, h2, h)
+            c = jnp.where(vm, c2, c)
+            _, k, v = self._qkv(params, h2)
+            pos = start_len + t
+            page = jnp.take_along_axis(
+                table, (pos // page_size)[:, None], axis=1)[:, 0]
+            pg = jnp.where(valid, page, 0)
+            off = jnp.where(valid, pos % page_size, 0)
+            kp = kp.at[0, pg, off].set(k.astype(kp.dtype))
+            vp = vp.at[0, pg, off].set(v.astype(vp.dtype))
+            return (h, c, kp, vp), None
+
+        (h, c, k_pages, v_pages), _ = lax.scan(
+            body, (h, c, k_pages, v_pages), jnp.arange(C))
+        lengths = jnp.maximum(start_len + n_valid, 1)
+        q, _, _ = self._qkv(params, h)
+        attn = paged_decode_attention(q, k_pages[0], v_pages[0],
+                                      table, lengths)
+        nxt = jnp.argmax(self._logits(params, h, attn),
+                         axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, 0)
+        return nxt, h, c, k_pages, v_pages
+
+
+# ---------------------------------------------------------------------------
+# streaming future
+# ---------------------------------------------------------------------------
+
+class DecodeStream:
+    """Per-request streaming future: each generated token is delivered
+    as the step that computed it retires through the dispatch window.
+    Iterate for tokens as they arrive, or :meth:`result` for the full
+    sequence; :meth:`record` yields the streaming-latency record
+    (``ttft_s`` / ``tpot_s`` / ``tokens``) loadgen aggregates."""
+
+    def __init__(self, t_submit: float):
+        self._cv = threading.Condition()
+        self._tokens: List[int] = []
+        self._times: List[float] = []
+        self._cursor = 0
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self.t_submit = t_submit
+
+    # -- engine side (called under the engine lock)
+    def _deliver(self, tok: int, t: float):
+        with self._cv:
+            self._tokens.append(int(tok))
+            self._times.append(float(t))
+            self._cv.notify_all()
+
+    def _finish(self):
+        with self._cv:
+            self._done = True
+            self._cv.notify_all()
+
+    def _fail(self, exc: BaseException):
+        with self._cv:
+            self._exc = exc
+            self._done = True
+            self._cv.notify_all()
+
+    # -- client side
+    def next_token(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Next token, blocking until one arrives; None at end of
+        stream. Raises the request's typed failure (after any tokens
+        delivered before it) once the cursor reaches it."""
+        with self._cv:
+            if not self._cv.wait_for(
+                    lambda: self._cursor < len(self._tokens) or self._done,
+                    timeout=timeout):
+                raise MXNetError("DecodeStream.next_token timed out")
+            if self._cursor < len(self._tokens):
+                tok = self._tokens[self._cursor]
+                self._cursor += 1
+                return tok
+            if self._exc is not None:
+                raise self._exc
+            return None
+
+    def __iter__(self):
+        while True:
+            tok = self.next_token()
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._done, timeout=timeout):
+                raise MXNetError("DecodeStream.result timed out")
+            if self._exc is not None:
+                raise self._exc
+            return list(self._tokens)
+
+    @property
+    def done(self) -> bool:
+        with self._cv:
+            return self._done
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        with self._cv:
+            return (self._times[0] - self.t_submit) if self._times else None
+
+    def record(self) -> dict:
+        """Streaming-latency record: the shape
+        ``loadgen.streaming_summary`` aggregates."""
+        with self._cv:
+            times = list(self._times)
+            n = len(times)
+            return {
+                "tokens": n,
+                "ttft_s": (times[0] - self.t_submit) if n else None,
+                "tpot_s": [times[i] - times[i - 1] for i in range(1, n)],
+                "wall_s": (times[-1] - self.t_submit) if n else None,
+                "outcome": ("error" if self._exc is not None
+                            else "ok" if self._done else "pending"),
+            }
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "eos", "stream", "deadline",
+                 "t_submit", "t_last_tok", "slot", "phase", "pos",
+                 "generated", "done", "npages", "seq")
+
+    def __init__(self, prompt, max_new, eos, stream, deadline, npages,
+                 seq):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos = eos
+        self.stream = stream
+        self.deadline = deadline
+        self.t_submit = stream.t_submit
+        self.t_last_tok = stream.t_submit
+        self.slot = -1
+        self.phase = "queued"      # queued -> prefill -> decode
+        self.pos = 0               # prompt tokens consumed
+        self.generated = 0
+        self.done = False
+        self.npages = npages
+        self.seq = seq
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class DecodeEngine:
+    """Iteration-level scheduler over a fixed slot ladder with a paged
+    KV cache (module docstring has the design).
+
+    ``static=True`` flips ONLY the scheduling policy to the classic
+    whole-batch baseline — fill every slot, prefill all prompts, decode
+    until the LAST member finishes, then admit the next batch — with
+    the identical compiled programs, which is what makes the bench
+    ``decode`` leg an honest continuous-vs-static A/B.
+
+    Deterministic tests drive a ``start=False`` engine manually with
+    :meth:`step_once` (+ :meth:`sync` to retire in-flight steps) and an
+    injected ``clock``.
+    """
+
+    def __init__(self, model, *, ladder: Optional[Sequence[int]] = None,
+                 num_pages: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 max_context: int = 128, max_new_default: int = 16,
+                 eos_id: Optional[int] = None,
+                 depth: Optional[int] = None, inflight: int = 1,
+                 static: bool = False, admission: bool = True,
+                 dtype: str = "float32",
+                 clock: Callable[[], float] = time.perf_counter,
+                 start: bool = True):
+        self.model = model
+        self._ladder = _parse_ladder(ladder if ladder is not None
+                                     else slot_ladder())
+        self.slots = self._ladder[-1]
+        ps = int(page_size) if page_size else kv_page_size()
+        self._chunk = prefill_chunk()
+        self.max_context = int(max_context)
+        self.max_pages_per_slot = pages_needed(self.max_context, ps)
+        if num_pages is None:
+            num_pages = 1 + self.slots * self.max_pages_per_slot
+        self.kv = PagedKVCache(model.num_layers, model.num_heads,
+                               model.head_dim, num_pages, ps, dtype=dtype)
+        self._h, self._c = model.init_state(self.slots)
+        self._tokens_dev = jnp.zeros((self.slots,), jnp.int32)
+        self._table = onp.zeros((self.slots, self.max_pages_per_slot),
+                                onp.int32)
+        self._device_len = onp.zeros(self.slots, onp.int64)
+        self._occupant: List[Optional[_Request]] = [None] * self.slots
+        self._queue: "deque[_Request]" = deque()
+        self._depth = queue_depth() if depth is None else max(1, int(depth))
+        self.max_new_default = max(1, int(max_new_default))
+        self.eos_id = eos_id
+        self.static = bool(static)
+        self.admission = bool(admission)
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._clock = clock
+        self._window = DispatchWindow(max_inflight=max(0, int(inflight)),
+                                      what="decode step",
+                                      sync_fn=self._retire_sync)
+        self._programs: Dict[tuple, dict] = {}
+        self._n_traces = 0
+        self._seq = 0
+        self._tag = 0
+        self._draining = False
+        self._dead: Optional[BaseException] = None
+        self._ewma_step: Optional[float] = None
+        self._last_was_prefill = False
+        self.stats = {"submitted": 0, "completed": 0, "rejected": 0,
+                      "deadline_missed": 0, "steps": 0,
+                      "prefill_chunks": 0, "tokens": 0,
+                      "kv_util_peak": 0.0}
+        t = _telemetry()
+        reg = t.registry()
+        self._m_tokens = reg.counter(t.names.DECODE_TOKENS)
+        self._m_active = reg.gauge(t.names.DECODE_ACTIVE_SLOTS)
+        self._m_ttft = reg.histogram(t.names.DECODE_TTFT_SECONDS)
+        self._m_tpot = reg.histogram(t.names.DECODE_TPOT_SECONDS)
+        self._m_rejected = reg.counter(t.names.SERVING_REJECTED,
+                                       label_key="reason")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._serve_loop, name="mx-decode-engine",
+                daemon=True)
+            self._thread.start()
+
+    # ---------------- compiled programs ----------------
+    def _entry(self, kind: str, bucket: int) -> dict:
+        key = (kind, bucket)
+        entry = self._programs.get(key)
+        if entry is None:
+            model = self.model
+            ps = self.kv.page_size
+            eng = self
+            if kind == "decode":
+                def raw(params, tokens, h, c, kp, vp, pidx, poff,
+                        table, lengths, active):
+                    eng._n_traces += 1
+                    return model.decode_step(params, tokens, h, c, kp,
+                                             vp, pidx, poff, table,
+                                             lengths, active)
+            else:
+                def raw(params, tokens, h, c, kp, vp, start_len,
+                        n_valid, reset, active, table):
+                    eng._n_traces += 1
+                    return model.prefill_chunk(params, tokens, h, c,
+                                               kp, vp, start_len,
+                                               n_valid, reset, active,
+                                               table, page_size=ps)
+            entry = {"fn": jax.jit(raw, donate_argnums=(4, 5)),
+                     "exe": None, "analysis": None}
+            self._programs[key] = entry
+        return entry
+
+    def _example_args(self, kind: str, bucket: int):
+        """ShapeDtypeStruct mirrors of one bucket's runtime arguments —
+        the lowering/AOT example (no device allocation)."""
+        b = int(bucket)
+        H = self.model.d_model
+        sds = jax.ShapeDtypeStruct
+        params = jax.tree_util.tree_map(
+            lambda a: sds(jnp.shape(a), a.dtype), self.model.params)
+        kv = sds((self.kv.num_layers, self.kv.num_pages,
+                  self.kv.page_size, self.kv.num_heads,
+                  self.kv.head_dim), jnp.dtype(self.kv.dtype))
+        i32 = jnp.dtype("int32")
+        f32 = jnp.dtype("float32")
+        table = sds((b, self.max_pages_per_slot), i32)
+        if kind == "decode":
+            return (params, sds((b,), i32), sds((b, H), f32),
+                    sds((b, H), f32), kv, kv, sds((b,), i32),
+                    sds((b,), i32), table, sds((b,), i32),
+                    sds((b,), jnp.dtype(bool)))
+        return (params, sds((b, self._chunk), i32), sds((b, H), f32),
+                sds((b, H), f32), kv, kv, sds((b,), i32),
+                sds((b,), i32), sds((b,), jnp.dtype(bool)),
+                sds((b,), jnp.dtype(bool)), table)
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
+        """AOT-compile the decode + prefill program of every ladder
+        bucket (``.lower().compile()``, warm-started from the
+        persistent ``MXNET_COMPILE_CACHE``) so no request ever eats a
+        first-iteration compile. Returns {(kind, bucket): executable}."""
+        out = {}
+        for b in (buckets or self._ladder):
+            for kind in ("decode", "prefill"):
+                entry = self._entry(kind, int(b))
+                if entry["exe"] is None:
+                    n_before = self._n_traces
+                    try:
+                        entry["exe"] = entry["fn"].lower(
+                            *self._example_args(kind, int(b))).compile()
+                    finally:
+                        self._n_traces = n_before
+                out[(kind, int(b))] = entry["exe"]
+        return out
+
+    def _call(self, entry: dict, args: tuple):
+        fn = entry["exe"] if entry["exe"] is not None else entry["fn"]
+        try:
+            return fn(*args)
+        except (TypeError, ValueError):
+            if entry["exe"] is None:
+                raise
+            entry["exe"] = None       # AOT signature drifted: re-jit
+            return entry["fn"](*args)
+
+    # ---------------- static analysis ----------------
+    @property
+    def mode(self) -> str:
+        return "predict"
+
+    @property
+    def n_traces(self) -> int:
+        return self._n_traces
+
+    def lower_entry(self, *args, batch_size: Optional[int] = None,
+                    **kwargs):
+        """Lower one slot bucket's DECODE program for static analysis —
+        the same artifact contract as ``CompiledPredictor.lower_entry``
+        so the program lint runs unchanged over the decode engine."""
+        bucket = self._bucket_for(int(batch_size) if batch_size
+                                  else self.slots)
+        entry = self._entry("decode", bucket)
+        if entry["analysis"] is not None:
+            return entry["analysis"]
+        example = self._example_args("decode", bucket)
+        n_before = self._n_traces
+        try:
+            lowered = entry["fn"].lower(*example)
+            try:
+                jaxpr = jax.make_jaxpr(entry["fn"])(*example)
+            except Exception:       # pragma: no cover - defensive
+                jaxpr = None
+        finally:
+            self._n_traces = n_before
+        info = dict(kind="predict", mode="predict", lowered=lowered,
+                    jaxpr=jaxpr, mesh=None, axis=None,
+                    expected_donated=None, unit_sizes=[],
+                    n_params=len(jax.tree_util.tree_leaves(
+                        self.model.params)),
+                    n_state_leaves=0, blessed_dtypes=[], report=None)
+        entry["analysis"] = info
+        return info
+
+    def analyze(self, batch_size: Optional[int] = None):
+        """Full program lint of the decode-step program
+        (:class:`~mxnet_tpu.analysis.ProgramReport`, ``predict``
+        expectations: no collectives, no unblessed host transfers, no
+        stranded fusables)."""
+        from ..analysis.program import analyze_step
+        return analyze_step(self, batch_size=batch_size)
+
+    # ---------------- admission ----------------
+    def _reject(self, reason: str, msg: str):
+        self.stats["rejected"] += 1
+        self._m_rejected.inc(label=reason)
+        raise Overloaded(msg, reason=reason)
+
+    def submit(self, prompt, max_new: Optional[int] = None,
+               eos: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> DecodeStream:
+        """Admit one request (or shed it with a typed ``Overloaded``)
+        and return its token stream. Admission control, in order:
+        draining, queue depth, the PR 15 EWMA deadline shedder, and KV
+        page reservation (``reason="kvcache"``) — a request that cannot
+        get its worst-case pages up front is shed NOW rather than
+        corrupting a neighbour mid-flight."""
+        prompt = onp.asarray(prompt, onp.int32).ravel()
+        if prompt.size < 1:
+            raise MXNetError("decode prompt must have >= 1 token")
+        mn = self.max_new_default if max_new is None else max(1,
+                                                              int(max_new))
+        if deadline_ms is None:
+            deadline_ms = default_deadline_ms()
+        with self._lock:
+            if self._dead is not None:
+                raise ServingShutdown(
+                    "DecodeEngine is shut down") from self._dead
+            if self._draining:
+                self._reject("draining",
+                             "DecodeEngine is draining; request shed")
+            if len(self._queue) >= self._depth:
+                self._reject("queue",
+                             f"decode queue full ({self._depth})")
+            slack = max(1, self._window.max_inflight)
+            need_tokens = int(prompt.size) + mn + slack
+            if need_tokens > self.max_pages_per_slot * self.kv.page_size:
+                raise MXNetError(
+                    f"request needs {need_tokens} KV positions "
+                    f"(prompt {prompt.size} + max_new {mn} + inflight "
+                    f"slack {slack}) > max_context {self.max_context}")
+            npages = pages_needed(need_tokens, self.kv.page_size)
+            mode = shed_mode()
+            if (deadline_ms is not None and mode != "off"
+                    and self._ewma_step is not None):
+                projected = self._ewma_step * (len(self._queue) + 1)
+                if projected * 1e3 > float(deadline_ms):
+                    self._reject(
+                        "deadline",
+                        f"projected first-token wait {projected * 1e3:.1f}"
+                        f" ms exceeds deadline {deadline_ms:.1f} ms")
+            now = self._clock()
+            stream = DecodeStream(now)
+            deadline = (now + float(deadline_ms) / 1e3
+                        if deadline_ms is not None else None)
+            req = _Request(prompt, mn, eos, stream, deadline, npages,
+                           self._seq)
+            self._seq += 1
+            if self.admission and not self.kv.reserve(req, npages):
+                self._reject(
+                    "kvcache",
+                    f"KV page pool exhausted: need {npages} page(s), "
+                    f"{self.kv.free_pages()} free of "
+                    f"{self.kv.num_pages - 1}")
+            self._queue.append(req)
+            self.stats["submitted"] += 1
+            self._work.notify_all()
+            return stream
+
+    # ---------------- scheduling ----------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self._ladder:
+            if b >= n:
+                return b
+        return self._ladder[-1]
+
+    def _bucket(self) -> int:
+        hi = max((s + 1 for s in range(self.slots)
+                  if self._occupant[s] is not None), default=1)
+        return self._bucket_for(hi)
+
+    def _refill(self):
+        if self.static:
+            # whole-batch barrier: admit a new batch only once every
+            # slot is free (the baseline the bench A/Bs against)
+            if any(o is not None for o in self._occupant):
+                return
+        for slot in range(self.slots):
+            if not self._queue:
+                break
+            if self._occupant[slot] is not None:
+                continue
+            req = self._queue[0]
+            pages = self.kv.alloc(req, req.npages)
+            if pages is None:        # admission=False path: wait
+                break
+            self._queue.popleft()
+            req.slot = slot
+            req.phase = "prefill"
+            self._occupant[slot] = req
+            self._table[slot, :] = 0
+            self._table[slot, :len(pages)] = pages
+            self._device_len[slot] = 0
+        self._m_active.set(sum(1 for o in self._occupant
+                               if o is not None))
+
+    def _plan(self):
+        occ = self._occupant
+        pre = [s for s in range(self.slots)
+               if occ[s] is not None and occ[s].phase == "prefill"]
+        dec = [s for s in range(self.slots)
+               if occ[s] is not None and occ[s].phase == "decode"
+               and not occ[s].done]
+        if self.static:
+            if pre:
+                return "prefill", min(pre, key=lambda s: occ[s].seq)
+            if dec:
+                return "decode", dec
+            return None, None
+        # continuous: strict alternation — prefill may never run twice
+        # in a row while decode work exists (the non-starvation rule)
+        if pre and (not dec or not self._last_was_prefill):
+            return "prefill", min(pre, key=lambda s: occ[s].seq)
+        if dec:
+            return "decode", dec
+        return None, None
+
+    def step_once(self) -> bool:
+        """One scheduler iteration: refill free slots, dispatch ONE
+        compiled program (a decode step over every active slot, or one
+        prefill chunk), push it into the window. False when there is no
+        work. The manual-driving hook for deterministic tests; the
+        background loop calls exactly this."""
+        with self._lock:
+            if self._dead is not None:
+                return False
+            self._refill()
+            kind, what = self._plan()
+            if kind is None:
+                return False
+            try:
+                if kind == "prefill":
+                    self._dispatch_prefill(what)
+                else:
+                    self._dispatch_decode(what)
+            except MXNetError as e:
+                self._fail_all(e)
+                return False
+            return True
+
+    def sync(self):
+        """Retire every in-flight step (the blessed waits) — delivers
+        all tokens computed so far to their streams."""
+        with self._lock:
+            if len(self._window):
+                self._window.drain()
+
+    def _stitch(self, b: int, h2, c2, nxt, kp, vp):
+        """Fold one bucket's outputs back into the full-slot device
+        arrays (device-side chaining: no host round trip)."""
+        self.kv.k_pages._data = kp
+        self.kv.v_pages._data = vp
+        if b == self.slots:
+            self._h, self._c = h2, c2
+            return nxt
+        self._h = jnp.concatenate([h2, self._h[b:]], axis=0)
+        self._c = jnp.concatenate([c2, self._c[b:]], axis=0)
+        return None
+
+    def _push(self, meta: tuple, arr):
+        self._tag += 1
+        self._window.push((meta, arr), tag=f"{meta[0]}#{self._tag}")
+
+    def _dispatch_decode(self, slots_active: List[int]):
+        b = self._bucket()
+        ps = self.kv.page_size
+        pidx = onp.zeros(b, onp.int32)
+        poff = onp.zeros(b, onp.int32)
+        lengths = onp.ones(b, onp.int32)
+        act = onp.zeros(b, bool)
+        metas = []
+        for s in slots_active:
+            dl = int(self._device_len[s])
+            pidx[s] = self._table[s, dl // ps]
+            poff[s] = dl % ps
+            lengths[s] = dl + 1
+            act[s] = True
+            metas.append((s, self._occupant[s]))
+            self._device_len[s] += 1
+        entry = self._entry("decode", b)
+        args = (self.model.params, self._tokens_dev[:b], self._h[:b],
+                self._c[:b], self.kv.k_pages._data,
+                self.kv.v_pages._data, jnp.asarray(pidx),
+                jnp.asarray(poff), jnp.asarray(self._table[:b]),
+                jnp.asarray(lengths), jnp.asarray(act))
+        with _tguard.hot_scope("DecodeEngine.decode_step"):
+            nxt, h2, c2, kp, vp = self._call(entry, args)
+        full = self._stitch(b, h2, c2, nxt, kp, vp)
+        self._tokens_dev = full if full is not None else \
+            jnp.concatenate([nxt, self._tokens_dev[b:]])
+        self.stats["steps"] += 1
+        self._last_was_prefill = False
+        self._push(("decode", metas, self._clock()), nxt)
+
+    def _dispatch_prefill(self, slot: int):
+        req = self._occupant[slot]
+        b = self._bucket()
+        C = self._chunk
+        n_valid = min(C, req.prompt.size - req.pos)
+        toks = onp.zeros((b, C), onp.int32)
+        toks[slot, :n_valid] = req.prompt[req.pos:req.pos + n_valid]
+        start = onp.zeros(b, onp.int32)
+        start[slot] = self._device_len[slot]
+        nv = onp.zeros(b, onp.int32)
+        nv[slot] = n_valid
+        reset = onp.zeros(b, bool)
+        reset[slot] = req.pos == 0
+        act = onp.zeros(b, bool)
+        act[slot] = True
+        entry = self._entry("prefill", b)
+        args = (self.model.params, jnp.asarray(toks), self._h[:b],
+                self._c[:b], self.kv.k_pages._data,
+                self.kv.v_pages._data, jnp.asarray(start),
+                jnp.asarray(nv), jnp.asarray(reset), jnp.asarray(act),
+                jnp.asarray(self._table[:b]))
+        with _tguard.hot_scope("DecodeEngine.prefill_chunk"):
+            nxt, h2, c2, kp, vp = self._call(entry, args)
+        full = self._stitch(b, h2, c2, None, kp, vp)
+        self._device_len[slot] += n_valid
+        req.pos += n_valid
+        final = req.pos >= req.prompt.size
+        if final:
+            # the slot joins the decode batch NEXT iteration; its first
+            # token chains device-side (async) into the token array
+            req.phase = "decode"
+            self._tokens_dev = self._tokens_dev.at[slot].set(nxt[slot])
+        self.stats["prefill_chunks"] += 1
+        self._last_was_prefill = True
+        self._push(("prefill", slot, req, final, self._clock()), nxt)
+
+    # ---------------- retire (the one blessed sync) ----------------
+    def _retire_sync(self, payload):
+        meta, arr = payload
+        toks = onp.asarray(arr)      # blessed: runs under the window's
+        now = self._clock()          # allow_transfers at retire
+        if meta[0] == "decode":
+            _, pairs, t0 = meta
+            dt = max(0.0, now - t0)
+            self._ewma_step = dt if self._ewma_step is None \
+                else 0.8 * self._ewma_step + 0.2 * dt
+            for slot, req in pairs:
+                if req.done:
+                    continue
+                self._deliver(slot, req, int(toks[slot]), now)
+        else:
+            _, slot, req, final, _t0 = meta
+            if final and not req.done:
+                self._deliver(slot, req, int(toks[slot]), now)
+        util = self.kv.utilization()
+        if util > self.stats["kv_util_peak"]:
+            self.stats["kv_util_peak"] = util
+        return toks
+
+    def _deliver(self, slot: int, req: _Request, tok: int, now: float):
+        first = req.generated == 0
+        req.generated += 1
+        req.stream._deliver(tok, now)
+        self.stats["tokens"] += 1
+        self._m_tokens.inc()
+        if first:
+            self._m_ttft.observe(max(0.0, now - req.t_submit))
+        else:
+            self._m_tpot.observe(max(0.0, now - req.t_last_tok))
+        req.t_last_tok = now
+        if req.deadline is not None and now > req.deadline:
+            self.stats["deadline_missed"] += 1
+            self._finish_slot(slot, req, DeadlineExceeded(
+                f"decode request missed its deadline after "
+                f"{req.generated} token(s)"))
+            return
+        eos = req.eos if req.eos is not None else self.eos_id
+        if (eos is not None and tok == eos) or \
+                req.generated >= req.max_new:
+            self._finish_slot(slot, req, None)
+
+    def _finish_slot(self, slot: int, req: _Request,
+                     exc: Optional[BaseException]):
+        req.done = True
+        if self._occupant[slot] is req:
+            self._occupant[slot] = None
+            self._table[slot, :] = 0
+        self.kv.release(req)
+        if exc is None:
+            self.stats["completed"] += 1
+            req.stream._finish()
+        else:
+            req.stream._fail(exc)
+        self._m_active.set(sum(1 for o in self._occupant
+                               if o is not None))
+        self._work.notify_all()
+
+    def _fail_all(self, exc: BaseException):
+        self._dead = exc
+        self._window.abandon()
+        for slot in range(self.slots):
+            req = self._occupant[slot]
+            if req is not None and not req.done:
+                req.done = True
+                self.kv.release(req)
+                req.stream._fail(exc)
+            self._occupant[slot] = None
+        while self._queue:
+            req = self._queue.popleft()
+            self.kv.release(req)
+            req.stream._fail(exc)
+        self._m_active.set(0)
+
+    # ---------------- lifecycle ----------------
+    def _idle(self) -> bool:
+        return (not self._queue and len(self._window) == 0
+                and all(o is None for o in self._occupant))
+
+    def _serve_loop(self):
+        while not self._stop.is_set():
+            did = self.step_once()
+            if did:
+                continue
+            with self._lock:
+                if len(self._window):
+                    try:
+                        self._window.drain()
+                    except MXNetError as e:
+                        self._fail_all(e)
+                    continue
+            with self._work:
+                self._work.wait(0.002)
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Stop admitting (subsequent submits shed with
+        ``reason="draining"``) and run every accepted request to
+        completion. True when fully drained."""
+        with self._lock:
+            self._draining = True
+            self._work.notify_all()
+        if self._thread is not None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._idle() or self._dead is not None:
+                        return self._dead is None
+                time.sleep(0.002)
+            return False
+        while True:
+            if self.step_once():
+                continue
+            with self._lock:
+                if len(self._window):
+                    try:
+                        self._window.drain()
+                    except MXNetError as e:
+                        self._fail_all(e)
+                        return False
+                    continue
+                return self._idle()
+
+    def close(self, timeout: float = 5.0):
+        """Drain the window, fail anything still queued with a typed
+        ``ServingShutdown``, stop the dispatch thread."""
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        with self._lock:
+            try:
+                if len(self._window):
+                    self._window.drain()
+            except MXNetError:
+                self._window.abandon()
+            if self._dead is None:
+                exc = ServingShutdown("DecodeEngine closed")
+                for slot in range(self.slots):
+                    req = self._occupant[slot]
+                    if req is not None and not req.done:
+                        req.done = True
+                        self.kv.release(req)
+                        req.stream._fail(exc)
+                    self._occupant[slot] = None
+                while self._queue:
+                    req = self._queue.popleft()
+                    self.kv.release(req)
+                    req.stream._fail(exc)
+                self._dead = exc
+                self._m_active.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# bench harness: continuous vs static A/B
+# ---------------------------------------------------------------------------
+
+def run_decode(model, prompts, max_new, *, static: bool = False,
+               ladder: Optional[Sequence[int]] = None,
+               page_size: Optional[int] = None,
+               eos_id: Optional[int] = None, inflight: int = 1,
+               warmup: bool = True) -> dict:
+    """Submit every request up front and drive the engine to
+    completion — the bench ``decode`` leg's harness. ``static``
+    selects the whole-batch baseline policy; everything else (model,
+    compiled programs, kernels, page geometry) is identical, so the
+    delta is pure scheduling."""
+    prompts = [onp.asarray(p, onp.int32).ravel() for p in prompts]
+    mns = ([int(max_new)] * len(prompts) if isinstance(max_new, int)
+           else [int(m) for m in max_new])
+    slack = max(1, int(inflight))
+    ps = int(page_size) if page_size else kv_page_size()
+    mc = max(int(p.size) + m + slack for p, m in zip(prompts, mns))
+    # size the pool so every request can hold its reservation at once:
+    # the A/B measures scheduling, not page starvation
+    total_pages = 1 + sum(pages_needed(p.size + m + slack, ps)
+                          for p, m in zip(prompts, mns))
+    eng = DecodeEngine(model, ladder=ladder, num_pages=total_pages,
+                       page_size=ps, max_context=mc, eos_id=eos_id,
+                       inflight=inflight, depth=len(prompts) + 1,
+                       static=static, start=False)
+    try:
+        if warmup:
+            eng.warmup()
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new=m)
+                   for p, m in zip(prompts, mns)]
+        eng.drain()
+        wall = time.perf_counter() - t0
+        recs = [s.record() for s in streams]
+        tokens = sum(r["tokens"] for r in recs)
+        from . import loadgen
+        out = {
+            "mode": "static" if static else "continuous",
+            "requests": len(prompts),
+            "tokens": int(tokens),
+            "wall_s": round(wall, 4),
+            "decode_tokens_per_sec": round(tokens / wall, 2)
+            if wall > 0 else None,
+            "steps": eng.stats["steps"],
+            "prefill_chunks": eng.stats["prefill_chunks"],
+            "kv_page_util": round(eng.stats["kv_util_peak"], 4),
+            "slot_ladder": list(eng._ladder),
+            "page_size": ps,
+        }
+        out.update(loadgen.streaming_summary(recs, wall))
+        return out
+    finally:
+        eng.close()

@@ -35,7 +35,7 @@ from typing import Callable, Optional
 import numpy as onp
 
 __all__ = ["run_closed_loop", "run_open_loop", "percentiles",
-           "classify_outcome"]
+           "classify_outcome", "streaming_summary"]
 
 OUTCOMES = ("ok", "rejected", "deadline_missed", "error")
 
@@ -66,6 +66,38 @@ def percentiles(latencies) -> dict:
     return {"p50_ms": round(float(onp.percentile(a, 50)), 3),
             "p99_ms": round(float(onp.percentile(a, 99)), 3),
             "mean_ms": round(float(a.mean()), 3)}
+
+
+def streaming_summary(records, wall: Optional[float] = None) -> dict:
+    """Aggregate per-request STREAMING records into the token-level
+    latency view request-level p50/p99 cannot express: exact TTFT
+    (time to first token) and TPOT (time per output token)
+    percentiles, plus token goodput. A record is a dict with
+    ``ttft_s`` (float), ``tpot_s`` (inter-token gaps, seconds) and
+    ``tokens`` — the shape ``DecodeStream.record()`` produces."""
+    records = [r for r in records if isinstance(r, dict)]
+    ttfts = [r["ttft_s"] for r in records
+             if r.get("ttft_s") is not None]
+    tpots = [g for r in records for g in (r.get("tpot_s") or ())]
+    tokens = sum(int(r.get("tokens") or 0) for r in records)
+    out = {}
+    out.update({"ttft_" + k: v for k, v in percentiles(ttfts).items()})
+    out.update({"tpot_" + k: v for k, v in percentiles(tpots).items()})
+    out["stream_tokens"] = tokens
+    out["tokens_per_sec"] = round(tokens / wall, 2) \
+        if wall and wall > 0 else None
+    return out
+
+
+def _maybe_streaming(out: dict, records: list, wall: float) -> dict:
+    """Attach TTFT/TPOT/goodput next to the request-level percentiles
+    when the issue/wait callables returned streaming records (a dict
+    carrying ``ttft_s``); plain predictors change nothing."""
+    recs = [r for r in records
+            if isinstance(r, dict) and "ttft_s" in r]
+    if recs:
+        out.update(streaming_summary(recs, wall))
+    return out
 
 
 def _report(mode: str, outcomes: dict, ok_lat, wall: float,
@@ -100,9 +132,13 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
     one request) back-to-back until ``requests`` total are issued.
     Latency is the full ``issue`` wall time per request; with
     ``deadline_s`` a completion slower than it counts as
-    ``deadline_missed``, not ``ok`` (goodput is ok/s)."""
+    ``deadline_missed``, not ``ok`` (goodput is ok/s). An ``issue``
+    that RETURNS a streaming record (a dict with ``ttft_s``/``tpot_s``
+    per token — ``DecodeStream.record()``) additionally gets exact
+    TTFT/TPOT percentiles and ``tokens_per_sec`` in the report."""
     outcomes = {k: 0 for k in OUTCOMES}
     ok_lat: list = []
+    stream_recs: list = []
     lock = threading.Lock()
     counter = [0]
 
@@ -115,13 +151,15 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
                 counter[0] += 1
             t0 = time.perf_counter()
             try:
-                issue(i)
+                ret = issue(i)
             except Exception as e:
                 with lock:
                     outcomes[classify_outcome(e)] += 1
                 continue
             dt = time.perf_counter() - t0
             with lock:
+                if isinstance(ret, dict) and "ttft_s" in ret:
+                    stream_recs.append(ret)
                 if deadline_s is not None and dt > deadline_s:
                     outcomes["deadline_missed"] += 1
                 else:
@@ -136,8 +174,9 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    return _report("closed", outcomes, ok_lat, wall,
-                   {"concurrency": int(concurrency)})
+    return _maybe_streaming(
+        _report("closed", outcomes, ok_lat, wall,
+                {"concurrency": int(concurrency)}), stream_recs, wall)
 
 
 def run_open_loop(submit: Callable[[int], Callable[[], None]],
@@ -158,6 +197,7 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
     gaps = rng.exponential(1.0 / max(rate_qps, 1e-9), size=requests)
     outcomes = {k: 0 for k in OUTCOMES}
     ok_lat: list = []
+    stream_recs: list = []
     lock = threading.Lock()
     # a waiter pool records each completion AS IT HAPPENS — waiting
     # sequentially after the arrival phase would inflate every early
@@ -172,15 +212,17 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
             t0, wait = item
             try:
                 try:
-                    wait() if timeout is None else wait(timeout)
+                    ret = wait() if timeout is None else wait(timeout)
                 except TypeError:
-                    wait()
+                    ret = wait()
             except Exception as e:
                 with lock:
                     outcomes[classify_outcome(e)] += 1
                 continue
             dt = time.perf_counter() - t0
             with lock:
+                if isinstance(ret, dict) and "ttft_s" in ret:
+                    stream_recs.append(ret)
                 if deadline_s is not None and dt > deadline_s:
                     outcomes["deadline_missed"] += 1
                 else:
@@ -212,5 +254,6 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    return _report("open", outcomes, ok_lat, wall,
-                   {"rate_qps": float(rate_qps)})
+    return _maybe_streaming(
+        _report("open", outcomes, ok_lat, wall,
+                {"rate_qps": float(rate_qps)}), stream_recs, wall)

@@ -75,7 +75,8 @@ class DeadlineExceeded(MXNetError):
 
 class Overloaded(MXNetError):
     """The request was shed at admission (``.reason`` ∈ {``queue``,
-    ``deadline``, ``breaker``, ``draining``}): the service preserved
+    ``deadline``, ``breaker``, ``draining``, ``kvcache``}): the service
+    preserved
     the p99 of already-accepted traffic instead of queueing work it
     cannot finish in time. Counted under
     ``mx_serving_rejected_total{reason}``. Retryable — after backoff,

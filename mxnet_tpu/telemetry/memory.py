@@ -80,7 +80,8 @@ _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
 #: the census pool taxonomy (docs/OBSERVABILITY.md "memory"); earlier
 #: pools win when two pools reach the same physical buffer
-POOLS = ("params", "optimizer", "checkpoint", "prefetch", "ndarray")
+POOLS = ("params", "optimizer", "checkpoint", "prefetch", "kvcache",
+         "ndarray")
 
 #: schema of the OOM post-mortem dump (golden-tested)
 DUMP_SCHEMA_VERSION = 1

@@ -178,6 +178,15 @@ SERVING_BREAKER_STATE = "mx_serving_breaker_state"
 SERVING_DRAIN_SECONDS = "mx_serving_drain_seconds"
 
 # ---------------------------------------------------------------------------
+# continuous-batching decode engine (serving/decode.py + kvcache.py)
+# ---------------------------------------------------------------------------
+DECODE_TOKENS = "mx_decode_tokens_total"
+DECODE_ACTIVE_SLOTS = "mx_decode_active_slots"
+DECODE_KV_PAGES = "mx_decode_kv_pages"
+DECODE_TTFT_SECONDS = "mx_decode_ttft_seconds"
+DECODE_TPOT_SECONDS = "mx_decode_tpot_seconds"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -305,7 +314,7 @@ CATALOG = {
     MEM_POOL_BYTES: dict(
         kind="gauge", label="pool",
         help="live per-replica buffer bytes by census pool (params, "
-             "optimizer, checkpoint, prefetch, ndarray)"),
+             "optimizer, checkpoint, prefetch, kvcache, ndarray)"),
     MEM_POOL_BUFFERS: dict(
         kind="gauge", label="pool",
         help="live buffer count by census pool"),
@@ -452,7 +461,8 @@ CATALOG = {
         help="requests shed at admission by reason (queue = bounded "
              "queue full, deadline = projected wait exceeds the "
              "request deadline, breaker = circuit breaker open during "
-             "recovery, draining = graceful shutdown in progress; "
+             "recovery, draining = graceful shutdown in progress, "
+             "kvcache = decode KV page pool exhausted; "
              "MXNET_SERVING_SHED, docs/SERVING.md)"),
     SERVING_DEADLINE_MISSED: dict(
         kind="counter", label=None,
@@ -480,6 +490,29 @@ CATALOG = {
         help="graceful-drain duration: reject-new to queue flushed + "
              "in-flight retired + batcher closed (SIGTERM/preemption "
              "workflow, docs/SERVING.md)"),
+    DECODE_TOKENS: dict(
+        kind="counter", label=None,
+        help="decode tokens delivered to streaming clients (useful "
+             "tokens only: dropped post-EOS in-flight tokens excluded)"),
+    DECODE_ACTIVE_SLOTS: dict(
+        kind="gauge", label=None,
+        help="batch slots occupied by a live request (prefilling or "
+             "decoding) in the continuous-batching decode engine"),
+    DECODE_KV_PAGES: dict(
+        kind="gauge", label="state",
+        help="paged-KV-cache page counts by state (used / free / "
+             "reserved-null); bytes ride the kvcache census pool in "
+             "mx_mem_pool_bytes"),
+    DECODE_TTFT_SECONDS: dict(
+        kind="histogram", label=None,
+        help="time-to-first-token per decode request: admission to "
+             "first streamed token retire (queueing + chunked prefill "
+             "+ first step)"),
+    DECODE_TPOT_SECONDS: dict(
+        kind="histogram", label=None,
+        help="time-per-output-token: inter-token gap between "
+             "consecutive streamed tokens of one request (steady-state "
+             "decode cadence)"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

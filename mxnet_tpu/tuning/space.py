@@ -287,7 +287,8 @@ def ensure_registered():
     the process has touched so far."""
     import importlib
     for mod in ("mxnet_tpu.engine", "mxnet_tpu.ops.kernels",
-                "mxnet_tpu.gluon.fused_step", "mxnet_tpu.serving.batcher"):
+                "mxnet_tpu.gluon.fused_step", "mxnet_tpu.serving.batcher",
+                "mxnet_tpu.serving.decode"):
         try:
             importlib.import_module(mod)
         except Exception:        # pragma: no cover - partial installs

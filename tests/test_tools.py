@@ -240,6 +240,24 @@ def test_diagnose_serving_section(capsys):
     assert "shed policy  : MXNET_SERVING_SHED=" in out
 
 
+def test_diagnose_decode_section(capsys):
+    """--decode: AOT-compiles the continuous-batching decode engine
+    over its slot ladder, runs a 6-request streamed burst, and prints
+    the mid-burst slot table, the page-allocator census, the TTFT/TPOT
+    probe and the decode-kernel dispatch decision."""
+    diagnose = _load("tools/diagnose.py", "diagnose_dec")
+    assert diagnose.main(["--decode"]) == 0
+    out = capsys.readouterr().out
+    assert "Continuous-Batching Decode" in out
+    assert "slot ladder" in out and "prefill chunk" in out
+    assert "-- slot table (mid-burst) --" in out
+    assert "-- page allocator --" in out
+    assert "used_pages" in out and "bytes_per_page" in out
+    assert "-- streamed burst --" in out
+    assert "ttft" in out and "tpot" in out and "tok/s" in out
+    assert "decode kernel:" in out and "MXNET_PALLAS=" in out
+
+
 def test_diagnose_elastic_section(capsys):
     """--elastic: runs a tiny supervised TrainLoop, injects one mid-run
     fault, and prints the RecoveryLog table (exactly one recovery) and

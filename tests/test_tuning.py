@@ -205,8 +205,12 @@ def test_search_space_views_and_signature():
     train = tuning.SearchSpace("train")
     serving_sp = tuning.SearchSpace("serving")
     assert {t.name for t in serving_sp} == {"serving.max_batch",
-                                            "serving.batch_timeout_ms"}
-    assert not any(t.name.startswith("serving.") for t in train)
+                                            "serving.batch_timeout_ms",
+                                            "decode.slot_ladder",
+                                            "decode.kv_page_size",
+                                            "decode.prefill_chunk"}
+    assert not any(t.name.startswith(("serving.", "decode."))
+                   for t in train)
     assert train.valid(train.defaults())
     assert not train.valid({"kernels.vmem_tile_budget": 2**40})
     assert train.signature() != serving_sp.signature()

@@ -865,6 +865,81 @@ def check_serving():
         print("serving check failed:", repr(e))
 
 
+def check_decode():
+    """Continuous-batching decode health (docs/SERVING.md "Continuous
+    batching"): build the reference decoder + engine, stream a small
+    mixed-length burst, and print the slot table, the page-allocator
+    census, and the streamed-burst latency panel — a wedged scheduler
+    (starved decode batch, leaked pages, dead slots) is visible
+    without a load rig."""
+    print("----------Continuous-Batching Decode----------")
+    try:
+        import numpy as onp
+        from mxnet_tpu import serving
+        from mxnet_tpu.ops import kernels as _kern
+        import time
+
+        model = serving.TinyDecoder(vocab=48, d_model=32, num_heads=2,
+                                    seed=0)
+        eng = serving.DecodeEngine(model, ladder=(1, 2, 4),
+                                   max_context=48, page_size=8,
+                                   start=False)
+        t0 = time.time()
+        eng.warmup()
+        print(f"slot ladder  : {tuple(eng._ladder)} "
+              f"(decode+prefill AOT-compiled in {time.time() - t0:.2f}s)")
+        print(f"prefill chunk: {eng._chunk} tokens   "
+              f"page size: {eng.kv.page_size} tokens")
+        rng = onp.random.RandomState(3)
+        prompts = [rng.randint(0, 48, size=int(n))
+                   for n in (3, 11, 5, 2, 7, 4)]
+        mns = [6, 3, 12, 4, 3, 5]
+        t0 = time.time()
+        streams = [eng.submit(p, max_new=m)
+                   for p, m in zip(prompts, mns)]
+        # mid-flight slot table: run a few iterations, then look
+        for _ in range(4):
+            eng.step_once()
+        eng.sync()
+        print("-- slot table (mid-burst) --")
+        print(f"{'slot':<6}{'phase':<10}{'pos':<6}{'kv_len':<8}"
+              f"{'tokens':<8}pages")
+        for s in range(eng.slots):
+            req = eng._occupant[s]
+            if req is None:
+                print(f"{s:<6}{'free':<10}")
+                continue
+            pages = [int(p) for p in eng._table[s] if p]
+            print(f"{s:<6}{req.phase:<10}{req.pos:<6}"
+                  f"{int(eng._device_len[s]):<8}{req.generated:<8}"
+                  f"{pages}")
+        print("-- page allocator --")
+        for k, v in eng.kv.stats().items():
+            print(f"{k:<15}{v}")
+        eng.drain()
+        recs = [s.record() for s in streams]
+        wall = time.time() - t0
+        from mxnet_tpu.serving import loadgen
+        summ = loadgen.streaming_summary(recs, wall)
+        print("-- streamed burst --")
+        print(f"requests     : {len(prompts)} "
+              f"({sum(r['tokens'] for r in recs)} tokens, "
+              f"{eng.stats['steps']} decode steps, "
+              f"{eng.stats['prefill_chunks']} prefill chunks)")
+        print(f"ttft         : p50 {summ['ttft_p50_ms']} ms, "
+              f"p99 {summ['ttft_p99_ms']} ms")
+        print(f"tpot         : p50 {summ['tpot_p50_ms']} ms, "
+              f"p99 {summ['tpot_p99_ms']} ms")
+        print(f"goodput      : {summ['tokens_per_sec']} tok/s")
+        print(f"kv util peak : {eng.stats['kv_util_peak']:.3f}")
+        path, reason = _kern.decisions().get(
+            "rnn_decode_step", ("?", "never dispatched"))
+        print(f"decode kernel: {path} ({reason})")
+        eng.close()
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("decode check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -966,6 +1041,11 @@ def main(argv=None):
                         "predictor, run a concurrent burst through the "
                         "dynamic batcher, and print the batcher stats "
                         "table plus a p50/p99 latency probe")
+    parser.add_argument("--decode", action="store_true",
+                        help="also build the continuous-batching "
+                        "decode engine, stream a mixed-length burst, "
+                        "and print the slot table, page-allocator "
+                        "census, and TTFT/TPOT panel")
     parser.add_argument("--elastic", action="store_true",
                         help="also run a tiny supervised TrainLoop, "
                         "inject one mid-run fault (device revocation / "
@@ -997,6 +1077,8 @@ def main(argv=None):
         check_autotune()
     if args.serving:
         check_serving()
+    if args.decode:
+        check_decode()
     if args.elastic:
         check_elastic()
     check_os()
