@@ -157,6 +157,12 @@ def analyze_framework_step(tag, loop, x_nd, y_nd):
            # leg ships with its reshard diff, and the digest pins
            # whether two captures laid buffers out identically
            "sharding": d["sharding"],
+           # exposed-comm posture next to comm_cost_est_s
+           # (docs/PERF_NOTES.md "Communication overlap"):
+           # {exposed_comm_s, overlap_fraction, zero_bucket_bytes, ...}
+           # — a perf delta on a sharded leg says whether collectives
+           # were hidden behind compute, not just how many bytes moved
+           "overlap": d["overlap"],
            # which implementation produced this number: per-kernel
            # MXNET_PALLAS dispatch (pallas/interpret/xla) — a perf
            # delta between captures must name its kernel path

@@ -17,6 +17,11 @@ Four cooperating checkers (docs/ANALYSIS.md):
   invariant packs for every parallelism path, and a checked-in per-leg
   reshard regression gate (``MXNET_SHARDING_BASELINE``).
   ``mx.analysis.audit_sharding(hlo, mesh=...)``.
+- **overlap analysis** (:mod:`.overlap`): exposed-communication pass
+  over the optimized-HLO schedule — per-axis exposed vs total comm
+  seconds and the overlap fraction, with a checked-in per-leg
+  regression gate (``MXNET_OVERLAP_BASELINE``).
+  ``mx.analysis.overlap_census(hlo, mesh=...)``.
 - **source lint** (:mod:`.lint`): AST pass over HybridBlock forwards /
   loss functions for jit-unsafe Python (``.asnumpy()``, tracer-dependent
   ``if``, unkeyed randomness).  ``python -m mxnet_tpu.analysis.lint``.
@@ -46,6 +51,7 @@ __all__ = [
     "CollectiveRule", "audit_sharding", "sharding_table",
     "implicit_reshards", "comm_cost", "bandwidth_profile",
     "expect_spec", "register_spec_pack", "get_spec_pack", "spec_packs",
+    "overlap_census", "OverlapReport",
 ]
 
 _LAZY = {
@@ -66,8 +72,9 @@ _LAZY = {
     "comm_cost": "sharding", "bandwidth_profile": "sharding",
     "expect_spec": "sharding", "register_spec_pack": "sharding",
     "get_spec_pack": "sharding", "spec_packs": "sharding",
+    "overlap_census": "overlap", "OverlapReport": "overlap",
     "program": None, "lint": None, "guard": None, "hlo": None,
-    "report": None, "fusion": None, "sharding": None,
+    "report": None, "fusion": None, "sharding": None, "overlap": None,
 }
 
 

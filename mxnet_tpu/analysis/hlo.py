@@ -145,6 +145,10 @@ class HloModule:
     num_partitions: int = 1
     computations: Dict[str, HloComputation] = field(default_factory=dict)
     entry: Optional[str] = None
+    #: module header carried ``is_scheduled=true`` — op text order IS
+    #: the compiler's final kernel schedule (optimized dumps from
+    #: ``compiled.as_text()`` have it; pre-optimization dumps don't)
+    is_scheduled: bool = False
 
     @property
     def spmd_partitioned(self) -> bool:
@@ -299,6 +303,8 @@ def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
     np_m = re.search(r"num_partitions=(\d+)", text[:2000] if text else "")
     if np_m:
         mod.num_partitions = int(np_m.group(1))
+    if re.search(r"is_scheduled=true", text[:2000] if text else ""):
+        mod.is_scheduled = True
     current: Optional[HloComputation] = None
     for line in (text or "").splitlines():
         cm = _COMPUTATION_RE.match(line)
@@ -334,7 +340,8 @@ def parse_hlo(text: str, num_devices: int = 1) -> HloModule:
         if opcode in ("all-reduce", "all-gather", "reduce-scatter",
                       "collective-permute", "all-to-all",
                       "all-reduce-start", "all-gather-start",
-                      "reduce-scatter-start"):
+                      "reduce-scatter-start", "collective-permute-start",
+                      "all-to-all-start", "async-start"):
             op.replica_groups = parse_replica_groups(line, num_devices)
             if op.replica_groups is None and \
                     opcode.startswith("collective-permute"):

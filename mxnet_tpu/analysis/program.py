@@ -50,7 +50,9 @@ _COLLECTIVE_KINDS = {
     "reduce-scatter": "reduce_scatter",
     "reduce-scatter-start": "reduce_scatter",
     "collective-permute": "collective_permute",
+    "collective-permute-start": "collective_permute",
     "all-to-all": "all_to_all",
+    "all-to-all-start": "all_to_all",
 }
 
 # host-transfer primitives at the jaxpr level (jax's callback family) and
@@ -110,26 +112,45 @@ def _axes_for_groups(groups, mesh) -> Tuple[str, ...]:
 def _classify_decomposed(mod: HloModule, op: HloOp, group: int) -> bool:
     """True when ``op`` (an all-reduce) is the CPU decomposition of a
     reduce-scatter: every real consumer takes exactly a 1/group shard
-    (dynamic-slice by partition id, usually fused)."""
+    (dynamic-slice by partition id, usually fused).
+
+    Transparent consumers (get-tuple-element / bitcast / copy) are
+    followed recursively with THEIR OWN element counts — XLA's
+    all-reduce combiner merges bucketed gradient all-reduces into one
+    variadic tuple all-reduce whose direct consumers are only GTEs, and
+    judging those at the tuple's total element count would misclassify
+    the combined op as a plain all-reduce (2(n-1)/n wire pricing, a 2x
+    overcount of the decomposed reduce-scatter's (n-1)/n)."""
     if group <= 1 or op.elements == 0 or op.elements % group:
         return False
-    shard = op.elements // group
-    consumers = mod.consumers(op.name)
-    if not consumers:
-        return False
     sliced = 0
-    for c in consumers:
-        if c.opcode in ("dynamic-slice", "fusion") and \
-                c.elements == shard:
-            # a consumer producing exactly the 1/group shard is the
-            # partition-id dynamic-slice (usually fused into the
-            # shard-local compute that follows it)
-            sliced += 1
-        elif c.opcode in ("get-tuple-element", "bitcast", "copy"):
-            continue      # transparent; judged by their own consumers
-        else:
+
+    def walk(name: str, elements: int, depth: int) -> bool:
+        nonlocal sliced
+        if elements == 0 or elements % group:
             return False
-    return sliced > 0
+        shard = elements // group
+        consumers = mod.consumers(name)
+        if not consumers:
+            # a dangling transparent hop vetoes nothing; a dangling
+            # all-reduce result is not a reduce-scatter
+            return depth > 0
+        for c in consumers:
+            if c.opcode in ("dynamic-slice", "fusion") and \
+                    c.elements == shard:
+                # a consumer producing exactly the 1/group shard is the
+                # partition-id dynamic-slice (usually fused into the
+                # shard-local compute that follows it)
+                sliced += 1
+            elif c.opcode in ("get-tuple-element", "bitcast", "copy") \
+                    and depth < 4:
+                if not walk(c.name, c.elements, depth + 1):
+                    return False
+            else:
+                return False
+        return True
+
+    return walk(op.name, op.elements, 0) and sliced > 0
 
 
 def collective_census(hlo_text: str, mesh=None,
@@ -420,6 +441,20 @@ def analyze_lowered(lowered, mesh=None, expected_donated=None,
             _fusion.publish(report.fusion)
         except Exception:       # pragma: no cover - defensive
             _LOG.debug("fusion census failed", exc_info=True)
+    if hlo_text:
+        try:
+            from . import overlap as _overlap
+            report.overlap = _overlap.overlap_census(
+                hlo_text, mesh=mesh)
+            report.findings.extend(report.overlap.findings)
+            env = _overlap.baseline_from_env()
+            if env is not None:
+                baselines, leg = env
+                report.findings.extend(_overlap.check_baseline(
+                    report.overlap, baselines, leg or mode))
+            _overlap.publish(report.overlap)
+        except Exception:       # pragma: no cover - defensive
+            _LOG.debug("overlap census failed", exc_info=True)
     for p in report.donation.copied:
         report.add(Finding(
             checker="program", rule="donation-copy",

@@ -173,6 +173,10 @@ class ProgramReport:
     #: per-buffer sharding table, implicit reshards, and per-axis comm
     #: cost — None where there was no HLO text to audit
     sharding: Optional[Any] = None
+    #: exposed-communication analysis (analysis.overlap.OverlapReport):
+    #: per-axis exposed vs total comm seconds and the overlap fraction
+    #: measured on the optimized-HLO schedule
+    overlap: Optional[Any] = None
 
     def add(self, finding: Finding):
         self.findings.append(finding)
@@ -223,6 +227,8 @@ class ProgramReport:
             else None,
             "sharding": self.sharding.brief()
             if self.sharding is not None else None,
+            "overlap": self.overlap.brief()
+            if self.overlap is not None else None,
             "findings": [str(f) for f in self.all_findings()],
         }
 
@@ -254,6 +260,9 @@ class ProgramReport:
         if self.sharding is not None:
             lines.append("  sharding    : "
                          + self.sharding.summary_line())
+        if self.overlap is not None:
+            lines.append("  overlap     : "
+                         + self.overlap.summary_line())
         n_bless = len(self.host_transfers) + len(self.dtype_drift) \
             - len(self._unblessed(self.host_transfers)) \
             - len(self._unblessed(self.dtype_drift))

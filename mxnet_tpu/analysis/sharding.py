@@ -652,6 +652,29 @@ def bandwidth_profile(spec: Optional[str] = None) -> BandwidthProfile:
     return BandwidthProfile(g, name=name)
 
 
+def collective_wire_fraction(kind: str, group_size: int,
+                             decomposed: bool = False) -> float:
+    """Ring-model wire traffic as a FRACTION of the census record's
+    payload bytes.  Costing through this fraction prices collectives
+    per payload byte rather than per op, so N bucketed collectives of B
+    bytes each cost the same as one collective of N*B bytes — bucketing
+    the ZeRO gradient for overlap must not inflate the modeled cost."""
+    n = max(1, group_size)
+    if n == 1:
+        return 0.0
+    if kind == "all_gather":
+        return (n - 1) / n
+    if kind == "reduce_scatter":
+        if decomposed:                    # payload = full input
+            return (n - 1) / n
+        return float(n - 1)               # payload = the 1/n shard
+    if kind == "all_reduce":
+        return 2 * (n - 1) / n
+    if kind == "all_to_all":
+        return (n - 1) / n
+    return 1.0                            # collective_permute: one hop
+
+
 def collective_wire_bytes(op: CollectiveOp) -> int:
     """Ring-algorithm bytes each participant moves over its link for
     one collective, from the census record's RESULT payload.
@@ -717,13 +740,23 @@ def comm_cost(census: CollectiveStats,
     """Cost every collective in a census against the bandwidth profile
     — the per-axis estimate that turns the PR 4 census from counting
     into costing (arXiv:1909.09756's first-order pod-scaling
-    question)."""
+    question).
+
+    Seconds are priced PER PAYLOAD BYTE (``collective_wire_fraction``
+    x payload / bandwidth), not per op — N bucketed collectives of B
+    bytes each sum to the cost of one collective of N*B bytes, so the
+    overlap-motivated bucketing of the ZeRO gradient leaves the modeled
+    comm budget unchanged (the ``wire_bytes`` per-op records keep the
+    floor-divided integer form pinned by the ring-formula goldens)."""
     profile = profile or bandwidth_profile()
     cost = CommCost(profile=profile.name)
     for op in census.ops:
         wire = collective_wire_bytes(op)
+        payload = op.elements * _DTYPE_BYTES.get(op.dtype, 4)
+        frac = collective_wire_fraction(
+            op.kind, op.group_size, op.decomposed)
         gbps = profile.gbps(op.axes)
-        sec = wire / (gbps * 1e9) if gbps > 0 else 0.0
+        sec = payload * frac / (gbps * 1e9) if gbps > 0 else 0.0
         ax = op.axes[0] if op.axes else "?"
         cost.per_op.append({"name": op.name, "kind": op.kind,
                             "axes": list(op.axes), "wire_bytes": wire,

@@ -133,6 +133,64 @@ def _zero_min_size() -> int:
         return 2048
 
 
+def _zero_bucket_bytes() -> int:
+    """ZeRO gradient communication bucket size (bytes): autotune
+    override > ``MXNET_ZERO_BUCKET_BYTES`` > 4 MiB.  ``<= 0`` selects
+    the monolithic serial baseline (one collective payload over every
+    unit: backward -> reduce-scatter -> update -> all-gather with no
+    independent compute left to hide the wire time)."""
+    from ..tuning import space as _tspace
+    found, v = _tspace.get_override("zero.bucket_bytes")
+    if not found:
+        v = os.environ.get("MXNET_ZERO_BUCKET_BYTES", str(4 << 20))
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 4 << 20
+
+
+def zero_bucket_schedule(units, bucket_bytes: int):
+    """Partition ZeRO unit indices into size-bounded communication
+    buckets, in REVERSE unit order — backward produces the LAST
+    layer's gradients first, so the first bucket's reduce-scatter can
+    launch while earlier layers' backward compute still runs
+    (reverse-topological grad availability, arXiv:1909.09756's
+    compute/comm overlap checklist).  A bucket's units concatenate into
+    ONE flat collective payload (parallel/collectives.py
+    ``reduce_scatter_bucketed``), so buckets never mix update dtypes.
+    ``bucket_bytes <= 0`` returns the fewest possible buckets (one per
+    contiguous update-dtype run, usually one total): the monolithic
+    serial baseline."""
+
+    def _ub(u):
+        try:
+            return int(u["padded"]) * onp.dtype(u["upd_dtype"]).itemsize
+        except Exception:    # pragma: no cover - defensive
+            return int(u["padded"]) * 4
+
+    serial = bucket_bytes is None or int(bucket_bytes) <= 0
+    bucket_bytes = None if serial else int(bucket_bytes)
+    order = range(len(units)) if serial else reversed(range(len(units)))
+    buckets, cur, cur_b, cur_dt = [], [], 0, None
+    for k in order:
+        u = units[k]
+        ub = _ub(u)
+        # forward dtype AND update dtype must both be uniform within a
+        # bucket: the packed forward buffer is in forward dtype, the
+        # collective payload in update dtype
+        dt = (str(u["upd_dtype"]), str(u["dtypes"][0]))
+        if cur and (dt != cur_dt or
+                    (not serial and cur_b + ub > bucket_bytes)):
+            buckets.append(cur)
+            cur, cur_b = [], 0
+        cur.append(k)
+        cur_b += ub
+        cur_dt = dt
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
 def _register_tunables():
     """The ZeRO bucket-floor tunable, declared next to the constant it
     makes sweepable: the floor trades collective COUNT (every solo
@@ -150,6 +208,20 @@ def _register_tunables():
         scope="train", affects_program=True,
         doc="element floor for a param to get its own RS/AG pair "
             "under the ZeRO-1 sharded update"))
+    register(Tunable(
+        "zero.bucket_bytes", default=4 << 20,
+        grid=(0, 1 << 20, 4 << 20, 16 << 20),
+        env="MXNET_ZERO_BUCKET_BYTES", parse=int,
+        valid=lambda v, _c: int(v) >= 0,
+        seam="gluon.fused_step._zero_bucket_bytes() -> "
+             "zero_bucket_schedule comm bucketing (0 = monolithic "
+             "serial baseline)",
+        scope="train", affects_program=True,
+        doc="byte bound per ZeRO gradient communication bucket — "
+            "smaller buckets expose more collectives to latency "
+            "hiding, larger ones amortize per-collective latency; "
+            "the analytical autotuner scores both against modeled "
+            "exposed comm seconds (analysis/overlap.py)"))
 
 
 try:
@@ -1000,11 +1072,99 @@ class CompiledTrainStep:
                 n = v.shape[0]
                 return v if n == padded else jnp.pad(v, (0, padded - n))
 
+            # comm bucketing (docs/PERF_NOTES.md "Communication
+            # overlap"): the flat units are grouped into size-bounded
+            # buckets in reverse-topological grad order and each bucket
+            # concatenates into ONE reduce-scatter / shard update / ONE
+            # all-gather (parallel/collectives.py). Overlap then falls
+            # out of real data dependencies — bucket k's collectives
+            # depend only on bucket k's units, so other buckets'
+            # backward/update compute is free to hide the wire time —
+            # with nothing for XLA's simplifier or scheduler to defeat
+            # (barriers and value-ties both die before the final
+            # schedule). Per-unit elementwise math is untouched and the
+            # packing is pure routing, so ANY bucketing (including the
+            # serial single-bucket baseline) is bit-exact vs any other.
+            bucket_bytes = _zero_bucket_bytes()
+            buckets = zero_bucket_schedule(units, bucket_bytes)
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.collectives import allgather_bucketed
+            nsh = plan.n_shards
+            shard2d = NamedSharding(
+                plan.mesh.mesh, PartitionSpec(plan.axis, None))
+
+            def _unpack_bucket(buf, idx):
+                """Per-unit padded flats out of an interleaved
+                (n_shards, S) bucket buffer — comm-free slices on the
+                free axis whether the buffer is sharded or replicated
+                (parallel/collectives.py layout)."""
+                outs, off = [], 0
+                for k in idx:
+                    s = units[k]["padded"] // nsh
+                    outs.append(buf[:, off:off + s].reshape(
+                        units[k]["padded"]))
+                    off += s
+                return outs
+
+            def _scatter_members(dst, k, flat, to_pds=True):
+                """Write unit k's member views of a flat buffer into
+                ``dst`` — at the param positions (``to_pds``) or at the
+                trainable-slot positions."""
+                u = units[k]
+                off = 0
+                for j, shp, n in zip(u["members"], u["shapes"],
+                                     u["sizes"]):
+                    dst[t_pos[j] if to_pds else j] = \
+                        flat[off:off + n].reshape(shp)
+                    off += n
+
+            def pack_buckets(pds):
+                """Per-bucket interleaved (n_shards, S) forward weight
+                buffers (forward dtype — buckets are dtype-uniform)."""
+                bufs = []
+                for idx in buckets:
+                    rows = [
+                        _padded(_flat_cat(
+                            [pds[t_pos[j]]
+                             for j in units[k]["members"]]),
+                            units[k]["padded"]).reshape(
+                                nsh, units[k]["padded"] // nsh)
+                        for k in idx]
+                    buf = rows[0] if len(rows) == 1 \
+                        else jnp.concatenate(rows, axis=1)
+                    # pin the PRIMAL pack replicated: the params are
+                    # already replicated, so re-materializing them in
+                    # run_loss_bufs must stay comm-free slicing.
+                    # Without the pin GSPMD may shard the pack (its
+                    # cotangent wants P(axis)) and then pay per-param
+                    # gather chains to rebuild the forward weights
+                    bufs.append(wsc(buf, repl))
+                return tuple(bufs)
+
+            def run_loss_bufs(bufs, pds, traced_leaves, key):
+                """run_loss with the trainable params re-materialized
+                from the packed bucket buffers.  Differentiating w.r.t.
+                ``bufs`` (not ``pds``) makes autodiff ACCUMULATE each
+                bucket's gradient into one flat packed buffer, so the
+                pending cross-replica sum covers the whole bucket and
+                GSPMD lowers it as ONE reduce-scatter per bucket —
+                reducing per-param grads first and concatenating after
+                would materialize one collective per unit instead."""
+                pds = list(pds)
+                for bi, idx in enumerate(buckets):
+                    for k, flat in zip(idx,
+                                       _unpack_bucket(bufs[bi], idx)):
+                        _scatter_members(pds, k, flat)
+                return run_loss(tuple(pds), traced_leaves, key)
+
             def zero_fused(pds, sts, masters, traced_leaves, ulrs, uwds,
                            uts, rescale, clip, key):
                 step_self._n_traces += 1
-                l, state, gs = grad_part(pds, traced_leaves, key)
-                ws_u, gs_u = [], []
+                (_, (l, state)), grad_bufs = jax.value_and_grad(
+                    run_loss_bufs, has_aux=True)(
+                        pack_buckets(pds), pds, traced_leaves, key)
+                n_units = len(units)
+                ws_u = [None] * n_units
                 for k, u in enumerate(units):
                     if u["mp"]:
                         wflat = masters[mslot[k]]   # persistent fp32 shard
@@ -1012,17 +1172,57 @@ class CompiledTrainStep:
                         wflat = wsc(_padded(_flat_cat(
                             [pds[t_pos[j]] for j in u["members"]]),
                             u["padded"]), shard)
-                    gflat = _padded(_flat_cat(
-                        [gs[j] for j in u["members"]]), u["padded"])
-                    gflat = wsc(gflat.astype(u["upd_dtype"]), shard)
-                    ws_u.append(wflat)
-                    gs_u.append(gflat)
-                new_ws, new_sts = opt_fn(tuple(ws_u), tuple(gs_u), ulrs,
-                                         uwds, uts, rescale, clip, sts)
+                    ws_u[k] = wflat
+                gs_u = [None] * n_units
+                new_ws = [None] * n_units
+                new_sts_u = [None] * n_units
+                fulls = [None] * n_units
+                for bi, idx in enumerate(buckets):
+                    # ONE reduce-scatter for the whole bucket: the
+                    # packed gradient buffer is a single pending
+                    # cross-replica sum, and the shard2d constraint
+                    # turns it into one collective whose per-unit
+                    # shards slice out comm-free
+                    gbuf = grad_bufs[bi]
+                    upd = units[idx[0]]["upd_dtype"]
+                    if gbuf.dtype != upd:
+                        gbuf = gbuf.astype(upd)
+                    # the constraint is applied to the FLAT view (row d
+                    # of the interleaved layout = contiguous slice d of
+                    # the flat buffer): GSPMD lowers a 1-D P(axis) pin
+                    # on a pending sum as the clean reduce-scatter /
+                    # all-reduce + partition-id-slice pattern the
+                    # zero-dp program checks assert on
+                    gbuf = wsc(gbuf.reshape(-1), shard).reshape(
+                        nsh, -1)
+                    b_gs = _unpack_bucket(gbuf, idx)
+                    for k, g in zip(idx, b_gs):
+                        gs_u[k] = g
+                    bw, bst = opt_fn(
+                        tuple(ws_u[k] for k in idx), tuple(b_gs),
+                        tuple(ulrs[k] for k in idx),
+                        tuple(uwds[k] for k in idx),
+                        tuple(uts[k] for k in idx),
+                        rescale, clip,
+                        tuple(sts[k] for k in idx))
+                    for k, w, st in zip(idx, bw, bst):
+                        new_ws[k] = w
+                        new_sts_u[k] = st
+                    # ONE all-gather for the bucket's new weights.  The
+                    # inner shard2d pin keeps the update output sharded
+                    # so the `repl` constraint gathers the RESULT once —
+                    # without it GSPMD propagates `repl` into the
+                    # update's last elementwise op and all-gathers both
+                    # of its operands instead
+                    b_fulls = allgather_bucketed(
+                        list(bw), nsh,
+                        constrain=lambda b: wsc(wsc(b, shard2d), repl))
+                    for k, f in zip(idx, b_fulls):
+                        fulls[k] = f
                 new_pds = list(state)
                 new_masters = [None] * len(mslot)
                 for k, u in enumerate(units):
-                    full = wsc(new_ws[k], repl)     # the all-gather
+                    full = fulls[k]
                     off = 0
                     for j, shp, n, dt in zip(u["members"], u["shapes"],
                                              u["sizes"], u["dtypes"]):
@@ -1035,10 +1235,23 @@ class CompiledTrainStep:
                 # replicated all-gather consumer above must not make
                 # GSPMD replicate the persistent buffers on the way out
                 new_sts = tuple(tuple(wsc(s, shard) for s in st)
-                                for st in new_sts)
+                                for st in new_sts_u)
                 out = (tuple(new_pds), new_sts, tuple(new_masters), l)
                 if numerics:
-                    out = out + (zero_aux(ws_u, gs_u, new_ws, gs,
+                    gs_log = ()
+                    if numerics == "per_layer":
+                        # logical per-param grads, sliced back out of
+                        # the packed pre-scatter buffers (materializes
+                        # the full gradient — the documented per-layer
+                        # cost)
+                        gs_log = [None] * len(t_pos)
+                        for bi, idx in enumerate(buckets):
+                            for k, flat in zip(
+                                    idx, _unpack_bucket(grad_bufs[bi],
+                                                        idx)):
+                                _scatter_members(gs_log, k, flat,
+                                                 to_pds=False)
+                    out = out + (zero_aux(ws_u, gs_u, new_ws, gs_log,
                                           rescale),)
                 return out
 

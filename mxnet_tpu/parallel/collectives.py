@@ -19,7 +19,7 @@ from ..ndarray.ndarray import NDArray
 from .mesh import DeviceMesh, current_mesh
 
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast_axis",
-           "ppermute"]
+           "ppermute", "reduce_scatter_bucketed", "allgather_bucketed"]
 
 
 def _get_mesh(mesh):
@@ -145,6 +145,100 @@ def ppermute(x: NDArray, perm, axis: str = "dp",
     spec = _batch_spec(x, axis)
     out = _shard_map(f, mesh, (spec,), spec)(_on_mesh(x, mesh, spec))
     return NDArray(out)
+
+
+# ---------------------------------------------------------------------------
+# bucketed flat-segment collectives (trace-level: jax arrays, usable
+# inside jit — the ZeRO-1 fused step's communication bucketing rides
+# these; gluon/fused_step.py)
+# ---------------------------------------------------------------------------
+
+def _bucket_rows(segs, num_shards: int):
+    """Pad each flat segment to ``num_shards`` divisibility and view it
+    as ``(num_shards, s_k)`` rows.  Returns ``(rows, cols)`` where
+    ``cols[k]`` is the per-shard column count of segment ``k``."""
+    rows, cols = [], []
+    for g in segs:
+        g = jnp.reshape(g, (-1,))
+        n = int(g.shape[0])
+        s = -(-n // num_shards)
+        pad = s * num_shards - n
+        if pad:
+            g = jnp.pad(g, (0, pad))
+        rows.append(g.reshape(num_shards, s))
+        cols.append(s)
+    return rows, cols
+
+
+def reduce_scatter_bucketed(segs, num_shards: int, constrain=None):
+    """One reduce-scatter per BUCKET instead of one per segment.
+
+    ``segs`` is a list of flat gradient segments (arbitrary lengths;
+    each is zero-padded to ``num_shards`` divisibility).  Every segment
+    is viewed as ``(num_shards, s_k)`` and the views concatenate on the
+    free axis into a single ``(num_shards, S)`` buffer, so ONE
+    collective on the leading dim hands shard ``d`` exactly
+    ``[seg_0[d*s_0:(d+1)*s_0], seg_1[...], ...]`` — per-segment shard
+    extraction afterwards is a comm-free slice on the free axis.
+
+    ``constrain`` maps the ``(num_shards, S)`` buffer to its sharded
+    layout (e.g. ``lambda b: with_sharding_constraint(b,
+    NamedSharding(mesh, P(axis, None)))``) and is where the collective
+    actually materializes; ``None`` is the identity, which makes the
+    routing itself unit-testable without a mesh.
+
+    Returns a list of flat ``(num_shards * s_k,)`` padded segments in
+    input order (values identical to padding + constraining each
+    segment individually — the packing is pure routing).
+    """
+    rows, cols = _bucket_rows(segs, num_shards)
+    buf = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+    if constrain is not None:
+        buf = constrain(buf)
+    outs, off = [], 0
+    for s in cols:
+        outs.append(buf[:, off:off + s].reshape(num_shards * s))
+        off += s
+    return outs
+
+
+def allgather_bucketed(shards, num_shards: int, constrain=None,
+                       orig_lens=None):
+    """One all-gather per BUCKET: the inverse routing of
+    :func:`reduce_scatter_bucketed`.
+
+    ``shards`` is a list of flat sharded segments whose lengths are
+    ``num_shards``-divisible (the reduce-scatter outputs, or the
+    optimizer's new weights computed from them).  They concatenate into
+    the same interleaved ``(num_shards, S)`` buffer, ``constrain``
+    replicates it (the all-gather), and per-segment full values slice
+    back out comm-free.  ``orig_lens`` (optional, per segment) strips
+    the scatter padding; ``None`` keeps segments padded.
+
+    Returns the list of flat replicated segments in input order.
+    """
+    rows = []
+    for w in shards:
+        w = jnp.reshape(w, (-1,))
+        n = int(w.shape[0])
+        if n % num_shards:
+            raise MXNetError(
+                "allgather_bucketed: segment length %d not divisible "
+                "by num_shards=%d (pass reduce_scatter_bucketed "
+                "outputs)" % (n, num_shards))
+        rows.append(w.reshape(num_shards, n // num_shards))
+    buf = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+    if constrain is not None:
+        buf = constrain(buf)
+    outs, off = [], 0
+    for k, r in enumerate(rows):
+        s = r.shape[1]
+        full = buf[:, off:off + s].reshape(num_shards * s)
+        if orig_lens is not None:
+            full = full[:int(orig_lens[k])]
+        outs.append(full)
+        off += s
+    return outs
 
 
 def _batch_spec(x: NDArray, axis: str):

@@ -12,8 +12,19 @@ through scan + ppermute gives the 1F1B-equivalent backward automatically).
 
 Uniform activation shape across stages is required (the transformer/MLP
 case); a stage is any ``fn(stage_params, x) -> y`` with y.shape == x.shape.
+
+``double_buffer=True`` switches to a one-slot-delay schedule that holds
+TWO ring carries: the hop launched at tick t is not consumed until tick
+t+2, so the collective-permute of microbatch m's activations is in
+flight while the stage computes microbatch m+1 — the permute latency
+hides behind compute instead of sitting on the critical path between
+ticks.  The price is a deeper fill/drain bubble (2·(pp-1) ticks instead
+of pp-1); per-microbatch results are bit-identical either way, only the
+schedule changes.  Default comes from ``MXNET_PIPELINE_DOUBLE_BUFFER``.
 """
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -24,31 +35,52 @@ from ..base import MXNetError
 __all__ = ["pipeline_apply", "run_pipeline"]
 
 
-def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
+def _double_buffer_default() -> bool:
+    return os.environ.get("MXNET_PIPELINE_DOUBLE_BUFFER", "0").lower() in (
+        "1", "true", "yes", "on")
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp",
+                   double_buffer=None):
     """Run inside shard_map over ``axis_name``. ``stage_params`` are THIS
     device's stage weights; ``microbatches`` (M, mb, ...) the full
     replicated stream. Returns (M, mb, ...) outputs, replicated (last
-    stage's results psum-broadcast)."""
+    stage's results psum-broadcast). ``double_buffer`` selects the
+    latency-hiding one-slot-delay hop schedule (None → the
+    ``MXNET_PIPELINE_DOUBLE_BUFFER`` env default)."""
+    if double_buffer is None:
+        double_buffer = _double_buffer_default()
     pp = lax.psum(1, axis_name)  # axis size (lax.axis_size needs newer jax)
     idx = lax.axis_index(axis_name)
     m_count = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
+    # hop latency in ticks: 1 for the classic GPipe ring (a hop launched
+    # at tick t is eaten at t+1, serializing permute after compute), 2
+    # when double-buffered (the hop rides a second carry slot for one
+    # extra tick, so it permutes WHILE tick t+1 computes)
+    lat = 2 if double_buffer else 1
 
     def tick(carry_out, t):
-        carry, outputs = carry_out
+        ready, inflight, outputs = carry_out
         # stage 0 ingests microbatch t (while it exists); later stages eat
         # the ring carry from their predecessor
         inp = jnp.where(idx == 0,
-                        microbatches[jnp.clip(t, 0, m_count - 1)], carry)
+                        microbatches[jnp.clip(t, 0, m_count - 1)], ready)
         out = stage_fn(stage_params, inp)
-        # the last stage emits microbatch j = t - (pp-1) once the pipe fills
-        j = t - (pp - 1)
+        # the last stage emits microbatch j = t - lat*(pp-1) once the
+        # pipe fills
+        j = t - lat * (pp - 1)
         outputs = jnp.where((idx == pp - 1) & (j >= 0),
                             outputs.at[jnp.clip(j, 0, m_count - 1)].set(out),
                             outputs)
-        carry = lax.ppermute(out, axis_name, perm)
-        return (carry, outputs), None
+        hop = lax.ppermute(out, axis_name, perm)
+        if double_buffer:
+            # this tick's hop parks in the inflight slot; the PREVIOUS
+            # tick's hop (already a full compute tick in flight) becomes
+            # next tick's input
+            return (inflight, hop, outputs), None
+        return (hop, inflight, outputs), None
 
     def _varying(a):
         # the ring carry differs per device; mark the initial zeros as
@@ -65,9 +97,10 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
         return a
 
     init = (_varying(jnp.zeros(mb_shape, microbatches.dtype)),
+            _varying(jnp.zeros(mb_shape, microbatches.dtype)),
             _varying(jnp.zeros((m_count,) + mb_shape, microbatches.dtype)))
-    (carry, outputs), _ = lax.scan(tick, init,
-                                   jnp.arange(m_count + pp - 1))
+    (_, _, outputs), _ = lax.scan(tick, init,
+                                  jnp.arange(m_count + lat * (pp - 1)))
     # broadcast the last stage's buffer to every device so callers can use
     # replicated out_specs
     return lax.psum(jnp.where(idx == pp - 1, outputs,
@@ -75,7 +108,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
 
 
 def run_pipeline(stage_fn, stacked_params, x, num_microbatches, mesh,
-                 axis_name="pp"):
+                 axis_name="pp", double_buffer=None):
     """Convenience wrapper: shard ``stacked_params`` (leading dim = number
     of stages) over ``axis_name`` of ``mesh``, split batch ``x`` into
     ``num_microbatches``, run the pipeline, return (B, ...) outputs."""
@@ -94,7 +127,8 @@ def run_pipeline(stage_fn, stacked_params, x, num_microbatches, mesh,
 
     def shard_fn(params_local, micro_all):
         params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
-        return pipeline_apply(stage_fn, params_local, micro_all, axis_name)
+        return pipeline_apply(stage_fn, params_local, micro_all, axis_name,
+                              double_buffer=double_buffer)
 
     from .collectives import shard_map as _compat_shard_map
     out = _compat_shard_map(

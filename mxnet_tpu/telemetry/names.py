@@ -143,6 +143,8 @@ SHARDING_RESHARDS = "mx_sharding_implicit_reshards"
 SHARDING_RESHARD_BYTES = "mx_sharding_reshard_bytes"
 SHARDING_COMM_COST = "mx_sharding_comm_cost_seconds"
 SHARDING_COLLECTIVE_BYTES = "mx_sharding_collective_bytes"
+SHARDING_EXPOSED_COMM = "mx_sharding_exposed_comm_seconds"
+OVERLAP_FRACTION = "mx_overlap_fraction"
 
 # ---------------------------------------------------------------------------
 # Pallas kernel layer (ops/kernels dispatch gate)
@@ -409,6 +411,17 @@ CATALOG = {
         kind="gauge", label="axis",
         help="ring-model wire bytes per step moved by collectives, by "
              "mesh axis"),
+    SHARDING_EXPOSED_COMM: dict(
+        kind="gauge", label="axis",
+        help="exposed (non-overlapped) collective communication "
+             "seconds per step by mesh axis, measured on the "
+             "optimized-HLO schedule (analysis/overlap.py; '?' = "
+             "unattributed groups)"),
+    OVERLAP_FRACTION: dict(
+        kind="gauge", label=None,
+        help="share (0-1) of modeled collective seconds hidden behind "
+             "independent compute in the last-analyzed program's "
+             "schedule (0 = fully serial/exposed)"),
     KERNEL_DISPATCH: dict(
         kind="counter", label="path",
         help="Pallas kernel-layer dispatch decisions by path taken "

@@ -171,6 +171,9 @@ class AnalyticalStepBackend:
     ``max(flops/F, traffic/B)``  (the program on the roofline)
     ``+ HOST_DISPATCH_S / (1 + inflight)``  (window amortization)
     ``+ n_zero_units(min_size) * COLLECTIVE_LAT_S``  (collective count)
+    ``+ exposed_comm_s``  (schedule-level non-overlapped comm,
+    analysis/overlap.py — rewards candidates whose collectives hide
+    behind compute)
 
     The program term comes from ONE lower+compile per distinct
     program-affecting config slice (``cost_analysis`` FLOPs +
@@ -203,7 +206,8 @@ class AnalyticalStepBackend:
             if info is None:
                 # eager path: no program to score — every candidate
                 # ties, the defaults win, which is the right answer
-                probe = {"flops": 0.0, "traffic_bytes": 0.0}
+                probe = {"flops": 0.0, "traffic_bytes": 0.0,
+                         "exposed_comm_s": 0.0, "overlap_fraction": 1.0}
             else:
                 compiled = info["lowered"].compile()
                 flops = 0.0
@@ -222,7 +226,22 @@ class AnalyticalStepBackend:
                                     + rep.temp_bytes)
                 except Exception:   # pragma: no cover - backend-dep
                     pass
-                probe = {"flops": flops, "traffic_bytes": traffic}
+                exposed, frac = 0.0, 1.0
+                try:
+                    # exposed-comm posture of the candidate's schedule
+                    # (analysis/overlap.py): a bucketing knob that hides
+                    # its collectives behind backward/update compute
+                    # scores strictly better than one that serializes
+                    # them, even at equal FLOPs and traffic
+                    from ..analysis import overlap as _ov
+                    orep = _ov.overlap_census(compiled.as_text())
+                    exposed = float(orep.exposed_comm_s)
+                    frac = float(orep.overlap_fraction)
+                except Exception:   # pragma: no cover - backend-dep
+                    pass
+                probe = {"flops": flops, "traffic_bytes": traffic,
+                         "exposed_comm_s": exposed,
+                         "overlap_fraction": frac}
         self._probes[key] = probe
         return probe
 
@@ -261,14 +280,18 @@ class AnalyticalStepBackend:
         n_units = self._zero_units(
             _cfg_value(config, "zero.shard_min_size"))
         t_coll = 2 * n_units * COLLECTIVE_LAT_S   # RS + AG per unit
-        score = t_program + t_host + t_coll
+        t_exposed = float(probe.get("exposed_comm_s", 0.0))
+        score = t_program + t_host + t_coll + t_exposed
         if not math.isfinite(score):
             return MeasureResult.infeasible("non-finite analytical score")
         return MeasureResult(score, detail={
             "t_program": t_program, "t_host": t_host,
             "t_collective": t_coll, "flops": probe["flops"],
             "traffic_bytes": probe["traffic_bytes"],
-            "zero_units": n_units})
+            "zero_units": n_units,
+            "exposed_comm_s": t_exposed,
+            "overlap_fraction": probe.get("overlap_fraction", 1.0),
+            "zero_bucket_bytes": _cfg_value(config, "zero.bucket_bytes")})
 
 
 class TimedStepBackend:

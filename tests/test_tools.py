@@ -276,6 +276,24 @@ def test_diagnose_elastic_section(capsys):
     assert ("device_lost" in out) or ("transient" in out)
 
 
+def test_diagnose_overlap_section(capsys):
+    """--overlap: compiles the zero-sharded adam MLP serial AND
+    bucketed on the virtual dp mesh and prints each schedule's
+    exposed-communication table (docs/PERF_NOTES.md \"Communication
+    overlap\")."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("overlap section needs a >=2-device mesh")
+    diagnose = _load("tools/diagnose.py", "diagnose7")
+    assert diagnose.main(["--overlap"]) == 0
+    out = capsys.readouterr().out
+    assert "Communication Overlap" in out
+    assert "serial (bucket_bytes=0)" in out
+    assert "bucketed (bucket_bytes=16384)" in out
+    assert "exposed=" in out and "collective" in out
+    assert "overlap check failed" not in out
+
+
 # ---------------------------------------------------------------------------
 # launch.py graceful stop
 # ---------------------------------------------------------------------------

@@ -608,6 +608,66 @@ def check_sharding():
         print("sharding check failed:", repr(e))
 
 
+def check_overlap():
+    """Exposed-communication posture (docs/PERF_NOTES.md "Communication
+    overlap"): compile the zero-sharded adam MLP on the virtual dp mesh
+    twice — monolithic serial baseline (zero.bucket_bytes=0) vs
+    bucketed (16 KiB) — and print each schedule's per-collective
+    overlap windows. The bucketed program should show a positive
+    overlap fraction (bucket k's all-gather hides behind bucket k+1's
+    update) where the serial baseline measures ~0."""
+    print("----------Communication Overlap----------")
+    try:
+        import numpy as onp
+        import jax
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon import Trainer, nn
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+        from mxnet_tpu.parallel import make_mesh, shard_batch
+        from mxnet_tpu.analysis.overlap import overlap_census
+        from mxnet_tpu.tuning import space as tspace
+
+        ndev = min(8, len(jax.devices()))
+        if ndev < 2:
+            print(f"only {ndev} device(s) — overlap analysis needs a "
+                  ">=2-device mesh (virtual CPU mesh: "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+            return
+
+        def census_for(bucket_bytes):
+            onp.random.seed(3)
+            mx.random.seed(3)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(64, in_units=32, activation="relu"),
+                    nn.Dense(48, activation="relu"), nn.Dense(10))
+            net.initialize()
+            loss = SoftmaxCrossEntropyLoss()
+            x = mx.nd.array(onp.random.randn(64, 32).astype("float32"))
+            y = mx.nd.array(onp.random.randint(0, 10, size=(64,))
+                            .astype("float32"))
+            net(x)   # materialize deferred-init params off-mesh
+            trainer = Trainer(net.collect_params(), "adam",
+                              {"learning_rate": 0.01}, kvstore=None)
+            step = trainer.compile_step(lambda a, b: loss(net(a), b))
+            with tspace.trial({"zero.shard_min_size": 1,
+                               "zero.bucket_bytes": bucket_bytes}):
+                with make_mesh({"dp": ndev}, jax.devices()[:ndev]) as m:
+                    xs, ys = shard_batch(x, m), shard_batch(y, m)
+                    step(xs, ys)
+                    info = step.lower_entry(xs, ys)
+                    hlo = info["lowered"].compile().as_text()
+                    return overlap_census(hlo, mesh=m)
+
+        for label, bb in (("serial (bucket_bytes=0)", 0),
+                          ("bucketed (bucket_bytes=16384)", 16384)):
+            rep = census_for(bb)
+            print(f"{label}: {rep.summary_line()}")
+            print(rep.table_str(top=8))
+            print()
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("overlap check failed:", repr(e))
+
+
 def check_kernels():
     """Pallas kernel-layer health (docs/PERF_NOTES.md "Pallas kernel
     layer"): the MXNET_PALLAS dispatch decision (path + reason) for
@@ -1025,6 +1085,11 @@ def main(argv=None):
                         "virtual dp mesh and print its sharding-flow "
                         "table, top implicit reshards, and per-axis "
                         "communication cost estimate")
+    parser.add_argument("--overlap", action="store_true",
+                        help="also compile the zero-sharded adam MLP "
+                        "serial vs bucketed on the virtual dp mesh and "
+                        "print each schedule's per-collective overlap "
+                        "windows and exposed-comm fractions")
     parser.add_argument("--kernels", action="store_true",
                         help="also print the Pallas kernel layer's "
                         "per-kernel dispatch decisions (pallas/"
@@ -1071,6 +1136,8 @@ def main(argv=None):
         check_fusion()
     if args.sharding:
         check_sharding()
+    if args.overlap:
+        check_overlap()
     if args.kernels:
         check_kernels()
     if args.autotune:
