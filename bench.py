@@ -1051,6 +1051,162 @@ def bench_decode(dtype):
     }
 
 
+def bench_fleet(dtype):
+    """Serving fleet leg (mx.serving.fleet, docs/SERVING.md "Serving
+    fleet"): a small probe MLP served by a FleetController, measured
+    four ways —
+
+    - closed-loop goodput through ONE replica (the single-replica
+      posture PR 15 ends at);
+    - the same traffic through a 3-replica fleet behind the
+      least-wait router (``fleet_speedup_vs_single``);
+    - kill-one-mid-burst: a targeted device revocation at one
+      replica's dispatch seam while the burst runs — goodput under
+      failover, plus the replica's out-of-rotation window
+      (``kill_recovery_downtime_s``: replica_lost -> restart, from
+      the structured FleetEvent log);
+    - a rolling weight swap under the same fleet
+      (``swap_downtime_s``: the LONGEST single replica's
+      drain->serving window; the fleet itself never goes dark).
+
+    The probe model is deliberately tiny — the routing/failover/
+    rollout machinery, not the matmuls, is under test."""
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.checkpoint import atomic as ck_atomic
+    from mxnet_tpu.checkpoint import state as ck_state
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.serving import loadgen
+    from mxnet_tpu.testing import faults
+
+    in_dim, hidden, classes = 32, 64, 8
+    requests, conc, buckets = 96, 6, (1, 2, 4)
+    n_dev = len(dist.available_devices())
+    n_fleet = min(3, n_dev)
+
+    def build_probe():
+        mx.random.seed(17)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="relu", in_units=in_dim),
+                nn.Dense(classes, in_units=hidden))
+        net.initialize()
+        net(mx.nd.array(onp.zeros((1, in_dim), "float32")))
+        return net
+
+    def build():
+        return serving.CompiledPredictor(build_probe(),
+                                         bucket_sizes=buckets)
+
+    xp = mx.nd.array(onp.zeros((1, in_dim), "float32"))
+    Xp = onp.random.RandomState(3).randn(64, in_dim).astype("float32")
+
+    def make_args(i):
+        return (mx.nd.array(Xp[i % 64:i % 64 + 1]),)
+
+    log(f"bench[fleet]: probe mlp {in_dim}->{hidden}->{classes}, "
+        f"{n_fleet} replicas over {n_dev} device(s), "
+        f"requests={requests} concurrency={conc}")
+
+    single = serving.FleetController(build, example=(xp,), replicas=1,
+                                     max_batch=buckets[-1],
+                                     timeout_ms=2.0)
+    rep1 = loadgen.run_closed_loop(
+        loadgen.fleet_issue(single.router, make_args, timeout=60),
+        conc, requests)
+    single.close()
+    log(f"bench[fleet]: 1 replica {rep1}")
+
+    fleet = serving.FleetController(build, example=(xp,),
+                                    replicas=n_fleet,
+                                    max_batch=buckets[-1],
+                                    timeout_ms=2.0)
+    repN = loadgen.run_closed_loop(
+        loadgen.fleet_issue(fleet.router, make_args, timeout=60),
+        conc, requests)
+    log(f"bench[fleet]: {n_fleet} replicas {repN}")
+
+    # kill-one-mid-burst A/B: revoke the last replica's device at its
+    # dispatch seam while the burst runs; the router + failover keep
+    # accepted traffic flowing on the survivors
+    kill_rep, kill_downtime = None, None
+    if n_fleet >= 2:
+        victim = fleet.replicas[-1]
+        faults.configure(f"serving.dispatch@{victim.name}:before=1:"
+                         f"revoke:d{victim.device.id}")
+        try:
+            kill_rep = loadgen.run_closed_loop(
+                loadgen.fleet_issue(fleet.router, make_args,
+                                    timeout=60), conc, requests)
+        finally:
+            faults.reset()
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline and not any(
+                e.kind in ("restart", "restart_failed")
+                for e in fleet.events):
+            time.sleep(0.05)
+        t_lost = next((e.t for e in fleet.events
+                       if e.kind == "replica_lost"), None)
+        t_back = next((e.t for e in fleet.events
+                       if e.kind == "restart"), None)
+        if t_lost is not None and t_back is not None:
+            kill_downtime = round(max(0.0, t_back - t_lost), 3)
+        log(f"bench[fleet]: kill-mid-burst {kill_rep} "
+            f"recovery_downtime={kill_downtime}s "
+            f"restarts={fleet.stats['restarts']}")
+
+    # rolling weight swap: drain one replica at a time onto a fresh
+    # CRC-verified checkpoint; the out-of-rotation window per replica
+    # is the honest "downtime" (the fleet keeps serving throughout)
+    swap_downtime, swap_total = None, None
+    try:
+        st = ck_state.capture_train_state(net=build_probe(), step=1)
+        root = tempfile.mkdtemp(prefix="mx-fleet-swap-")
+        ck_atomic.write_checkpoint(root, 1, st.arrays,
+                                   array_meta=st.array_meta,
+                                   meta=st.meta)
+        t0 = time.perf_counter()
+        fleet.swap_weights(root)
+        swap_total = round(time.perf_counter() - t0, 3)
+        drains = {e.replica: e.t for e in fleet.events
+                  if e.kind == "swap_drain"}
+        gaps = [e.t - drains[e.replica] for e in fleet.events
+                if e.kind == "swap_done" and e.replica in drains]
+        swap_downtime = round(max(gaps), 3) if gaps else None
+        log(f"bench[fleet]: rolling swap total={swap_total}s "
+            f"max_replica_window={swap_downtime}s")
+    except Exception as e:  # pragma: no cover - probe must not kill leg
+        log(f"bench[fleet]: swap probe failed "
+            f"({type(e).__name__}: {e})")
+    fstats = dict(fleet.stats)
+    fleet.close()
+
+    speedup = round(repN["goodput_qps"] / rep1["goodput_qps"], 2) \
+        if repN.get("goodput_qps") and rep1.get("goodput_qps") else None
+    log(f"bench[fleet]: fleet-vs-single goodput speedup {speedup}x")
+    return {
+        "fleet_goodput_qps": repN.get("goodput_qps"),
+        "single_goodput_qps": rep1.get("goodput_qps"),
+        "fleet_speedup_vs_single": speedup,
+        "kill_recovery_downtime_s": kill_downtime,
+        "swap_downtime_s": swap_downtime,
+        "swap_total_s": swap_total,
+        "replicas": n_fleet,
+        "fleet_p50_ms": repN.get("p50_ms"),
+        "fleet_p99_ms": repN.get("p99_ms"),
+        "per_replica": repN.get("replicas"),
+        "kill_outcomes": kill_rep.get("outcomes")
+        if kill_rep is not None else None,
+        "kill_goodput_qps": kill_rep.get("goodput_qps")
+        if kill_rep is not None else None,
+        "restarts": fstats.get("restarts"),
+        "failovers": fstats.get("failovers"),
+        "requeued": fstats.get("requeued"),
+        "swaps": fstats.get("swaps"),
+    }
+
+
 def main():
     model = os.environ.get("MXNET_BENCH_MODEL", "all")
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bf16")
@@ -1245,6 +1401,34 @@ def main():
                 "decode_kv_page_util": d["kv_page_util"],
                 "decode_speedup_vs_static": d["speedup_vs_static"],
                 "decode_detail": d,
+            })
+    if model in ("all", "fleet"):
+        # serving fleet leg: isolated like the other secondary legs
+        try:
+            fl = bench_fleet(dtype)
+        except Exception as e:
+            if model == "fleet":
+                raise
+            log(f"bench[fleet]: FAILED ({type(e).__name__}: {e}); "
+                "continuing without it")
+            fl = None
+        if fl is not None:
+            if model == "fleet":
+                out.update({
+                    "metric": "fleet_goodput_qps",
+                    "value": fl["fleet_goodput_qps"],
+                    "unit": "req/s",
+                    "vs_baseline": fl["fleet_speedup_vs_single"],
+                    "dtype": dtype,
+                })
+            out.update({
+                "fleet_goodput_qps": fl["fleet_goodput_qps"],
+                "fleet_speedup_vs_single":
+                    fl["fleet_speedup_vs_single"],
+                "kill_recovery_downtime_s":
+                    fl["kill_recovery_downtime_s"],
+                "swap_downtime_s": fl["swap_downtime_s"],
+                "fleet_detail": fl,
             })
     try:
         roof = matmul_roofline()

@@ -181,6 +181,15 @@ class TrainCheckpointManager:
     def has_checkpoint(self) -> bool:
         return self.latest_step() is not None
 
+    def latest_path(self) -> Optional[str]:
+        """Directory of the newest VALID checkpoint, or None — the
+        path a serving-side weight rollout loads
+        (:meth:`~mxnet_tpu.serving.FleetController.swap_weights`
+        accepts it directly; corrupt candidates are already skipped
+        here, and the fleet re-validates before any replica drains)."""
+        found = atomic.latest_valid(self._root)
+        return found[1] if found else None
+
     @property
     def last_saved_step(self) -> Optional[int]:
         return self._last_saved

@@ -21,12 +21,14 @@ The chaos harness lives in ``mxnet_tpu/testing/faults.py`` (``revoke``/
 from . import detect                                    # noqa: F401
 from .detect import (is_device_lost, classify,          # noqa: F401
                      maybe_record_device_lost, device_lost_guard,
-                     PreemptionNotice, notice, elastic_enabled, armed,
+                     PreemptionNotice, notice, clear_scoped_notices,
+                     elastic_enabled, armed,
                      max_retries, preemption_grace_sec)
 
 __all__ = ["detect", "is_device_lost", "classify",
            "maybe_record_device_lost", "device_lost_guard",
-           "PreemptionNotice", "notice", "elastic_enabled", "armed",
+           "PreemptionNotice", "notice", "clear_scoped_notices",
+           "elastic_enabled", "armed",
            "max_retries", "preemption_grace_sec",
            # lazily resolved from .supervisor (needs gluon loaded):
            "supervisor", "ElasticSupervisor", "ElasticResult",

@@ -38,8 +38,8 @@ from typing import Optional
 
 __all__ = ["is_device_lost", "classify", "maybe_record_device_lost",
            "device_lost_guard", "PreemptionNotice", "notice",
-           "elastic_enabled", "armed", "max_retries",
-           "preemption_grace_sec"]
+           "clear_scoped_notices", "elastic_enabled", "armed",
+           "max_retries", "preemption_grace_sec"]
 
 _LOG = logging.getLogger("mxnet_tpu.elastic")
 
@@ -232,9 +232,20 @@ class PreemptionNotice:
     window can be drained and the final checkpoint committed cleanly.
     ``trigger()`` raises the flag programmatically (tests, cloud
     maintenance-event watchers that poll a metadata endpoint).
+
+    ``scope`` (default None = the process-global notice) names the
+    subset of the process this notice concerns — e.g. one serving
+    replica in a :class:`~mxnet_tpu.serving.FleetController`, so a
+    single host's preemption drains exactly that replica while the
+    rest keep serving. Scoped notices live in a registry keyed by the
+    scope string (:func:`notice`); consumers that poll a scope must
+    ALSO poll the global notice (a process-wide SIGTERM still drains
+    everyone) — :meth:`requested` on a scoped notice does exactly
+    that.
     """
 
-    def __init__(self):
+    def __init__(self, scope: Optional[str] = None):
+        self.scope = scope
         self._event = threading.Event()
         self._time: Optional[float] = None
         self._prev: dict = {}
@@ -279,13 +290,19 @@ class PreemptionNotice:
                 self._time = time.time()
         self._event.set()
         _LOG.warning(
-            "preemption notice received (%s): requesting grace-window "
+            "preemption notice received (%s%s): requesting grace-window "
             "final checkpoint (MXNET_PREEMPTION_GRACE_SEC=%.0fs)",
             f"signal {signum}" if signum is not None else "programmatic",
+            f", scope {self.scope!r}" if self.scope else "",
             preemption_grace_sec())
 
     def requested(self) -> bool:
-        return self._event.is_set()
+        """Whether this notice (or, for a SCOPED notice, the process-
+        global one too — a process-wide SIGTERM concerns every scope)
+        has fired."""
+        if self._event.is_set():
+            return True
+        return self.scope is not None and _notice._event.is_set()
 
     @property
     def notice_time(self) -> Optional[float]:
@@ -306,9 +323,28 @@ class PreemptionNotice:
 
 
 _notice = PreemptionNotice()
+_scoped_lock = threading.Lock()
+_scoped: dict = {}
 
 
-def notice() -> PreemptionNotice:
-    """The process-global preemption notice (one SIGTERM concerns every
-    supervisor in the process)."""
-    return _notice
+def notice(scope: Optional[str] = None) -> PreemptionNotice:
+    """With no ``scope``: the process-global preemption notice (one
+    SIGTERM concerns every supervisor in the process). With a scope
+    string: the per-scope notice from the registry (created on first
+    use) — triggering it drains exactly the consumers polling that
+    scope (e.g. one fleet replica), while everyone else keeps running;
+    a scoped notice's :meth:`~PreemptionNotice.requested` also honours
+    the process-global flag, so a real SIGTERM still drains all."""
+    if scope is None:
+        return _notice
+    with _scoped_lock:
+        n = _scoped.get(scope)
+        if n is None:
+            n = _scoped[scope] = PreemptionNotice(scope=scope)
+        return n
+
+
+def clear_scoped_notices():
+    """Drop every scoped notice (test teardown / fleet shutdown)."""
+    with _scoped_lock:
+        _scoped.clear()

@@ -258,6 +258,9 @@ class ElasticSupervisor:
         self._recover = detect.elastic_enabled() if recover is None \
             else bool(recover)
         self._log = log if log is not None else recovery_log()
+        # the TRAINING supervisor deliberately polls only the process-
+        # global notice: scoped notices (detect.notice("fleet/...")) are
+        # per-replica serving machinery and must not pause training
         self._preempt = detect.notice()
 
         # run state

@@ -25,6 +25,13 @@ telemetry catalog, the program-lint gates) turned into a serving path.
   once), :class:`CircuitBreaker`, and the typed error taxonomy
   (:class:`DeadlineExceeded` / :class:`Overloaded` /
   :class:`ServingShutdown`).
+- :mod:`.fleet` — :class:`FleetController`/:class:`FleetRouter`: a
+  multi-replica serving fleet (one predictor+batcher+supervisor per
+  device, AOT-warm from the shared compile cache) with least-wait
+  routing, replica-loss failover onto the survivors (exactly-once
+  re-enqueue), drain-then-retire on scoped preemption notices,
+  autoscaling, and zero-downtime rolling weight swaps
+  (``mx_fleet_*`` telemetry; docs/SERVING.md "Serving fleet").
 - :func:`predictor_for` — bf16/fp16/int8 serving variants through the
   existing AMP and post-training-quantization paths.
 - :mod:`.loadgen` — closed-/open-loop load generation with per-request
@@ -49,6 +56,11 @@ from .kvcache import KV_PAGE_SIZE, PagedKVCache, pages_needed
 from .decode import (DecodeEngine, DecodeStream, TinyDecoder,
                      kv_page_size, prefill_chunk, run_decode,
                      slot_ladder)
+from .fleet import (FleetController, FleetEvent, FleetRouter,
+                    fleet_max_replicas, fleet_min_replicas,
+                    fleet_replicas, fleet_restart_retries,
+                    fleet_scale_down_wait_s, fleet_scale_up_wait_s)
+from . import fleet
 from . import loadgen
 from . import resilience
 from . import decode
@@ -63,4 +75,7 @@ __all__ = ["CompiledPredictor", "DynamicBatcher", "ServingFuture",
            "decode", "kvcache", "DecodeEngine", "DecodeStream",
            "TinyDecoder", "PagedKVCache", "KV_PAGE_SIZE",
            "pages_needed", "run_decode", "slot_ladder", "kv_page_size",
-           "prefill_chunk"]
+           "prefill_chunk", "fleet", "FleetController", "FleetRouter",
+           "FleetEvent", "fleet_replicas", "fleet_min_replicas",
+           "fleet_max_replicas", "fleet_scale_up_wait_s",
+           "fleet_scale_down_wait_s", "fleet_restart_retries"]

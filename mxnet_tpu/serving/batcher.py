@@ -214,7 +214,7 @@ class ServingFuture:
     """
 
     __slots__ = ("_cv", "_build", "_out", "_err", "_done", "_epoch",
-                 "_supervised")
+                 "_supervised", "replica", "version")
 
     def __init__(self):
         self._cv = threading.Condition()
@@ -224,6 +224,10 @@ class ServingFuture:
         self._done = False
         self._epoch = 0
         self._supervised = False
+        # routing breadcrumbs (FleetRouter tags these): which replica
+        # served the request and that replica's weight version
+        self.replica: Optional[str] = None
+        self.version: Optional[int] = None
 
     def _resolve(self, build):
         with self._cv:
@@ -382,12 +386,18 @@ class DynamicBatcher:
         self._thread = None
         self._draining = False
         self._dead: Optional[BaseException] = None
-        self._ewma_service: Optional[float] = None
+        # seed the admission EWMA from the predictor's warmup() timing
+        # (when it ran): deadline shedding projects from request 1
+        # instead of admitting blindly until the first retire lands
+        self._ewma_service: Optional[float] = self._service_seed(predictor)
         # resilience hooks (ServingSupervisor wires these)
         self.breaker = None
         self.on_batch_failure = None
         self.on_batch_retired = None
         self.drain_check = None
+        # chaos-harness context tag: the FleetController sets this to
+        # the replica name so point@ctx fault rules target one replica
+        self.fault_ctx: Optional[str] = None
         self.stats = {"requests": 0, "batches": 0, "rows": 0,
                       "padded_rows": 0, "flush_full": 0,
                       "flush_timeout": 0, "flush_idle": 0,
@@ -432,7 +442,7 @@ class DynamicBatcher:
         ``MXNET_SERVING_QUEUE_TIMEOUT_MS``); a still-full queue sheds
         with :class:`~mxnet_tpu.serving.Overloaded` (reason
         ``queue``). Never raises a bare ``queue.Full``."""
-        fault_point("serving.admit", "before")
+        fault_point("serving.admit", "before", ctx=self.fault_ctx)
         if self._dead is not None:
             raise ServingShutdown(
                 f"serving dispatcher thread died "
@@ -494,12 +504,23 @@ class DynamicBatcher:
         self._m_queue.set(self._queue.qsize() + len(self._forming))
         return fut
 
+    @staticmethod
+    def _service_seed(predictor) -> Optional[float]:
+        seed = getattr(predictor, "service_time_seed_s", None)
+        try:
+            seed = float(seed) if seed is not None else None
+        except (TypeError, ValueError):
+            return None
+        return seed if seed and seed > 0 else None
+
     def estimated_wait_s(self, rows: int = 0) -> Optional[float]:
         """Projected wait until a request submitted NOW would retire:
         (waiting rows incl. its own, bucketed at ``max_batch``) plus
         the in-flight micro-batches, times the EWMA micro-batch
-        service time. None before the first retire (no estimate —
-        admit; the queue bound still protects memory)."""
+        service time. The EWMA is seeded from the predictor's
+        ``warmup()`` execution timing when available; None only when
+        neither a warmup seed nor a retire has happened yet (no
+        estimate — admit; the queue bound still protects memory)."""
         ewma = self._ewma_service
         if ewma is None:
             return None
@@ -658,6 +679,8 @@ class DynamicBatcher:
                 f"predictor's largest shape bucket "
                 f"({predictor.bucket_sizes[-1]})")
         self._predictor = predictor
+        if self._ewma_service is None:
+            self._ewma_service = self._service_seed(predictor)
 
     def abandon_inflight(self) -> List[_Request]:
         """Discard every in-flight micro-batch WITHOUT syncing (work
@@ -879,7 +902,7 @@ class DynamicBatcher:
             for i in range(n_pos))
         # chaos-harness seam: a revoked device surfaces here when the
         # loss hits at dispatch time (testing/faults.py)
-        fault_point("serving.dispatch", "before")
+        fault_point("serving.dispatch", "before", ctx=self.fault_ctx)
         outs = pred.predict(*batch_args)
         out_leaves, out_tree = jax.tree_util.tree_flatten(
             outs, is_leaf=lambda t: isinstance(t, NDArray))
@@ -929,7 +952,7 @@ class DynamicBatcher:
         try:
             # chaos-harness seam: a deferred device loss surfaces at
             # the blocking wait on the in-flight micro-batch
-            fault_point("serving.retire", "before")
+            fault_point("serving.retire", "before", ctx=self.fault_ctx)
             jax.block_until_ready(list(datas))
         except BaseException as e:
             rec = self._inflight.pop(tag, None)
@@ -951,4 +974,4 @@ class DynamicBatcher:
                 self.on_batch_retired()
             except Exception:    # pragma: no cover - defensive
                 _LOG.warning("serving retire hook failed", exc_info=True)
-        fault_point("serving.retire", "after")
+        fault_point("serving.retire", "after", ctx=self.fault_ctx)

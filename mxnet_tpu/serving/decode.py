@@ -18,7 +18,12 @@ STEP boundary instead:
   indirection, so admission control is simply "are there free pages" —
   a request that cannot reserve its worst-case pages is shed with a
   typed ``Overloaded(reason="kvcache")`` (composing the PR 15 EWMA/
-  deadline shedder, which still applies first).
+  deadline shedder, which still applies first). Deadlines are
+  re-projected PER TOKEN at retire: when the inter-token (TPOT) EWMA
+  says the remaining tokens cannot land inside the request's
+  deadline, the stream is shed mid-flight with a typed
+  ``DeadlineExceeded`` and its KV pages free immediately for streams
+  that can still make their budget.
 - **Chunked prefill.** Long prompts are consumed ``decode.prefill_chunk``
   tokens at a time, strictly alternating with decode iterations when
   both kinds of work exist — a long prompt can never starve the
@@ -520,10 +525,14 @@ class DecodeEngine:
         self._draining = False
         self._dead: Optional[BaseException] = None
         self._ewma_step: Optional[float] = None
+        # inter-token-gap EWMA (TPOT): the per-token deadline
+        # re-projection sheds a stream mid-flight when the projected
+        # remaining decode time cannot land inside its deadline
+        self._ewma_tpot: Optional[float] = None
         self._last_was_prefill = False
         self.stats = {"submitted": 0, "completed": 0, "rejected": 0,
-                      "deadline_missed": 0, "steps": 0,
-                      "prefill_chunks": 0, "tokens": 0,
+                      "deadline_missed": 0, "shed_midstream": 0,
+                      "steps": 0, "prefill_chunks": 0, "tokens": 0,
                       "kv_util_peak": 0.0}
         t = _telemetry()
         reg = t.registry()
@@ -938,7 +947,10 @@ class DecodeEngine:
         if first:
             self._m_ttft.observe(max(0.0, now - req.t_submit))
         else:
-            self._m_tpot.observe(max(0.0, now - req.t_last_tok))
+            gap = max(0.0, now - req.t_last_tok)
+            self._m_tpot.observe(gap)
+            self._ewma_tpot = gap if self._ewma_tpot is None \
+                else 0.8 * self._ewma_tpot + 0.2 * gap
         req.t_last_tok = now
         if req.deadline is not None and now > req.deadline:
             self.stats["deadline_missed"] += 1
@@ -950,6 +962,23 @@ class DecodeEngine:
         if (eos is not None and tok == eos) or \
                 req.generated >= req.max_new:
             self._finish_slot(slot, req, None)
+            return
+        # per-token deadline re-projection: when the TPOT EWMA says the
+        # REMAINING tokens cannot land inside the deadline, shed the
+        # stream NOW — its KV pages free immediately for streams that
+        # can still make their budget, instead of decoding tokens the
+        # client will throw away at the reactive check above
+        left = req.max_new - req.generated
+        if req.deadline is not None and self._ewma_tpot is not None \
+                and now + left * self._ewma_tpot > req.deadline:
+            self.stats["deadline_missed"] += 1
+            self.stats["shed_midstream"] += 1
+            self._finish_slot(slot, req, DeadlineExceeded(
+                f"decode stream shed mid-flight after {req.generated} "
+                f"token(s): projected remaining decode time "
+                f"({left} x {self._ewma_tpot * 1e3:.2f} ms TPOT) "
+                f"overruns the deadline — KV pages freed for streams "
+                f"that can still finish in budget"))
 
     def _finish_slot(self, slot: int, req: _Request,
                      exc: Optional[BaseException]):

@@ -25,6 +25,15 @@ exact p50/p99 latency computed from the raw per-request samples of the
 ``ok`` population (no histogram interpolation — bench.py puts these
 next to the training legs in the BENCH json;
 ``mx_serving_request_seconds`` carries the live-histogram view).
+
+Fleet targets: :func:`fleet_issue` / :func:`fleet_submit` adapt a
+:class:`~mxnet_tpu.serving.FleetRouter` (or a list of per-replica
+submit callables) into the loops' issue/submit shape, carrying the
+``fut.replica`` routing breadcrumb through successes AND failures.
+When those breadcrumbs are present, both loops add a ``replicas`` key
+to the report — per-replica {qps, goodput_qps, p50/p99, outcome
+census} next to the fleet aggregate — so a hot or broken replica is
+visible in the same artifact as the fleet number.
 """
 from __future__ import annotations
 
@@ -35,7 +44,8 @@ from typing import Callable, Optional
 import numpy as onp
 
 __all__ = ["run_closed_loop", "run_open_loop", "percentiles",
-           "classify_outcome", "streaming_summary"]
+           "classify_outcome", "streaming_summary", "fleet_issue",
+           "fleet_submit"]
 
 OUTCOMES = ("ok", "rejected", "deadline_missed", "error")
 
@@ -100,8 +110,35 @@ def _maybe_streaming(out: dict, records: list, wall: float) -> dict:
     return out
 
 
+def _tally_replica(by: dict, replica, outcome: str, dt):
+    """Fold one terminal state into the per-replica census (no-op when
+    the request carried no routing breadcrumb — plain predictors)."""
+    if not replica:
+        return
+    rec = by.setdefault(replica, {
+        "outcomes": {k: 0 for k in OUTCOMES}, "lat": []})
+    rec["outcomes"][outcome] += 1
+    if dt is not None:
+        rec["lat"].append(dt)
+
+
+def _replica_report(by: dict, wall: float) -> dict:
+    out = {}
+    for name in sorted(by):
+        rec = by[name]
+        oc = rec["outcomes"]
+        done = oc["ok"] + oc["deadline_missed"] + oc["error"]
+        r = {"qps": round(done / wall, 2) if wall > 0 else None,
+             "goodput_qps": round(oc["ok"] / wall, 2)
+             if wall > 0 else None,
+             "outcomes": dict(oc)}
+        r.update(percentiles(rec["lat"]))
+        out[name] = r
+    return out
+
+
 def _report(mode: str, outcomes: dict, ok_lat, wall: float,
-            extra: dict) -> dict:
+            extra: dict, by_replica: Optional[dict] = None) -> dict:
     total = sum(outcomes.values())
     done = outcomes["ok"] + outcomes["deadline_missed"] \
         + outcomes["error"]
@@ -122,7 +159,69 @@ def _report(mode: str, outcomes: dict, ok_lat, wall: float,
                                     4) if total else None,
     })
     out.update(percentiles(ok_lat))
+    if by_replica:
+        out["replicas"] = _replica_report(by_replica, wall)
     return out
+
+
+def _submit_of(target) -> Callable:
+    """One submit callable from a fleet target: a FleetRouter (or any
+    object with ``.submit``) routes every request; a LIST of submit
+    callables (one per replica) is round-robined by request index."""
+    if callable(getattr(target, "submit", None)):
+        return lambda i, *args, **kw: target.submit(*args, **kw)
+    fns = list(target)
+    if not fns or not all(callable(f) for f in fns):
+        raise TypeError(
+            "fleet target must be a router (with .submit) or a "
+            "non-empty list of submit callables")
+    return lambda i, *args, **kw: fns[i % len(fns)](*args, **kw)
+
+
+def _attributed_wait(fut, timeout):
+    """``fut.result`` with the routing breadcrumb carried through both
+    outcomes: failures get ``e.replica`` stamped so the loops can
+    attribute sheds/deadline-misses, successes return the per-replica
+    record."""
+    try:
+        fut.result(timeout)
+    except BaseException as e:
+        rep = getattr(fut, "replica", None)
+        if rep is not None:
+            try:
+                e.replica = rep
+            except Exception:    # pragma: no cover - exotic exception
+                pass
+        raise
+    return {"replica": getattr(fut, "replica", None)}
+
+
+def fleet_issue(target, make_args: Callable[[int], tuple],
+                deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = 30.0) -> Callable:
+    """Adapt a fleet target into :func:`run_closed_loop`'s
+    ``issue(i)``: submit ``make_args(i)`` through the router (or the
+    ``i % N``-th of a list of submit callables), wait for the result,
+    and return the per-replica record the loop's census groups by."""
+    submit = _submit_of(target)
+
+    def issue(i: int):
+        fut = submit(i, *make_args(i), deadline_ms=deadline_ms)
+        return _attributed_wait(fut, timeout)
+    return issue
+
+
+def fleet_submit(target, make_args: Callable[[int], tuple],
+                 deadline_ms: Optional[float] = None) -> Callable:
+    """Adapt a fleet target into :func:`run_open_loop`'s
+    ``submit(i)``: enqueue without waiting, return the wait callable
+    (which yields the per-replica record)."""
+    submit = _submit_of(target)
+
+    def submit_one(i: int):
+        fut = submit(i, *make_args(i), deadline_ms=deadline_ms)
+        return lambda timeout=None: _attributed_wait(fut, timeout)
+    return submit_one
 
 
 def run_closed_loop(issue: Callable[[int], None], concurrency: int,
@@ -135,10 +234,13 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
     ``deadline_missed``, not ``ok`` (goodput is ok/s). An ``issue``
     that RETURNS a streaming record (a dict with ``ttft_s``/``tpot_s``
     per token — ``DecodeStream.record()``) additionally gets exact
-    TTFT/TPOT percentiles and ``tokens_per_sec`` in the report."""
+    TTFT/TPOT percentiles and ``tokens_per_sec`` in the report; one
+    that returns/raises with a ``replica`` breadcrumb
+    (:func:`fleet_issue`) additionally gets the per-replica census."""
     outcomes = {k: 0 for k in OUTCOMES}
     ok_lat: list = []
     stream_recs: list = []
+    by_replica: dict = {}
     lock = threading.Lock()
     counter = [0]
 
@@ -154,17 +256,25 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
                 ret = issue(i)
             except Exception as e:
                 with lock:
-                    outcomes[classify_outcome(e)] += 1
+                    oc = classify_outcome(e)
+                    outcomes[oc] += 1
+                    _tally_replica(by_replica,
+                                   getattr(e, "replica", None), oc, None)
                 continue
             dt = time.perf_counter() - t0
             with lock:
+                rep = ret.get("replica") if isinstance(ret, dict) \
+                    else None
                 if isinstance(ret, dict) and "ttft_s" in ret:
                     stream_recs.append(ret)
                 if deadline_s is not None and dt > deadline_s:
                     outcomes["deadline_missed"] += 1
+                    _tally_replica(by_replica, rep, "deadline_missed",
+                                   None)
                 else:
                     outcomes["ok"] += 1
                     ok_lat.append(dt)
+                    _tally_replica(by_replica, rep, "ok", dt)
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(1, concurrency))]
@@ -176,7 +286,8 @@ def run_closed_loop(issue: Callable[[int], None], concurrency: int,
     wall = time.perf_counter() - t0
     return _maybe_streaming(
         _report("closed", outcomes, ok_lat, wall,
-                {"concurrency": int(concurrency)}), stream_recs, wall)
+                {"concurrency": int(concurrency)}, by_replica),
+        stream_recs, wall)
 
 
 def run_open_loop(submit: Callable[[int], Callable[[], None]],
@@ -198,6 +309,7 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
     outcomes = {k: 0 for k in OUTCOMES}
     ok_lat: list = []
     stream_recs: list = []
+    by_replica: dict = {}
     lock = threading.Lock()
     # a waiter pool records each completion AS IT HAPPENS — waiting
     # sequentially after the arrival phase would inflate every early
@@ -217,17 +329,25 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
                     ret = wait()
             except Exception as e:
                 with lock:
-                    outcomes[classify_outcome(e)] += 1
+                    oc = classify_outcome(e)
+                    outcomes[oc] += 1
+                    _tally_replica(by_replica,
+                                   getattr(e, "replica", None), oc, None)
                 continue
             dt = time.perf_counter() - t0
             with lock:
+                rep = ret.get("replica") if isinstance(ret, dict) \
+                    else None
                 if isinstance(ret, dict) and "ttft_s" in ret:
                     stream_recs.append(ret)
                 if deadline_s is not None and dt > deadline_s:
                     outcomes["deadline_missed"] += 1
+                    _tally_replica(by_replica, rep, "deadline_missed",
+                                   None)
                 else:
                     outcomes["ok"] += 1
                     ok_lat.append(dt)
+                    _tally_replica(by_replica, rep, "ok", dt)
 
     n_waiters = min(32, max(4, requests // 8))
     threads = [threading.Thread(target=waiter, daemon=True)
@@ -245,7 +365,10 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
             waitfn = submit(i)
         except Exception as e:       # shed at admission
             with lock:
-                outcomes[classify_outcome(e)] += 1
+                oc = classify_outcome(e)
+                outcomes[oc] += 1
+                _tally_replica(by_replica,
+                               getattr(e, "replica", None), oc, None)
         else:
             work.put((t0, waitfn))
         next_t += gaps[i]
@@ -256,4 +379,5 @@ def run_open_loop(submit: Callable[[int], Callable[[], None]],
     wall = time.perf_counter() - t_start
     return _maybe_streaming(
         _report("open", outcomes, ok_lat, wall,
-                {"rate_qps": float(rate_qps)}), stream_recs, wall)
+                {"rate_qps": float(rate_qps)}, by_replica),
+        stream_recs, wall)

@@ -39,6 +39,7 @@ Julia-to-TPU work (arXiv:1810.09868) applied to the serving path:
 from __future__ import annotations
 
 import logging
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence
 
@@ -141,6 +142,11 @@ class CompiledPredictor:
         self._autotune_outcome = None
         self._analyze = _analysis_mode(analyze)
         self._analysis_report = None
+        # measured per-micro-batch service time from the warmup()
+        # execution; a DynamicBatcher seeds its admission EWMA from it
+        # so deadline shedding works from request 1 (no cold-start
+        # blindness). None until warmup ran.
+        self.service_time_seed_s: Optional[float] = None
         # params with materialized data, bound functionally per call —
         # the same handles every time (resident on device); quantized
         # blocks own no Parameters and close their weights over the trace
@@ -384,12 +390,30 @@ class CompiledPredictor:
                              "(%s: %s); serving with defaults",
                              type(e).__name__, e)
         out = {}
+        last_padded = None
         for b in (buckets or self.bucket_sizes):
             padded = tuple(
                 _pad_rows(l, b) if isinstance(l, _ARRAY_TYPES) and
                 getattr(_data_of(l), "ndim", 0) >= 1 else l
                 for l in example)
             out[b] = self.aot_compile(*padded)
+            last_padded = padded
+        # time ONE execution of the largest warmed bucket (compile
+        # already paid above): the measured micro-batch service time
+        # seeds the DynamicBatcher's admission EWMA, so deadline-based
+        # shedding projects honestly from the very first request
+        if last_padded is not None and example:
+            try:
+                t0 = time.perf_counter()
+                res = self.predict(*last_padded)
+                jax.block_until_ready([
+                    _data_of(l) for l in jax.tree_util.tree_leaves(
+                        res, is_leaf=lambda t: isinstance(t, NDArray))
+                    if isinstance(l, _ARRAY_TYPES)])
+                self.service_time_seed_s = time.perf_counter() - t0
+            except Exception:    # pragma: no cover - warmup is advisory
+                _LOG.debug("warmup timing execution failed",
+                           exc_info=True)
         return out
 
     # ---------------- static analysis ----------------

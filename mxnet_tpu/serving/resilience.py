@@ -291,7 +291,11 @@ class ServingSupervisor:
     :class:`~mxnet_tpu.elastic.PreemptionNotice` from the dispatch
     loop: SIGTERM flips the batcher to drain mode — reject new
     (:class:`Overloaded` ``reason="draining"``), flush forming +
-    in-flight, close — so no accepted request is silently lost.
+    in-flight, close — so no accepted request is silently lost. Pass a
+    STRING instead of True to poll a *scoped* notice
+    (``elastic.notice(scope)``): a notice for that scope drains only
+    this supervisor — the fleet's per-replica drain-then-retire path —
+    while the process-global notice still drains everyone.
     """
 
     def __init__(self, build: Callable, example: Optional[Sequence] = None,
@@ -303,7 +307,7 @@ class ServingSupervisor:
                  max_retries: Optional[int] = None,
                  backoff_base: float = 0.05, backoff_max: float = 2.0,
                  breaker: Optional[CircuitBreaker] = None,
-                 drain_on_preemption: bool = True,
+                 drain_on_preemption=True,
                  clock: Callable[[], float] = time.perf_counter,
                  start: bool = True):
         from .batcher import DynamicBatcher
@@ -337,9 +341,13 @@ class ServingSupervisor:
         self._batcher.breaker = self.breaker
         self._batcher.on_batch_failure = self._on_batch_failure
         self._batcher.on_batch_retired = self._on_batch_retired
+        self.notice_scope = drain_on_preemption \
+            if isinstance(drain_on_preemption, str) else None
         if drain_on_preemption:
-            self._batcher.drain_check = \
-                lambda: self._detect.notice().requested()
+            # a scoped notice's requested() also honours the process-
+            # global flag, so a real SIGTERM still drains every scope
+            n = self._detect.notice(self.notice_scope)
+            self._batcher.drain_check = n.requested
 
     # ---------------- public surface ----------------
     @property

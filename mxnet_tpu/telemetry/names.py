@@ -189,6 +189,16 @@ DECODE_TTFT_SECONDS = "mx_decode_ttft_seconds"
 DECODE_TPOT_SECONDS = "mx_decode_tpot_seconds"
 
 # ---------------------------------------------------------------------------
+# serving fleet controller (serving/fleet.py)
+# ---------------------------------------------------------------------------
+FLEET_REPLICAS = "mx_fleet_replicas"
+FLEET_ROUTED = "mx_fleet_routed_requests_total"
+FLEET_RESTARTS = "mx_fleet_replica_restarts_total"
+FLEET_SWAPS = "mx_fleet_weight_swaps_total"
+FLEET_SCALE_EVENTS = "mx_fleet_scale_events_total"
+FLEET_QUEUE_WAIT = "mx_fleet_queue_wait_seconds"
+
+# ---------------------------------------------------------------------------
 # telemetry self-observation (telemetry/exporters.py)
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
@@ -526,6 +536,38 @@ CATALOG = {
         help="time-per-output-token: inter-token gap between "
              "consecutive streamed tokens of one request (steady-state "
              "decode cadence)"),
+    FLEET_REPLICAS: dict(
+        kind="gauge", label="state",
+        help="fleet replicas by lifecycle state (serving = in "
+             "rotation, draining = flushing accepted requests before "
+             "retire/swap, recovering = predictor rebuild after a "
+             "replica loss, retired = out of the fleet for good)"),
+    FLEET_ROUTED: dict(
+        kind="counter", label="replica",
+        help="requests the FleetRouter handed to each replica "
+             "(lowest-projected-wait policy; an open breaker or a "
+             "draining replica receives zero)"),
+    FLEET_RESTARTS: dict(
+        kind="counter", label=None,
+        help="replica restarts after a replica loss (in-flight "
+             "requests re-enqueued onto survivors; the dead replica "
+             "rebuilt with bounded backoff on a spare device)"),
+    FLEET_SWAPS: dict(
+        kind="counter", label=None,
+        help="zero-downtime rolling weight swaps completed "
+             "(FleetController.swap_weights: drain one replica at a "
+             "time, load the CRC-verified checkpoint, return to "
+             "rotation)"),
+    FLEET_SCALE_EVENTS: dict(
+        kind="counter", label="direction",
+        help="autoscale actions (up = replica added on queue-wait "
+             "EWMA past MXNET_FLEET_SCALE_UP_WAIT_MS, down = emptiest "
+             "replica drained-then-retired below the low-water mark)"),
+    FLEET_QUEUE_WAIT: dict(
+        kind="histogram", label=None,
+        help="projected queue wait of the replica chosen at each "
+             "routed submit — the fleet-wide load signal the "
+             "autoscaler EWMAs"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

@@ -16,7 +16,11 @@ env var::
 
 Grammar (``;``-separated rules)::
 
-    rule   := point ':' phase '=' nth [':' action]
+    rule   := point ['@' ctx] ':' phase '=' nth [':' action]
+    ctx    := caller-supplied context tag (e.g. a fleet replica name):
+              the rule fires on the nth hit AT THAT CONTEXT only —
+              ``fault_point(point, phase, ctx=...)`` call sites opt in;
+              rules without '@' match every context (legacy behavior)
     phase  := 'before' | 'after'     # relative to the guarded operation
     nth    := 1-based hit count at which the rule fires (once)
     action := 'kill'                 # os.kill(SIGKILL) — hard preemption
@@ -25,6 +29,9 @@ Grammar (``;``-separated rules)::
             | 'revoke' [':' count]   # mark `count` devices (default 1)
                                      # revoked and raise DeviceRevokedError
                                      # — a mid-run device loss
+            | 'revoke' ':' targets   # targets := 'd' id ['+' 'd' id ...]
+                                     # revoke SPECIFIC device ids (the
+                                     # fleet's replica-targeted kill)
             | 'restore'              # un-revoke every revoked device (the
                                      # chaos "grow back"); does not raise
 
@@ -48,11 +55,18 @@ The SERVING chaos seams (docs/SERVING.md "Resilient serving") mirror
 them on the inference path: ``serving.admit`` (inside
 ``DynamicBatcher.submit``, before admission control),
 ``serving.dispatch`` (just before the coalesced micro-batch's
-predictor call) and ``serving.retire`` (inside the window-retire sync
-on the micro-batch's outputs). A ``revoke`` at either of the last two
+predictor call), ``serving.retire`` (inside the window-retire sync
+on the micro-batch's outputs) and ``serving.route`` (inside
+``FleetRouter.submit``, after the replica was chosen — fired with
+``ctx=<replica name>``). A ``revoke`` at dispatch/retire
 is what the :class:`~mxnet_tpu.serving.ServingSupervisor`'s
 device-loss recovery is tested against (tests/
-test_serving_resilience.py).
+test_serving_resilience.py); a replica-targeted rule like
+``serving.dispatch@replica-1:before=1:revoke:d3`` is the FLEET chaos
+harness — it fires only on that replica's dispatcher thread and
+revokes that replica's device, so the fleet's failover (re-route
+in-flight onto survivors, restart the replica) is what recovers
+(tests/test_fleet.py).
 """
 from __future__ import annotations
 
@@ -86,10 +100,11 @@ class DeviceRevokedError(RuntimeError):
 
 class FaultRule:
     __slots__ = ("point", "phase", "nth", "action", "delay_ms", "count",
-                 "fired")
+                 "ctx", "device_ids", "fired")
 
     def __init__(self, point: str, phase: str, nth: int, action: str,
-                 delay_ms: int = 0, count: int = 1):
+                 delay_ms: int = 0, count: int = 1,
+                 ctx: Optional[str] = None, device_ids=None):
         if phase not in ("before", "after"):
             raise ValueError(f"fault phase must be before/after, got {phase!r}")
         if action not in ("kill", "error", "delay", "revoke", "restore"):
@@ -100,11 +115,29 @@ class FaultRule:
         self.action = action
         self.delay_ms = int(delay_ms)
         self.count = max(1, int(count))
+        self.ctx = ctx               # None = match every context
+        self.device_ids = tuple(device_ids) if device_ids else None
         self.fired = False
 
     def __repr__(self):
-        return (f"FaultRule({self.point}:{self.phase}={self.nth}"
+        at = f"@{self.ctx}" if self.ctx else ""
+        return (f"FaultRule({self.point}{at}:{self.phase}={self.nth}"
                 f":{self.action})")
+
+
+def _parse_revoke_arg(arg: str):
+    """``revoke``'s optional argument: a plain count, or 'd<id>'
+    (+-joined for several) naming SPECIFIC device ids to revoke."""
+    if arg and arg.lstrip().startswith("d"):
+        ids = []
+        for tok in arg.split("+"):
+            tok = tok.strip()
+            if not tok.startswith("d"):
+                raise ValueError(
+                    f"bad revoke target {tok!r}; expected 'd<id>'")
+            ids.append(int(tok[1:]))
+        return 1, tuple(ids)
+    return int(arg), None
 
 
 def _parse(spec: str) -> List[FaultRule]:
@@ -117,16 +150,21 @@ def _parse(spec: str) -> List[FaultRule]:
         if len(parts) < 2 or "=" not in parts[1]:
             raise ValueError(
                 f"bad {ENV_VAR} rule {chunk!r}; expected "
-                "'point:before|after=N[:kill|error|delay:MS]'")
-        point = parts[0]
+                "'point[@ctx]:before|after=N[:kill|error|delay:MS"
+                "|revoke[:COUNT|:dID]]'")
+        point, ctx = parts[0], None
+        if "@" in point:
+            point, ctx = point.split("@", 1)
         phase, nth = parts[1].split("=", 1)
         action = parts[2] if len(parts) > 2 else "kill"
         delay_ms = int(parts[3]) if action == "delay" and len(parts) > 3 \
             else 0
-        count = int(parts[3]) if action == "revoke" and len(parts) > 3 \
-            else 1
+        count, device_ids = 1, None
+        if action == "revoke" and len(parts) > 3:
+            count, device_ids = _parse_revoke_arg(parts[3])
         rules.append(FaultRule(point, phase.strip(), int(nth), action,
-                               delay_ms, count))
+                               delay_ms, count, ctx=ctx,
+                               device_ids=device_ids))
     return rules
 
 
@@ -201,17 +239,36 @@ def _revoke_devices(count: int):
     return lost
 
 
+def _revoke_specific(ids):
+    """Mark SPECIFIC device ids revoked (the fleet's replica-targeted
+    kill); at least one device always survives. Returns the lost
+    devices."""
+    import jax
+    wanted = set(ids)
+    with _lock:
+        alive = [d for d in jax.devices() if d.id not in _revoked]
+        lost = [d for d in alive if d.id in wanted]
+        lost = lost[:max(0, len(alive) - 1)]
+        _revoked.update(d.id for d in lost)
+    return lost
+
+
 def hit_counts() -> Dict[Tuple[str, str], int]:
     return dict(_counts)
 
 
-def fault_point(point: str, phase: str = "before"):
+def fault_point(point: str, phase: str = "before",
+                ctx: Optional[str] = None):
     """Declare a named fault point. Call sites bracket a critical
     operation::
 
         fault_point("checkpoint.commit", "before")
         os.replace(tmp, final)
         fault_point("checkpoint.commit", "after")
+
+    ``ctx`` tags the call with a caller context (e.g. a fleet replica
+    name): ``point@ctx`` rules fire on the nth hit AT that context
+    only; context-less rules keep matching every hit.
 
     Inert (one dict lookup) unless ``MXNET_FAULT_INJECT``/``configure``
     armed a matching rule.
@@ -222,9 +279,15 @@ def fault_point(point: str, phase: str = "before"):
     with _lock:
         key = (point, phase)
         _counts[key] = n = _counts.get(key, 0) + 1
+        nc = None
+        if ctx is not None:
+            ckey = (point, phase, ctx)
+            _counts[ckey] = nc = _counts.get(ckey, 0) + 1
         to_fire = [r for r in rules
                    if r.point == point and r.phase == phase
-                   and not r.fired and r.nth == n]
+                   and not r.fired
+                   and (r.nth == n if r.ctx is None
+                        else (r.ctx == ctx and r.nth == nc))]
         for r in to_fire:
             r.fired = True
     for r in to_fire:
@@ -243,7 +306,8 @@ def _fire(rule: FaultRule):
     elif rule.action == "delay":
         time.sleep(rule.delay_ms / 1000.0)
     elif rule.action == "revoke":
-        lost = _revoke_devices(rule.count)
+        lost = _revoke_specific(rule.device_ids) if rule.device_ids \
+            else _revoke_devices(rule.count)
         # a single-device world has nothing to revoke (>= 1 always
         # survives) but the failure is still injected — name it so
         names = ", ".join(str(d) for d in lost) \

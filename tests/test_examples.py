@@ -42,7 +42,10 @@ def test_example_ring_attention():
     assert b"ring attention over 8 devices" in r.stdout, r.stdout
 
 
+@pytest.mark.slow
 def test_example_mnist_one_epoch():
+    # a full synthetic epoch (~10s subprocess) — slow tier; the quick
+    # gate keeps the shorter example scripts below
     _run("train_mnist_gluon.py", ("x", "--epochs", "1"))
 
 

@@ -302,7 +302,9 @@ def test_hybrid_random_apply_probability_direction():
             return x * 2.0
 
     img = mx.nd.array(onp.ones((2, 2, 3), "float32"))
-    n = 200
+    # n=80 keeps the direction unambiguous under the fixed seed while
+    # staying cheap (each draw is an eager device round-trip)
+    n = 80
     for p, lo, hi in ((0.05, 0.0, 0.3), (0.95, 0.7, 1.0)):
         mx.random.seed(42)
         tf = T.HybridRandomApply(Scale(), p=p)

@@ -403,6 +403,11 @@ def test_pipelined_telemetry_zero_unblessed_syncs(tmp_path, monkeypatch):
     compile-cache, and checkpoint-latency series."""
     monkeypatch.setenv("MXNET_TELEMETRY", "1")
     monkeypatch.setenv("MXNET_TRANSFER_GUARD", "raise")
+    # this test is about sync discipline + exported series, not stall
+    # detection (test_artificial_stall_one_anomaly_in_window pins that)
+    # — an OS/GC hiccup during the ~2ms steps must not bill a stall
+    # anomaly against the zero-anomalies assertion on a loaded CI box
+    monkeypatch.setenv("MXNET_WATCHDOG_STALL_FACTOR", "50")
     loop = _loop(checkpoint_dir=str(tmp_path / "ckpt"),
                  checkpoint_every=6)
     x, y = _batch()

@@ -368,6 +368,9 @@ def test_step_signature_stable_and_shape_sensitive():
 def test_signature_change_invalidates_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("MXNET_AUTOTUNE_CACHE",
                        str(tmp_path / "at.json"))
+    # cache-keying semantics only — a tiny search budget keeps the
+    # three full searches cheap without touching what's asserted
+    monkeypatch.setenv("MXNET_AUTOTUNE_BUDGET_TRIALS", "8")
     x, y = make_batch()
     step, _, _ = make_step(autotune="on")
     step(x, y)
@@ -451,6 +454,8 @@ def test_explicit_autotune_method_and_outcome_record(tmp_path,
                                                      monkeypatch):
     monkeypatch.setenv("MXNET_AUTOTUNE_CACHE",
                        str(tmp_path / "at.json"))
+    # outcome-record plumbing only — a tiny search budget suffices
+    monkeypatch.setenv("MXNET_AUTOTUNE_BUDGET_TRIALS", "8")
     x, y = make_batch()
     step, _, _ = make_step()
     out = step.autotune(x, y, mode="on")
@@ -577,6 +582,8 @@ def test_predictor_warmup_autotune_and_bucket_feasibility(tmp_path,
 def test_train_and_serving_scopes_do_not_cross(tmp_path, monkeypatch):
     monkeypatch.setenv("MXNET_AUTOTUNE_CACHE",
                        str(tmp_path / "at.json"))
+    # scope filtering only — any search size proves it
+    monkeypatch.setenv("MXNET_AUTOTUNE_BUDGET_TRIALS", "8")
     x, y = make_batch()
     step, _, _ = make_step(autotune="on")
     step(x, y)
@@ -591,6 +598,9 @@ def test_train_and_serving_scopes_do_not_cross(tmp_path, monkeypatch):
 def test_autotune_metric_flow(tmp_path, monkeypatch):
     monkeypatch.setenv("MXNET_AUTOTUNE_CACHE",
                        str(tmp_path / "at.json"))
+    # metric plumbing only (trials counter, active-config gauges,
+    # hit/miss counters) — a tiny search budget keeps it cheap
+    monkeypatch.setenv("MXNET_AUTOTUNE_BUDGET_TRIALS", "8")
     x, y = make_batch()
     step, _, _ = make_step(autotune="on")
     step(x, y)

@@ -144,7 +144,7 @@ def test_deformable_conv_zero_offset_equals_conv():
     onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
-def test_deformable_conv_golden_and_grad():
+def test_deformable_conv_golden():
     rng = onp.random.RandomState(6)
     data = rng.randn(1, 2, 5, 5).astype("float32")
     weight = rng.randn(3, 2, 3, 3).astype("float32")
@@ -154,6 +154,16 @@ def test_deformable_conv_golden_and_grad():
         kernel=(3, 3), num_filter=3).asnumpy()
     golden = _np_deform_conv(data, offset, weight, (1, 1), (0, 0), (1, 1), 1)
     onp.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_deformable_conv_numeric_grad():
+    # ~540 eager finite-difference evaluations (~35s) — slow tier; the
+    # quick gate keeps the forward golden above
+    rng = onp.random.RandomState(6)
+    data = rng.randn(1, 2, 5, 5).astype("float32")
+    weight = rng.randn(3, 2, 3, 3).astype("float32")
+    offset = (rng.randn(1, 18, 3, 3) * 0.5).astype("float32")
     check_numeric_gradient(
         lambda d, o, w: nd.contrib.DeformableConvolution(
             d, o, w, kernel=(3, 3), num_filter=3),
@@ -298,7 +308,10 @@ def test_correlation_golden(multiply):
     onp.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_correlation_grad():
+    # pure finite-difference sweep (~9s) — slow tier; the forward
+    # goldens above stay in the quick gate
     rng = onp.random.RandomState(11)
     a = rng.randn(1, 2, 5, 5).astype("float32")
     b = rng.randn(1, 2, 5, 5).astype("float32")

@@ -1000,6 +1000,117 @@ def check_decode():
         print("decode check failed:", repr(e))
 
 
+def check_fleet():
+    """Serving-fleet health (docs/SERVING.md "Serving fleet"): spin a
+    small multi-replica fleet on the visible devices, push a routed
+    burst through the FleetRouter, revoke one replica's device
+    mid-traffic, and print the per-replica census, the failover /
+    restart ledger, and the mx_fleet_* metric snapshot — a fleet that
+    loses accepted requests or never restarts a dead replica is
+    visible without a load rig."""
+    print("----------Serving Fleet----------")
+    try:
+        import numpy as onp
+        import jax
+        import mxnet_tpu as mx
+        from mxnet_tpu import serving, telemetry
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.serving import loadgen
+        from mxnet_tpu.testing import faults
+
+        import time
+        n_dev = len(jax.devices())
+        n = min(3, n_dev)
+        print(f"devices      : {n_dev} visible, fleet size {n}"
+              + ("" if n > 1 else "  (single device: failover leg "
+                 "needs >=2 — set XLA_FLAGS="
+                 "--xla_force_host_platform_device_count=4)"))
+        print("env knobs    : "
+              f"MXNET_FLEET_REPLICAS={serving.fleet_replicas()} "
+              f"min={serving.fleet_min_replicas()} "
+              f"max={serving.fleet_max_replicas()} "
+              f"scale_up_wait={serving.fleet_scale_up_wait_s() * 1e3:.0f}ms "
+              f"restart_retries={serving.fleet_restart_retries()}")
+
+        def build():
+            mx.random.seed(11)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(64, activation="relu", in_units=32),
+                    nn.Dense(8, in_units=64))
+            net.initialize()
+            net(mx.nd.array(onp.zeros((1, 32), "float32")))
+            return serving.CompiledPredictor(net, bucket_sizes=(1, 2, 4))
+
+        x1 = mx.nd.array(onp.zeros((1, 32), "float32"))
+        t0 = time.time()
+        fleet = serving.FleetController(build, example=(x1,),
+                                        replicas=n, max_batch=4,
+                                        timeout_ms=2.0)
+        print(f"spawn        : {n} replica(s) warm in "
+              f"{time.time() - t0:.2f}s "
+              f"({[r.device.id for r in fleet.replicas]})")
+        onp.random.seed(0)
+        X = onp.random.randn(64, 32).astype("float32")
+        victim = fleet.replicas[-1]
+        try:
+            if n > 1:
+                # one targeted device revocation two dispatches into
+                # the burst: the fleet must failover the victim's
+                # backlog and restart it on a spare (or same) device
+                faults.configure(
+                    f"serving.dispatch@{victim.name}:before=2"
+                    f":revoke:d{victim.device.id}")
+            rep = loadgen.run_closed_loop(
+                loadgen.fleet_issue(
+                    fleet.router,
+                    lambda i: (mx.nd.array(X[i % 64:i % 64 + 1]),),
+                    timeout=60),
+                concurrency=4, requests=32)
+        finally:
+            faults.reset()
+        if n > 1:
+            deadline = time.time() + 15
+            while time.time() < deadline and not any(
+                    e.kind in ("restart", "restart_failed")
+                    for e in fleet.events):
+                time.sleep(0.05)
+        print(f"routed burst : 32 requests, concurrency 4 -> "
+              f"{rep['qps']} req/s "
+              f"(p50 {rep['p50_ms']} ms, p99 {rep['p99_ms']} ms)")
+        print("outcomes     :", rep["outcomes"])
+        for name, r in sorted(rep.get("replicas", {}).items()):
+            print(f"  {name:<12}: {r['qps']} req/s  {r['outcomes']}")
+        st = fleet.stats
+        print(f"failover     : failovers={st['failovers']} "
+              f"requeued={st['requeued']} restarts={st['restarts']} "
+              f"failed_requeues={st['failed_requeues']}")
+        kinds = [f"{e.kind}({e.replica})" for e in fleet.events
+                 if e.kind not in ("spawn",)]
+        if kinds:
+            print("events       :", " -> ".join(kinds))
+        print("-- replica table --")
+        print(f"{'replica':<12}{'state':<12}{'device':<14}"
+              f"{'version':<9}queued")
+        for r in fleet.describe()["replicas"]:
+            print(f"{r['name']:<12}{r['state']:<12}"
+                  f"{str(r['device']):<14}{r['version']:<9}"
+                  f"{r['queued']}")
+        routed = telemetry.registry().get(telemetry.names.FLEET_ROUTED)
+        if routed is not None:
+            print(f"{telemetry.names.FLEET_ROUTED}:",
+                  dict(sorted(routed.values().items())))
+        wait = telemetry.registry().get(
+            telemetry.names.FLEET_QUEUE_WAIT)
+        if wait is not None and wait.count():
+            print(f"{telemetry.names.FLEET_QUEUE_WAIT}   : "
+                  f"n={wait.count()} "
+                  f"p50={wait.percentile(50) * 1e3:.2f} ms "
+                  f"p99={wait.percentile(99) * 1e3:.2f} ms")
+        fleet.close()
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("fleet check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -1111,6 +1222,13 @@ def main(argv=None):
                         "decode engine, stream a mixed-length burst, "
                         "and print the slot table, page-allocator "
                         "census, and TTFT/TPOT panel")
+    parser.add_argument("--fleet", action="store_true",
+                        help="also spin a small multi-replica serving "
+                        "fleet, route a burst (with one injected "
+                        "replica-device revocation when >=2 devices "
+                        "are visible), and print the per-replica "
+                        "census, failover/restart ledger, and "
+                        "mx_fleet_* metric snapshot")
     parser.add_argument("--elastic", action="store_true",
                         help="also run a tiny supervised TrainLoop, "
                         "inject one mid-run fault (device revocation / "
@@ -1146,6 +1264,8 @@ def main(argv=None):
         check_serving()
     if args.decode:
         check_decode()
+    if args.fleet:
+        check_fleet()
     if args.elastic:
         check_elastic()
     check_os()
