@@ -24,7 +24,8 @@ _LIB_PATH = os.path.join(_REPO_ROOT, "build", "libmxt_native.so")
 _SRC_DIR = os.path.join(_REPO_ROOT, "src", "native")
 
 _lib = None
-_lib_lock = threading.Lock()
+# bare on purpose: leaf guard below the audit layer (native library bootstrap)
+_lib_lock = threading.Lock()  # mx-lint: allow=MXA009
 _load_failed = False
 
 _OP_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
@@ -155,7 +156,8 @@ class NativeEngine:
         _check(lib.MXTEngineCreate(num_threads, ctypes.byref(h)))
         self._h = h
         self._closures = {}
-        self._closure_lock = threading.Lock()
+        # bare on purpose: leaf, engine-internal; never nests with audited locks
+        self._closure_lock = threading.Lock()  # mx-lint: allow=MXA009
         self._next_token = 1  # 0 would round-trip as NULL/None through ctypes
 
         def trampoline(token):

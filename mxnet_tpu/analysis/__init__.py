@@ -45,7 +45,9 @@ __all__ = [
     "expect_mode", "mode_spec_pack", "explain_signature_diff",
     "fusion_census", "check_baseline", "load_baselines",
     "lint_source", "lint_path", "lint_module", "lint_function",
+    "lint_threads_source", "lint_threads_path",
     "load_allowlist", "filter_allowed",
+    "mx_lock", "mx_rlock", "mx_condition", "ThreadReport",
     "transfer_guard", "hot_scope", "allow_transfers",
     "OpSharding", "ShardingTable", "ShardingAudit", "SpecPack",
     "CollectiveRule", "audit_sharding", "sharding_table",
@@ -65,6 +67,9 @@ _LAZY = {
     "lint_source": "lint", "lint_path": "lint", "lint_module": "lint",
     "lint_function": "lint", "load_allowlist": "lint",
     "filter_allowed": "lint",
+    "lint_threads_source": "lint", "lint_threads_path": "lint",
+    "mx_lock": "threads", "mx_rlock": "threads",
+    "mx_condition": "threads", "ThreadReport": "threads",
     "OpSharding": "sharding", "ShardingTable": "sharding",
     "ShardingAudit": "sharding", "SpecPack": "sharding",
     "CollectiveRule": "sharding", "audit_sharding": "sharding",
@@ -75,6 +80,7 @@ _LAZY = {
     "overlap_census": "overlap", "OverlapReport": "overlap",
     "program": None, "lint": None, "guard": None, "hlo": None,
     "report": None, "fusion": None, "sharding": None, "overlap": None,
+    "threads": None,
 }
 
 

@@ -37,11 +37,29 @@ MXA006 sharding-opaque placement / raw collectives —
        ``parallel/collectives.py`` bypass the version-compat shims and
        the spec packs that bless the framework's collective patterns —
        route them through ``mxnet_tpu.parallel.collectives``
+MXA007 blocking call inside a ``with <lock>`` body — ``queue.get/put``,
+       ``Future.result``, ``wait_to_read``, ``time.sleep``,
+       ``Thread.join``, predictor/step dispatch (``.predict``,
+       ``block_until_ready``).  Holding a lock across a blocking call
+       convoys every other acquirer and is one ordering edge away from
+       deadlock; move the blocking work outside the critical section
+MXA008 attribute mutated both from a thread body (``Thread(target=
+       self.m)`` and its transitive self-call closure) and from a
+       public method, with neither site inside a ``with <lock>`` — the
+       classic unguarded cross-thread write
+MXA009 bare ``threading.Lock()``/``RLock()``/``Condition()`` in
+       framework code instead of ``analysis.threads.mx_lock`` — an
+       unaudited lock is invisible to the lock-order graph and the
+       deadlock forensics; the audit stays total only while this rule
+       stays clean
 ====== =====================================================
 
-Scope: ``forward`` / ``hybrid_forward`` method bodies (and functions
-nested in them).  Code outside a forward — training scripts, metric
-code — may sync freely and is never flagged.
+Scope: MXA001-006 lint ``forward`` / ``hybrid_forward`` method bodies
+(and functions nested in them) — code outside a forward may sync
+freely and is never flagged.  The THREAD rules MXA007-009 have module
+scope instead (whole files, via :func:`lint_threads_source` /
+:func:`lint_threads_path`) and run only over framework code: the
+tier-1 sweep covers ``mxnet_tpu/``, not examples or tests.
 
 Blessing an intentional violation: append ``# mx-lint: allow`` (or
 ``# mx-lint: allow=MXA001``) to the offending line, or list
@@ -58,12 +76,14 @@ from __future__ import annotations
 import ast
 import importlib.util
 import os
+import re
 import sys
-from typing import Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .report import Finding
 
 __all__ = ["lint_source", "lint_path", "lint_module", "lint_function",
+           "lint_threads_source", "lint_threads_path",
            "load_allowlist", "filter_allowed", "main"]
 
 _SYNC_METHODS = {"asnumpy", "item", "asscalar", "wait_to_read",
@@ -488,6 +508,317 @@ def lint_module(name: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# thread rules (MXA007-009): module-scope, framework code only
+# ---------------------------------------------------------------------------
+
+#: receiver/context names that look like a mutual-exclusion primitive
+_LOCKISH = re.compile(r"(lock|mutex|(^|_)mu$|(^|_)cv$|cond)", re.I)
+#: receiver names that look like a queue
+_QUEUEISH = re.compile(r"(queue|(^|_)q$)", re.I)
+#: attribute calls that block on device/predictor work (MXA007)
+_DISPATCH_CALLS = {"predict", "block_until_ready", "dispatch",
+                   "_dispatch", "_dispatch_inner"}
+#: blocking attribute calls flagged unconditionally under a lock
+_BLOCKING_ATTRS = {"result", "wait_to_read", "wait_to_write"}
+#: bare-primitive constructors MXA009 keeps out of framework code
+_BARE_PRIMITIVES = {"Lock", "RLock", "Condition"}
+
+
+def _terminal_name(expr) -> Optional[str]:
+    """The last identifier of a Name/Attribute chain (``self._lock`` ->
+    ``_lock``); None for anything else."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _lockish_name(expr) -> Optional[str]:
+    nm = _terminal_name(expr)
+    if nm is not None and _LOCKISH.search(nm):
+        return nm
+    return None
+
+
+def _is_queue_get(node: ast.Call) -> bool:
+    """``Queue.get`` takes only ``block``/``timeout`` (bools/numbers);
+    a ``.get(key)`` with an arbitrary positional is a dict lookup on a
+    queue-ISH name, not a blocking dequeue."""
+    if any(k.arg not in ("block", "timeout") for k in node.keywords):
+        return False
+    return all(isinstance(a, ast.Constant)
+               and isinstance(a.value, (bool, int, float))
+               for a in node.args)
+
+
+def _is_join_blocking(node: ast.Call) -> bool:
+    """``.join()`` is Thread.join when it takes no argument, a numeric
+    timeout, or a ``timeout=`` keyword — ``", ".join(parts)`` (one
+    non-numeric positional) is str.join and never flagged."""
+    if any(k.arg == "timeout" for k in node.keywords):
+        return True
+    if not node.args and not node.keywords:
+        return True
+    if len(node.args) == 1 and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, (int, float)):
+        return True
+    return False
+
+
+class _ThreadLint(ast.NodeVisitor):
+    """MXA007 (blocking under lock) + MXA009 (bare primitive) over one
+    module. Lock context is LEXICAL: statements inside a ``with
+    <lockish>`` body; nested function definitions do not inherit it
+    (a closure defined under a lock runs later, lock-free)."""
+
+    def __init__(self, filename: str, lines: Sequence[str]):
+        self.filename = filename
+        self.lines = lines
+        self._locks: List[str] = []
+        self.findings: List[Finding] = []
+
+    def _flag(self, node, rule: str, message: str, severity="error"):
+        lineno = getattr(node, "lineno", 0)
+        line = self.lines[lineno - 1] if 0 < lineno <= len(self.lines) \
+            else ""
+        allowed = _allow_marker(line)
+        blessed = allowed is not None and (not allowed or rule in allowed)
+        self.findings.append(Finding(
+            checker="source", rule=rule, message=message,
+            where=f"{self.filename}:{lineno}", severity=severity,
+            blessed=blessed))
+
+    # -------- lexical lock context --------
+    def visit_With(self, node):
+        held = [n for n in (_lockish_name(i.context_expr)
+                            for i in node.items) if n]
+        self._locks.extend(held)
+        for item in node.items:
+            self.visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        if held:
+            del self._locks[-len(held):]
+
+    def _visit_fn(self, node):
+        saved, self._locks = self._locks, []
+        self.generic_visit(node)
+        self._locks = saved
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -------- calls --------
+    def visit_Call(self, node):
+        fn = node.func
+        # MXA009 everywhere (lock context irrelevant)
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "threading" and \
+                fn.attr in _BARE_PRIMITIVES:
+            self._flag(node, "MXA009",
+                       f"bare `threading.{fn.attr}()` in framework code "
+                       "is invisible to the lock-order audit and the "
+                       "deadlock forensics — use analysis.threads."
+                       f"{'mx_condition' if fn.attr == 'Condition' else 'mx_rlock' if fn.attr == 'RLock' else 'mx_lock'}"
+                       "(name) (or bless the few legitimate bare locks "
+                       "inline)")
+        if not self._locks:
+            self.generic_visit(node)
+            return
+        lock = self._locks[-1]
+        # MXA007: blocking calls lexically under a lock
+        blocked = None
+        if isinstance(fn, ast.Attribute):
+            recv = _terminal_name(fn.value)
+            if fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "time":
+                blocked = "time.sleep"
+            elif fn.attr == "join" and _is_join_blocking(node):
+                blocked = f"{recv or '?'}.join"
+            elif fn.attr in _BLOCKING_ATTRS:
+                blocked = f"{recv or '?'}.{fn.attr}"
+            elif fn.attr in ("get", "put") and recv is not None \
+                    and _QUEUEISH.search(recv) \
+                    and (fn.attr == "put" or _is_queue_get(node)):
+                blocked = f"{recv}.{fn.attr}"
+            elif fn.attr in _DISPATCH_CALLS:
+                blocked = f"{recv or '?'}.{fn.attr}"
+        if blocked is not None:
+            self._flag(node, "MXA007",
+                       f"blocking call `{blocked}(...)` inside `with "
+                       f"{lock}:` — every other acquirer of {lock} "
+                       "convoys behind this wait (and it is one "
+                       "lock-order edge away from deadlock); move the "
+                       "blocking work outside the critical section")
+        self.generic_visit(node)
+
+
+class _ClassShareAudit:
+    """MXA008 over one ClassDef: attributes mutated WITHOUT a lock both
+    from the class's thread-body closure (``Thread(target=self.m)``
+    plus transitive self-calls) and from a public method."""
+
+    def __init__(self, linter: "_ThreadLint", cls: ast.ClassDef):
+        self.linter = linter
+        self.cls = cls
+        self.methods: Dict[str, ast.FunctionDef] = {
+            it.name: it for it in cls.body
+            if isinstance(it, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        # method -> attr -> [(lineno, guarded)]
+        self.mutations: Dict[str, Dict[str, list]] = {}
+        self.calls: Dict[str, Set[str]] = {}
+        self.entries: Set[str] = set()
+
+    def run(self):
+        for name, fn in self.methods.items():
+            self._scan_method(name, fn)
+        closure = self._closure()
+        if not closure:
+            return
+        public = [m for m in self.methods
+                  if not m.startswith("_") and m not in closure]
+        for attr in sorted({a for m in closure
+                            for a in self.mutations.get(m, ())}):
+            t_sites = [(m, ln) for m in closure
+                       for ln, g in self.mutations.get(m, {}).get(attr, ())
+                       if not g]
+            if not t_sites:
+                continue
+            p_sites = [(m, ln) for m in public
+                       for ln, g in self.mutations.get(m, {}).get(attr, ())
+                       if not g]
+            if not p_sites:
+                continue
+            tm, tl = t_sites[0]
+            pm, pl = p_sites[0]
+            self.linter._flag(
+                _Loc(pl), "MXA008",
+                f"`self.{attr}` is written without a lock from the "
+                f"thread body `{self.cls.name}.{tm}` (line {tl}) AND "
+                f"from public `{self.cls.name}.{pm}` (line {pl}) — "
+                "guard both writes with one mx_lock, or bless with a "
+                "comment naming why the race is benign")
+
+    # ---- per-method scan: mutations + lock context + self-calls ----
+    def _scan_method(self, name: str, fn):
+        muts: Dict[str, list] = self.mutations.setdefault(name, {})
+        calls: Set[str] = self.calls.setdefault(name, set())
+
+        def self_attr(expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                return expr.attr
+            return None
+
+        def mutated_attr(tgt) -> Optional[str]:
+            a = self_attr(tgt)
+            if a is not None:
+                return a
+            if isinstance(tgt, ast.Subscript):
+                return self_attr(tgt.value)
+            return None
+
+        def walk(node, depth: int):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                for child in ast.iter_child_nodes(node):
+                    walk(child, 0)      # closures run lock-free later
+                return
+            if isinstance(node, ast.With):
+                held = sum(1 for i in node.items
+                           if _lockish_name(i.context_expr))
+                for i in node.items:
+                    walk(i, depth)
+                for stmt in node.body:
+                    walk(stmt, depth + held)
+                return
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    a = mutated_attr(tgt)
+                    if a is not None:
+                        muts.setdefault(a, []).append(
+                            (node.lineno, depth > 0))
+            elif isinstance(node, ast.AugAssign):
+                a = mutated_attr(node.target)
+                if a is not None:
+                    muts.setdefault(a, []).append(
+                        (node.lineno, depth > 0))
+            elif isinstance(node, ast.Call):
+                callee = node.func
+                m = self_attr(callee)
+                if m is not None and m in self.methods:
+                    calls.add(m)
+                if isinstance(callee, (ast.Name, ast.Attribute)) and \
+                        _terminal_name(callee) == "Thread":
+                    for k in node.keywords:
+                        if k.arg == "target":
+                            t = self_attr(k.value)
+                            if t is not None:
+                                self.entries.add(t)
+            for child in ast.iter_child_nodes(node):
+                walk(child, depth)
+
+        walk(fn, 0)
+
+    def _closure(self) -> Set[str]:
+        out: Set[str] = set()
+        todo = [m for m in self.entries if m in self.methods]
+        while todo:
+            m = todo.pop()
+            if m in out:
+                continue
+            out.add(m)
+            todo.extend(c for c in self.calls.get(m, ())
+                        if c in self.methods and c not in out)
+        return out
+
+
+class _Loc:
+    """Minimal lineno carrier for _flag on synthesized findings."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+
+
+def lint_threads_source(src: str,
+                        filename: str = "<string>") -> List[Finding]:
+    """MXA007-009 over one file (module scope — not just forwards);
+    blessed findings included, marked."""
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        return [Finding(checker="source", rule="MXA000", severity="warn",
+                        message=f"could not parse: {e}",
+                        where=f"{filename}:{e.lineno or 0}")]
+    lines = src.splitlines()
+    linter = _ThreadLint(filename, lines)
+    linter.visit(tree)
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            _ClassShareAudit(linter, cls).run()
+    return linter.findings
+
+
+def lint_threads_path(path: str) -> List[Finding]:
+    """Thread rules over a file or every ``*.py`` under a directory."""
+    findings: List[Finding] = []
+    if os.path.isdir(path):
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git")]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    findings.extend(
+                        lint_threads_path(os.path.join(root, f)))
+        return findings
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_threads_source(fh.read(), filename=path)
+
+
+# ---------------------------------------------------------------------------
 # allowlist
 # ---------------------------------------------------------------------------
 
@@ -545,11 +876,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--show-blessed", action="store_true",
                         help="also print violations blessed inline or by "
                              "the allowlist")
+    parser.add_argument("--threads", action="store_true",
+                        help="run the module-scope thread rules "
+                             "MXA007-009 instead of the forward rules")
     args = parser.parse_args(argv)
+    lint_fn = lint_threads_path if args.threads else lint_path
     findings: List[Finding] = []
     for target in args.targets:
         if os.path.exists(target):
-            findings.extend(lint_path(target))
+            findings.extend(lint_fn(target))
+        elif args.threads:
+            spec = importlib.util.find_spec(target)
+            if spec is None or not spec.origin:
+                raise ImportError(f"cannot locate module {target!r}")
+            for loc in (spec.submodule_search_locations or [spec.origin]):
+                findings.extend(lint_threads_path(loc))
         else:
             findings.extend(lint_module(target))
     allow = load_allowlist(args.allowlist) if args.allowlist else []

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..analysis.threads import mx_lock
 from ..base import MXNetError
 from . import atomic
 from .state import TrainState, apply_train_state, capture_train_state
@@ -82,6 +83,10 @@ class TrainCheckpointManager:
         self._async = async_save
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        # guards the writer handoff (_thread/_error) between save(),
+        # wait() and the background writer; the join itself runs
+        # outside it so waiters never block each other behind slow I/O
+        self._mu = mx_lock("checkpoint.manager")
         self._last_saved: Optional[int] = None
         t = _telemetry()
         reg = t.registry()
@@ -126,10 +131,12 @@ class TrainCheckpointManager:
         if sync:
             self._write(state)
         else:
-            self._thread = threading.Thread(
+            t = threading.Thread(
                 target=self._write_guarded, args=(state,),
                 name=f"ckpt-write-step{step}", daemon=True)
-            self._thread.start()
+            with self._mu:
+                self._thread = t
+            t.start()
         return state
 
     def save_state(self, state: TrainState):
@@ -144,7 +151,8 @@ class TrainCheckpointManager:
             _LOG.error("async checkpoint write for step %d failed: %s",
                        state.step, e)
             self._m_errors.inc()
-            self._error = e
+            with self._mu:
+                self._error = e
 
     def _write(self, state: TrainState):
         t0 = time.perf_counter()
@@ -164,12 +172,13 @@ class TrainCheckpointManager:
 
     def wait(self):
         """Block until the in-flight write finishes; re-raise its error."""
-        t = self._thread
+        with self._mu:
+            t, self._thread = self._thread, None
         if t is not None:
-            t.join()
-            self._thread = None
-        if self._error is not None:
+            t.join()        # outside the lock: never join while holding it
+        with self._mu:
             err, self._error = self._error, None
+        if err is not None:
             raise MXNetError(
                 f"background checkpoint write failed: {err}") from err
 

@@ -249,7 +249,8 @@ class PreemptionNotice:
         self._event = threading.Event()
         self._time: Optional[float] = None
         self._prev: dict = {}
-        self._lock = threading.Lock()
+        # bare on purpose: failure-path leaf: must work when the audit itself is suspect
+        self._lock = threading.Lock()  # mx-lint: allow=MXA009
 
     def install(self, signals=(signal.SIGTERM,)):
         """Arm the handlers; safe to call repeatedly. Off the main
@@ -323,7 +324,8 @@ class PreemptionNotice:
 
 
 _notice = PreemptionNotice()
-_scoped_lock = threading.Lock()
+# bare on purpose: failure-path leaf: must work when the audit itself is suspect
+_scoped_lock = threading.Lock()  # mx-lint: allow=MXA009
 _scoped: dict = {}
 
 

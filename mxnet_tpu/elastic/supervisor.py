@@ -85,7 +85,8 @@ class RecoveryLog:
     """
 
     def __init__(self, max_events: int = 256):
-        self._lock = threading.Lock()
+        # bare on purpose: failure-path leaf: must work when the audit itself is suspect
+        self._lock = threading.Lock()  # mx-lint: allow=MXA009
         self._events: "deque[dict]" = deque(maxlen=max_events)
         t = _telemetry()
         reg = t.registry()
@@ -146,7 +147,8 @@ class RecoveryLog:
 
 
 _log: Optional[RecoveryLog] = None
-_log_lock = threading.Lock()
+# bare on purpose: failure-path leaf: must work when the audit itself is suspect
+_log_lock = threading.Lock()  # mx-lint: allow=MXA009
 
 
 def recovery_log() -> RecoveryLog:

@@ -19,7 +19,6 @@ the *semantic* surface the reference exposes and tests
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 import weakref
 from collections import deque
@@ -27,6 +26,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from .analysis.threads import mx_lock
 from .base import MXNetError, get_env
 from .testing.faults import fault_point
 
@@ -64,7 +64,7 @@ class Engine:
     """Process-global engine facade (reference Engine::Get singleton)."""
 
     _instance = None
-    _lock = threading.Lock()
+    _lock = mx_lock("engine.singleton")
 
     def __init__(self, kind: str):
         self.kind = kind
@@ -194,6 +194,12 @@ class DispatchWindow:
             else jax.block_until_ready
         self._what = what
         self._pending: "deque[tuple]" = deque()
+        # pushes/retires run on the dispatching thread, but abandon()
+        # arrives from recovery paths (elastic supervisor, fleet
+        # failover) — _pending and stats mutations are guarded; the
+        # blocking sync itself stays OUTSIDE the critical section so
+        # an abandon never waits behind a dead device
+        self._mu = mx_lock("engine.window")
         self.stats = {"pushes": 0, "retires": 0, "errors": 0,
                       "max_pending": 0}
         self._last_retire_t: Optional[float] = None
@@ -219,21 +225,27 @@ class DispatchWindow:
         this entry's retire — inside the same blessed sync, after the
         step's program has completed — so numerics stay sync-free."""
         st = self.stats
-        st["pushes"] += 1
+        with self._mu:
+            st["pushes"] += 1
+            self._pending.append((tag, payload, aux, time.perf_counter()))
+            if len(self._pending) > st["max_pending"]:
+                st["max_pending"] = len(self._pending)
+            depth = len(self._pending)
         self._m_pushes.inc()
         # re-assert per push: gauges survive telemetry.reset() zeroing
         self._m_capacity.set(self.max_inflight)
-        self._pending.append((tag, payload, aux, time.perf_counter()))
-        if len(self._pending) > st["max_pending"]:
-            st["max_pending"] = len(self._pending)
-        self._m_occupancy.set(len(self._pending))
+        self._m_occupancy.set(depth)
         while len(self._pending) > self.max_inflight:
             self._retire_oldest()
 
     def _retire_oldest(self):
         from .analysis import guard as _tguard
-        tag, payload, aux, t_push = self._pending.popleft()
-        self._m_occupancy.set(len(self._pending))
+        with self._mu:
+            if not self._pending:
+                return      # abandoned concurrently by a recovery path
+            tag, payload, aux, t_push = self._pending.popleft()
+            depth = len(self._pending)
+        self._m_occupancy.set(depth)
         _tguard.count_sync("window_retire")
         # chaos-harness seam: a revoked device surfaces exactly here in
         # a pipelined run — at the blocking wait on an in-flight step
@@ -243,7 +255,8 @@ class DispatchWindow:
             try:
                 self._sync(payload)
             except MXNetError as e:
-                self.stats["errors"] += 1
+                with self._mu:
+                    self.stats["errors"] += 1
                 self._m_errors.inc()
                 _telemetry().memory.maybe_record_oom(
                     e, "dispatch-window retire", step=tag)
@@ -251,7 +264,8 @@ class DispatchWindow:
                     e, "dispatch-window retire", step=tag)
                 raise
             except Exception as e:
-                self.stats["errors"] += 1
+                with self._mu:
+                    self.stats["errors"] += 1
                 self._m_errors.inc()
                 # a deferred RESOURCE_EXHAUSTED surfaces HERE, steps
                 # after the allocation that failed — write the ranked
@@ -267,7 +281,8 @@ class DispatchWindow:
                     f"{tag if tag is not None else '<untagged>'} failed "
                     f"(deferred error surfaced at its in-flight-window "
                     f"retire): {type(e).__name__}: {e}") from e
-            self.stats["retires"] += 1
+            with self._mu:
+                self.stats["retires"] += 1
             self._m_retires.inc()
             # still inside the blessed retire region: the watchdog's
             # NaN peek at the (already completed) payload is the one
@@ -318,11 +333,12 @@ class DispatchWindow:
         dead device would only raise again. Returns the discarded tags
         (the steps whose results are gone; the checkpoint is the source
         of truth for them)."""
-        tags = [t for t, _p, _a, _ts in self._pending]
-        self._pending.clear()
+        with self._mu:
+            tags = [t for t, _p, _a, _ts in self._pending]
+            self._pending.clear()
+            self.stats["abandoned"] = self.stats.get("abandoned", 0) \
+                + len(tags)
         self._m_occupancy.set(0)
-        self.stats["abandoned"] = self.stats.get("abandoned", 0) \
-            + len(tags)
         return tags
 
     def drain_partial(self):
@@ -348,7 +364,7 @@ class DispatchWindow:
 
 
 _host_engine = None
-_host_lock = threading.Lock()
+_host_lock = mx_lock("engine.host")
 
 
 def host():

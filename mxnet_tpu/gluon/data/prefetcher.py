@@ -36,6 +36,7 @@ import numpy as onp
 
 import jax
 
+from ...analysis.threads import mx_lock, register_queue
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
 
@@ -100,6 +101,10 @@ class DevicePrefetcher:
         self.stats = {"prefetch_depth": self._depth,
                       "prefetch_batches": 0, "input_wait_ms": 0.0,
                       "starvation_count": 0}
+        # stats is a public dict read while the producer thread runs;
+        # every mutation goes through this lock so a reader (monitor
+        # thread, test assertion) never sees a torn update
+        self._stats_mu = mx_lock("data.prefetch.stats")
         t = _telemetry()
         reg = t.registry()
         self._m_batches = reg.counter(t.names.PREFETCH_BATCHES)
@@ -179,7 +184,8 @@ class DevicePrefetcher:
 
     def _record_wait(self, ordinal, t0, t1):
         """h2d_wait span (consumer blocked on staged input)."""
-        self.stats["input_wait_ms"] += (t1 - t0) * 1e3
+        with self._stats_mu:
+            self.stats["input_wait_ms"] += (t1 - t0) * 1e3
         self._m_wait.inc(t1 - t0)
         t = _telemetry()
         if t.active():
@@ -198,13 +204,15 @@ class DevicePrefetcher:
                     return
                 staged = self._stage_batch(batch, n)
                 self._record_fetch(n, t0, time.perf_counter())
-                self.stats["prefetch_batches"] += 1
+                with self._stats_mu:
+                    self.stats["prefetch_batches"] += 1
                 self._m_batches.inc()
                 n += 1
                 yield staged
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        register_queue("data.prefetch", q)   # visible in thread dumps
         stop = threading.Event()
 
         def produce():
@@ -245,7 +253,8 @@ class DevicePrefetcher:
             n = 0
             while True:
                 if q.empty():
-                    self.stats["starvation_count"] += 1
+                    with self._stats_mu:
+                        self.stats["starvation_count"] += 1
                     self._m_starved.inc()
                 t0 = time.perf_counter()
                 try:
@@ -269,7 +278,8 @@ class DevicePrefetcher:
                     _edet.maybe_record_device_lost(
                         item.exc, "prefetch staging", step=n)
                     raise item.exc
-                self.stats["prefetch_batches"] += 1
+                with self._stats_mu:
+                    self.stats["prefetch_batches"] += 1
                 self._m_batches.inc()
                 n += 1
                 yield item

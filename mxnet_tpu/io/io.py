@@ -230,7 +230,8 @@ class PrefetchingIter(DataIter):
         self._queue = collections.deque()
         self._sem = threading.Semaphore(0)
         self._space = threading.Semaphore(prefetch_depth)
-        self._lock = threading.Lock()
+        # bare on purpose: leaf iterator lock; never nests with audited locks
+        self._lock = threading.Lock()  # mx-lint: allow=MXA009
         self._done = False
         self._thread = None
         self._start()

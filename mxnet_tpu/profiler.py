@@ -48,7 +48,8 @@ class Profiler:
     """Process-global profiler (reference Profiler singleton)."""
 
     _instance = None
-    _lock = threading.Lock()
+    # bare on purpose: profiler sits below the audit layer; leaf lock
+    _lock = threading.Lock()  # mx-lint: allow=MXA009
 
     def __init__(self):
         self.filename = "profile.json"
@@ -57,7 +58,8 @@ class Profiler:
         self.running = False
         self.paused = False
         self._events = []
-        self._ev_lock = threading.Lock()
+        # bare on purpose: profiler sits below the audit layer; leaf lock
+        self._ev_lock = threading.Lock()  # mx-lint: allow=MXA009
         self._scope = ""
         self._hook_installed = False
         self._tb_active = False

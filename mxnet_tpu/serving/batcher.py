@@ -71,6 +71,7 @@ import jax
 import jax.numpy as jnp
 
 from ..analysis import guard as _tguard
+from ..analysis.threads import mx_condition, mx_lock, register_queue
 from ..base import MXNetError
 from ..engine import DispatchWindow
 from ..ndarray.ndarray import NDArray
@@ -217,7 +218,7 @@ class ServingFuture:
                  "_supervised", "replica", "version")
 
     def __init__(self):
-        self._cv = threading.Condition()
+        self._cv = mx_condition("serving.future")
         self._build = None
         self._out = None
         self._err = None
@@ -375,6 +376,7 @@ class DynamicBatcher:
         self._clock = clock
         self._queue: "queue.Queue[_Request]" = queue.Queue(
             maxsize=queue_depth() if depth is None else max(1, int(depth)))
+        register_queue("serving.batcher", self._queue)  # thread dumps
         self._forming: List[_Request] = []
         self._inflight: dict = {}   # tag -> (requests, t_dispatch)
         self._window = DispatchWindow(max_inflight=inflight,
@@ -404,6 +406,10 @@ class DynamicBatcher:
                       "flush_force": 0, "errors": 0, "rejected": 0,
                       "deadline_missed": 0, "requeued": 0,
                       "recovered_batches": 0, "shutdown_failed": 0}
+        # stats is written from both the client surface (submit/reject)
+        # and the dispatcher thread; every mutation holds this lock so
+        # concurrent submits never lose increments
+        self._stats_mu = mx_lock("serving.batcher.stats")
         t = _telemetry()
         reg = t.registry()
         self._m_requests = reg.counter(t.names.SERVING_REQUESTS)
@@ -424,7 +430,8 @@ class DynamicBatcher:
 
     # ---------------- client surface ----------------
     def _reject(self, reason: str, msg: str):
-        self.stats["rejected"] += 1
+        with self._stats_mu:
+            self.stats["rejected"] += 1
         self._m_rejected.inc(label=reason)
         raise Overloaded(msg, reason=reason)
 
@@ -499,7 +506,21 @@ class DynamicBatcher:
                 "requests) — the service is overloaded "
                 "(MXNET_SERVING_QUEUE_DEPTH / "
                 "MXNET_SERVING_QUEUE_TIMEOUT_MS)")
-        self.stats["requests"] += 1
+        if self._stop.is_set() and not fut.done():
+            # the batcher closed the instant we enqueued: the drain's
+            # final fail-pending sweep may already have run, so nobody
+            # will ever pop this request. Fail the future (typed, for
+            # any holder) and raise like the up-front closed check —
+            # an accepted request can never hang, and a router retries
+            # the next replica (the sched-harness submit-vs-drain
+            # invariant).
+            err = ServingShutdown(
+                "serving closed while this request was being accepted "
+                "— it was never dispatched")
+            fut._fail(err)
+            raise err
+        with self._stats_mu:
+            self.stats["requests"] += 1
         self._m_requests.inc()
         self._m_queue.set(self._queue.qsize() + len(self._forming))
         return fut
@@ -533,8 +554,9 @@ class DynamicBatcher:
     def batch_fill(self) -> Optional[float]:
         """Valid rows / dispatched bucket rows — the padding waste
         ratio (1.0 = every dispatched row was a real request)."""
-        total = self.stats["rows"] + self.stats["padded_rows"]
-        return self.stats["rows"] / total if total else None
+        with self._stats_mu:
+            total = self.stats["rows"] + self.stats["padded_rows"]
+            return self.stats["rows"] / total if total else None
 
     def flush(self):
         """Dispatch whatever is waiting (regardless of age/size) and
@@ -553,7 +575,11 @@ class DynamicBatcher:
         list); duration lands in ``mx_serving_drain_seconds``.
         Idempotent."""
         t0 = self._clock()
-        self._draining = True
+        # monotonic latch (False -> True only, never cleared); both the
+        # dispatcher's preemption drain and this public path may set it
+        # concurrently and either order is correct, so the race is
+        # benign by construction
+        self._draining = True  # mx-lint: allow=MXA008
         if self._thread is not None:
             self._drain_now.set()
             self._thread.join(timeout=60.0)
@@ -636,7 +662,8 @@ class DynamicBatcher:
         kept = []
         for r in self._forming:
             if r.deadline is not None and now >= r.deadline:
-                self.stats["deadline_missed"] += 1
+                with self._stats_mu:
+                    self.stats["deadline_missed"] += 1
                 self._m_deadline.inc()
                 r.future._fail(DeadlineExceeded(
                     f"request deadline expired after "
@@ -655,7 +682,8 @@ class DynamicBatcher:
         pending, self._forming = self._forming, []
         for r in pending:
             if not r.future.done():
-                self.stats["shutdown_failed"] += 1
+                with self._stats_mu:
+                    self.stats["shutdown_failed"] += 1
                 r.future._fail(err)
         self._m_queue.set(0)
 
@@ -666,8 +694,12 @@ class DynamicBatcher:
         them promptly; original deadlines still apply."""
         if not reqs:
             return
-        self._forming[0:0] = list(reqs)
-        self.stats["requeued"] += len(reqs)
+        # dispatcher-thread-only path: the supervisor's recovery hook
+        # runs on the thread that owns the forming list (the docstring
+        # contract), so this is single-owner, not a cross-thread write
+        self._forming[0:0] = list(reqs)  # mx-lint: allow=MXA008
+        with self._stats_mu:
+            self.stats["requeued"] += len(reqs)
         self._m_queue.set(self._queue.qsize() + len(self._forming))
 
     def rebind(self, predictor):
@@ -817,7 +849,8 @@ class DynamicBatcher:
                     continue
                 _LOG.warning("serving dispatch failed (%s: %s)",
                              type(e).__name__, e, exc_info=True)
-                self.stats["errors"] += 1
+                with self._stats_mu:
+                    self.stats["errors"] += 1
 
     def _wants_drain(self) -> bool:
         """Poll the drain hook (the ServingSupervisor's preemption-
@@ -850,6 +883,12 @@ class DynamicBatcher:
             "serving drained (preemption) before this request could "
             "be dispatched"))
         self._stop.set()
+        # second sweep AFTER the stop flag: a submit that raced its
+        # enqueue between the first sweep and the flag would otherwise
+        # sit in a stopped batcher forever
+        self._fail_pending(ServingShutdown(
+            "serving drained (preemption) before this request could "
+            "be dispatched"))
         self._m_drain.observe(max(0.0, self._clock() - t0))
 
     # ---------------- dispatch ----------------
@@ -867,7 +906,8 @@ class DynamicBatcher:
                        "to failing the batch", exc_info=True)
             return False
         if handled:
-            self.stats["recovered_batches"] += 1
+            with self._stats_mu:
+                self.stats["recovered_batches"] += 1
         return handled
 
     def _dispatch(self, reqs: List[_Request], reason: str):
@@ -917,10 +957,11 @@ class DynamicBatcher:
         self._inflight[tag] = (list(reqs), self._clock())
         payload = (tag, tuple(l._data for l in out_leaves
                               if isinstance(l, NDArray)))
-        self.stats["batches"] += 1
-        self.stats["rows"] += rows
-        self.stats["padded_rows"] += bucket - rows
-        self.stats["flush_" + reason] += 1
+        with self._stats_mu:
+            self.stats["batches"] += 1
+            self.stats["rows"] += rows
+            self.stats["padded_rows"] += bucket - rows
+            self.stats["flush_" + reason] += 1
         self._m_batches.inc()
         self._m_occupancy.observe(rows / bucket)
         self._window.push(payload, tag=tag)

@@ -351,7 +351,8 @@ class DecodeStream:
     (``ttft_s`` / ``tpot_s`` / ``tokens``) loadgen aggregates."""
 
     def __init__(self, t_submit: float):
-        self._cv = threading.Condition()
+        # bare on purpose: decode hot loop: per-token budget; leaf, never nests
+        self._cv = threading.Condition()  # mx-lint: allow=MXA009
         self._tokens: List[int] = []
         self._times: List[float] = []
         self._cursor = 0
@@ -512,8 +513,10 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.static = bool(static)
         self.admission = bool(admission)
-        self._lock = threading.RLock()
-        self._work = threading.Condition(self._lock)
+        # bare on purpose: decode hot loop: per-token budget; leaf, never nests
+        self._lock = threading.RLock()  # mx-lint: allow=MXA009
+        # bare on purpose: decode hot loop: per-token budget; leaf, never nests
+        self._work = threading.Condition(self._lock)  # mx-lint: allow=MXA009
         self._clock = clock
         self._window = DispatchWindow(max_inflight=max(0, int(inflight)),
                                       what="decode step",

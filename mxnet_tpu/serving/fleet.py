@@ -72,6 +72,7 @@ from functools import partial
 from typing import Callable, List, Optional, Sequence
 
 from ..analysis import guard as _tguard
+from ..analysis.threads import mx_lock, mx_rlock
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..testing.faults import fault_point
@@ -338,8 +339,8 @@ class FleetController:
         self._dist = _dist
         self._backoff_base = float(backoff_base)
         self._backoff_max = float(backoff_max)
-        self._lock = threading.RLock()
-        self._scale_lock = threading.Lock()
+        self._lock = mx_rlock("serving.fleet")
+        self._scale_lock = mx_lock("serving.fleet.scale")
         self._replicas: List[_Replica] = []
         self._next_idx = 0
         self.version = 0         # current weight version (swaps bump it)

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis.threads import mx_lock
 from ..base import MXNetError
 from . import names
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -225,10 +226,21 @@ class Heartbeat:
             target=self._run, name="mx-telemetry-heartbeat", daemon=True)
         self._counter = _default_registry().counter(names.HEARTBEATS)
         self.beats = 0
+        # serializes beat() between the daemon thread and any caller
+        # (atexit flush, tests); also guards the terminal _stopped flag,
+        # so a stop() landing mid-beat waits the beat out instead of
+        # racing it into a second MXNET_PROMETHEUS_FILE write
+        self._beat_mu = mx_lock("telemetry.heartbeat.beat")
+        self._stopped = False
 
     def start(self) -> "Heartbeat":
+        if self._stopped:
+            raise MXNetError(
+                "Heartbeat.start: this heartbeat was stopped; threads "
+                "cannot be restarted — build a new Heartbeat()")
         _install_atexit()   # short runs still flush a final snapshot
-        self._thread.start()
+        if not self._thread.is_alive():
+            self._thread.start()
         return self
 
     def _run(self):
@@ -237,20 +249,31 @@ class Heartbeat:
 
     def beat(self):
         """One heartbeat: log the condensed payload, bump the counter,
-        refresh the Prometheus file when configured."""
-        try:
-            payload = _heartbeat_payload()
-            _LOG.info("mx-telemetry %s", json.dumps(payload))
-            self._counter.inc()
-            self.beats += 1
-            if self._write_file and prometheus_file():
-                write_prometheus()
-        except Exception:            # a heartbeat must never kill a run
-            _LOG.warning("telemetry heartbeat failed", exc_info=True)
+        refresh the Prometheus file when configured. Serialized against
+        concurrent callers and a no-op once :meth:`stop` has landed, so
+        the final flush never doubles up with an in-flight beat."""
+        with self._beat_mu:
+            if self._stopped:
+                return
+            try:
+                payload = _heartbeat_payload()
+                _LOG.info("mx-telemetry %s", json.dumps(payload))
+                self._counter.inc()
+                self.beats += 1
+                if self._write_file and prometheus_file():
+                    write_prometheus()
+            except Exception:        # a heartbeat must never kill a run
+                _LOG.warning("telemetry heartbeat failed", exc_info=True)
 
     def stop(self, timeout: float = 5.0):
-        """Signal shutdown and join the thread (idempotent)."""
+        """Signal shutdown and join the thread (idempotent).
+
+        Acquiring the beat lock first means an in-flight beat finishes
+        (or the next one sees ``_stopped`` and bails) before we join —
+        and the join itself happens with no lock held."""
         self._stop.set()
+        with self._beat_mu:
+            self._stopped = True
         if self._thread.is_alive():
             self._thread.join(timeout)
 
@@ -260,7 +283,7 @@ class Heartbeat:
 
 
 _active_heartbeat: Optional[Heartbeat] = None
-_hb_lock = threading.Lock()
+_hb_lock = mx_lock("telemetry.heartbeat")
 _atexit_installed = False
 
 

@@ -203,6 +203,14 @@ FLEET_QUEUE_WAIT = "mx_fleet_queue_wait_seconds"
 # ---------------------------------------------------------------------------
 HEARTBEATS = "mx_telemetry_heartbeats_total"
 
+# ---------------------------------------------------------------------------
+# thread/lock audit (analysis/threads.py)
+# ---------------------------------------------------------------------------
+THREADS_HELD = "mx_threads_held_locks"
+THREADS_LONGEST_WAIT = "mx_threads_longest_wait_seconds"
+THREADS_LOCK_WAIT = "mx_threads_lock_wait_seconds"
+THREADS_DUMPS = "mx_threads_dumps_total"
+
 
 #: name -> {kind, help, label}: the complete set of series the framework
 #: may export. Registration of an unknown ``mx_*`` name raises.
@@ -568,6 +576,21 @@ CATALOG = {
         help="projected queue wait of the replica chosen at each "
              "routed submit — the fleet-wide load signal the "
              "autoscaler EWMAs"),
+    THREADS_HELD: dict(
+        kind="gauge", label=None,
+        help="audited (mx_lock) locks currently held, process-wide"),
+    THREADS_LONGEST_WAIT: dict(
+        kind="gauge", label=None,
+        help="longest single audited-lock wait observed since reset "
+             "(updated live while a waiter is still blocked, so a "
+             "wedged process shows its stall)"),
+    THREADS_LOCK_WAIT: dict(
+        kind="histogram", label="name",
+        help="contended audited-lock acquisition wait per lock name"),
+    THREADS_DUMPS: dict(
+        kind="counter", label=None,
+        help="deadlock/stall forensics dumps written to "
+             "MXNET_THREADS_DUMP_DIR"),
     HEARTBEATS: dict(
         kind="counter", label=None,
         help="periodic telemetry heartbeat log lines emitted"),

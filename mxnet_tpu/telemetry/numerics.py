@@ -344,7 +344,8 @@ class NumericsMonitor:
     ``CompiledTrainStep.numerics_values()`` for windowless callers."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # bare on purpose: telemetry substrate: the audit's metrics path runs under it
+        self._lock = threading.Lock()  # mx-lint: allow=MXA009
         self._ewma_g: Optional[float] = None
         self._n_g = 0
         self._ewma_r: Optional[float] = None

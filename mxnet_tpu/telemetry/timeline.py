@@ -49,7 +49,8 @@ class StepTimeline:
 
     def __init__(self, capacity: int = 2048):
         self._events: "deque[dict]" = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        # bare on purpose: telemetry substrate: the audit's metrics path runs under it
+        self._lock = threading.Lock()  # mx-lint: allow=MXA009
         self._hist = _default_registry().histogram(
             names.STEP_PHASE_SECONDS, label_key="phase")
 

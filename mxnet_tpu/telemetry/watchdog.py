@@ -82,7 +82,8 @@ class Watchdog:
     """Process-global MFU gauge + NaN/stall anomaly detector."""
 
     def __init__(self, max_events: int = 256):
-        self._lock = threading.Lock()
+        # bare on purpose: telemetry substrate: the deadlock episode fires under it
+        self._lock = threading.Lock()  # mx-lint: allow=MXA009
         self._events: "deque[dict]" = deque(maxlen=max_events)
         self._ewma: Optional[float] = None
         self._samples = 0

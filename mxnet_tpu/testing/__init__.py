@@ -1,13 +1,23 @@
-"""Testing support: fault injection for crash-consistency proofs.
+"""Testing support: fault injection and deterministic scheduling.
 
 Reference analog: the reference's test fault tooling is ad-hoc
 (tests/python/unittest/common.py retry decorators); here fault points
 are first-class so the checkpoint stack's atomicity claims are enforced
-by kill-9 tests instead of asserted in comments.
+by kill-9 tests instead of asserted in comments. :mod:`.sched` (lazy —
+it pulls in the analysis layer) adds the deterministic-schedule
+harness: seeded, replayable thread interleavings over the audited
+locks of ``analysis/threads.py``.
 """
 from . import faults                              # noqa: F401
 from .faults import (fault_point, FaultInjectedError,  # noqa: F401
                      DeviceRevokedError, FaultRule)
 
 __all__ = ["faults", "fault_point", "FaultInjectedError",
-           "DeviceRevokedError", "FaultRule"]
+           "DeviceRevokedError", "FaultRule", "sched"]
+
+
+def __getattr__(name):
+    if name == "sched":
+        import importlib
+        return importlib.import_module(".sched", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

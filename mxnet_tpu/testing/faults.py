@@ -170,7 +170,8 @@ def _parse(spec: str) -> List[FaultRule]:
 
 # (point, phase) -> hit count; rules parsed once per process (subprocess
 # tests re-exec with the env var set) or overridden via configure()
-_lock = threading.Lock()
+# bare on purpose: fault points fire inside audited sections; auditing recurses
+_lock = threading.Lock()  # mx-lint: allow=MXA009
 _rules: Optional[List[FaultRule]] = None
 _counts: Dict[Tuple[str, str], int] = {}
 

@@ -116,7 +116,8 @@ class Tunable:
                 f"grid={self.grid!r}, scope={self.scope!r})")
 
 
-_LOCK = threading.Lock()
+# bare on purpose: leaf module-init lock; never nests with audited locks
+_LOCK = threading.Lock()  # mx-lint: allow=MXA009
 _REGISTRY: "Dict[str, Tunable]" = {}
 _OVERRIDES: "Dict[str, Any]" = {}
 

@@ -276,6 +276,28 @@ def test_diagnose_elastic_section(capsys):
     assert ("device_lost" in out) or ("transient" in out)
 
 
+def test_diagnose_threads_section(capsys):
+    """--threads: prints the audited-lock table, the observed
+    lock-order graph's cycle status, a planted two-lock inversion demo
+    (on a private graph — the global hierarchy stays clean), and a
+    contention snapshot with a live waiter."""
+    from mxnet_tpu.analysis import threads
+    diagnose = _load("tools/diagnose.py", "diagnose_thr")
+    assert diagnose.main(["--threads"]) == 0
+    out = capsys.readouterr().out
+    assert "Concurrency Audit" in out
+    assert "MXNET_LOCK_STALL_SEC=" in out
+    assert "-- audited locks" in out
+    assert "order graph" in out
+    assert "-- planted inversion demo (1 finding) --" in out
+    assert "demo.inversion.a" in out and "demo.inversion.b" in out
+    assert "-- contention snapshot --" in out
+    assert "demo.contention" in out and "1 waiter(s)" in out
+    # the demo's inversion must NOT have leaked into the global graph
+    assert not any("demo.inversion" in f"{a}{b}"
+                   for a, b in threads.graph().edge_pairs())
+
+
 def test_diagnose_overlap_section(capsys):
     """--overlap: compiles the zero-sharded adam MLP serial AND
     bucketed on the virtual dp mesh and prints each schedule's

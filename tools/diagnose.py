@@ -1111,6 +1111,94 @@ def check_fleet():
         print("fleet check failed:", repr(e))
 
 
+def check_threads():
+    """Concurrency-audit panel (docs/ANALYSIS.md "Concurrency
+    analysis"): the live audited-lock table, the observed lock-order
+    graph with its cycle status, a planted two-lock inversion demo on
+    a PRIVATE graph (so the demo never pollutes the process-global
+    hierarchy), and a brief contention snapshot under a deliberately
+    held lock — lock-order bugs and stalls are visible without
+    attaching a debugger."""
+    print("----------Concurrency Audit----------")
+    try:
+        import threading
+        import time
+
+        from mxnet_tpu import serving, telemetry  # noqa: F401 - wires locks
+        from mxnet_tpu.analysis import threads
+
+        print(f"env knobs    : MXNET_LOCK_STALL_SEC="
+              f"{threads.stall_seconds():g} "
+              f"MXNET_THREADS_DUMP_DIR={threads.dump_dir() or '<unset>'}")
+        locks = threads.describe_locks()
+        print(f"-- audited locks ({len(locks)} name(s)) --")
+        print(f"{'name':<28}{'kind':<7}{'inst':<6}{'held':<6}"
+              f"{'waiters':<9}owner")
+        for l in locks:
+            print(f"{l['name']:<28}{l['kind']:<7}{l['instances']:<6}"
+                  f"{l['held']:<6}{l['waiters']:<9}{l['owner'] or '-'}")
+        edges = threads.graph().edges()
+        cycles = threads.find_cycles()
+        print(f"order graph  : {len(edges)} edge(s), "
+              f"{len(cycles)} cycle(s)"
+              + ("  <- POTENTIAL DEADLOCK" if cycles else ""))
+        for e in sorted(edges, key=lambda e: (e['from'], e['to']))[:12]:
+            print(f"  {e['from']} -> {e['to']}  (x{e['count']}, "
+                  f"thread {e['thread']})")
+        if len(edges) > 12:
+            print(f"  ... and {len(edges) - 12} more")
+
+        # planted inversion demo on a PRIVATE graph: what a real
+        # lock-cycle finding looks like, without touching the global
+        # hierarchy the tier-1 baseline sweep audits
+        demo = threads.LockOrderGraph()
+        a = threads.mx_lock("demo.inversion.a", graph=demo)
+        b = threads.mx_lock("demo.inversion.b", graph=demo)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        findings = threads.cycle_findings(demo)
+        print(f"-- planted inversion demo ({len(findings)} finding) --")
+        for f in findings:
+            print(" ", str(f)[:240])
+
+        # contention snapshot: hold a probe lock, let one waiter block,
+        # and show the waiter/longest-wait census the dump would rank
+        probe = threads.mx_lock("demo.contention")
+        seen = threading.Event()
+
+        def waiter():
+            seen.set()
+            with probe:
+                pass
+
+        with probe:
+            t = threading.Thread(target=waiter, name="demo-waiter",
+                                 daemon=True)
+            t.start()
+            seen.wait(1.0)
+            time.sleep(0.15)     # let the waiter enter its timed poll
+            row = [l for l in threads.describe_locks()
+                   if l["name"] == "demo.contention"]
+            if row:
+                print(f"-- contention snapshot --")
+                print(f"demo.contention: held by {row[0]['owner']!r}, "
+                      f"{row[0]['waiters']} waiter(s), longest wait "
+                      f"{row[0]['longest_wait_s'] * 1e3:.0f} ms")
+        t.join(2.0)
+        wait_h = telemetry.registry().get(
+            telemetry.names.THREADS_LOCK_WAIT)
+        if wait_h is not None and wait_h.count():
+            print(f"{telemetry.names.THREADS_LOCK_WAIT}: "
+                  f"n={wait_h.count()} "
+                  f"p99={wait_h.percentile(99) * 1e3:.2f} ms")
+    except Exception as e:  # pragma: no cover - env-dependent
+        print("threads check failed:", repr(e))
+
+
 def check_os():
     print("----------System Info----------")
     print("Platform     :", platform.platform())
@@ -1229,6 +1317,12 @@ def main(argv=None):
                         "are visible), and print the per-replica "
                         "census, failover/restart ledger, and "
                         "mx_fleet_* metric snapshot")
+    parser.add_argument("--threads", action="store_true",
+                        help="also print the concurrency-audit panel: "
+                        "live audited-lock table, observed lock-order "
+                        "graph + cycle status, a planted two-lock "
+                        "inversion demo (private graph), and a "
+                        "contention snapshot")
     parser.add_argument("--elastic", action="store_true",
                         help="also run a tiny supervised TrainLoop, "
                         "inject one mid-run fault (device revocation / "
@@ -1266,6 +1360,8 @@ def main(argv=None):
         check_decode()
     if args.fleet:
         check_fleet()
+    if args.threads:
+        check_threads()
     if args.elastic:
         check_elastic()
     check_os()
