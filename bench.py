@@ -1028,6 +1028,48 @@ def bench_decode(dtype):
         f"tok/s (ttft p99 {cont['ttft_p99_ms']}ms) vs static "
         f"{stat['decode_tokens_per_sec']} tok/s (ttft p99 "
         f"{stat['ttft_p99_ms']}ms) — speedup {speedup}x")
+    # --- speculative decode + prefix sharing A/B (docs/SERVING.md
+    # "Speculative decode & prefix sharing"): a repeated-suffix mix
+    # (prompt-lookup drafting territory) whose prompts extend one
+    # shared base prefix, decoded plain-greedy vs draft->verify with
+    # the prefix cache on. Emitted tokens are bit-identical by
+    # contract; the delta is steps, not tokens.
+    base = rng.randint(0, vocab, size=3 * page_size).astype(onp.int32)
+    sp_prompts, sp_mns = [], []
+    for i in range(max(8, n_req // 2)):
+        tail = rng.randint(0, vocab, size=2 + (i % 3)).astype(onp.int32)
+        sp_prompts.append(onp.concatenate([base, tail]))
+        sp_mns.append(24)
+    plain = serving.run_decode(model, sp_prompts, sp_mns,
+                               ladder=ladder, page_size=page_size,
+                               spec_k=0, prefix_share=False)
+    spec = serving.run_decode(model, sp_prompts, sp_mns,
+                              ladder=ladder, page_size=page_size,
+                              spec_k=4, prefix_share=True)
+    speedup_spec = round(spec["decode_tokens_per_sec"]
+                         / plain["decode_tokens_per_sec"], 2) \
+        if spec.get("decode_tokens_per_sec") and \
+        plain.get("decode_tokens_per_sec") else None
+    tps = (spec.get("tokens_per_step") or {}).get("mean")
+    cap = max(1, spec.get("kv_num_pages", 2) - 1)
+    shared_pct = round(100.0 * spec.get("kv_shared_peak", 0) / cap, 2)
+    log(f"bench[decode]: speculative {spec['decode_tokens_per_sec']} "
+        f"tok/s vs greedy {plain['decode_tokens_per_sec']} tok/s — "
+        f"speedup {speedup_spec}x, acceptance "
+        f"{spec.get('acceptance_rate')}, tokens/step {tps}, shared "
+        f"pages peak {shared_pct}% of pool")
+
+    # --- GQA transformer workload: the second decode model over the
+    # same engine/cache (half the K/V heads -> half the cache bytes
+    # per token at this query width)
+    from mxnet_tpu.gluon import GQADecoder
+    gqa = GQADecoder(vocab=vocab, d_model=d_model, num_heads=heads * 2,
+                     num_kv_heads=heads, num_layers=2, seed=0)
+    gqa_res = serving.run_decode(gqa, prompts[:8], mns[:8],
+                                 ladder=ladder, page_size=page_size)
+    log(f"bench[decode]: gqa transformer "
+        f"{gqa_res['decode_tokens_per_sec']} tok/s "
+        f"({gqa.num_heads} q heads / {gqa.num_kv_heads} kv heads)")
     return {
         "decode_tokens_per_sec": cont.get("decode_tokens_per_sec"),
         "ttft_p50_ms": cont.get("ttft_p50_ms"),
@@ -1046,6 +1088,13 @@ def bench_decode(dtype):
         "slot_ladder": list(ladder),
         "page_size": page_size,
         "kernel_path": _kern.dispatch_table().get("rnn_decode_step"),
+        "spec_acceptance_rate": spec.get("acceptance_rate"),
+        "tokens_per_step": tps,
+        "kv_shared_page_pct": shared_pct,
+        "speedup_vs_nonspec": speedup_spec,
+        "spec_detail": spec,
+        "gqa_tokens_per_sec": gqa_res.get("decode_tokens_per_sec"),
+        "gqa_detail": gqa_res,
         "continuous_detail": cont,
         "static_detail": stat,
     }

@@ -20,4 +20,9 @@ try:
 except ImportError:
     pass
 
+try:
+    from .gqa_decoder import GQADecoder  # noqa: F401
+except ImportError:
+    pass
+
 from .. import metric  # parity: mx.gluon.metric mirrors reference layout

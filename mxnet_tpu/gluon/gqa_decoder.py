@@ -1,0 +1,203 @@
+"""GQA transformer decode stack: the second serving decode workload.
+
+A minimal multi-layer decoder-only transformer implementing the
+:class:`~mxnet_tpu.serving.decode.DecodeEngine` model protocol
+(``decode_step`` / ``prefill_chunk`` / ``verify_chunk``) over the SAME
+paged KV layout as the reference RNN — per-layer K/V pages of shape
+``(num_layers, num_pages, page_size, num_kv_heads, head_dim)`` read and
+written through the engine's page table.
+
+Grouped-query attention is the point: the model queries with
+``num_heads`` heads but caches only ``num_kv_heads`` K/V heads
+(``num_heads`` must be a multiple), so the paged cache is
+``num_heads / num_kv_heads``× smaller per token than an MHA cache of
+the same query width. The broadcast across query groups happens inside
+:func:`~mxnet_tpu.ops.attention.paged_decode_attention` — the engine
+only sees the smaller cache geometry via the model's ``num_kv_heads``
+attribute.
+
+Parity discipline (the property speculative decode leans on): all
+three entry points process one token through the SAME single-token
+block — ``decode_step`` directly, ``prefill_chunk`` and
+``verify_chunk`` via a ``lax.scan`` over positions. A transformer has
+no recurrent carry, so the engine's ``h``/``c`` state rows are dummy
+``(slots, 1)`` zeros passed through untouched; K/V pages ARE the whole
+decode state, which also makes prefix sharing exact for free.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.attention import paged_decode_attention
+
+__all__ = ["GQADecoder"]
+
+
+def _rmsnorm(x, g, eps: float = 1e-6):
+    return x * g * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1,
+                                      keepdims=True) + eps)
+
+
+class GQADecoder:
+    """Decoder-only transformer with grouped-query attention over the
+    engine's paged KV cache.
+
+    Per layer: pre-norm -> q/k/v projections (q: ``num_heads`` heads,
+    k/v: ``num_kv_heads`` heads) -> K/V page write at this token's
+    position -> paged attention (GQA broadcast) -> output projection
+    residual -> pre-norm MLP residual. Logits tie the embedding.
+    """
+
+    def __init__(self, vocab: int = 64, d_model: int = 32,
+                 num_heads: int = 4, num_kv_heads: int = 2,
+                 num_layers: int = 2, max_len: int = 512,
+                 seed: int = 0):
+        if d_model % num_heads:
+            raise MXNetError(f"d_model={d_model} not divisible by "
+                             f"num_heads={num_heads}")
+        if num_heads % num_kv_heads:
+            raise MXNetError(
+                f"num_heads={num_heads} not a multiple of "
+                f"num_kv_heads={num_kv_heads} (GQA groups must be even)")
+        self.vocab = int(vocab)
+        self.d_model = int(d_model)
+        self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads)
+        self.num_layers = int(num_layers)
+        self.head_dim = self.d_model // self.num_heads
+        self.max_len = int(max_len)
+        rng = onp.random.RandomState(seed)
+        H = self.d_model
+        kvw = self.num_kv_heads * self.head_dim
+
+        def mat(*shape, scale=0.3):
+            return jnp.asarray(
+                rng.normal(0.0, scale, shape).astype("float32"))
+
+        self.params = {
+            "embed": mat(self.vocab, H, scale=0.5),
+            "pos": mat(self.max_len, H, scale=0.2),
+            "lnf": jnp.ones((H,), "float32"),
+            "layers": [
+                {
+                    "ln1": jnp.ones((H,), "float32"),
+                    "wq": mat(H, H), "wk": mat(H, kvw),
+                    "wv": mat(H, kvw), "wo": mat(H, H),
+                    "ln2": jnp.ones((H,), "float32"),
+                    "w1": mat(H, 2 * H), "w2": mat(2 * H, H),
+                }
+                for _ in range(self.num_layers)
+            ],
+        }
+
+    def init_state(self, slots: int):
+        # no recurrent carry: (slots, 1) dummies the engine threads
+        # through every program unchanged
+        return (jnp.zeros((slots, 1), "float32"),
+                jnp.zeros((slots, 1), "float32"))
+
+    # -- the single-token block every entry point shares (parity by
+    #    construction across decode / prefill / verify)
+    def _block(self, params, tokens, pos, k_pages, v_pages, pidx, poff,
+               table, lengths):
+        S = tokens.shape[0]
+        Hq, Hkv, D = self.num_heads, self.num_kv_heads, self.head_dim
+        p = jnp.clip(pos, 0, self.max_len - 1)
+        x = params["embed"][tokens] + params["pos"][p]
+        for li, lp in enumerate(params["layers"]):
+            y = _rmsnorm(x, lp["ln1"])
+            q = (y @ lp["wq"]).reshape(S, Hq, D)
+            k = (y @ lp["wk"]).reshape(S, Hkv, D)
+            v = (y @ lp["wv"]).reshape(S, Hkv, D)
+            k_pages = k_pages.at[li, pidx, poff].set(
+                k.astype(k_pages.dtype))
+            v_pages = v_pages.at[li, pidx, poff].set(
+                v.astype(v_pages.dtype))
+            attn = paged_decode_attention(q, k_pages[li], v_pages[li],
+                                          table, lengths)
+            x = x + attn.reshape(S, -1) @ lp["wo"]
+            y2 = _rmsnorm(x, lp["ln2"])
+            x = x + jnp.maximum(y2 @ lp["w1"], 0.0) @ lp["w2"]
+        logits = _rmsnorm(x, params["lnf"]) @ params["embed"].T
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, k_pages, v_pages
+
+    def decode_step(self, params, tokens, h, c, k_pages, v_pages,
+                    pidx, poff, table, lengths, active):
+        """One iteration over every slot: write this token's K/V in
+        every layer, attend over the slot's paged history, emit the
+        greedy next token. Inactive slots write the null page and
+        bit-preserve their token."""
+        pidx = jnp.where(active, pidx, 0)
+        poff = jnp.where(active, poff, 0)
+        nxt, k_pages, v_pages = self._block(
+            params, tokens, lengths - 1, k_pages, v_pages, pidx, poff,
+            table, lengths)
+        nxt = jnp.where(active, nxt, tokens)
+        return nxt, h, c, k_pages, v_pages
+
+    def prefill_chunk(self, params, tokens, h, c, k_pages, v_pages,
+                      start_len, n_valid, reset, active, table,
+                      page_size: int):
+        """Consume up to ``tokens.shape[1]`` prompt tokens through the
+        same single-token block, one position per scan step (each
+        position's attention must see the chunk's earlier writes). The
+        returned token is the greedy continuation of the last valid
+        position."""
+        S, C = tokens.shape
+
+        def body(carry, t):
+            kp, vp, last = carry
+            tok = tokens[:, t]
+            valid = active & (t < n_valid)
+            pos = start_len + t
+            page = jnp.take_along_axis(
+                table, (pos // page_size)[:, None], axis=1)[:, 0]
+            pg = jnp.where(valid, page, 0)
+            off = jnp.where(valid, pos % page_size, 0)
+            lengths = jnp.where(valid, pos + 1, 1)
+            nxt, kp, vp = self._block(params, tok, pos, kp, vp, pg,
+                                      off, table, lengths)
+            last = jnp.where(valid, nxt, last)
+            return (kp, vp, last), None
+
+        (k_pages, v_pages, last), _ = lax.scan(
+            body, (k_pages, v_pages,
+                   jnp.zeros((S,), jnp.int32)), jnp.arange(C))
+        nxt = jnp.where(active, last, 0)
+        return nxt, h, c, k_pages, v_pages
+
+    def verify_chunk(self, params, tokens, h, c, k_pages, v_pages,
+                     start_len, n_draft, active, table,
+                     page_size: int):
+        """Score the committed token + drafts in one dispatch: the scan
+        body IS the decode block, so position t emits exactly what
+        sequential greedy decode would. State trajectories are the
+        dummy carries tiled per position (nothing to roll back — the
+        pages hold all the state and acceptance is length
+        bookkeeping)."""
+        S, K = tokens.shape
+
+        def body(kv, t):
+            kp, vp = kv
+            tok = tokens[:, t]
+            valid = active & (t < n_draft)
+            pos = start_len + t
+            page = jnp.take_along_axis(
+                table, (pos // page_size)[:, None], axis=1)[:, 0]
+            pg = jnp.where(valid, page, 0)
+            off = jnp.where(valid, pos % page_size, 0)
+            lengths = jnp.where(valid, pos + 1, 1)
+            y, kp, vp = self._block(params, tok, pos, kp, vp, pg, off,
+                                    table, lengths)
+            return (kp, vp), y
+
+        (k_pages, v_pages), ys = lax.scan(
+            body, (k_pages, v_pages), jnp.arange(K))
+        hs = jnp.broadcast_to(h[None], (K,) + h.shape)
+        cs = jnp.broadcast_to(c[None], (K,) + c.shape)
+        return ys.T, hs, cs, k_pages, v_pages

@@ -656,8 +656,11 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     indirection.
 
     - ``q``: (S, H, D) — the current token's query per slot;
-    - ``k_pages``/``v_pages``: (P, page_size, H, D) — the pooled page
-      arrays of one layer;
+    - ``k_pages``/``v_pages``: (P, page_size, Hkv, D) — the pooled page
+      arrays of one layer. ``Hkv`` may DIVIDE the query head count H
+      (grouped-query attention): each stored K/V head is broadcast
+      across its group of ``H // Hkv`` query heads, so a GQA decoder
+      pays the KV-cache bytes of ``Hkv`` heads while attending with H;
     - ``page_table``: (S, max_pages) int32 — slot → page ids, padded
       with the null page 0 past each slot's allocation;
     - ``lengths``: (S,) — valid key count per slot (the token just
@@ -671,12 +674,22 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     (S, H, D).
     """
     s, h, d = q.shape
+    hkv = k_pages.shape[2]
+    if h != hkv and (hkv < 1 or h % hkv):
+        raise MXNetError(
+            f"paged_decode_attention: query heads {h} not a multiple "
+            f"of K/V heads {hkv} (GQA needs integer groups)")
     ps = k_pages.shape[1]
     t = page_table.shape[1] * ps
-    # (S, max_pages, page_size, H, D) -> (S, H, T, D): slot s's key at
-    # position p lives at flat index p because pages fill in order
-    k = k_pages[page_table].reshape(s, t, h, d).transpose(0, 2, 1, 3)
-    v = v_pages[page_table].reshape(s, t, h, d).transpose(0, 2, 1, 3)
+    # (S, max_pages, page_size, Hkv, D) -> (S, Hkv, T, D): slot s's key
+    # at position p lives at flat index p because pages fill in order
+    k = k_pages[page_table].reshape(s, t, hkv, d).transpose(0, 2, 1, 3)
+    v = v_pages[page_table].reshape(s, t, hkv, d).transpose(0, 2, 1, 3)
+    if h != hkv:
+        # GQA broadcast: repeat each stored head over its query group
+        # (head j serves query heads [j*g, (j+1)*g))
+        k = jnp.repeat(k, h // hkv, axis=1)
+        v = jnp.repeat(v, h // hkv, axis=1)
     out = flash_attention(q[:, :, None, :], k, v, causal=False,
                           sm_scale=sm_scale, valid_length=lengths)
     return out[:, :, 0, :]

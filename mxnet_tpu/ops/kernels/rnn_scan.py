@@ -39,7 +39,8 @@ from jax import lax
 
 from . import dispatch, vmem_tile_budget
 
-__all__ = ["rnn_scan", "rnn_decode_step", "scan_supported"]
+__all__ = ["rnn_scan", "rnn_decode_step", "rnn_verify_scan",
+           "scan_supported"]
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 _MAX_BLOCK_T = 16      # unrolled in-kernel; bounds Mosaic program size
@@ -573,6 +574,45 @@ def rnn_decode_step(xw, h, c, w_hh, b_hh, mode: str):
         return _fwd_step(mode, xw, h, c, hw, b_hh)
     return _decode_pallas(xw, h, c, w_hh, b_hh, mode,
                           path == "interpret")
+
+
+def rnn_verify_scan(xw, h, c, w_hh, b_hh, mode: str, valid):
+    """Masked multi-position scan for speculative-decode verification
+    (serving/decode.py): run the SAME single-step cell as
+    :func:`rnn_decode_step` over K candidate positions ``xw`` (K, N,
+    G*H), bit-preserving the carry wherever ``valid`` (K, N) is False,
+    and return the full per-position state TRAJECTORIES ``(hs, cs)``
+    (each (K, N, H); ``cs`` None for non-LSTM modes) — the verifier
+    needs the state AT EVERY position so acceptance can roll the carry
+    back to the last accepted draft. The dispatch decision (Pallas
+    decode kernel vs the XLA ``_fwd_step`` reference) is made ONCE and
+    the chosen single-step body scans, so each position's math is
+    bit-identical to the step :func:`rnn_decode_step` would run —
+    parity with plain decode is by construction.
+    """
+    why = decode_supported(xw[0], h, c, mode)
+    path, _ = dispatch("rnn_decode_step", supported=why is None,
+                       reason=why)
+    lstm = mode == "lstm"
+    valid = jnp.asarray(valid)
+
+    def body(carry, inp):
+        h, c = carry
+        xw_t, v_t = inp
+        if path == "xla":
+            hw = lax.dot_general(h, w_hh, (((1,), (1,)), ((), ())))
+            h2, c2 = _fwd_step(mode, xw_t, h, c, hw, b_hh)
+        else:
+            h2, c2 = _decode_pallas(xw_t, h, c, w_hh, b_hh, mode,
+                                    path == "interpret")
+        vm = v_t[:, None]
+        h = jnp.where(vm, h2, h)
+        c = jnp.where(vm, c2, c) if lstm else None
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(body, (h, c if lstm else None),
+                                (xw, valid))
+    return hs, cs
 
 
 # ---------------------------------------------------------------------------

@@ -52,10 +52,12 @@ from .resilience import (CircuitBreaker, DeadlineExceeded, Overloaded,
 from .predictor import CompiledPredictor, DEFAULT_BUCKETS, predictor_for
 from .batcher import (DynamicBatcher, ServingFuture, batch_timeout_s,
                       max_batch_rows, queue_depth)
-from .kvcache import KV_PAGE_SIZE, PagedKVCache, pages_needed
-from .decode import (DecodeEngine, DecodeStream, TinyDecoder,
-                     kv_page_size, prefill_chunk, run_decode,
-                     slot_ladder)
+from .kvcache import (KV_PAGE_SIZE, PagedKVCache, pages_needed,
+                      prefix_hash)
+from .decode import (DecodeEngine, DecodeStream, ModelDrafter,
+                     NgramDrafter, TinyDecoder, kv_page_size,
+                     prefill_chunk, prefix_share, run_decode,
+                     slot_ladder, spec_k)
 from .fleet import (FleetController, FleetEvent, FleetRouter,
                     fleet_max_replicas, fleet_min_replicas,
                     fleet_replicas, fleet_restart_retries,
@@ -75,7 +77,9 @@ __all__ = ["CompiledPredictor", "DynamicBatcher", "ServingFuture",
            "decode", "kvcache", "DecodeEngine", "DecodeStream",
            "TinyDecoder", "PagedKVCache", "KV_PAGE_SIZE",
            "pages_needed", "run_decode", "slot_ladder", "kv_page_size",
-           "prefill_chunk", "fleet", "FleetController", "FleetRouter",
+           "prefill_chunk", "prefix_hash", "NgramDrafter",
+           "ModelDrafter", "spec_k", "prefix_share",
+           "fleet", "FleetController", "FleetRouter",
            "FleetEvent", "fleet_replicas", "fleet_min_replicas",
            "fleet_max_replicas", "fleet_scale_up_wait_s",
            "fleet_scale_down_wait_s", "fleet_restart_retries"]

@@ -50,6 +50,31 @@ discovered EOS writes one garbage token into the finished request's
 steps in dispatch order, so the garbage write always lands BEFORE the
 next occupant's prefill overwrites those pages — and it is budgeted:
 admission reserves ``pages_needed(prompt + max_new + inflight)``.
+
+**Speculative decode** (``decode.spec_k`` / ``MXNET_DECODE_SPEC_K``;
+0 = off): a cheap host-side drafter (:class:`NgramDrafter` by default —
+prompt-lookup over the request's own token history; any object with
+``propose(history, k)`` plugs in, e.g. :class:`ModelDrafter` wrapping a
+small engine-protocol model) proposes up to K tokens per slot, and ONE
+``verify`` program — the chunked-prefill scan shape over the SAME
+per-token cell (:func:`~mxnet_tpu.ops.kernels.rnn_scan
+.rnn_verify_scan`) — scores all K positions, accepts the longest
+prefix matching the model's own greedy continuation DEVICE-side, rolls
+the recurrent carry back to the accepted position, and emits between 1
+and K tokens per dispatch. Rejected positions wrote K/V beyond the
+committed length; the rollback is pure length bookkeeping — attention
+masks by ``lengths`` and the next step overwrites them. Emitted
+streams are BIT-exact vs plain greedy decode (tier-1 pins it); the
+verify bucket ladder AOT-compiles at :meth:`DecodeEngine.warmup`.
+
+**Prefix sharing** (``decode.prefix_share`` /
+``MXNET_DECODE_PREFIX_SHARE``): retired prefill chunks register their
+committed pages in the cache's content-hash registry; a later request
+whose prompt extends a registered prefix maps those physical pages
+(refcounted), installs the registered recurrent-state snapshot, and
+prefills only its unshared tail — admission prices only that tail.
+First divergent write onto a page held by >= 2 requests triggers a
+copy-on-write page copy (kvcache.py has the lifecycle).
 """
 from __future__ import annotations
 
@@ -68,21 +93,30 @@ from ..base import MXNetError
 from ..analysis import guard as _tguard
 from ..engine import DispatchWindow
 from ..ops.attention import paged_decode_attention
-from ..ops.kernels.rnn_scan import rnn_decode_step
+from ..ops.kernels import pallas_mode
+from ..ops.kernels.rnn_scan import rnn_decode_step, rnn_verify_scan
 from .kvcache import KV_PAGE_SIZE, PagedKVCache, pages_needed
 from .resilience import (DeadlineExceeded, Overloaded, ServingShutdown,
                          default_deadline_ms, shed_mode)
 from .batcher import queue_depth
 
 __all__ = ["DecodeEngine", "DecodeStream", "TinyDecoder", "run_decode",
-           "slot_ladder", "kv_page_size", "prefill_chunk",
-           "DECODE_SLOT_LADDER", "PREFILL_CHUNK"]
+           "NgramDrafter", "ModelDrafter",
+           "slot_ladder", "kv_page_size", "prefill_chunk", "spec_k",
+           "prefix_share", "DECODE_SLOT_LADDER", "PREFILL_CHUNK",
+           "SPEC_K", "PREFIX_SHARE"]
 
 #: shipped slot-count ladder (``decode.slot_ladder`` / ``MXNET_DECODE_SLOTS``)
 DECODE_SLOT_LADDER = (1, 2, 4, 8)
 #: shipped prompt-chunk width (``decode.prefill_chunk`` /
 #: ``MXNET_DECODE_PREFILL_CHUNK``)
 PREFILL_CHUNK = 16
+#: shipped max draft tokens per speculative step (``decode.spec_k`` /
+#: ``MXNET_DECODE_SPEC_K``; 0 = speculative decode off)
+SPEC_K = 0
+#: shipped prefix-cache sharing switch (``decode.prefix_share`` /
+#: ``MXNET_DECODE_PREFIX_SHARE``; 1 = on)
+PREFIX_SHARE = 1
 
 
 def _parse_ladder(v) -> Tuple[int, ...]:
@@ -131,6 +165,27 @@ def prefill_chunk() -> int:
         return PREFILL_CHUNK
 
 
+def spec_k() -> int:
+    """Max draft tokens per speculative-decode step (0 disables) —
+    autotune override > ``MXNET_DECODE_SPEC_K`` > the default."""
+    from ..tuning import space as _tspace
+    try:
+        return max(0, int(_tspace.value("decode.spec_k", SPEC_K)))
+    except (TypeError, ValueError):
+        return SPEC_K
+
+
+def prefix_share() -> bool:
+    """Whether the engine shares prefix-cache pages across requests —
+    autotune override > ``MXNET_DECODE_PREFIX_SHARE`` > the default."""
+    from ..tuning import space as _tspace
+    try:
+        return bool(int(_tspace.value("decode.prefix_share",
+                                      PREFIX_SHARE)))
+    except (TypeError, ValueError):
+        return bool(PREFIX_SHARE)
+
+
 def _page_size_valid(v, _config) -> bool:
     """A candidate page size is valid when a nominal full cache (the
     shipped ladder's worst slot count at a 256-token context, f32,
@@ -152,6 +207,32 @@ def _page_size_valid(v, _config) -> bool:
     slots = DECODE_SLOT_LADDER[-1]
     page_bytes = 2 * 1 * v * 2 * 16 * 4       # K+V, 1 layer, 2x16 f32
     pages = 1 + slots * pages_needed(256, v)
+    return pages * page_bytes <= budget
+
+
+def _spec_k_valid(v, _config) -> bool:
+    """A candidate draft width is valid when the speculative overrun
+    slack (up to ``spec_k`` uncommitted KV positions per slot) still
+    fits ``MXNET_MEMORY_BUDGET`` at the same nominal geometry
+    ``_page_size_valid`` prices — engines re-check their REAL geometry
+    at construction."""
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        return False
+    if not 0 <= v <= 64:
+        return False
+    try:
+        from ..telemetry.memory import memory_budget
+        budget = memory_budget()
+    except Exception:           # pragma: no cover - defensive
+        return True
+    if budget is None or v == 0:
+        return True
+    slots = DECODE_SLOT_LADDER[-1]
+    ps = KV_PAGE_SIZE
+    page_bytes = 2 * 1 * ps * 2 * 16 * 4       # K+V, 1 layer, 2x16 f32
+    pages = 1 + slots * pages_needed(256 + v, ps)
     return pages * page_bytes <= budget
 
 
@@ -191,6 +272,26 @@ def _register_tunables():
         doc="prompt tokens one prefill iteration consumes (smaller = "
             "better decode-batch latency, larger = better prefill "
             "throughput)"))
+    register(Tunable(
+        "decode.spec_k", default=SPEC_K,
+        grid=(0, 2, 4, 8),
+        env="MXNET_DECODE_SPEC_K", parse=int,
+        valid=_spec_k_valid,
+        seam="serving.decode.spec_k() -> DecodeEngine draft->verify "
+             "width (verify-program token dim = spec_k + 1)",
+        scope="serving", affects_program=True,
+        doc="max draft tokens the drafter proposes per speculative "
+            "step (0 = off; overrun slack must fit the KV budget)"))
+    register(Tunable(
+        "decode.prefix_share", default=PREFIX_SHARE,
+        grid=(0, 1),
+        env="MXNET_DECODE_PREFIX_SHARE", parse=int,
+        valid=lambda v, _c: int(v) in (0, 1),
+        seam="serving.decode.prefix_share() -> PagedKVCache prefix "
+             "registry + COW sharing",
+        scope="serving", affects_program=False,
+        doc="share committed prompt-prefix KV pages across requests "
+            "(refcounted, copy-on-write on divergence)"))
 
 
 try:
@@ -204,6 +305,131 @@ except Exception:    # pragma: no cover - tuning must never break serving
 def _telemetry():
     from .. import telemetry
     return telemetry
+
+
+# ---------------------------------------------------------------------------
+# speculative drafters
+# ---------------------------------------------------------------------------
+
+class NgramDrafter:
+    """The default drafter: prompt-lookup / n-gram matching over the
+    request's OWN token history (prompt + everything emitted so far).
+    ``propose`` finds the most recent earlier occurrence of the last
+    ``n`` tokens and returns (up to ``k``) of the tokens that followed
+    it — free to compute, host-side, and exact on repetitive suffixes
+    (code, templates, greedy loops). Proposals are only ever drafts:
+    the verify program accepts at most the model's own greedy
+    continuation, so a bad draft costs speed, never correctness.
+    """
+
+    def __init__(self, n: int = 2, min_n: int = 1):
+        self.n = max(1, int(n))
+        self.min_n = max(1, min(int(min_n), self.n))
+
+    def propose(self, history, k: int) -> List[int]:
+        k = int(k)
+        if k <= 0 or len(history) < 2:
+            return []
+        hist = list(history)
+        L = len(hist)
+        for n in range(min(self.n, L - 1), self.min_n - 1, -1):
+            tail = hist[L - n:]
+            # most recent earlier occurrence of the suffix n-gram
+            for i in range(L - n - 1, -1, -1):
+                if hist[i:i + n] == tail:
+                    cont = hist[i + n:i + n + k]
+                    if cont:
+                        return [int(t) for t in cont]
+                    break
+        return []
+
+
+class ModelDrafter:
+    """Pluggable small-model drafter: greedy-decodes ``k`` draft tokens
+    with a SECOND engine-protocol model (same ``decode_step`` contract,
+    its own tiny state per request) — the classic two-model speculative
+    setup. Draft quality tracks how well the small model imitates the
+    target; correctness never depends on it. Host-side readback of each
+    draft token makes this drafter sync per proposal, so it is NOT for
+    transfer-guard-pinned paths — the default :class:`NgramDrafter`
+    is."""
+
+    def __init__(self, model):
+        self.model = model
+        self._state: Dict[int, tuple] = {}
+
+    def reset(self, key: int):
+        self._state.pop(key, None)
+
+    def propose(self, history, k: int, key: int = 0) -> List[int]:
+        k = int(k)
+        if k <= 0 or not len(history):
+            return []
+        import jax.numpy as _jnp
+        h, c = self.model.init_state(1)
+        # replay the history through the cell (small model, tiny state);
+        # incremental caching per key keeps this O(new tokens)
+        cached = self._state.get(key)
+        start = 0
+        if cached is not None and cached[0] <= len(history) \
+                and list(history[:cached[0]]) == cached[1]:
+            start, _, h, c = cached[0], cached[1], cached[2], cached[3]
+        for t in history[start:]:
+            tok = _jnp.asarray([int(t)], _jnp.int32)
+            h, c = self.model._cell(self.model.params, tok, h, c)
+        self._state[key] = (len(history), list(history), h, c)
+        out: List[int] = []
+        logits_of = getattr(self.model, "draft_logits", None)
+        cur = int(history[-1])
+        for _ in range(k):
+            if logits_of is None:
+                break
+            cur = int(logits_of(self.model.params, h).argmax())
+            out.append(cur)
+            tok = _jnp.asarray([cur], _jnp.int32)
+            h, c = self.model._cell(self.model.params, tok, h, c)
+        return out
+
+
+def _accept_longest_prefix(ys, hs, cs, tokens, n_draft, active):
+    """Device-side acceptance for one verify dispatch.
+
+    ``ys`` (S, K): the model's greedy token at each verified position;
+    ``hs``/``cs`` (K, S, ...): masked per-position state trajectories;
+    ``tokens`` (S, K): the fed inputs (position 0 = last committed
+    token, 1.. = drafts); ``n_draft`` (S,): valid input count.
+
+    Position t's output is emitted iff every draft before it matched
+    the model's own continuation (``ys[t-1] == tokens[t]`` for all
+    t' <= t), so the emitted block is EXACTLY what sequential greedy
+    decode would have produced — acceptance can shorten a step, never
+    change a token. Returns (emitted (S, K), n_acc (S,), next_tok (S,),
+    h_fin, c_fin) with the state rolled back to the last accepted
+    position (inactive slots bit-preserve everything).
+    """
+    S, K = ys.shape
+    if K > 1:
+        idx = jnp.arange(1, K)[None, :]
+        eq = (ys[:, :-1] == tokens[:, 1:]) & (idx < n_draft[:, None])
+        n_acc = 1 + jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
+    else:
+        n_acc = jnp.ones((S,), jnp.int32)
+    n_acc = jnp.minimum(n_acc, jnp.maximum(n_draft, 1)).astype(jnp.int32)
+    a_idx = jnp.maximum(n_acc - 1, 0)
+
+    def _at_accept(traj):
+        if traj is None:
+            return None
+        t = jnp.moveaxis(traj, 0, 1)              # (S, K, ...)
+        ix = a_idx.reshape((S,) + (1,) * (t.ndim - 1))
+        return jnp.take_along_axis(t, ix, axis=1)[:, 0]
+
+    h_fin = _at_accept(hs)
+    c_fin = _at_accept(cs)
+    next_tok = jnp.take_along_axis(ys, a_idx[:, None], axis=1)[:, 0]
+    next_tok = jnp.where(active, next_tok, tokens[:, 0])
+    n_acc = jnp.where(active, n_acc, 0)
+    return ys, n_acc, next_tok, h_fin, c_fin
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +564,49 @@ class TinyDecoder:
         nxt = jnp.where(active, nxt, 0)
         return nxt, h, c, k_pages, v_pages
 
+    def verify_chunk(self, params, tokens, h, c, k_pages, v_pages,
+                     start_len, n_draft, active, table,
+                     page_size: int):
+        """Score ``tokens`` (S, K: last committed token + up to K-1
+        drafts) in ONE dispatch: the recurrence runs the masked
+        verify scan (the SAME per-position cell as :meth:`decode_step`
+        — the carry never depends on attention), then each position
+        writes its K/V through the page table and emits the greedy
+        token over exactly the history sequential decode would see.
+        Returns per-position tokens ``ys`` (S, K) plus the full state
+        trajectories for device-side acceptance rollback."""
+        S, K = tokens.shape
+        emb = params["embed"][tokens]                     # (S, K, H)
+        xw = (emb @ params["w_ih"].T
+              + params["b_ih"]).transpose(1, 0, 2)        # (K, S, 4H)
+        valid = active[None, :] & (jnp.arange(K)[:, None]
+                                   < n_draft[None, :])
+        hs, cs = rnn_verify_scan(xw, h, c, params["w_hh"],
+                                 params["b_hh"], "lstm", valid)
+
+        def body(kv, t):
+            kp, vp = kv
+            h2 = hs[t]
+            q, k, v = self._qkv(params, h2)
+            val = valid[t]
+            pos = start_len + t
+            page = jnp.take_along_axis(
+                table, (pos // page_size)[:, None], axis=1)[:, 0]
+            pg = jnp.where(val, page, 0)
+            off = jnp.where(val, pos % page_size, 0)
+            kp = kp.at[0, pg, off].set(k.astype(kp.dtype))
+            vp = vp.at[0, pg, off].set(v.astype(vp.dtype))
+            lengths = jnp.where(val, pos + 1, 1)
+            attn = paged_decode_attention(q, kp[0], vp[0], table,
+                                          lengths)
+            y = jnp.argmax(self._logits(params, h2, attn),
+                           axis=-1).astype(jnp.int32)
+            return (kp, vp), y
+
+        (k_pages, v_pages), ys = lax.scan(
+            body, (k_pages, v_pages), jnp.arange(K))
+        return ys.T, hs, cs, k_pages, v_pages
+
 
 # ---------------------------------------------------------------------------
 # streaming future
@@ -359,6 +628,13 @@ class DecodeStream:
         self._done = False
         self._exc: Optional[BaseException] = None
         self.t_submit = t_submit
+        # speculative-decode accounting (empty unless the engine runs
+        # a draft->verify loop): per-step emitted-token counts plus
+        # drafted/accepted totals — loadgen.streaming_summary turns
+        # these into acceptance_rate and tokens_per_step percentiles
+        self._step_tokens: List[int] = []
+        self._drafted = 0
+        self._accepted = 0
 
     # -- engine side (called under the engine lock)
     def _deliver(self, tok: int, t: float):
@@ -366,6 +642,12 @@ class DecodeStream:
             self._tokens.append(int(tok))
             self._times.append(float(t))
             self._cv.notify_all()
+
+    def _record_step(self, emitted: int, drafted: int, accepted: int):
+        with self._cv:
+            self._step_tokens.append(int(emitted))
+            self._drafted += int(drafted)
+            self._accepted += int(accepted)
 
     def _finish(self):
         with self._cv:
@@ -427,7 +709,7 @@ class DecodeStream:
         with self._cv:
             times = list(self._times)
             n = len(times)
-            return {
+            rec = {
                 "tokens": n,
                 "ttft_s": (times[0] - self.t_submit) if n else None,
                 "tpot_s": [times[i] - times[i - 1] for i in range(1, n)],
@@ -435,15 +717,21 @@ class DecodeStream:
                 "outcome": ("error" if self._exc is not None
                             else "ok" if self._done else "pending"),
             }
+            if self._step_tokens:
+                rec["step_tokens"] = list(self._step_tokens)
+                rec["spec_drafted"] = self._drafted
+                rec["spec_accepted"] = self._accepted
+            return rec
 
 
 class _Request:
     __slots__ = ("prompt", "max_new", "eos", "stream", "deadline",
                  "t_submit", "t_last_tok", "slot", "phase", "pos",
-                 "generated", "done", "npages", "seq")
+                 "generated", "done", "npages", "seq", "need_tokens",
+                 "history", "inflight", "shared_len")
 
     def __init__(self, prompt, max_new, eos, stream, deadline, npages,
-                 seq):
+                 seq, need_tokens=0):
         self.prompt = prompt
         self.max_new = max_new
         self.eos = eos
@@ -458,6 +746,12 @@ class _Request:
         self.done = False
         self.npages = npages
         self.seq = seq
+        self.need_tokens = need_tokens   # worst-case KV positions
+        # host-side token history (prompt + emitted): what the drafter
+        # proposes from and what prefix registration keys on
+        self.history = [int(t) for t in prompt]
+        self.inflight = False      # a verify step is in flight
+        self.shared_len = 0        # prompt tokens seated from the cache
 
 
 # ---------------------------------------------------------------------------
@@ -488,18 +782,29 @@ class DecodeEngine:
                  static: bool = False, admission: bool = True,
                  dtype: str = "float32",
                  clock: Callable[[], float] = time.perf_counter,
-                 start: bool = True):
+                 start: bool = True,
+                 spec_k: Optional[int] = None, drafter=None,
+                 prefix_share: Optional[bool] = None):
         self.model = model
         self._ladder = _parse_ladder(ladder if ladder is not None
                                      else slot_ladder())
         self.slots = self._ladder[-1]
         ps = int(page_size) if page_size else kv_page_size()
         self._chunk = prefill_chunk()
+        self._spec_k = (globals()["spec_k"]() if spec_k is None
+                        else max(0, int(spec_k)))
+        self._prefix_share = (globals()["prefix_share"]()
+                              if prefix_share is None
+                              else bool(prefix_share))
+        self._drafter = drafter if drafter is not None else \
+            (NgramDrafter() if self._spec_k else None)
         self.max_context = int(max_context)
         self.max_pages_per_slot = pages_needed(self.max_context, ps)
         if num_pages is None:
             num_pages = 1 + self.slots * self.max_pages_per_slot
-        self.kv = PagedKVCache(model.num_layers, model.num_heads,
+        # GQA models cache fewer K/V heads than they query with
+        kv_heads = int(getattr(model, "num_kv_heads", model.num_heads))
+        self.kv = PagedKVCache(model.num_layers, kv_heads,
                                model.head_dim, num_pages, ps, dtype=dtype)
         self._h, self._c = model.init_state(self.slots)
         self._tokens_dev = jnp.zeros((self.slots,), jnp.int32)
@@ -536,7 +841,12 @@ class DecodeEngine:
         self.stats = {"submitted": 0, "completed": 0, "rejected": 0,
                       "deadline_missed": 0, "shed_midstream": 0,
                       "steps": 0, "prefill_chunks": 0, "tokens": 0,
-                      "kv_util_peak": 0.0}
+                      "kv_util_peak": 0.0,
+                      "spec_steps": 0, "spec_drafted": 0,
+                      "spec_accepted": 0,
+                      "accept_hist": {},     # accepted-block len -> n
+                      "prefix_hits": 0, "prefix_tokens": 0,
+                      "kv_shared_peak": 0}
         t = _telemetry()
         reg = t.registry()
         self._m_tokens = reg.counter(t.names.DECODE_TOKENS)
@@ -545,6 +855,8 @@ class DecodeEngine:
         self._m_tpot = reg.histogram(t.names.DECODE_TPOT_SECONDS)
         self._m_rejected = reg.counter(t.names.SERVING_REJECTED,
                                        label_key="reason")
+        self._m_drafted = reg.counter(t.names.DECODE_SPEC_DRAFTED)
+        self._m_accepted = reg.counter(t.names.DECODE_SPEC_ACCEPTED)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -558,34 +870,67 @@ class DecodeEngine:
         key = (kind, bucket)
         entry = self._programs.get(key)
         if entry is None:
-            model = self.model
-            ps = self.kv.page_size
-            eng = self
-            if kind == "decode":
-                def raw(params, tokens, h, c, kp, vp, pidx, poff,
-                        table, lengths, active):
-                    eng._n_traces += 1
-                    return model.decode_step(params, tokens, h, c, kp,
-                                             vp, pidx, poff, table,
-                                             lengths, active)
-            else:
-                def raw(params, tokens, h, c, kp, vp, start_len,
-                        n_valid, reset, active, table):
-                    eng._n_traces += 1
-                    return model.prefill_chunk(params, tokens, h, c,
-                                               kp, vp, start_len,
-                                               n_valid, reset, active,
-                                               table, page_size=ps)
-            entry = {"fn": jax.jit(raw, donate_argnums=(4, 5)),
+            entry = {"fn": self._shared_program(kind),
                      "exe": None, "analysis": None}
             self._programs[key] = entry
         return entry
+
+    def _shared_program(self, kind: str):
+        """One ``jax.jit`` wrapper per (model, kind, page geometry,
+        kernel gate), shared by every engine over the same model: a
+        rebuilt engine (fleet restart, A/B run, test) reuses the
+        already-traced program for any slot bucket it has seen, paying
+        zero retrace. The wrapper is bucket-polymorphic (jit re-traces
+        per leading-dim shape internally); only AOT ``exe`` artifacts
+        stay per-engine."""
+        model = self.model
+        ps = self.kv.page_size
+        cache = model.__dict__.setdefault("_mx_decode_programs", {})
+        ck = (kind, ps, pallas_mode())
+        cached = cache.get(ck)
+        if cached is not None:
+            cached["owner"]["eng"] = self
+            return cached["fn"]
+        owner = {"eng": self}
+
+        def count_trace():
+            eng = owner["eng"]
+            if eng is not None:
+                eng._n_traces += 1
+
+        if kind == "decode":
+            def raw(params, tokens, h, c, kp, vp, pidx, poff,
+                    table, lengths, active):
+                count_trace()
+                return model.decode_step(params, tokens, h, c, kp,
+                                         vp, pidx, poff, table,
+                                         lengths, active)
+        elif kind == "verify":
+            def raw(params, tokens, h, c, kp, vp, start_len,
+                    n_draft, active, table):
+                count_trace()
+                ys, hs, cs, kp, vp = model.verify_chunk(
+                    params, tokens, h, c, kp, vp, start_len,
+                    n_draft, active, table, page_size=ps)
+                emitted, n_acc, nxt, h2, c2 = _accept_longest_prefix(
+                    ys, hs, cs, tokens, n_draft, active)
+                return emitted, n_acc, nxt, h2, c2, kp, vp
+        else:
+            def raw(params, tokens, h, c, kp, vp, start_len,
+                    n_valid, reset, active, table):
+                count_trace()
+                return model.prefill_chunk(params, tokens, h, c,
+                                           kp, vp, start_len,
+                                           n_valid, reset, active,
+                                           table, page_size=ps)
+        cache[ck] = {"fn": jax.jit(raw, donate_argnums=(4, 5)),
+                     "owner": owner}
+        return cache[ck]["fn"]
 
     def _example_args(self, kind: str, bucket: int):
         """ShapeDtypeStruct mirrors of one bucket's runtime arguments —
         the lowering/AOT example (no device allocation)."""
         b = int(bucket)
-        H = self.model.d_model
         sds = jax.ShapeDtypeStruct
         params = jax.tree_util.tree_map(
             lambda a: sds(jnp.shape(a), a.dtype), self.model.params)
@@ -593,16 +938,23 @@ class DecodeEngine:
                   self.kv.page_size, self.kv.num_heads,
                   self.kv.head_dim), jnp.dtype(self.kv.dtype))
         i32 = jnp.dtype("int32")
-        f32 = jnp.dtype("float32")
         table = sds((b, self.max_pages_per_slot), i32)
+        # state mirrors follow the LIVE state arrays (an attention-only
+        # model carries dummy (slots, 1) pass-throughs, the RNN carries
+        # (slots, d_model) — the program must match either)
+        h = sds((b,) + tuple(self._h.shape[1:]), self._h.dtype)
+        c = sds((b,) + tuple(self._c.shape[1:]), self._c.dtype)
         if kind == "decode":
-            return (params, sds((b,), i32), sds((b, H), f32),
-                    sds((b, H), f32), kv, kv, sds((b,), i32),
-                    sds((b,), i32), table, sds((b,), i32),
-                    sds((b,), jnp.dtype(bool)))
-        return (params, sds((b, self._chunk), i32), sds((b, H), f32),
-                sds((b, H), f32), kv, kv, sds((b,), i32),
-                sds((b,), i32), sds((b,), jnp.dtype(bool)),
+            return (params, sds((b,), i32), h, c, kv, kv,
+                    sds((b,), i32), sds((b,), i32), table,
+                    sds((b,), i32), sds((b,), jnp.dtype(bool)))
+        if kind == "verify":
+            return (params, sds((b, self._spec_k + 1), i32), h, c,
+                    kv, kv, sds((b,), i32), sds((b,), i32),
+                    sds((b,), jnp.dtype(bool)), table)
+        return (params, sds((b, self._chunk), i32), h, c, kv, kv,
+                sds((b,), i32), sds((b,), i32),
+                sds((b,), jnp.dtype(bool)),
                 sds((b,), jnp.dtype(bool)), table)
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
@@ -611,8 +963,10 @@ class DecodeEngine:
         persistent ``MXNET_COMPILE_CACHE``) so no request ever eats a
         first-iteration compile. Returns {(kind, bucket): executable}."""
         out = {}
+        kinds = ("decode", "prefill", "verify") if self._spec_k > 0 \
+            else ("decode", "prefill")
         for b in (buckets or self._ladder):
-            for kind in ("decode", "prefill"):
+            for kind in kinds:
                 entry = self._entry(kind, int(b))
                 if entry["exe"] is None:
                     n_before = self._n_traces
@@ -713,6 +1067,10 @@ class DecodeEngine:
                 self._reject("queue",
                              f"decode queue full ({self._depth})")
             slack = max(1, self._window.max_inflight)
+            if self._spec_k:
+                # a verify step writes up to spec_k draft positions
+                # past the committed length before acceptance trims
+                slack += self._spec_k
             need_tokens = int(prompt.size) + mn + slack
             if need_tokens > self.max_pages_per_slot * self.kv.page_size:
                 raise MXNetError(
@@ -720,6 +1078,17 @@ class DecodeEngine:
                     f"(prompt {prompt.size} + max_new {mn} + inflight "
                     f"slack {slack}) > max_context {self.max_context}")
             npages = pages_needed(need_tokens, self.kv.page_size)
+            if self._prefix_share:
+                # price only the unshared tail: FULL pages covered by a
+                # registered prefix are mapped, not allocated (the seat
+                # re-checks and falls back to worst case if the entry
+                # died; a partial shared page is still priced as owned
+                # — it is the COW target's budget)
+                ent = self.kv.lookup_prefix(
+                    prompt, max_pos=int(prompt.size) - 1)
+                if ent is not None:
+                    npages = max(1, npages
+                                 - ent.pos // self.kv.page_size)
             mode = shed_mode()
             if (deadline_ms is not None and mode != "off"
                     and self._ewma_step is not None):
@@ -734,7 +1103,7 @@ class DecodeEngine:
             deadline = (now + float(deadline_ms) / 1e3
                         if deadline_ms is not None else None)
             req = _Request(prompt, mn, eos, stream, deadline, npages,
-                           self._seq)
+                           self._seq, need_tokens=need_tokens)
             self._seq += 1
             if self.admission and not self.kv.reserve(req, npages):
                 self._reject(
@@ -765,22 +1134,54 @@ class DecodeEngine:
             # slot is free (the baseline the bench A/Bs against)
             if any(o is not None for o in self._occupant):
                 return
+        ps = self.kv.page_size
         for slot in range(self.slots):
             if not self._queue:
                 break
             if self._occupant[slot] is not None:
                 continue
             req = self._queue[0]
-            pages = self.kv.alloc(req, req.npages)
-            if pages is None:        # admission=False path: wait
-                break
+            tot = (pages_needed(req.need_tokens, ps)
+                   if req.need_tokens else req.npages)
+            ent = None
+            if self._prefix_share and req.prompt.size > 1:
+                # seat-time lookup (the authoritative one — the
+                # submit-time lookup only priced admission); cap leaves
+                # >= 1 prompt token to prefill so the final chunk still
+                # produces the request's first output token
+                ent = self.kv.lookup_prefix(
+                    req.prompt, max_pos=int(req.prompt.size) - 1)
+            if ent is not None:
+                shared = list(ent.pages)
+                own_n = max(0, tot - len(shared))
+                own = self.kv.alloc(req, own_n) if own_n else []
+                if own is None:      # admission=False path: wait
+                    break
+                self.kv.share(req, shared)
+                # reservation correction: keep ONE spare page when the
+                # last shared page is partial — the COW target for the
+                # first divergent write into it
+                self.kv.trim_reservation(req, 1 if ent.pos % ps else 0)
+                pages = shared + list(own)
+                self._device_len[slot] = ent.pos
+                req.pos = ent.pos
+                req.shared_len = ent.pos
+                if ent.state is not None:
+                    self._h = self._h.at[slot].set(ent.state[0])
+                    self._c = self._c.at[slot].set(ent.state[1])
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens"] += ent.pos
+            else:
+                pages = self.kv.alloc(req, tot)
+                if pages is None:    # admission=False path: wait
+                    break
+                self._device_len[slot] = 0
             self._queue.popleft()
             req.slot = slot
             req.phase = "prefill"
             self._occupant[slot] = req
             self._table[slot, :] = 0
             self._table[slot, :len(pages)] = pages
-            self._device_len[slot] = 0
         self._m_active.set(sum(1 for o in self._occupant
                                if o is not None))
 
@@ -791,18 +1192,28 @@ class DecodeEngine:
         dec = [s for s in range(self.slots)
                if occ[s] is not None and occ[s].phase == "decode"
                and not occ[s].done]
+        kind = "decode"
+        if self._spec_k:
+            # speculative mode: a slot joins a verify step only once
+            # its FIRST token has retired (the drafter proposes from
+            # host history) and its previous verify is out of flight —
+            # the window drain is the per-slot sync point, so a slot
+            # never has two verifies speculating past each other
+            kind = "verify"
+            dec = [s for s in dec if not occ[s].inflight
+                   and occ[s].generated >= 1]
         if self.static:
             if pre:
                 return "prefill", min(pre, key=lambda s: occ[s].seq)
             if dec:
-                return "decode", dec
+                return kind, dec
             return None, None
         # continuous: strict alternation — prefill may never run twice
         # in a row while decode work exists (the non-starvation rule)
         if pre and (not dec or not self._last_was_prefill):
             return "prefill", min(pre, key=lambda s: occ[s].seq)
         if dec:
-            return "decode", dec
+            return kind, dec
         return None, None
 
     def step_once(self) -> bool:
@@ -821,6 +1232,8 @@ class DecodeEngine:
             try:
                 if kind == "prefill":
                     self._dispatch_prefill(what)
+                elif kind == "verify":
+                    self._dispatch_verify(what)
                 else:
                     self._dispatch_decode(what)
             except MXNetError as e:
@@ -851,6 +1264,21 @@ class DecodeEngine:
         self._tag += 1
         self._window.push((meta, arr), tag=f"{meta[0]}#{self._tag}")
 
+    def _cow_guard(self, slot: int, req: _Request, start: int, n: int):
+        """Copy-on-write fence: before a dispatch writes device
+        positions ``[start, start + n)``, give the slot private copies
+        of every page in the write range still shared with another
+        request (one async device-side page copy each; the table row
+        repoints to the copy). MUST run before the dispatch snapshots
+        the table into program arguments."""
+        if not self._prefix_share or n <= 0:
+            return
+        ps = self.kv.page_size
+        for pi in range(start // ps, (start + n - 1) // ps + 1):
+            page = int(self._table[slot, pi])
+            if page and self.kv.page_shared(page):
+                self._table[slot, pi] = self.kv.cow(req, page)
+
     def _dispatch_decode(self, slots_active: List[int]):
         b = self._bucket()
         ps = self.kv.page_size
@@ -861,6 +1289,7 @@ class DecodeEngine:
         metas = []
         for s in slots_active:
             dl = int(self._device_len[s])
+            self._cow_guard(s, self._occupant[s], dl, 1)
             pidx[s] = self._table[s, dl // ps]
             poff[s] = dl % ps
             lengths[s] = dl + 1
@@ -897,6 +1326,7 @@ class DecodeEngine:
         reset[slot] = req.pos == 0
         act = onp.zeros(b, bool)
         act[slot] = True
+        self._cow_guard(slot, req, int(start[slot]), n_valid)
         entry = self._entry("prefill", b)
         args = (self.model.params, jnp.asarray(toks), self._h[:b],
                 self._c[:b], self.kv.k_pages._data,
@@ -914,16 +1344,74 @@ class DecodeEngine:
             # token chains device-side (async) into the token array
             req.phase = "decode"
             self._tokens_dev = self._tokens_dev.at[slot].set(nxt[slot])
+        reg = None
+        if self._prefix_share:
+            # snapshot NOW (post-stitch the state rows are exactly the
+            # post-chunk state; by retire time they may have advanced):
+            # the registry commits tokens[:pos] -> pages + state at
+            # retire, once the writes are known good
+            npg = pages_needed(req.pos, self.kv.page_size)
+            reg = (onp.ascontiguousarray(req.prompt[:req.pos]),
+                   req.pos,
+                   [int(p) for p in self._table[slot, :npg]],
+                   (self._h[slot], self._c[slot]))
         self.stats["prefill_chunks"] += 1
         self._last_was_prefill = True
-        self._push(("prefill", slot, req, final, self._clock()), nxt)
+        self._push(("prefill", slot, req, final, self._clock(), reg),
+                   nxt)
+
+    def _dispatch_verify(self, slots_active: List[int]):
+        b = self._bucket()
+        ps = self.kv.page_size
+        K = self._spec_k + 1
+        toks = onp.zeros((b, K), onp.int32)
+        start = onp.zeros(b, onp.int32)
+        nd = onp.ones(b, onp.int32)
+        act = onp.zeros(b, bool)
+        metas = []
+        for s in slots_active:
+            req = self._occupant[s]
+            dl = int(self._device_len[s])
+            # never draft past the request's token budget or its page
+            # table (the admission slack covers spec_k positions)
+            room = self.max_pages_per_slot * ps - dl - 1
+            left = req.max_new - req.generated - 1
+            k_prop = max(0, min(self._spec_k, left, room))
+            drafts = (list(self._drafter.propose(req.history,
+                                                 k_prop))[:k_prop]
+                      if k_prop else [])
+            n = 1 + len(drafts)
+            toks[s, 0] = req.history[-1]
+            if drafts:
+                toks[s, 1:n] = drafts
+            start[s] = dl
+            nd[s] = n
+            act[s] = True
+            req.inflight = True
+            self._cow_guard(s, req, dl, n)
+            metas.append((s, req, n))
+        entry = self._entry("verify", b)
+        args = (self.model.params, jnp.asarray(toks), self._h[:b],
+                self._c[:b], self.kv.k_pages._data,
+                self.kv.v_pages._data, jnp.asarray(start),
+                jnp.asarray(nd), jnp.asarray(act),
+                jnp.asarray(self._table[:b]))
+        with _tguard.hot_scope("DecodeEngine.verify_step"):
+            emitted, n_acc, nxt, h2, c2, kp, vp = self._call(entry, args)
+        full = self._stitch(b, h2, c2, nxt, kp, vp)
+        self._tokens_dev = full if full is not None else \
+            jnp.concatenate([nxt, self._tokens_dev[b:]])
+        self.stats["steps"] += 1
+        self.stats["spec_steps"] += 1
+        self._last_was_prefill = False
+        self._push(("verify", metas, self._clock()), (emitted, n_acc))
 
     # ---------------- retire (the one blessed sync) ----------------
     def _retire_sync(self, payload):
         meta, arr = payload
-        toks = onp.asarray(arr)      # blessed: runs under the window's
-        now = self._clock()          # allow_transfers at retire
-        if meta[0] == "decode":
+        now = self._clock()          # blessed: runs under the window's
+        if meta[0] == "decode":      # allow_transfers at retire
+            toks = onp.asarray(arr)
             _, pairs, t0 = meta
             dt = max(0.0, now - t0)
             self._ewma_step = dt if self._ewma_step is None \
@@ -932,10 +1420,50 @@ class DecodeEngine:
                 if req.done:
                     continue
                 self._deliver(slot, req, int(toks[slot]), now)
+        elif meta[0] == "verify":
+            emitted = onp.asarray(arr[0])
+            n_acc = onp.asarray(arr[1])
+            toks = emitted
+            _, triples, t0 = meta
+            dt = max(0.0, now - t0)
+            self._ewma_step = dt if self._ewma_step is None \
+                else 0.8 * self._ewma_step + 0.2 * dt
+            for slot, req, n in triples:
+                req.inflight = False
+                if req.done:
+                    continue
+                a = max(1, min(int(n_acc[slot]), n))
+                # KV commit is pure length bookkeeping: the verify
+                # already wrote positions [dl, dl+n); attention masks
+                # by lengths, so the rejected tail is plain garbage
+                # that a later step overwrites
+                self._device_len[slot] += a
+                drafted, accepted = n - 1, a - 1
+                self.stats["spec_drafted"] += drafted
+                self.stats["spec_accepted"] += accepted
+                hist = self.stats["accept_hist"]
+                hist[a] = hist.get(a, 0) + 1
+                if drafted:
+                    self._m_drafted.inc(drafted)
+                if accepted:
+                    self._m_accepted.inc(accepted)
+                req.stream._record_step(a, drafted, accepted)
+                for t in range(a):
+                    self._deliver(slot, req, int(emitted[slot, t]), now)
+                    if req.done:
+                        break
         else:
-            _, slot, req, final, _t0 = meta
+            toks = onp.asarray(arr)
+            _, slot, req, final, _t0, reg = meta
+            if reg is not None and not req.done:
+                toks_r, pos_r, pages_r, state_r = reg
+                self.kv.register_prefix(toks_r, pos_r, pages_r,
+                                        state=state_r)
             if final and not req.done:
                 self._deliver(slot, req, int(toks[slot]), now)
+        shared = self.kv.shared_pages()
+        if shared > self.stats["kv_shared_peak"]:
+            self.stats["kv_shared_peak"] = shared
         util = self.kv.utilization()
         if util > self.stats["kv_util_peak"]:
             self.stats["kv_util_peak"] = util
@@ -944,6 +1472,7 @@ class DecodeEngine:
     def _deliver(self, slot: int, req: _Request, tok: int, now: float):
         first = req.generated == 0
         req.generated += 1
+        req.history.append(int(tok))
         req.stream._deliver(tok, now)
         self.stats["tokens"] += 1
         self._m_tokens.inc()
@@ -1110,7 +1639,9 @@ def run_decode(model, prompts, max_new, *, static: bool = False,
                ladder: Optional[Sequence[int]] = None,
                page_size: Optional[int] = None,
                eos_id: Optional[int] = None, inflight: int = 1,
-               warmup: bool = True) -> dict:
+               warmup: bool = True, spec_k: Optional[int] = None,
+               prefix_share: Optional[bool] = None,
+               drafter=None) -> dict:
     """Submit every request up front and drive the engine to
     completion — the bench ``decode`` leg's harness. ``static``
     selects the whole-batch baseline policy; everything else (model,
@@ -1119,7 +1650,9 @@ def run_decode(model, prompts, max_new, *, static: bool = False,
     prompts = [onp.asarray(p, onp.int32).ravel() for p in prompts]
     mns = ([int(max_new)] * len(prompts) if isinstance(max_new, int)
            else [int(m) for m in max_new])
-    slack = max(1, int(inflight))
+    sk = (globals()["spec_k"]() if spec_k is None
+          else max(0, int(spec_k)))
+    slack = max(1, int(inflight)) + sk
     ps = int(page_size) if page_size else kv_page_size()
     mc = max(int(p.size) + m + slack for p, m in zip(prompts, mns))
     # size the pool so every request can hold its reservation at once:
@@ -1129,7 +1662,8 @@ def run_decode(model, prompts, max_new, *, static: bool = False,
     eng = DecodeEngine(model, ladder=ladder, num_pages=total_pages,
                        page_size=ps, max_context=mc, eos_id=eos_id,
                        inflight=inflight, depth=len(prompts) + 1,
-                       static=static, start=False)
+                       static=static, start=False, spec_k=sk,
+                       prefix_share=prefix_share, drafter=drafter)
     try:
         if warmup:
             eng.warmup()
@@ -1151,9 +1685,22 @@ def run_decode(model, prompts, max_new, *, static: bool = False,
             "steps": eng.stats["steps"],
             "prefill_chunks": eng.stats["prefill_chunks"],
             "kv_page_util": round(eng.stats["kv_util_peak"], 4),
+            "kv_num_pages": eng.kv.num_pages,
             "slot_ladder": list(eng._ladder),
             "page_size": ps,
         }
+        if eng._spec_k:
+            st = eng.stats
+            out["spec_k"] = eng._spec_k
+            out["spec_steps"] = st["spec_steps"]
+            out["spec_drafted"] = st["spec_drafted"]
+            out["spec_accepted"] = st["spec_accepted"]
+            out["accept_hist"] = dict(st["accept_hist"])
+        if eng._prefix_share:
+            kvs = eng.kv.stats()
+            out["prefix_hits"] = kvs["prefix_hits"]
+            out["cow_copies"] = kvs["cow_copies"]
+            out["kv_shared_peak"] = eng.stats["kv_shared_peak"]
         out.update(loadgen.streaming_summary(recs, wall))
         return out
     finally:

@@ -33,9 +33,29 @@ Donation discipline: the engine's compiled step donates the page
 arrays and rebinds each handle's ``_data`` after dispatch — the census
 weakrefs survive because the HANDLE survives (telemetry/memory.py's
 registration contract).
+
+**Prefix sharing + copy-on-write** (docs/SERVING.md "Speculative decode
+& prefix sharing"): the allocator additionally keeps a content-hashed
+registry over committed prefill pages. Because a page's K/V content is
+a function of the ENTIRE token prefix up to its end (the recurrent
+state threads through every position), the registry key is the full
+token prefix ``prompt[:pos]`` — hashed for lookup, and byte-verified
+against the stored tokens before any sharing decision (a hash
+collision must never alias two different prefixes). A request whose
+prompt extends a registered prefix maps the same physical pages
+(:meth:`PagedKVCache.share` bumps per-page refcounts) and the engine
+skips prefilling the shared region. Pages are freed refcount-exactly:
+:meth:`release` returns a page to the free list only when its LAST
+holder leaves, and evicts any registry entry built over it — the
+registry pins nothing by itself, so allocator bytes == census bytes
+keeps holding and a shed/EOS frees exactly the private tail. A write
+landing on a page held by >= 2 requests first gets a private copy
+(:meth:`cow` — one device-side page copy, no host sync), so divergence
+after a shared prefix can never corrupt a neighbour.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as onp
@@ -45,7 +65,8 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["PagedKVCache", "KV_PAGE_SIZE", "pages_needed"]
+__all__ = ["PagedKVCache", "KV_PAGE_SIZE", "pages_needed",
+           "prefix_hash"]
 
 #: tokens per KV page — the shipped default behind the
 #: ``decode.kv_page_size`` tunable / ``MXNET_DECODE_KV_PAGE_SIZE``
@@ -57,6 +78,30 @@ KV_PAGE_SIZE = 16
 def pages_needed(tokens: int, page_size: int) -> int:
     """Pages covering ``tokens`` positions."""
     return max(1, -(-int(tokens) // max(1, int(page_size))))
+
+
+def prefix_hash(tokens) -> int:
+    """Registry key for a committed token prefix: a stable content hash
+    over the int32 token bytes. Lookups ALWAYS byte-verify against the
+    stored tokens afterwards — tests monkeypatch this to a constant to
+    pin that a hash collision alone can never alias two prefixes."""
+    b = onp.ascontiguousarray(tokens, onp.int32).tobytes()
+    return int.from_bytes(hashlib.blake2b(b, digest_size=8).digest(),
+                          "little")
+
+
+class _PrefixEntry:
+    """One registered prefix: ``pages`` hold the K/V of
+    ``tokens[:pos]`` (last page possibly partial), ``state`` is the
+    engine's opaque recurrent-state snapshot at ``pos``."""
+
+    __slots__ = ("tokens", "pages", "pos", "state")
+
+    def __init__(self, tokens, pages, pos, state):
+        self.tokens = onp.ascontiguousarray(tokens, onp.int32)
+        self.pages = tuple(int(p) for p in pages)
+        self.pos = int(pos)
+        self.state = state
 
 
 class PagedKVCache:
@@ -98,11 +143,22 @@ class PagedKVCache:
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._owned: Dict[object, List[int]] = {}
         self._reserved: Dict[object, int] = {}
+        # prefix sharing: per-page holder counts (only pages held by
+        # >= 2 owners appear), the content-hash registry, and the
+        # page -> registry-keys index driving refcount-exact eviction
+        self._refcnt: Dict[int, int] = {}
+        self._prefix: Dict[int, List[_PrefixEntry]] = {}
+        self._page_keys: Dict[int, set] = {}
+        self.cow_copies = 0
+        self.prefix_hits = 0
         from .. import telemetry as _t
         _t.memory.census().register("kvcache", self.k_pages)
         _t.memory.census().register("kvcache", self.v_pages)
         self._g_pages = _t.registry().gauge(_t.names.DECODE_KV_PAGES,
                                             label_key="state")
+        self._m_prefix_hits = _t.registry().counter(
+            _t.names.DECODE_PREFIX_HITS)
+        self._m_cow = _t.registry().counter(_t.names.DECODE_COW_COPIES)
         self._publish()
 
     # ---------------- accounting ----------------
@@ -127,7 +183,19 @@ class PagedKVCache:
         return len(self._free) - sum(self._reserved.values())
 
     def used_pages(self) -> int:
+        """PHYSICAL pages allocated (a page shared by N requests
+        counts once — that is the whole point of sharing)."""
+        return self.num_pages - 1 - len(self._free)
+
+    def logical_pages(self) -> int:
+        """Request-side page holdings summed over owners (a shared
+        page counts once PER holder); logical - used = pages saved by
+        prefix sharing."""
         return sum(len(p) for p in self._owned.values())
+
+    def shared_pages(self) -> int:
+        """Physical pages currently mapped by >= 2 owners."""
+        return sum(1 for n in self._refcnt.values() if n >= 2)
 
     def utilization(self) -> float:
         """used / allocatable (the null page is outside both)."""
@@ -153,6 +221,19 @@ class PagedKVCache:
     def unreserve(self, owner):
         self._reserved.pop(owner, None)
         self._publish()
+
+    def trim_reservation(self, owner, keep: int):
+        """Lower ``owner``'s reservation to at most ``keep`` pages —
+        the seat-time correction when a prefix-cache hit means the
+        submit-time worst-case pricing over-reserved."""
+        keep = max(0, int(keep))
+        have = self._reserved.get(owner, 0)
+        if have > keep:
+            if keep:
+                self._reserved[owner] = keep
+            else:
+                self._reserved.pop(owner, None)
+            self._publish()
 
     # ---------------- alloc / free ----------------
     def alloc(self, owner, n: int = 1) -> Optional[List[int]]:
@@ -180,18 +261,146 @@ class PagedKVCache:
 
     def release(self, owner):
         """Return every page ``owner`` holds (and any leftover
-        reservation) to the free list — the slot-retire path."""
+        reservation) to the free list — the slot-retire path. A SHARED
+        page only leaves ``owner``'s holdings: it goes back to the
+        free list (and its registry entries are evicted) exactly when
+        the last holder releases it — refcount-exact frees, so a
+        mid-stream shed or EOS returns precisely the private tail."""
         pages = self._owned.pop(owner, [])
-        self._free.extend(reversed(pages))
+        freed = []
+        for p in reversed(pages):
+            n = self._refcnt.get(p)
+            if n is not None and n >= 2:
+                if n == 2:
+                    self._refcnt.pop(p, None)
+                else:
+                    self._refcnt[p] = n - 1
+                continue
+            self._refcnt.pop(p, None)
+            self._evict_prefixes(p)
+            self._free.append(p)
+            freed.append(p)
         self._reserved.pop(owner, None)
         self._publish()
-        return len(pages)
+        return len(freed)
+
+    # ---------------- prefix sharing + copy-on-write ----------------
+    def page_shared(self, page: int) -> bool:
+        """Whether a write to ``page`` needs a private copy first."""
+        return self._refcnt.get(int(page), 1) >= 2
+
+    def share(self, owner, pages) -> List[int]:
+        """Map already-allocated ``pages`` into ``owner``'s holdings
+        (the prefix-cache hit path): each page's holder count bumps and
+        the page now frees only when its LAST holder releases."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if not 1 <= p < self.num_pages or p in self._free:
+                raise MXNetError(f"share: page {p} is not allocated")
+            self._refcnt[p] = self._refcnt.get(p, 1) + 1
+        self._owned.setdefault(owner, []).extend(pages)
+        self.prefix_hits += 1
+        try:
+            self._m_prefix_hits.inc()
+        except Exception:    # pragma: no cover - telemetry never fatal
+            pass
+        self._publish()
+        return pages
+
+    def cow(self, owner, page: int) -> int:
+        """Copy-on-write: give ``owner`` a private copy of ``page``
+        before it writes (one device-side page copy across K, V and
+        every layer — async, no host sync). Draws the copy target from
+        ``owner``'s reservation/free list, swaps it into the holdings,
+        and drops ``owner``'s hold on the original. Returns the new
+        page id."""
+        page = int(page)
+        held = self._owned.get(owner, [])
+        if page not in held:
+            raise MXNetError(f"cow: owner does not hold page {page}")
+        got = self.alloc(owner, 1)
+        if got is None:
+            raise MXNetError(
+                "cow: no page available for a copy-on-write target "
+                "(admission under-priced the unshared tail)")
+        new = got[0]
+        kd, vd = self.k_pages._data, self.v_pages._data
+        self.k_pages._data = kd.at[:, new].set(kd[:, page])
+        self.v_pages._data = vd.at[:, new].set(vd[:, page])
+        held.remove(page)
+        n = self._refcnt.get(page)
+        if n is not None:
+            if n <= 2:
+                self._refcnt.pop(page, None)
+            else:
+                self._refcnt[page] = n - 1
+        self.cow_copies += 1
+        try:
+            self._m_cow.inc()
+        except Exception:    # pragma: no cover - telemetry never fatal
+            pass
+        self._publish()
+        return new
+
+    def register_prefix(self, tokens, pos: int, pages, state=None):
+        """Commit ``tokens[:pos]`` -> ``pages`` into the content-hash
+        registry (``state`` = the engine's recurrent-state snapshot at
+        ``pos``). Entries hold no refcount of their own: they are
+        evicted the moment any underlying page is freed."""
+        pos = int(pos)
+        if pos < 1:
+            return
+        toks = onp.ascontiguousarray(
+            onp.asarray(tokens, onp.int32).ravel()[:pos])
+        key = prefix_hash(toks)
+        bucket = self._prefix.setdefault(key, [])
+        for e in bucket:
+            if e.pos == pos and onp.array_equal(e.tokens, toks):
+                return                      # already registered
+        entry = _PrefixEntry(toks, pages, pos, state)
+        bucket.append(entry)
+        for p in entry.pages:
+            self._page_keys.setdefault(p, set()).add(key)
+
+    def lookup_prefix(self, prompt, max_pos: Optional[int] = None):
+        """Longest registered prefix of ``prompt`` (hash lookup per
+        registered boundary position, then a BYTE compare against the
+        stored tokens — a hash collision must never share). Returns the
+        :class:`_PrefixEntry` or None; ``max_pos`` caps the usable
+        prefix length (the engine keeps >= 1 prompt token to prefill)."""
+        prompt = onp.asarray(prompt, onp.int32).ravel()
+        cap = prompt.size if max_pos is None else min(int(max_pos),
+                                                      prompt.size)
+        positions = sorted({e.pos for b in self._prefix.values()
+                            for e in b if e.pos <= cap}, reverse=True)
+        for pos in positions:
+            key = prefix_hash(onp.ascontiguousarray(prompt[:pos]))
+            for e in self._prefix.get(key, ()):
+                if e.pos == pos and onp.array_equal(
+                        e.tokens, prompt[:pos]):
+                    return e
+        return None
+
+    def prefix_entries(self) -> int:
+        return sum(len(b) for b in self._prefix.values())
+
+    def _evict_prefixes(self, page: int):
+        """Drop every registry entry built over ``page`` (called when
+        the page returns to the free list)."""
+        for key in self._page_keys.pop(page, ()):
+            bucket = self._prefix.get(key)
+            if not bucket:
+                continue
+            bucket[:] = [e for e in bucket if page not in e.pages]
+            if not bucket:
+                self._prefix.pop(key, None)
 
     # ---------------- observability ----------------
     def _publish(self):
         try:
             self._g_pages.set(self.used_pages(), label="used")
             self._g_pages.set(self.free_pages(), label="free")
+            self._g_pages.set(self.shared_pages(), label="shared")
         except Exception:    # pragma: no cover - telemetry never fatal
             pass
 
@@ -200,9 +409,14 @@ class PagedKVCache:
             "num_pages": self.num_pages,
             "page_size": self.page_size,
             "used_pages": self.used_pages(),
+            "logical_pages": self.logical_pages(),
+            "shared_pages": self.shared_pages(),
             "free_pages": self.free_pages(),
             "reserved_pages": sum(self._reserved.values()),
             "owners": len(self._owned),
+            "prefix_entries": self.prefix_entries(),
+            "prefix_hits": self.prefix_hits,
+            "cow_copies": self.cow_copies,
             "bytes_per_page": self.bytes_per_page,
             "total_bytes": self.total_bytes(),
             "utilization": round(self.utilization(), 4),

@@ -96,6 +96,25 @@ def streaming_summary(records, wall: Optional[float] = None) -> dict:
     out["stream_tokens"] = tokens
     out["tokens_per_sec"] = round(tokens / wall, 2) \
         if wall and wall > 0 else None
+    # speculative-decode view (present only when records carry the
+    # engine's per-step accounting): acceptance_rate = accepted drafts
+    # / proposed drafts, and tokens_per_step percentiles over the
+    # pooled per-step emitted-token counts (> 1 means a verify step
+    # emitted a whole accepted block in one dispatch)
+    steps = [n for r in records for n in (r.get("step_tokens") or ())]
+    if steps:
+        drafted = sum(int(r.get("spec_drafted") or 0) for r in records)
+        accepted = sum(int(r.get("spec_accepted") or 0)
+                       for r in records)
+        a = onp.asarray(steps, dtype="float64")
+        out["acceptance_rate"] = round(accepted / drafted, 4) \
+            if drafted else None
+        out["tokens_per_step"] = {
+            "mean": round(float(a.mean()), 3),
+            "p50": round(float(onp.percentile(a, 50)), 3),
+            "p99": round(float(onp.percentile(a, 99)), 3),
+            "max": int(a.max()),
+        }
     return out
 
 

@@ -187,6 +187,10 @@ DECODE_ACTIVE_SLOTS = "mx_decode_active_slots"
 DECODE_KV_PAGES = "mx_decode_kv_pages"
 DECODE_TTFT_SECONDS = "mx_decode_ttft_seconds"
 DECODE_TPOT_SECONDS = "mx_decode_tpot_seconds"
+DECODE_SPEC_DRAFTED = "mx_decode_spec_drafted_total"
+DECODE_SPEC_ACCEPTED = "mx_decode_spec_accepted_total"
+DECODE_PREFIX_HITS = "mx_decode_prefix_hits_total"
+DECODE_COW_COPIES = "mx_decode_cow_copies_total"
 
 # ---------------------------------------------------------------------------
 # serving fleet controller (serving/fleet.py)
@@ -532,7 +536,8 @@ CATALOG = {
     DECODE_KV_PAGES: dict(
         kind="gauge", label="state",
         help="paged-KV-cache page counts by state (used / free / "
-             "reserved-null); bytes ride the kvcache census pool in "
+             "shared — shared pages are mapped by >= 2 requests and "
+             "counted once); bytes ride the kvcache census pool in "
              "mx_mem_pool_bytes"),
     DECODE_TTFT_SECONDS: dict(
         kind="histogram", label=None,
@@ -544,6 +549,25 @@ CATALOG = {
         help="time-per-output-token: inter-token gap between "
              "consecutive streamed tokens of one request (steady-state "
              "decode cadence)"),
+    DECODE_SPEC_DRAFTED: dict(
+        kind="counter", label=None,
+        help="draft tokens proposed by the speculative-decode drafter "
+             "(the guaranteed per-step token is not a draft and is "
+             "excluded; acceptance rate = accepted / drafted)"),
+    DECODE_SPEC_ACCEPTED: dict(
+        kind="counter", label=None,
+        help="draft tokens the verify scan accepted (longest prefix "
+             "matching the model's own greedy continuation — the "
+             "emitted stream stays bit-exact vs plain decode)"),
+    DECODE_PREFIX_HITS: dict(
+        kind="counter", label=None,
+        help="requests seated onto shared prefix-cache pages (a "
+             "registered prompt prefix matched byte-for-byte, so "
+             "prefill skipped the shared region)"),
+    DECODE_COW_COPIES: dict(
+        kind="counter", label=None,
+        help="copy-on-write page copies: a writer diverging on a "
+             "shared KV page got a private copy before the write"),
     FLEET_REPLICAS: dict(
         kind="gauge", label="state",
         help="fleet replicas by lifecycle state (serving = in "

@@ -256,6 +256,14 @@ def test_diagnose_decode_section(capsys):
     assert "-- streamed burst --" in out
     assert "ttft" in out and "tpot" in out and "tok/s" in out
     assert "decode kernel:" in out and "MXNET_PALLAS=" in out
+    # speculative panel: drafter line, acceptance histogram, shared/COW
+    # page census
+    assert "-- speculative decode --" in out
+    assert "MXNET_DECODE_SPEC_K" in out
+    assert "drafter      : NgramDrafter" in out
+    assert "verify steps :" in out and "accept" in out
+    assert "prefix cache :" in out and "COW copies" in out
+    assert "decode check failed" not in out
 
 
 def test_diagnose_elastic_section(capsys):

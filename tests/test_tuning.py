@@ -208,7 +208,9 @@ def test_search_space_views_and_signature():
                                             "serving.batch_timeout_ms",
                                             "decode.slot_ladder",
                                             "decode.kv_page_size",
-                                            "decode.prefill_chunk"}
+                                            "decode.prefill_chunk",
+                                            "decode.spec_k",
+                                            "decode.prefix_share"}
     assert not any(t.name.startswith(("serving.", "decode."))
                    for t in train)
     assert train.valid(train.defaults())

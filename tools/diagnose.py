@@ -996,6 +996,52 @@ def check_decode():
             "rnn_decode_step", ("?", "never dispatched"))
         print(f"decode kernel: {path} ({reason})")
         eng.close()
+
+        # -- speculative decode + prefix sharing panel --
+        print("-- speculative decode --")
+        from mxnet_tpu.serving.decode import spec_k as _sk, \
+            prefix_share as _psh
+        print(f"spec_k       : {_sk()} (MXNET_DECODE_SPEC_K)   "
+              f"prefix_share: {int(_psh())} "
+              f"(MXNET_DECODE_PREFIX_SHARE)")
+        sp = serving.DecodeEngine(model, ladder=(1, 4),
+                                  max_context=64, page_size=8,
+                                  start=False, spec_k=4,
+                                  prefix_share=True)
+        sp.warmup()
+        base = rng.randint(0, 48, size=20).astype(onp.int32)
+        s1 = sp.submit(base, max_new=12)
+        for _ in range(5):
+            sp.step_once()
+            sp.sync()
+        more = [sp.submit(onp.concatenate(
+                    [base, onp.asarray([t, 5], onp.int32)]),
+                    max_new=10)
+                for t in (3, 4)]
+        sp.drain()
+        drafter = sp._drafter
+        print(f"drafter      : {type(drafter).__name__}"
+              f"{getattr(drafter, 'n', '')}")
+        st = sp.stats
+        rate = (st['spec_accepted'] / st['spec_drafted']
+                if st['spec_drafted'] else None)
+        print(f"verify steps : {st['spec_steps']} "
+              f"({st['spec_drafted']} drafted, "
+              f"{st['spec_accepted']} accepted, rate "
+              f"{rate if rate is None else round(rate, 3)})")
+        hist = st["accept_hist"]
+        width = max(hist.values()) if hist else 1
+        for n in sorted(hist):
+            bar = "#" * max(1, int(24 * hist[n] / width))
+            print(f"  accept {n:>2} | {bar} {hist[n]}")
+        kvs = sp.kv.stats()
+        print(f"prefix cache : {st['prefix_hits']} hits "
+              f"({st['prefix_tokens']} tokens skipped), "
+              f"{kvs['cow_copies']} COW copies, shared-page peak "
+              f"{st['kv_shared_peak']}")
+        for s in (s1, *more):
+            s.result()
+        sp.close()
     except Exception as e:  # pragma: no cover - env-dependent
         print("decode check failed:", repr(e))
 
